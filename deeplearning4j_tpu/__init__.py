@@ -1,0 +1,26 @@
+"""deeplearning4j_tpu — a TPU-native deep-learning framework.
+
+A ground-up re-design of the capability set of Eclipse Deeplearning4j
+(reference: /root/reference, DL4J 1.0.0-SNAPSHOT) for TPU hardware:
+
+- declarative, JSON-serializable network configuration
+  (DL4J: deeplearning4j-nn/.../nn/conf/NeuralNetConfiguration.java:584)
+- sequential and DAG network containers with fit/evaluate/serialize
+  (DL4J: MultiLayerNetwork.java, ComputationGraph.java)
+- accelerated-kernel seam (DL4J: cuDNN helpers -> here XLA/Pallas registry)
+- data-parallel training over a TPU mesh (DL4J: ParallelWrapper + Spark
+  masters -> here pjit/shard_map with ICI collectives)
+- evaluation, early stopping, transfer learning, checkpointing, listeners,
+  model zoo, word embeddings, nearest neighbors, t-SNE.
+
+The compute path is JAX/XLA (jit-compiled, functional); the design is
+TPU-first (static shapes, NHWC layouts, bf16-friendly, MXU-sized matmuls),
+not a translation of the reference's class hierarchy.
+"""
+
+__version__ = "0.1.0"
+
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+__all__ = ["MultiLayerNetwork", "ComputationGraph", "__version__"]

@@ -1,0 +1,12 @@
+from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
+from deeplearning4j_tpu.data.iterator import (
+    DataSetIterator, ArrayDataSetIterator, ExistingDataSetIterator,
+    BenchmarkDataSetIterator,
+)
+from deeplearning4j_tpu.data.async_iterator import AsyncDataSetIterator
+
+__all__ = [
+    "DataSet", "MultiDataSet", "DataSetIterator", "ArrayDataSetIterator",
+    "ExistingDataSetIterator", "BenchmarkDataSetIterator",
+    "AsyncDataSetIterator",
+]

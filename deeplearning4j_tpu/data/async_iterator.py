@@ -1,0 +1,92 @@
+"""Asynchronous prefetching iterator.
+
+Parity with DL4J AsyncDataSetIterator
+(deeplearning4j-data/deeplearning4j-utility-iterators/.../AsyncDataSetIterator.java),
+which every fit() wraps by default (MultiLayerNetwork.java:1272-1274): a
+background thread pulls batches from the source iterator into a bounded queue
+so host ETL overlaps device compute. On TPU this additionally starts the
+host->HBM transfer (jax.device_put) from the worker thread, so the next
+batch's DMA overlaps the current step — the role DL4J's device-aware
+buffering plays for CUDA.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Optional
+
+import jax
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.data.iterator import DataSetIterator
+
+_SENTINEL = object()
+
+
+class AsyncDataSetIterator(DataSetIterator):
+    def __init__(self, source: DataSetIterator, queue_size: int = 4,
+                 device_put: bool = True, device=None):
+        self._source = source
+        self._queue_size = int(queue_size)
+        self._device_put = device_put
+        self._device = device
+
+    def reset(self):
+        self._source.reset()
+
+    def batch_size(self):
+        return self._source.batch_size()
+
+    def _put(self, q: "queue.Queue", stop: "threading.Event", item) -> bool:
+        """Bounded put that aborts when the consumer has gone away."""
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _worker(self, q, stop):
+        try:
+            for ds in self._source:
+                if stop.is_set():
+                    return
+                if self._device_put:
+                    dev = self._device or jax.local_devices()[0]
+                    ds = DataSet(
+                        jax.device_put(ds.features, dev),
+                        None if ds.labels is None else jax.device_put(ds.labels, dev),
+                        None if ds.features_mask is None else jax.device_put(ds.features_mask, dev),
+                        None if ds.labels_mask is None else jax.device_put(ds.labels_mask, dev),
+                    )
+                if not self._put(q, stop, ds):
+                    return
+        except BaseException as e:      # surface worker errors to the consumer
+            self._put(q, stop, e)
+            return
+        self._put(q, stop, _SENTINEL)
+
+    def __iter__(self):
+        q: "queue.Queue" = queue.Queue(maxsize=self._queue_size)
+        stop = threading.Event()
+        t = threading.Thread(target=self._worker, args=(q, stop), daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is _SENTINEL:
+                    break
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            # Consumer done or abandoned iteration: release the worker even
+            # if it is blocked on a full queue (no leaked thread / HBM batch).
+            stop.set()
+            try:
+                while True:
+                    q.get_nowait()
+            except queue.Empty:
+                pass
+            t.join(timeout=5)

@@ -1,0 +1,7 @@
+from deeplearning4j_tpu.eval.evaluation import Evaluation, ConfusionMatrix, EvaluationBinary
+from deeplearning4j_tpu.eval.regression import RegressionEvaluation
+from deeplearning4j_tpu.eval.roc import ROC, ROCMultiClass, EvaluationCalibration
+
+__all__ = ["Evaluation", "ConfusionMatrix", "EvaluationBinary",
+           "RegressionEvaluation", "ROC", "ROCMultiClass",
+           "EvaluationCalibration"]

@@ -1,0 +1,13 @@
+from deeplearning4j_tpu.nn.conf.base import (
+    InputType, LayerConf, register_layer, layer_from_dict, layer_to_dict,
+)
+from deeplearning4j_tpu.nn.conf.network import (
+    MultiLayerConfiguration, NeuralNetConfiguration,
+    ComputationGraphConfiguration, GraphBuilder,
+)
+
+__all__ = [
+    "InputType", "LayerConf", "register_layer", "layer_from_dict",
+    "layer_to_dict", "MultiLayerConfiguration", "NeuralNetConfiguration",
+    "ComputationGraphConfiguration", "GraphBuilder",
+]

@@ -1,0 +1,264 @@
+"""Layer-configuration base machinery.
+
+Capability parity with DL4J's declarative config layer
+(deeplearning4j-nn/.../nn/conf/ — NeuralNetConfiguration.java:584 builders,
+polymorphic JSON serde in nn/conf/serde/). Differences by design:
+
+- A layer config here is a frozen dataclass that *also carries the math*
+  (`init`/`apply` pure functions) instead of DL4J's conf-class/impl-class
+  split — in JAX the "implementation" is a pure function, so a separate
+  stateful Layer object would add nothing.
+- Shape inference uses `InputType` exactly like DL4J's
+  `nn/conf/inputs/InputType.java`; preprocessors between mismatched layer
+  kinds are auto-inserted like
+  `MultiLayerConfiguration.Builder.setInputType` does.
+- Serde is a simple `{"@class": <registered name>, ...fields}` scheme —
+  the analog of Jackson's polymorphic type info — so configs round-trip
+  through JSON (the wire format used for model replication and checkpoints,
+  DL4J MultiLayerConfiguration.java:120,138).
+
+Layout conventions are TPU-native: CNN activations are NHWC (DL4J is NCHW),
+RNN activations are (batch, time, features) (DL4J is (batch, features, time)).
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+import typing
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+# ----------------------------------------------------------------- InputType
+class Kind(str, enum.Enum):
+    FF = "ff"          # (features,)
+    CNN = "cnn"        # (height, width, channels) NHWC
+    CNN1D = "cnn1d"    # (time, channels)
+    RNN = "rnn"        # (time, features)
+
+
+@dataclasses.dataclass(frozen=True)
+class InputType:
+    """Shape metadata for one activation tensor, batch dim excluded.
+
+    Mirrors DL4J nn/conf/inputs/InputType (feedForward / convolutional /
+    recurrent), with the CNN layout fixed to NHWC.
+    """
+    kind: Kind
+    shape: Tuple[int, ...]
+
+    @staticmethod
+    def feed_forward(n: int) -> "InputType":
+        return InputType(Kind.FF, (int(n),))
+
+    @staticmethod
+    def convolutional(height: int, width: int, channels: int) -> "InputType":
+        return InputType(Kind.CNN, (int(height), int(width), int(channels)))
+
+    @staticmethod
+    def recurrent(features: int, timesteps: int) -> "InputType":
+        return InputType(Kind.RNN, (int(timesteps), int(features)))
+
+    @property
+    def features(self) -> int:
+        """Per-step / per-pixel feature count (DL4J getSize-ish)."""
+        if self.kind == Kind.FF:
+            return self.shape[0]
+        if self.kind in (Kind.RNN, Kind.CNN1D):
+            return self.shape[1]
+        return self.shape[2]
+
+    @property
+    def flat_size(self) -> int:
+        n = 1
+        for s in self.shape:
+            n *= s
+        return n
+
+    def to_dict(self):
+        return {"kind": self.kind.value, "shape": list(self.shape)}
+
+    @staticmethod
+    def from_dict(d):
+        return InputType(Kind(d["kind"]), tuple(d["shape"]))
+
+
+# ------------------------------------------------------------- serde registry
+_LAYER_REGISTRY: Dict[str, type] = {}
+
+
+def register_layer(cls):
+    """Class decorator: register a LayerConf subclass for polymorphic serde
+    (the analog of Jackson subtype registration in DL4J nn/conf/serde/)."""
+    _LAYER_REGISTRY[cls.__name__] = cls
+    return cls
+
+
+def _encode_value(v):
+    if dataclasses.is_dataclass(v) and not isinstance(v, type):
+        d = {"@class": type(v).__name__}
+        for f in dataclasses.fields(v):
+            d[f.name] = _encode_value(getattr(v, f.name))
+        return d
+    if isinstance(v, enum.Enum):
+        return v.value
+    if isinstance(v, (list, tuple)):
+        return [_encode_value(x) for x in v]
+    if isinstance(v, dict):
+        return {k: _encode_value(x) for k, x in v.items()}
+    return v
+
+
+def layer_to_dict(layer) -> dict:
+    return _encode_value(layer)
+
+
+def _decode_fields(cls, d: dict):
+    kwargs = {}
+    hints = typing.get_type_hints(cls)
+    for f in dataclasses.fields(cls):
+        if f.name not in d:
+            continue
+        v = d[f.name]
+        if isinstance(v, dict) and "@class" in v:
+            v = layer_from_dict(v)
+        elif isinstance(v, list):
+            v = tuple(layer_from_dict(x) if isinstance(x, dict) and "@class" in x else x
+                      for x in v)
+            hint = hints.get(f.name)
+            origin = typing.get_origin(hint)
+            if origin in (list,):
+                v = list(v)
+        kwargs[f.name] = v
+    return cls(**kwargs)
+
+
+def layer_from_dict(d: dict):
+    name = d.get("@class")
+    if name is None:
+        raise ValueError(f"Missing @class in layer dict: {list(d)[:8]}")
+    # Updaters/schedules are dataclasses registered in their own modules.
+    cls = _LAYER_REGISTRY.get(name) or _AUX_REGISTRY.get(name)
+    if cls is None:
+        raise ValueError(f"Unknown layer/config class '{name}'")
+    return _decode_fields(cls, d)
+
+
+# updaters & schedules participate in the same serde
+_AUX_REGISTRY: Dict[str, type] = {}
+
+
+def _register_aux_dataclasses():
+    from deeplearning4j_tpu.nn import updaters as U
+    for name in dir(U):
+        obj = getattr(U, name)
+        if isinstance(obj, type) and dataclasses.is_dataclass(obj):
+            _AUX_REGISTRY[obj.__name__] = obj
+
+
+_register_aux_dataclasses()
+
+
+# ---------------------------------------------------------------- LayerConf
+@dataclasses.dataclass(frozen=True)
+class LayerConf:
+    """Base class for all layer configurations.
+
+    Subclasses implement:
+      output_type(input_type)          shape inference (DL4J Layer.getOutputType)
+      init(key, input_type, dtype)     -> (params, state) dicts
+      apply(params, state, x, ...)     -> (y, new_state) pure forward
+    Backprop is jax.grad through `apply` (DL4J's hand-written
+    backpropGradient has no analog; gradient checks are the oracle).
+    """
+    name: Optional[str] = None
+    dropout: float = 0.0        # input dropout probability (0 disables)
+    l1: float = 0.0             # L1 regularization coefficient on weights
+    l2: float = 0.0             # L2 regularization coefficient on weights
+    updater: Optional[Any] = None   # per-layer updater override (DL4J .updater)
+    frozen: bool = False        # FrozenLayer semantics (transfer learning)
+
+    # ---- shape inference -------------------------------------------------
+    def output_type(self, input_type: InputType) -> InputType:
+        raise NotImplementedError
+
+    # ---- params ----------------------------------------------------------
+    def init(self, key, input_type: InputType, dtype=jnp.float32):
+        """Returns (params, state); both possibly empty dicts."""
+        return {}, {}
+
+    def apply(self, params, state, x, *, train: bool = False, rng=None,
+              mask=None):
+        """Pure forward. Returns (output, new_state)."""
+        raise NotImplementedError
+
+    # ---- helpers ---------------------------------------------------------
+    def maybe_dropout_input(self, x, train, rng):
+        """DL4J applies layer `dropOut` to the layer *input* during training
+        (Dropout in nn/conf/dropout applied via BaseLayer.applyDropOutIfNecessary)."""
+        if not train or self.dropout <= 0.0 or rng is None:
+            return x
+        keep = 1.0 - self.dropout
+        mask = jax.random.bernoulli(rng, keep, x.shape)
+        return jnp.where(mask, x / keep, 0.0)
+
+    def regularization_score(self, params) -> jnp.ndarray:
+        """L1/L2 penalty contribution (DL4J BaseLayer.calcRegularizationScore).
+        Applied to weight ("W"-like) params only, not biases, as in DL4J."""
+        score = jnp.asarray(0.0, jnp.float32)
+        if self.l1 == 0.0 and self.l2 == 0.0:
+            return score
+        for k, v in params.items():
+            if k.startswith("b"):
+                continue
+            if self.l1:
+                score = score + self.l1 * jnp.sum(jnp.abs(v))
+            if self.l2:
+                score = score + 0.5 * self.l2 * jnp.sum(v * v)
+        return score
+
+    def has_params(self) -> bool:
+        return True
+
+
+# ------------------------------------------------------------ preprocessors
+def preprocess_forward(from_type: InputType, to_kind: Kind, x):
+    """Reshape activations between layer kinds.
+
+    The analog of DL4J InputPreProcessor implementations
+    (CnnToFeedForwardPreProcessor, FeedForwardToRnnPreProcessor, ...,
+    nn/conf/preprocessor/), auto-applied like
+    MultiLayerConfiguration.Builder#setInputType does. Only the reshape-style
+    preprocessors exist; layout is already TPU-native NHWC / (B,T,F)."""
+    if from_type.kind == to_kind:
+        return x
+    b = x.shape[0]
+    if to_kind == Kind.FF:
+        return x.reshape(b, -1)   # CNN/RNN -> FF: flatten (RNN: requires T known)
+    if from_type.kind == Kind.FF and to_kind == Kind.CNN:
+        raise ValueError("FF->CNN preprocessing requires explicit target shape; "
+                         "use a ReshapeVertex / specify InputType.convolutional")
+    if from_type.kind == Kind.CNN and to_kind == Kind.RNN:
+        # collapse spatial dims to time (DL4J CnnToRnnPreProcessor)
+        h, w, c = from_type.shape
+        return x.reshape(b, h * w, c)
+    if from_type.kind == Kind.RNN and to_kind == Kind.CNN1D:
+        return x
+    if from_type.kind == Kind.CNN1D and to_kind == Kind.RNN:
+        return x
+    raise ValueError(f"No preprocessor from {from_type.kind} to {to_kind}")
+
+
+def preprocessed_type(from_type: InputType, to_kind: Kind) -> InputType:
+    if from_type.kind == to_kind:
+        return from_type
+    if to_kind == Kind.FF:
+        return InputType(Kind.FF, (from_type.flat_size,))
+    if from_type.kind == Kind.CNN and to_kind == Kind.RNN:
+        h, w, c = from_type.shape
+        return InputType(Kind.RNN, (h * w, c))
+    if from_type.kind in (Kind.RNN, Kind.CNN1D) and to_kind in (Kind.RNN, Kind.CNN1D):
+        return InputType(to_kind, from_type.shape)
+    raise ValueError(f"No preprocessor from {from_type.kind} to {to_kind}")

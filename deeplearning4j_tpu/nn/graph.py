@@ -1,0 +1,335 @@
+"""ComputationGraph — the DAG network container.
+
+Parity target: DL4J nn/graph/ComputationGraph.java (3904 LoC):
+- topological order        :152,401 -> ComputationGraphConfiguration.topological_order()
+- fit(MultiDataSetIterator):1015    -> fit(): jitted train step over the DAG
+- feedForward              :1409    -> feed_forward(): dict of all activations
+- output                   :1759    -> output()
+- multi-input / multi-output with per-output losses summed into one score
+
+The DAG executes inside ONE jit trace — XLA sees the whole graph and fuses
+across vertices (DL4J walks GraphVertex objects at runtime instead).
+"""
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
+from deeplearning4j_tpu.nn.conf.base import (
+    InputType, Kind, LayerConf, preprocess_forward, preprocessed_type,
+)
+from deeplearning4j_tpu.nn.conf.graph_vertices import GraphVertexConf
+from deeplearning4j_tpu.nn.conf.network import ComputationGraphConfiguration
+from deeplearning4j_tpu.nn.multilayer import _as_jnp, _required_kind
+from deeplearning4j_tpu.nn.updaters import NoOp, build_optimizer
+from deeplearning4j_tpu.util import params as param_util
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+
+class ComputationGraph:
+    def __init__(self, conf: ComputationGraphConfiguration):
+        self.conf = conf
+        self.params: Optional[dict] = None
+        self.state: Optional[dict] = None
+        self.opt_state = None
+        self.listeners: List = []
+        self.iteration_count = 0
+        self.epoch_count = 0
+        self._score: Optional[float] = None
+        self._param_dtype = jnp.dtype(conf.dtype)
+        self._compute_dtype = jnp.dtype(conf.compute_dtype or conf.dtype)
+        self._topo = conf.topological_order()
+        self._vertex_types: Optional[Dict[str, InputType]] = None
+        self._tx = None
+        self._train_step = None
+        self._output_fn = None
+
+    def set_listeners(self, *listeners):
+        self.listeners = list(listeners)
+        return self
+
+    # ----------------------------------------------------------- init/types
+    def _resolve_types(self) -> Dict[str, InputType]:
+        """InputType for every vertex output (DL4J getLayerActivationTypes)."""
+        if len(self.conf.input_types) != len(self.conf.network_inputs):
+            raise ValueError("ComputationGraphConfiguration.input_types must "
+                             "match network_inputs")
+        types: Dict[str, InputType] = dict(zip(self.conf.network_inputs,
+                                               self.conf.input_types))
+        self._pre_kind: Dict[str, Optional[Kind]] = {}
+        for name in self._topo:
+            vd = self.conf.vertices[name]
+            in_types = [types[i] for i in vd.inputs]
+            if isinstance(vd.vertex, GraphVertexConf):
+                self._pre_kind[name] = None
+                types[name] = vd.vertex.output_type(*in_types)
+            else:
+                need = _required_kind(vd.vertex)
+                self._pre_kind[name] = need
+                t = in_types[0]
+                if need is not None and t.kind != need:
+                    t = preprocessed_type(t, need)
+                types[name] = vd.vertex.output_type(t)
+        return types
+
+    def init(self, seed: Optional[int] = None):
+        seed = self.conf.seed if seed is None else seed
+        key = jax.random.PRNGKey(seed)
+        for name in self.conf.network_outputs:
+            if name not in self.conf.vertices:
+                raise ValueError(f"Unknown output vertex '{name}'")
+            v = self.conf.vertices[name].vertex
+            if not hasattr(v, "score"):
+                raise ValueError(
+                    f"Output vertex '{name}' ({type(v).__name__}) must be an "
+                    "output/loss layer with a score() method")
+        from deeplearning4j_tpu.nn.multilayer import validate_layer_conf
+        for vd in self.conf.vertices.values():
+            if isinstance(vd.vertex, LayerConf):
+                validate_layer_conf(vd.vertex)
+        self._vertex_types = self._resolve_types()
+        params: Dict[str, dict] = {}
+        state: Dict[str, dict] = {}
+        for name in self._topo:
+            vd = self.conf.vertices[name]
+            if isinstance(vd.vertex, GraphVertexConf):
+                continue
+            key, sub = jax.random.split(key)
+            in_t = self._vertex_types[vd.inputs[0]]
+            need = self._pre_kind[name]
+            if need is not None and in_t.kind != need:
+                in_t = preprocessed_type(in_t, need)
+            p, s = vd.vertex.init(sub, in_t, self._param_dtype)
+            params[name] = p
+            state[name] = s
+        self.params = params
+        self.state = state
+        self._build_optimizer()
+        return self
+
+    def _build_optimizer(self):
+        transforms = {"__global__": build_optimizer(
+            self.conf.updater, self.conf.grad_clip_norm, self.conf.grad_clip_value)}
+        labels = {}
+        any_override = False
+        for name, p in self.params.items():
+            vd = self.conf.vertices[name]
+            lab = "__global__"
+            if getattr(vd.vertex, "frozen", False) or \
+                    type(vd.vertex).__name__ == "FrozenLayerWrapper":
+                lab = "__noop__"
+                transforms.setdefault("__noop__", NoOp().to_optax())
+                any_override = True
+            elif getattr(vd.vertex, "updater", None) is not None:
+                lab = f"v_{name}"
+                transforms[lab] = build_optimizer(
+                    vd.vertex.updater, self.conf.grad_clip_norm,
+                    self.conf.grad_clip_value)
+                any_override = True
+            labels[name] = jax.tree_util.tree_map(lambda _: lab, p)
+        if any_override:
+            self._tx = optax.multi_transform(transforms, labels)
+        else:
+            self._tx = transforms["__global__"]
+        self.opt_state = self._tx.init(self.params)
+        self._train_step = None
+
+    # -------------------------------------------------------------- forward
+    def _cast_params(self, params):
+        if self._compute_dtype == self._param_dtype:
+            return params
+        def cast(a):
+            if jnp.issubdtype(a.dtype, jnp.floating):
+                return a.astype(self._compute_dtype)
+            return a
+        return jax.tree_util.tree_map(cast, params)
+
+    def _forward(self, params, state, inputs: Sequence, train, rng,
+                 fmasks: Optional[Sequence] = None, stash_pre: bool = False):
+        """Execute the DAG. Returns (activations dict, new_state).
+
+        With stash_pre=True, the pre-head activation of each output vertex is
+        stored under '__pre__<name>' so score() sees features, not
+        post-activation output (the analog of DL4J output layers keeping
+        preOutput for computeScore)."""
+        if self._vertex_types is None:
+            self._vertex_types = self._resolve_types()
+        params = self._cast_params(params)
+        acts: Dict[str, Any] = {}
+        for i, name in enumerate(self.conf.network_inputs):
+            acts[name] = _as_jnp(inputs[i], self._compute_dtype)
+        mask = None
+        if fmasks is not None:
+            mask = next((m for m in fmasks if m is not None), None)
+        new_state = {}
+        out_set = set(self.conf.network_outputs) if stash_pre else ()
+        for name in self._topo:
+            vd = self.conf.vertices[name]
+            xs = [acts[i] for i in vd.inputs]
+            if isinstance(vd.vertex, GraphVertexConf):
+                acts[name] = vd.vertex.apply(*xs)
+                continue
+            x = xs[0]
+            need = self._pre_kind[name]
+            src_t = self._input_type_of(vd.inputs[0])
+            if need is not None and src_t.kind != need:
+                x = preprocess_forward(src_t, need, x)
+            sub_rng = None
+            if rng is not None:
+                rng, sub_rng = jax.random.split(rng)
+            m = mask if need == Kind.RNN else None
+            if name in out_set:
+                acts["__pre__" + name] = x
+            y, s = vd.vertex.apply(params.get(name, {}), state.get(name, {}),
+                                   x, train=train, rng=sub_rng, mask=m)
+            new_state[name] = s
+            acts[name] = y
+        return acts, new_state
+
+    def _input_type_of(self, name: str) -> InputType:
+        return self._vertex_types[name]
+
+    # --------------------------------------------------------------- output
+    def output(self, *inputs, train: bool = False):
+        """Multi-output inference (ComputationGraph.output, :1759-1810)."""
+        if self._output_fn is None:
+            @jax.jit
+            def _out(params, state, inputs):
+                acts, _ = self._forward(params, state, inputs, False, None)
+                return tuple(acts[o] for o in self.conf.network_outputs)
+            self._output_fn = _out
+        outs = self._output_fn(self.params, self.state,
+                               tuple(_as_jnp(x, self._compute_dtype) for x in inputs))
+        return outs[0] if len(outs) == 1 else outs
+
+    def feed_forward(self, *inputs, train: bool = False):
+        acts, _ = self._forward(self.params, self.state, inputs, train, None)
+        return acts
+
+    # ------------------------------------------------------------------ fit
+    def _score_fn(self, params, state, inputs, labels, fmasks, lmasks, train, rng):
+        params_c = self._cast_params(params)
+        acts, new_state = self._forward(params_c, state, inputs, train, rng,
+                                        fmasks, stash_pre=True)
+        total = jnp.asarray(0.0, jnp.float32)
+        for i, out_name in enumerate(self.conf.network_outputs):
+            vd = self.conf.vertices[out_name]
+            feat = acts["__pre__" + out_name]
+            lmask = None
+            if lmasks is not None and lmasks[i] is not None:
+                lmask = lmasks[i]
+            lab = _as_jnp(labels[i], self._compute_dtype)
+            total = total + vd.vertex.score(params_c.get(out_name, {}), feat,
+                                            lab, train=train, rng=None,
+                                            mask=lmask).astype(jnp.float32)
+        for name, p in params.items():
+            vd = self.conf.vertices[name]
+            if isinstance(vd.vertex, LayerConf):
+                total = total + vd.vertex.regularization_score(p)
+        return total, new_state
+
+    def _make_train_step(self):
+        tx = self._tx
+
+        def step(params, opt_state, state, inputs, labels, fmasks, lmasks, rng):
+            def loss_fn(p):
+                return self._score_fn(p, state, inputs, labels, fmasks, lmasks,
+                                      True, rng)
+            (loss, new_state), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            updates, new_opt = tx.update(grads, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+            return new_params, new_opt, new_state, loss
+
+        return jax.jit(step, donate_argnums=(0, 1, 2))
+
+    def fit(self, data, epochs: int = 1):
+        """Train on a MultiDataSet / DataSet / iterator of either
+        (ComputationGraph.fit, :1015)."""
+        if self.params is None:
+            self.init()
+        if self._train_step is None:
+            self._train_step = self._make_train_step()
+        rng = jax.random.PRNGKey(self.conf.seed + 331 * (self.epoch_count + 1))
+        for _ in range(epochs):
+            for lst in self.listeners:
+                lst.on_epoch_start(self, self.epoch_count)
+            etl_start = time.perf_counter()
+            for mds in self._iter_data(data):
+                etl_ms = (time.perf_counter() - etl_start) * 1e3
+                rng, sub = jax.random.split(rng)
+                inputs = tuple(_as_jnp(f, self._compute_dtype) for f in mds.features)
+                labels = tuple(_as_jnp(l, self._compute_dtype) for l in mds.labels)
+                fmasks = None if mds.features_masks is None else tuple(
+                    _as_jnp(m) for m in mds.features_masks)
+                lmasks = None if mds.labels_masks is None else tuple(
+                    _as_jnp(m) for m in mds.labels_masks)
+                self.params, self.opt_state, self.state, loss = self._train_step(
+                    self.params, self.opt_state, self.state, inputs, labels,
+                    fmasks, lmasks, sub)
+                self._score = float(loss)
+                bs = int(np.shape(mds.features[0])[0])
+                for lst in self.listeners:
+                    lst.iteration_done(self, self.iteration_count,
+                                       self.epoch_count, self._score, etl_ms, bs)
+                self.iteration_count += 1
+                etl_start = time.perf_counter()
+            for lst in self.listeners:
+                lst.on_epoch_end(self, self.epoch_count)
+            self.epoch_count += 1
+            if hasattr(data, "reset"):
+                data.reset()
+        return self
+
+    def _iter_data(self, data):
+        if isinstance(data, MultiDataSet):
+            yield data
+        elif isinstance(data, DataSet):
+            yield MultiDataSet((data.features,), (data.labels,),
+                               None if data.features_mask is None else (data.features_mask,),
+                               None if data.labels_mask is None else (data.labels_mask,))
+        else:
+            for item in data:
+                yield from self._iter_data(item)
+
+    # -------------------------------------------------------------- scoring
+    def score(self, mds: Optional[MultiDataSet] = None) -> float:
+        if mds is None:
+            return self._score if self._score is not None else float("nan")
+        if isinstance(mds, DataSet):
+            mds = MultiDataSet((mds.features,), (mds.labels,))
+        loss, _ = self._score_fn(
+            self.params, self.state,
+            tuple(_as_jnp(f, self._compute_dtype) for f in mds.features),
+            tuple(_as_jnp(l, self._compute_dtype) for l in mds.labels),
+            None, None, False, None)
+        return float(loss)
+
+    def evaluate(self, data, batch_size: int = 32):
+        from deeplearning4j_tpu.eval.evaluation import Evaluation
+        ev = Evaluation()
+        for mds in self._iter_data(data):
+            preds = self.output(*mds.features)
+            if isinstance(preds, tuple):
+                preds = preds[0]
+            ev.eval(np.asarray(mds.labels[0]), np.asarray(preds))
+        if hasattr(data, "reset"):
+            data.reset()
+        return ev
+
+    # --------------------------------------------------------------- params
+    def num_params(self) -> int:
+        return param_util.num_params(self.params)
+
+    def params_flat(self):
+        return param_util.params_to_flat(self.params)
+
+    def set_params_flat(self, flat):
+        self.params = param_util.flat_to_params(flat, self.params)

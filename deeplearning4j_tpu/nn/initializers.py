@@ -1,0 +1,108 @@
+"""Weight initializers.
+
+Capability parity with DL4J WeightInit / WeightInitUtil
+(deeplearning4j-nn/.../nn/weights/WeightInit.java, WeightInitUtil.java).
+Each initializer is `fn(key, shape, fan_in, fan_out, dtype) -> Array`;
+fan values are supplied by the layer (DL4J computes them per-layer too).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+def _uniform(key, shape, lo, hi, dtype):
+    return jax.random.uniform(key, shape, minval=lo, maxval=hi, dtype=dtype)
+
+
+def zero(key, shape, fan_in, fan_out, dtype=jnp.float32):
+    return jnp.zeros(shape, dtype)
+
+
+def ones(key, shape, fan_in, fan_out, dtype=jnp.float32):
+    return jnp.ones(shape, dtype)
+
+
+def normal(key, shape, fan_in, fan_out, dtype=jnp.float32):
+    # DL4J WeightInit.NORMAL: N(0, 1/sqrt(fanIn))
+    return jax.random.normal(key, shape, dtype) / math.sqrt(max(fan_in, 1))
+
+
+def lecun_normal(key, shape, fan_in, fan_out, dtype=jnp.float32):
+    return jax.random.normal(key, shape, dtype) * math.sqrt(1.0 / max(fan_in, 1))
+
+
+def lecun_uniform(key, shape, fan_in, fan_out, dtype=jnp.float32):
+    b = math.sqrt(3.0 / max(fan_in, 1))
+    return _uniform(key, shape, -b, b, dtype)
+
+
+def uniform(key, shape, fan_in, fan_out, dtype=jnp.float32):
+    # DL4J WeightInit.UNIFORM: U(-a, a), a = 1/sqrt(fanIn)
+    a = 1.0 / math.sqrt(max(fan_in, 1))
+    return _uniform(key, shape, -a, a, dtype)
+
+
+def xavier(key, shape, fan_in, fan_out, dtype=jnp.float32):
+    # DL4J WeightInit.XAVIER: N(0, 2/(fanIn+fanOut))
+    return jax.random.normal(key, shape, dtype) * math.sqrt(2.0 / max(fan_in + fan_out, 1))
+
+
+def xavier_uniform(key, shape, fan_in, fan_out, dtype=jnp.float32):
+    b = math.sqrt(6.0 / max(fan_in + fan_out, 1))
+    return _uniform(key, shape, -b, b, dtype)
+
+
+def xavier_fan_in(key, shape, fan_in, fan_out, dtype=jnp.float32):
+    return jax.random.normal(key, shape, dtype) * math.sqrt(1.0 / max(fan_in, 1))
+
+
+def relu_init(key, shape, fan_in, fan_out, dtype=jnp.float32):
+    # He init: N(0, 2/fanIn)
+    return jax.random.normal(key, shape, dtype) * math.sqrt(2.0 / max(fan_in, 1))
+
+
+def relu_uniform(key, shape, fan_in, fan_out, dtype=jnp.float32):
+    b = math.sqrt(6.0 / max(fan_in, 1))
+    return _uniform(key, shape, -b, b, dtype)
+
+
+def sigmoid_uniform(key, shape, fan_in, fan_out, dtype=jnp.float32):
+    b = 4.0 * math.sqrt(6.0 / max(fan_in + fan_out, 1))
+    return _uniform(key, shape, -b, b, dtype)
+
+
+def identity_init(key, shape, fan_in, fan_out, dtype=jnp.float32):
+    if len(shape) == 2 and shape[0] == shape[1]:
+        return jnp.eye(shape[0], dtype=dtype)
+    raise ValueError("IDENTITY weight init requires a square 2d shape")
+
+
+INITIALIZERS = {
+    "zero": zero,
+    "ones": ones,
+    "normal": normal,
+    "lecun_normal": lecun_normal,
+    "lecun_uniform": lecun_uniform,
+    "uniform": uniform,
+    "xavier": xavier,
+    "xavier_uniform": xavier_uniform,
+    "xavier_fan_in": xavier_fan_in,
+    "relu": relu_init,
+    "he_normal": relu_init,
+    "relu_uniform": relu_uniform,
+    "he_uniform": relu_uniform,
+    "sigmoid_uniform": sigmoid_uniform,
+    "identity": identity_init,
+}
+
+
+def get_initializer(name_or_fn):
+    if callable(name_or_fn):
+        return name_or_fn
+    key = str(name_or_fn).lower()
+    if key not in INITIALIZERS:
+        raise ValueError(f"Unknown weight init '{name_or_fn}'. Known: {sorted(INITIALIZERS)}")
+    return INITIALIZERS[key]

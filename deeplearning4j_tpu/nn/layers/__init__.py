@@ -1,0 +1,34 @@
+from deeplearning4j_tpu.nn.layers.feedforward import (
+    DenseLayer, EmbeddingLayer, ActivationLayer, DropoutLayer,
+    OutputLayer, LossLayer, AutoEncoder,
+)
+from deeplearning4j_tpu.nn.layers.convolution import (
+    ConvolutionLayer, Convolution1DLayer, SubsamplingLayer,
+    Subsampling1DLayer, Upsampling2D, ZeroPaddingLayer, GlobalPoolingLayer,
+    Deconvolution2D, SeparableConvolution2D, DepthwiseConvolution2D,
+    SpaceToDepthLayer, SpaceToBatchLayer, Cropping2D, CnnLossLayer,
+)
+from deeplearning4j_tpu.nn.layers.normalization import (
+    BatchNormalization, LocalResponseNormalization,
+)
+from deeplearning4j_tpu.nn.layers.recurrent import (
+    LSTM, GravesLSTM, GravesBidirectionalLSTM, SimpleRnn, Bidirectional,
+    RnnOutputLayer, RnnLossLayer, LastTimeStep, MaskZeroLayer,
+)
+from deeplearning4j_tpu.nn.layers.variational import VariationalAutoencoder
+from deeplearning4j_tpu.nn.layers.samediff import SameDiffLayer, FrozenLayerWrapper
+
+__all__ = [
+    "DenseLayer", "EmbeddingLayer", "ActivationLayer", "DropoutLayer",
+    "OutputLayer", "LossLayer", "AutoEncoder",
+    "ConvolutionLayer", "Convolution1DLayer", "SubsamplingLayer",
+    "Subsampling1DLayer", "Upsampling2D", "ZeroPaddingLayer",
+    "GlobalPoolingLayer", "Deconvolution2D", "SeparableConvolution2D",
+    "DepthwiseConvolution2D", "SpaceToDepthLayer", "SpaceToBatchLayer",
+    "Cropping2D", "CnnLossLayer",
+    "BatchNormalization", "LocalResponseNormalization",
+    "LSTM", "GravesLSTM", "GravesBidirectionalLSTM", "SimpleRnn",
+    "Bidirectional", "RnnOutputLayer", "RnnLossLayer", "LastTimeStep",
+    "MaskZeroLayer", "VariationalAutoencoder", "SameDiffLayer",
+    "FrozenLayerWrapper",
+]

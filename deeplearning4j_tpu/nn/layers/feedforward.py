@@ -1,0 +1,231 @@
+"""Feed-forward layer family.
+
+Parity targets (config semantics, not code):
+- DenseLayer        <- DL4J nn/conf/layers/DenseLayer.java + nn/layers/feedforward/dense/
+- EmbeddingLayer    <- nn/conf/layers/EmbeddingLayer.java (one-hot index -> row lookup)
+- ActivationLayer   <- nn/conf/layers/ActivationLayer.java
+- DropoutLayer      <- nn/conf/layers/DropoutLayer.java
+- OutputLayer       <- nn/conf/layers/OutputLayer.java (dense + loss head)
+- LossLayer         <- nn/conf/layers/LossLayer.java (loss head, no params)
+- AutoEncoder       <- nn/conf/layers/AutoEncoder.java (denoising AE pretrain layer)
+
+All matmuls are (B, in) @ (in, out) — MXU-shaped; dtype follows the network's
+compute dtype (bf16 on TPU by default, fp32 for parity runs).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.activations import get_activation
+from deeplearning4j_tpu.nn.conf.base import InputType, Kind, LayerConf, register_layer
+from deeplearning4j_tpu.nn.initializers import get_initializer
+from deeplearning4j_tpu.nn.losses import get_loss
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class DenseLayer(LayerConf):
+    n_out: int = 0
+    n_in: Optional[int] = None          # inferred from input when None
+    activation: str = "identity"
+    weight_init: str = "xavier"
+    bias_init: float = 0.0
+    has_bias: bool = True
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.feed_forward(self.n_out)
+
+    def init(self, key, input_type: InputType, dtype=jnp.float32):
+        n_in = self.n_in or input_type.features
+        w_init = get_initializer(self.weight_init)
+        params = {"W": w_init(key, (n_in, self.n_out), n_in, self.n_out, dtype)}
+        if self.has_bias:
+            params["b"] = jnp.full((self.n_out,), self.bias_init, dtype)
+        return params, {}
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        x = self.maybe_dropout_input(x, train, rng)
+        y = x @ params["W"]
+        if self.has_bias:
+            y = y + params["b"]
+        return get_activation(self.activation)(y), state
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class EmbeddingLayer(LayerConf):
+    """Index -> embedding row. Input: (B,) or (B,1) integer indices.
+    DL4J's EmbeddingLayer is mathematically a one-hot matmul; on TPU we use a
+    gather (jnp.take) which XLA lowers to a dynamic-slice — no dense one-hot."""
+    n_out: int = 0
+    n_in: Optional[int] = None          # vocab size; must be set or inferred
+    activation: str = "identity"
+    weight_init: str = "xavier"
+    has_bias: bool = False
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.feed_forward(self.n_out)
+
+    def init(self, key, input_type: InputType, dtype=jnp.float32):
+        n_in = self.n_in or input_type.features
+        w_init = get_initializer(self.weight_init)
+        params = {"W": w_init(key, (n_in, self.n_out), n_in, self.n_out, dtype)}
+        if self.has_bias:
+            params["b"] = jnp.zeros((self.n_out,), dtype)
+        return params, {}
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        idx = x.astype(jnp.int32)
+        if idx.ndim == 2 and idx.shape[-1] == 1:
+            idx = idx[..., 0]
+        y = jnp.take(params["W"], idx, axis=0)
+        if self.has_bias:
+            y = y + params["b"]
+        return get_activation(self.activation)(y), state
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class ActivationLayer(LayerConf):
+    activation: str = "relu"
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return input_type
+
+    def has_params(self):
+        return False
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        return get_activation(self.activation)(x), state
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class DropoutLayer(LayerConf):
+    """Standalone dropout layer (DL4J DropoutLayer). `dropout` is the drop
+    probability; inverted scaling at train time, identity at inference."""
+    dropout: float = 0.5
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return input_type
+
+    def has_params(self):
+        return False
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        return self.maybe_dropout_input(x, train, rng), state
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class OutputLayer(LayerConf):
+    """Dense + loss head (DL4J OutputLayer: BaseOutputLayer.computeScore).
+
+    `apply` returns post-activation predictions; `score` computes the loss on
+    pre-activation output — autodiff differentiates through both."""
+    n_out: int = 0
+    n_in: Optional[int] = None
+    activation: str = "softmax"
+    loss: str = "mcxent"
+    weight_init: str = "xavier"
+    bias_init: float = 0.0
+    has_bias: bool = True
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.feed_forward(self.n_out)
+
+    def init(self, key, input_type: InputType, dtype=jnp.float32):
+        n_in = self.n_in or input_type.features
+        w_init = get_initializer(self.weight_init)
+        params = {"W": w_init(key, (n_in, self.n_out), n_in, self.n_out, dtype)}
+        if self.has_bias:
+            params["b"] = jnp.full((self.n_out,), self.bias_init, dtype)
+        return params, {}
+
+    def preout(self, params, x, train=False, rng=None):
+        x = self.maybe_dropout_input(x, train, rng)
+        y = x @ params["W"]
+        if self.has_bias:
+            y = y + params["b"]
+        return y
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        return get_activation(self.activation)(self.preout(params, x, train, rng)), state
+
+    def score(self, params, x, labels, *, train=False, rng=None, mask=None):
+        z = self.preout(params, x, train, rng)
+        return get_loss(self.loss)(labels, z, self.activation, mask=mask)
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class LossLayer(LayerConf):
+    """Parameter-free loss head (DL4J LossLayer)."""
+    activation: str = "identity"
+    loss: str = "mse"
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return input_type
+
+    def has_params(self):
+        return False
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        return get_activation(self.activation)(x), state
+
+    def score(self, params, x, labels, *, train=False, rng=None, mask=None):
+        return get_loss(self.loss)(labels, x, self.activation, mask=mask)
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class AutoEncoder(LayerConf):
+    """Denoising autoencoder pretrain layer (DL4J nn/conf/layers/AutoEncoder.java,
+    impl nn/layers/feedforward/autoencoder/AutoEncoder.java).
+
+    Forward (as a stacked layer) = encoder only. `pretrain_score` corrupts the
+    input, encodes, decodes with tied-shape decoder params and scores the
+    reconstruction — used by the layerwise-pretraining path
+    (MultiLayerNetwork.fit pretrain branch, MultiLayerNetwork.java:1344-1346).
+    """
+    n_out: int = 0
+    n_in: Optional[int] = None
+    activation: str = "sigmoid"
+    loss: str = "mse"
+    corruption_level: float = 0.3
+    weight_init: str = "xavier"
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.feed_forward(self.n_out)
+
+    def init(self, key, input_type: InputType, dtype=jnp.float32):
+        n_in = self.n_in or input_type.features
+        k1, k2 = jax.random.split(key)
+        w_init = get_initializer(self.weight_init)
+        params = {
+            "W": w_init(k1, (n_in, self.n_out), n_in, self.n_out, dtype),
+            "b": jnp.zeros((self.n_out,), dtype),
+            # decoder bias; decoder weight is tied (W^T), as in DL4J
+            "vb": jnp.zeros((n_in,), dtype),
+        }
+        return params, {}
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        x = self.maybe_dropout_input(x, train, rng)
+        act = get_activation(self.activation)
+        return act(x @ params["W"] + params["b"]), state
+
+    def pretrain_score(self, params, x, rng):
+        act = get_activation(self.activation)
+        if self.corruption_level > 0 and rng is not None:
+            keep = jax.random.bernoulli(rng, 1.0 - self.corruption_level, x.shape)
+            x_in = jnp.where(keep, x, 0.0)
+        else:
+            x_in = x
+        h = act(x_in @ params["W"] + params["b"])
+        recon_pre = h @ params["W"].T + params["vb"]
+        return get_loss(self.loss)(x, recon_pre, self.activation)

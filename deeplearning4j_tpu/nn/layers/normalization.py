@@ -1,0 +1,90 @@
+"""Normalization layers.
+
+- BatchNormalization <- DL4J nn/conf/layers/BatchNormalization.java; impl
+  nn/layers/normalization/BatchNormalization.java (cuDNN helper
+  CudnnBatchNormalizationHelper). XLA fuses the normalize+scale+shift chain;
+  running statistics live in the layer *state* pytree (the analog of DL4J's
+  global mean/var params updated with `decay`).
+- LocalResponseNormalization <- nn/conf/layers/LocalResponseNormalization.java
+  (cuDNN helper CudnnLocalResponseNormalizationHelper) — AlexNet-era
+  cross-channel LRN.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.nn.conf.base import InputType, LayerConf, register_layer
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class BatchNormalization(LayerConf):
+    epsilon: float = 1e-5
+    decay: float = 0.9          # running-stat EMA decay (DL4J `decay`)
+    gamma_init: float = 1.0
+    beta_init: float = 0.0
+    lock_gamma_beta: bool = False   # DL4J lockGammaBeta: fixed scale/shift
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return input_type
+
+    def init(self, key, input_type: InputType, dtype=jnp.float32):
+        c = input_type.features
+        params = {}
+        if not self.lock_gamma_beta:
+            params = {"gamma": jnp.full((c,), self.gamma_init, dtype),
+                      "beta": jnp.full((c,), self.beta_init, dtype)}
+        state = {"mean": jnp.zeros((c,), jnp.float32),
+                 "var": jnp.ones((c,), jnp.float32)}
+        return params, state
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        axes = tuple(range(x.ndim - 1))    # all but channel/feature dim
+        if train:
+            mean = jnp.mean(x.astype(jnp.float32), axis=axes)
+            var = jnp.var(x.astype(jnp.float32), axis=axes)
+            new_state = {
+                "mean": self.decay * state["mean"] + (1.0 - self.decay) * mean,
+                "var": self.decay * state["var"] + (1.0 - self.decay) * var,
+            }
+        else:
+            mean, var = state["mean"], state["var"]
+            new_state = state
+        inv = lax.rsqrt(var + self.epsilon)
+        y = (x - mean.astype(x.dtype)) * inv.astype(x.dtype)
+        if not self.lock_gamma_beta:
+            y = y * params["gamma"] + params["beta"]
+        else:
+            y = y * self.gamma_init + self.beta_init
+        return y, new_state
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class LocalResponseNormalization(LayerConf):
+    """Cross-channel LRN: y = x / (k + alpha*sum(x^2 over n channels))^beta."""
+    k: float = 2.0
+    n: int = 5
+    alpha: float = 1e-4
+    beta: float = 0.75
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return input_type
+
+    def has_params(self):
+        return False
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        sq = x * x
+        half = self.n // 2
+        # sum over a window of `n` adjacent channels (NHWC last axis)
+        summed = lax.reduce_window(
+            sq, 0.0, lax.add,
+            window_dimensions=(1, 1, 1, self.n),
+            window_strides=(1, 1, 1, 1),
+            padding=((0, 0), (0, 0), (0, 0), (half, self.n - 1 - half)),
+        )
+        return x / (self.k + self.alpha * summed) ** self.beta, state

@@ -1,0 +1,74 @@
+"""User-defined layers and freezing wrappers.
+
+- SameDiffLayer <- DL4J nn/layers/samediff/SameDiffLayer.java: the escape
+  hatch for custom layers. Here a custom layer supplies plain JAX functions
+  (define_params / forward) — autodiff handles backward, as SameDiff's graph
+  did in the reference.
+- FrozenLayerWrapper <- DL4J nn/layers/FrozenLayer.java: wraps any layer,
+  stopping gradients (lax.stop_gradient) so transfer learning can freeze
+  feature extractors.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.nn.conf.base import InputType, LayerConf, register_layer
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class SameDiffLayer(LayerConf):
+    """Custom layer from user-supplied pure functions.
+
+    define_params(key, input_type, dtype) -> params dict
+    forward(params, x, train) -> y
+    out_type(input_type) -> InputType
+
+    Not JSON-serializable unless the callables are module-level and
+    re-registered on load (same caveat as DL4J custom layers needing
+    the class on the classpath).
+    """
+    define_params: Optional[Callable] = None
+    forward: Optional[Callable] = None
+    out_type: Optional[Callable] = None
+
+    def output_type(self, input_type: InputType) -> InputType:
+        if self.out_type is not None:
+            return self.out_type(input_type)
+        return input_type
+
+    def init(self, key, input_type: InputType, dtype=jnp.float32):
+        if self.define_params is None:
+            return {}, {}
+        return self.define_params(key, input_type, dtype), {}
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        return self.forward(params, x, train), state
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class FrozenLayerWrapper(LayerConf):
+    """Stop-gradient wrapper (DL4J FrozenLayer). Params exist but receive no
+    gradient; the updater additionally maps them to NoOp (see
+    MultiLayerNetwork._label_params)."""
+    layer: Optional[LayerConf] = None
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return self.layer.output_type(input_type)
+
+    def init(self, key, input_type: InputType, dtype=jnp.float32):
+        return self.layer.init(key, input_type, dtype)
+
+    def has_params(self):
+        return self.layer.has_params()
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        frozen = jax.tree_util.tree_map(lax.stop_gradient, params)
+        # frozen layers run in inference mode (DL4J FrozenLayer semantics)
+        return self.layer.apply(frozen, state, x, train=False, rng=rng, mask=mask)

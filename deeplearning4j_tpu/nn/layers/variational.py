@@ -1,0 +1,129 @@
+"""Variational autoencoder layer.
+
+Parity target: DL4J nn/conf/layers/variational/VariationalAutoencoder.java and
+impl nn/layers/variational/VariationalAutoencoder.java — an unsupervised
+pretrain layer with encoder MLP -> (mean, logvar) -> reparameterized sample ->
+decoder MLP -> reconstruction distribution. As a stacked (supervised) layer its
+forward emits the latent mean, exactly like DL4J's activate() does.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.activations import get_activation
+from deeplearning4j_tpu.nn.conf.base import InputType, LayerConf, register_layer
+from deeplearning4j_tpu.nn.initializers import get_initializer
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class VariationalAutoencoder(LayerConf):
+    n_out: int = 0                      # latent size
+    n_in: Optional[int] = None
+    encoder_layer_sizes: Tuple[int, ...] = (100,)
+    decoder_layer_sizes: Tuple[int, ...] = (100,)
+    activation: str = "tanh"            # hidden activation
+    pzx_activation: str = "identity"    # activation for q(z|x) mean
+    reconstruction_distribution: str = "gaussian"   # gaussian | bernoulli
+    num_samples: int = 1
+    weight_init: str = "xavier"
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.feed_forward(self.n_out)
+
+    def _mlp_init(self, key, sizes, dtype):
+        w_init = get_initializer(self.weight_init)
+        layers = []
+        for i, (a, b) in enumerate(zip(sizes[:-1], sizes[1:])):
+            key, sub = jax.random.split(key)
+            layers.append({"W": w_init(sub, (a, b), a, b, dtype),
+                           "b": jnp.zeros((b,), dtype)})
+        return layers
+
+    def init(self, key, input_type: InputType, dtype=jnp.float32):
+        n_in = self.n_in or input_type.features
+        k_enc, k_mu, k_dec, k_out = jax.random.split(key, 4)
+        enc_sizes = (n_in,) + tuple(self.encoder_layer_sizes)
+        dec_sizes = (self.n_out,) + tuple(self.decoder_layer_sizes)
+        w_init = get_initializer(self.weight_init)
+        h_enc = enc_sizes[-1]
+        h_dec = dec_sizes[-1]
+        # reconstruction params per input dim: gaussian needs mean+logvar
+        recon_mult = 2 if self.reconstruction_distribution == "gaussian" else 1
+        params = {
+            "enc": self._mlp_init(k_enc, enc_sizes, dtype),
+            "muW": w_init(k_mu, (h_enc, 2 * self.n_out), h_enc, 2 * self.n_out, dtype),
+            "mub": jnp.zeros((2 * self.n_out,), dtype),
+            "dec": self._mlp_init(k_dec, dec_sizes, dtype),
+            "outW": w_init(k_out, (h_dec, recon_mult * n_in), h_dec,
+                           recon_mult * n_in, dtype),
+            "outb": jnp.zeros((recon_mult * n_in,), dtype),
+        }
+        return params, {}
+
+    def _mlp(self, layers, x):
+        act = get_activation(self.activation)
+        for l in layers:
+            x = act(x @ l["W"] + l["b"])
+        return x
+
+    def encode(self, params, x):
+        h = self._mlp(params["enc"], x)
+        stats = h @ params["muW"] + params["mub"]
+        mu, logvar = jnp.split(stats, 2, axis=-1)
+        mu = get_activation(self.pzx_activation)(mu)
+        return mu, logvar
+
+    def decode(self, params, z):
+        h = self._mlp(params["dec"], z)
+        return h @ params["outW"] + params["outb"]
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        mu, _ = self.encode(params, x)
+        return mu, state
+
+    def pretrain_score(self, params, x, rng):
+        """Negative ELBO (reconstruction NLL + KL(q(z|x) || N(0,I)))."""
+        mu, logvar = self.encode(params, x)
+        kl = -0.5 * jnp.sum(1.0 + logvar - mu**2 - jnp.exp(logvar), axis=-1)
+        total_recon = 0.0
+        for s in range(self.num_samples):
+            eps = jax.random.normal(jax.random.fold_in(rng, s), mu.shape, mu.dtype)
+            z = mu + jnp.exp(0.5 * logvar) * eps
+            out = self.decode(params, z)
+            if self.reconstruction_distribution == "gaussian":
+                rmu, rlogvar = jnp.split(out, 2, axis=-1)
+                nll = 0.5 * jnp.sum(
+                    rlogvar + (x - rmu) ** 2 / jnp.exp(rlogvar)
+                    + jnp.log(2.0 * jnp.pi), axis=-1)
+            elif self.reconstruction_distribution == "bernoulli":
+                nll = jnp.sum(jnp.maximum(out, 0) - out * x
+                              + jnp.log1p(jnp.exp(-jnp.abs(out))), axis=-1)
+            else:
+                raise ValueError(self.reconstruction_distribution)
+            total_recon = total_recon + nll
+        return jnp.mean(total_recon / self.num_samples + kl)
+
+    def reconstruction_probability(self, params, x, rng, num_samples=5):
+        """Monte-Carlo estimate of log p(x) (DL4J reconstructionLogProbability)."""
+        mu, logvar = self.encode(params, x)
+        logps = []
+        for s in range(num_samples):
+            eps = jax.random.normal(jax.random.fold_in(rng, s), mu.shape, mu.dtype)
+            z = mu + jnp.exp(0.5 * logvar) * eps
+            out = self.decode(params, z)
+            if self.reconstruction_distribution == "gaussian":
+                rmu, rlogvar = jnp.split(out, 2, axis=-1)
+                logp = -0.5 * jnp.sum(
+                    rlogvar + (x - rmu) ** 2 / jnp.exp(rlogvar)
+                    + jnp.log(2.0 * jnp.pi), axis=-1)
+            else:
+                logp = -jnp.sum(jnp.maximum(out, 0) - out * x
+                                + jnp.log1p(jnp.exp(-jnp.abs(out))), axis=-1)
+            logps.append(logp)
+        stacked = jnp.stack(logps)
+        return jax.scipy.special.logsumexp(stacked, axis=0) - jnp.log(float(num_samples))

@@ -1,0 +1,170 @@
+"""Loss functions.
+
+Capability parity with DL4J's ILossFunction family (nd4j-api losses consumed by
+deeplearning4j-nn output layers; see LossFunctions.LossFunction enum usage in
+nn/conf/layers/OutputLayer.java).
+
+Every loss is a pure function
+    loss(labels, preout, activation_fn, mask=None, weights=None) -> scalar mean score
+with a matching per-example variant used by evaluation. Losses consume the
+*pre-activation* output plus the output activation, mirroring DL4J where
+ILossFunction.computeGradient receives preOutput and the IActivation — but here
+autodiff differentiates through the activation, so there are no hand-derived
+fused gradients; the finite-difference gradient-check suite is the oracle
+instead (as in deeplearning4j-core/src/test/.../gradientcheck/LossFunctionGradientCheck.java).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.activations import get_activation
+
+_EPS = 1e-7
+
+
+def _apply_mask_and_mean(per_example, mask):
+    """Reduce per-example scores to the mean score, respecting an optional mask.
+
+    per_example: (B,) or (B,T) array of per-sample scores.
+    mask: broadcastable 0/1 array; masked-out samples contribute nothing
+    (DL4J divides by minibatch size of *unmasked* elements for time series).
+    """
+    if mask is None:
+        return jnp.mean(per_example)
+    mask = jnp.reshape(mask, per_example.shape)
+    total = jnp.sum(per_example * mask)
+    denom = jnp.maximum(jnp.sum(mask), 1.0)
+    return total / denom
+
+
+def _weighted(err, weights):
+    if weights is not None:
+        err = err * weights
+    return err
+
+
+def mse(labels, preout, activation="identity", mask=None, weights=None):
+    out = get_activation(activation)(preout)
+    err = _weighted((out - labels) ** 2, weights)
+    return _apply_mask_and_mean(jnp.mean(err, axis=-1), mask)
+
+
+def mae(labels, preout, activation="identity", mask=None, weights=None):
+    out = get_activation(activation)(preout)
+    err = _weighted(jnp.abs(out - labels), weights)
+    return _apply_mask_and_mean(jnp.mean(err, axis=-1), mask)
+
+
+def l1(labels, preout, activation="identity", mask=None, weights=None):
+    out = get_activation(activation)(preout)
+    err = _weighted(jnp.abs(out - labels), weights)
+    return _apply_mask_and_mean(jnp.sum(err, axis=-1), mask)
+
+
+def l2(labels, preout, activation="identity", mask=None, weights=None):
+    out = get_activation(activation)(preout)
+    err = _weighted((out - labels) ** 2, weights)
+    return _apply_mask_and_mean(jnp.sum(err, axis=-1), mask)
+
+
+def xent(labels, preout, activation="sigmoid", mask=None, weights=None):
+    """Binary cross-entropy (DL4J LossBinaryXENT). Computed stably from logits
+    when the output activation is sigmoid."""
+    act = str(activation).lower() if not callable(activation) else None
+    if act == "sigmoid":
+        # stable: max(z,0) - z*y + log(1+exp(-|z|))
+        z = preout
+        per = jnp.maximum(z, 0) - z * labels + jnp.log1p(jnp.exp(-jnp.abs(z)))
+    else:
+        out = jnp.clip(get_activation(activation)(preout), _EPS, 1.0 - _EPS)
+        per = -(labels * jnp.log(out) + (1.0 - labels) * jnp.log(1.0 - out))
+    per = _weighted(per, weights)
+    return _apply_mask_and_mean(jnp.sum(per, axis=-1), mask)
+
+
+def mcxent(labels, preout, activation="softmax", mask=None, weights=None):
+    """Multi-class cross-entropy / negative log likelihood
+    (DL4J LossMCXENT / LossNegativeLogLikelihood — identical when the output
+    activation is softmax). Computed from logits via log_softmax for stability."""
+    act = str(activation).lower() if not callable(activation) else None
+    if act == "softmax":
+        logp = jax.nn.log_softmax(preout, axis=-1)
+    else:
+        logp = jnp.log(jnp.clip(get_activation(activation)(preout), _EPS, 1.0))
+    per = -_weighted(labels * logp, weights)
+    return _apply_mask_and_mean(jnp.sum(per, axis=-1), mask)
+
+
+def sparse_mcxent(labels, preout, activation="softmax", mask=None, weights=None):
+    """MCXENT with integer class labels (DL4J LossSparseMCXENT)."""
+    logp = jax.nn.log_softmax(preout, axis=-1)
+    labels = labels.astype(jnp.int32)
+    per = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    if weights is not None:
+        per = per * jnp.take(weights, labels)
+    return _apply_mask_and_mean(per, mask)
+
+
+negativeloglikelihood = mcxent
+
+
+def kl_divergence(labels, preout, activation="softmax", mask=None, weights=None):
+    out = jnp.clip(get_activation(activation)(preout), _EPS, 1.0)
+    lab = jnp.clip(labels, _EPS, 1.0)
+    per = _weighted(lab * (jnp.log(lab) - jnp.log(out)), weights)
+    return _apply_mask_and_mean(jnp.sum(per, axis=-1), mask)
+
+
+def poisson(labels, preout, activation="identity", mask=None, weights=None):
+    out = jnp.clip(get_activation(activation)(preout), _EPS, None)
+    per = _weighted(out - labels * jnp.log(out), weights)
+    return _apply_mask_and_mean(jnp.sum(per, axis=-1), mask)
+
+
+def cosine_proximity(labels, preout, activation="identity", mask=None, weights=None):
+    out = get_activation(activation)(preout)
+    dot = jnp.sum(out * labels, axis=-1)
+    norm = jnp.linalg.norm(out, axis=-1) * jnp.linalg.norm(labels, axis=-1)
+    per = -dot / jnp.maximum(norm, _EPS)
+    return _apply_mask_and_mean(per, mask)
+
+
+def hinge(labels, preout, activation="identity", mask=None, weights=None):
+    # labels in {-1, +1}
+    out = get_activation(activation)(preout)
+    per = _weighted(jnp.maximum(0.0, 1.0 - labels * out), weights)
+    return _apply_mask_and_mean(jnp.sum(per, axis=-1), mask)
+
+
+def squared_hinge(labels, preout, activation="identity", mask=None, weights=None):
+    out = get_activation(activation)(preout)
+    per = _weighted(jnp.maximum(0.0, 1.0 - labels * out) ** 2, weights)
+    return _apply_mask_and_mean(jnp.sum(per, axis=-1), mask)
+
+
+LOSSES = {
+    "mse": mse,
+    "mae": mae,
+    "l1": l1,
+    "l2": l2,
+    "xent": xent,
+    "binary_crossentropy": xent,
+    "mcxent": mcxent,
+    "negativeloglikelihood": negativeloglikelihood,
+    "sparse_mcxent": sparse_mcxent,
+    "kl_divergence": kl_divergence,
+    "poisson": poisson,
+    "cosine_proximity": cosine_proximity,
+    "hinge": hinge,
+    "squared_hinge": squared_hinge,
+}
+
+
+def get_loss(name_or_fn):
+    if callable(name_or_fn):
+        return name_or_fn
+    key = str(name_or_fn).lower()
+    if key not in LOSSES:
+        raise ValueError(f"Unknown loss '{name_or_fn}'. Known: {sorted(LOSSES)}")
+    return LOSSES[key]

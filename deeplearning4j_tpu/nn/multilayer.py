@@ -1,0 +1,472 @@
+"""MultiLayerNetwork — the sequential network container.
+
+Parity target: DL4J nn/multilayer/MultiLayerNetwork.java (3545 LoC):
+- init()                    :549   -> init(): per-layer param init via InputType chain
+- fit(DataSetIterator)      :1268  -> fit(): jit-compiled train step (autodiff
+                                     replaces calcBackpropGradients :1378)
+- feedForward               :885   -> feed_forward(): all layer activations
+- output                    :2012  -> output(): jitted inference
+- computeGradientAndScore   :2360  -> the value_and_grad inside the train step
+- doTruncatedBPTT           :1315  -> tBPTT chunking with carried RNN state
+- rnnTimeStep               :2806  -> rnn_time_step(): stateful streaming step
+- score includes l1/l2 regularization (BaseLayer.calcRegularizationScore)
+
+TPU-native design: the whole training step (forward, backward, updater apply)
+is ONE jit-compiled XLA program with donated params/opt-state buffers (the
+analog of DL4J's workspace arena reuse, MultiLayerNetwork.java:1284-1292).
+Parameters are a pytree; the canonical flat view (util/params.py) replaces
+DL4J's flattenedParams single buffer (:114,603-627).
+"""
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.data.iterator import ArrayDataSetIterator, DataSetIterator
+from deeplearning4j_tpu.nn.conf.base import (
+    InputType, Kind, LayerConf, preprocess_forward, preprocessed_type,
+)
+from deeplearning4j_tpu.nn.conf.network import MultiLayerConfiguration
+from deeplearning4j_tpu.nn.updaters import build_optimizer, NoOp
+from deeplearning4j_tpu.util import params as param_util
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+# layer-kind requirements for automatic preprocessor insertion
+# (the analog of MultiLayerConfiguration.Builder#setInputType auto-adding
+#  InputPreProcessors). None = accepts anything (elementwise layers).
+_KIND_BY_CLASS = {
+    "DenseLayer": Kind.FF, "EmbeddingLayer": Kind.FF, "OutputLayer": Kind.FF,
+    "AutoEncoder": Kind.FF, "VariationalAutoencoder": Kind.FF,
+    "ConvolutionLayer": Kind.CNN, "Deconvolution2D": Kind.CNN,
+    "SeparableConvolution2D": Kind.CNN, "DepthwiseConvolution2D": Kind.CNN,
+    "SubsamplingLayer": Kind.CNN, "Upsampling2D": Kind.CNN,
+    "ZeroPaddingLayer": Kind.CNN, "Cropping2D": Kind.CNN,
+    "SpaceToDepthLayer": Kind.CNN, "SpaceToBatchLayer": Kind.CNN,
+    "LocalResponseNormalization": Kind.CNN, "CnnLossLayer": Kind.CNN,
+    "LSTM": Kind.RNN, "GravesLSTM": Kind.RNN, "SimpleRnn": Kind.RNN,
+    "Bidirectional": Kind.RNN, "GravesBidirectionalLSTM": Kind.RNN,
+    "RnnOutputLayer": Kind.RNN, "RnnLossLayer": Kind.RNN,
+    "LastTimeStep": Kind.RNN, "MaskZeroLayer": Kind.RNN,
+    "Convolution1DLayer": Kind.RNN, "Subsampling1DLayer": Kind.RNN,
+}
+
+_RECURRENT_CLASSES = {"LSTM", "GravesLSTM", "SimpleRnn"}
+
+
+def _required_kind(layer: LayerConf) -> Optional[Kind]:
+    name = type(layer).__name__
+    if name == "FrozenLayerWrapper":
+        return _required_kind(layer.layer)
+    return _KIND_BY_CLASS.get(name)
+
+
+def _as_jnp(a, dtype=None):
+    if a is None:
+        return None
+    arr = jnp.asarray(a)
+    if dtype is not None and jnp.issubdtype(arr.dtype, jnp.floating):
+        arr = arr.astype(dtype)
+    return arr
+
+
+def validate_layer_conf(layer: LayerConf):
+    """Fail fast on unresolvable names at init time (typos in activation /
+    weight_init / loss would otherwise only surface at first forward)."""
+    from deeplearning4j_tpu.nn.activations import get_activation
+    from deeplearning4j_tpu.nn.initializers import get_initializer
+    from deeplearning4j_tpu.nn.losses import get_loss
+    for field, resolver in (("activation", get_activation),
+                            ("gate_activation", get_activation),
+                            ("weight_init", get_initializer),
+                            ("loss", get_loss)):
+        v = getattr(layer, field, None)
+        if v is not None:
+            resolver(v)
+    inner = getattr(layer, "layer", None)
+    if isinstance(inner, LayerConf):
+        validate_layer_conf(inner)
+
+
+class MultiLayerNetwork:
+    def __init__(self, conf: MultiLayerConfiguration):
+        self.conf = conf
+        self.layers = conf.layers
+        self.params: Optional[dict] = None
+        self.state: Optional[dict] = None
+        self.opt_state = None
+        self.listeners: List = []
+        self.iteration_count = 0
+        self.epoch_count = 0
+        self._score: Optional[float] = None
+        self._rnn_carries: Dict[str, Any] = {}
+        self._param_dtype = jnp.dtype(conf.dtype)
+        self._compute_dtype = jnp.dtype(conf.compute_dtype or conf.dtype)
+        self._input_types: Optional[List[InputType]] = None
+        self._tx = None
+        self._train_step = None
+        self._output_fn = None
+
+    # ------------------------------------------------------------ plumbing
+    def set_listeners(self, *listeners):
+        self.listeners = list(listeners)
+        return self
+
+    def add_listeners(self, *listeners):
+        self.listeners.extend(listeners)
+        return self
+
+    def _resolve_types(self) -> List[InputType]:
+        """Per-layer input InputTypes (pre-preprocessor), following DL4J's
+        setInputType chain."""
+        if self.conf.input_type is None:
+            raise ValueError("MultiLayerConfiguration.input_type must be set "
+                             "(InputType.feed_forward/convolutional/recurrent)")
+        types = []
+        cur = self.conf.input_type
+        for layer in self.layers:
+            need = _required_kind(layer)
+            if need is not None and cur.kind != need:
+                cur = preprocessed_type(cur, need)
+            types.append(cur)
+            cur = layer.output_type(cur)
+        self._output_type = cur
+        return types
+
+    def init(self, seed: Optional[int] = None):
+        """Initialize parameters and optimizer state (DL4J init(), :549)."""
+        seed = self.conf.seed if seed is None else seed
+        key = jax.random.PRNGKey(seed)
+        for layer in self.layers:
+            validate_layer_conf(layer)
+        self._input_types = self._resolve_types()
+        params: Dict[str, dict] = {}
+        state: Dict[str, dict] = {}
+        for i, layer in enumerate(self.layers):
+            key, sub = jax.random.split(key)
+            p, s = layer.init(sub, self._input_types[i], self._param_dtype)
+            params[str(i)] = p
+            state[str(i)] = s
+        self.params = params
+        self.state = state
+        self._build_optimizer()
+        return self
+
+    def _label_params(self):
+        """Per-layer updater labels for optax.multi_transform (per-layer
+        updater overrides + FrozenLayer -> NoOp, DL4J UpdaterBlock grouping)."""
+        labels = {}
+        transforms = {"__global__": build_optimizer(
+            self.conf.updater, self.conf.grad_clip_norm, self.conf.grad_clip_value)}
+        any_override = False
+        for i, layer in enumerate(self.layers):
+            lab = "__global__"
+            if layer.frozen or type(layer).__name__ == "FrozenLayerWrapper":
+                lab = "__noop__"
+                transforms.setdefault("__noop__", NoOp().to_optax())
+                any_override = True
+            elif layer.updater is not None:
+                lab = f"layer_{i}"
+                transforms[lab] = build_optimizer(
+                    layer.updater, self.conf.grad_clip_norm, self.conf.grad_clip_value)
+                any_override = True
+            labels[str(i)] = jax.tree_util.tree_map(lambda _: lab, self.params[str(i)])
+        return any_override, labels, transforms
+
+    def _build_optimizer(self):
+        any_override, labels, transforms = self._label_params()
+        if any_override:
+            self._tx = optax.multi_transform(transforms, labels)
+        else:
+            self._tx = transforms["__global__"]
+        self.opt_state = self._tx.init(self.params)
+        self._train_step = None     # force re-trace
+
+    # ------------------------------------------------------------- forward
+    def _cast_params(self, params):
+        if self._compute_dtype == self._param_dtype:
+            return params
+        def cast(a):
+            if jnp.issubdtype(a.dtype, jnp.floating):
+                return a.astype(self._compute_dtype)
+            return a
+        return jax.tree_util.tree_map(cast, params)
+
+    def _forward(self, params, state, x, train, rng, fmask=None,
+                 carries=None, collect=False, upto: Optional[int] = None):
+        """Forward through layers [0, upto) with auto preprocessors
+        (upto=None -> all layers).
+
+        When `upto` cuts before the output head, the returned activation is
+        additionally preprocessed into the head's required kind, ready for
+        head.score(). Returns (activations list if collect else final
+        activation, new_state, new_carries)."""
+        if self._input_types is None:
+            self._input_types = self._resolve_types()
+        params = self._cast_params(params)
+        x = _as_jnp(x, self._compute_dtype)
+        cur_type = self.conf.input_type
+        n = len(self.layers) if upto is None else upto
+        new_state = dict(state)
+        new_carries = {}
+        acts = []
+        for i, layer in enumerate(self.layers[:n]):
+            need = _required_kind(layer)
+            if need is not None and cur_type.kind != need:
+                x = preprocess_forward(cur_type, need, x)
+                cur_type = preprocessed_type(cur_type, need)
+            sub_rng = None
+            if rng is not None:
+                rng, sub_rng = jax.random.split(rng)
+            mask = fmask if cur_type.kind == Kind.RNN else None
+            key = str(i)
+            if carries is not None and type(layer).__name__ in _RECURRENT_CLASSES:
+                y, carry = layer.apply_seq(params[key], x, carries.get(key),
+                                           train=train, rng=sub_rng, mask=mask)
+                new_carries[key] = carry
+                new_state[key] = state[key]
+            else:
+                y, s = layer.apply(params[key], state[key], x, train=train,
+                                   rng=sub_rng, mask=mask)
+                new_state[key] = s
+            x = y
+            cur_type = layer.output_type(cur_type)
+            if collect:
+                acts.append(x)
+        if upto is not None and upto < len(self.layers):
+            head = self.layers[upto]
+            need = _required_kind(head)
+            if need is not None and cur_type.kind != need:
+                x = preprocess_forward(cur_type, need, x)
+        return (acts if collect else x), new_state, new_carries
+
+    def _score_fn(self, params, state, x, y, fmask, lmask, train, rng,
+                  carries=None):
+        """Loss on a batch: last-layer score + regularization
+        (computeGradientAndScore, MultiLayerNetwork.java:2360)."""
+        if not self.layers or not hasattr(self.layers[-1], "score"):
+            raise ValueError("Last layer must be an output/loss layer with a "
+                             "score() method to compute training loss")
+        params_c = self._cast_params(params)
+        # forward up to (but excluding) the output layer
+        head = self.layers[-1]
+        feat, new_state, new_carries = self._forward(
+            params_c, state, x, train, rng, fmask, carries,
+            upto=len(self.layers) - 1)
+        out_mask = lmask if lmask is not None else (
+            fmask if _required_kind(head) == Kind.RNN else None)
+        loss = head.score(params_c[str(len(self.layers) - 1)], feat,
+                          _as_jnp(y, self._compute_dtype), train=train,
+                          rng=None, mask=out_mask)
+        reg = jnp.asarray(0.0, jnp.float32)
+        for i, layer in enumerate(self.layers):
+            reg = reg + layer.regularization_score(params[str(i)])
+        return loss.astype(jnp.float32) + reg, (new_state, new_carries)
+
+    # -------------------------------------------------------------- output
+    def output(self, x, train: bool = False):
+        """Inference (DL4J output(), :2012-2112). jit-compiled and cached."""
+        if self.params is None:
+            raise RuntimeError("Network is not initialized — call init() first")
+        if self._output_fn is None:
+            @jax.jit
+            def _out(params, state, x):
+                y, _, _ = self._forward(params, state, x, False, None)
+                return y
+            self._output_fn = _out
+        return self._output_fn(self.params, self.state, _as_jnp(x, self._compute_dtype))
+
+    def feed_forward(self, x, train: bool = False, rng=None):
+        """All layer activations (DL4J feedForward(), :885-1071).
+        With train=True and no rng given, a fresh dropout key is drawn per
+        call (so repeated calls do not reuse one mask)."""
+        if train and rng is None:
+            self._ff_counter = getattr(self, "_ff_counter", 0) + 1
+            rng = jax.random.fold_in(
+                jax.random.PRNGKey(self.conf.seed + 15485863), self._ff_counter)
+        acts, _, _ = self._forward(self.params, self.state, x, train,
+                                   rng if train else None, collect=True)
+        return acts
+
+    # ----------------------------------------------------------------- fit
+    def _make_train_step(self, with_fmask, with_lmask, with_carries):
+        tx = self._tx
+
+        def step(params, opt_state, state, x, y, fmask, lmask, rng, carries):
+            def loss_fn(p):
+                return self._score_fn(p, state, x, y, fmask, lmask, True, rng,
+                                      carries=carries)
+            (loss, (new_state, new_carries)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            updates, new_opt = tx.update(grads, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+            return new_params, new_opt, new_state, loss, new_carries
+
+        return jax.jit(step, donate_argnums=(0, 1, 2))
+
+    def _get_train_step(self, fmask, lmask, carries):
+        sig = (fmask is not None, lmask is not None, carries is not None)
+        if self._train_step is None:
+            self._train_step = {}
+        if sig not in self._train_step:
+            self._train_step[sig] = self._make_train_step(*sig)
+        return self._train_step[sig]
+
+    def fit(self, data, epochs: int = 1, batch_size: int = 32):
+        """Train (DL4J fit(DataSetIterator), :1268). Accepts a DataSetIterator,
+        a DataSet, or (features, labels) arrays."""
+        if self.params is None:
+            self.init()
+        iterator = self._as_iterator(data, batch_size)
+        for _ in range(epochs):
+            for lst in self.listeners:
+                lst.on_epoch_start(self, self.epoch_count)
+            if self.conf.backprop_type == "tbptt":
+                self._fit_epoch_tbptt(iterator)
+            else:
+                self._fit_epoch(iterator)
+            for lst in self.listeners:
+                lst.on_epoch_end(self, self.epoch_count)
+            self.epoch_count += 1
+            iterator.reset()
+        return self
+
+    def _as_iterator(self, data, batch_size) -> DataSetIterator:
+        if isinstance(data, DataSetIterator):
+            return data
+        if isinstance(data, DataSet):
+            from deeplearning4j_tpu.data.iterator import ExistingDataSetIterator
+            return ExistingDataSetIterator([data])
+        if isinstance(data, (tuple, list)) and len(data) == 2:
+            return ArrayDataSetIterator(data[0], data[1], batch_size=batch_size)
+        raise ValueError(f"Cannot interpret training data: {type(data)}")
+
+    def _fit_epoch(self, iterator):
+        etl_start = time.perf_counter()
+        rng = jax.random.PRNGKey(self.conf.seed + 7919 * (self.epoch_count + 1))
+        for ds in iterator:
+            etl_ms = (time.perf_counter() - etl_start) * 1e3
+            rng, sub = jax.random.split(rng)
+            step = self._get_train_step(ds.features_mask, ds.labels_mask, None)
+            self.params, self.opt_state, self.state, loss, _ = step(
+                self.params, self.opt_state, self.state,
+                _as_jnp(ds.features, self._compute_dtype),
+                _as_jnp(ds.labels, self._compute_dtype),
+                _as_jnp(ds.features_mask), _as_jnp(ds.labels_mask), sub, None)
+            self._score = float(loss)
+            bs = int(np.shape(ds.features)[0])
+            for lst in self.listeners:
+                lst.iteration_done(self, self.iteration_count,
+                                   self.epoch_count, self._score, etl_ms, bs)
+            self.iteration_count += 1
+            etl_start = time.perf_counter()
+
+    def _fit_epoch_tbptt(self, iterator):
+        """Truncated BPTT: chunk the time axis, carry RNN state across chunks,
+        stop gradients at chunk boundaries (doTruncatedBPTT, :1315-1317)."""
+        fwd = self.conf.tbptt_fwd_length
+        rng = jax.random.PRNGKey(self.conf.seed + 104729 * (self.epoch_count + 1))
+        for ds in iterator:
+            T = ds.features.shape[1]
+            carries = {}
+            for t0 in range(0, T, fwd):
+                t1 = min(t0 + fwd, T)
+                x = ds.features[:, t0:t1]
+                y = ds.labels[:, t0:t1] if ds.labels is not None and ds.labels.ndim >= 3 else ds.labels
+                fm = ds.features_mask[:, t0:t1] if ds.features_mask is not None else None
+                lm = ds.labels_mask[:, t0:t1] if ds.labels_mask is not None else None
+                rng, sub = jax.random.split(rng)
+                step = self._get_train_step(fm, lm, carries)
+                self.params, self.opt_state, self.state, loss, new_carries = step(
+                    self.params, self.opt_state, self.state,
+                    _as_jnp(x, self._compute_dtype),
+                    _as_jnp(y, self._compute_dtype),
+                    _as_jnp(fm), _as_jnp(lm), sub, carries)
+                # stop gradient across chunk boundary
+                carries = jax.tree_util.tree_map(jax.lax.stop_gradient, new_carries)
+                self._score = float(loss)
+                for lst in self.listeners:
+                    lst.iteration_done(self, self.iteration_count,
+                                       self.epoch_count, self._score, 0.0,
+                                       int(np.shape(x)[0]))
+                self.iteration_count += 1
+
+    # ------------------------------------------------------------- scoring
+    def score(self, dataset: Optional[DataSet] = None) -> float:
+        """Last training score, or score on a given DataSet (DL4J score())."""
+        if dataset is None:
+            return self._score if self._score is not None else float("nan")
+        loss, _ = self._score_fn(self.params, self.state,
+                                 _as_jnp(dataset.features, self._compute_dtype),
+                                 _as_jnp(dataset.labels, self._compute_dtype),
+                                 _as_jnp(dataset.features_mask),
+                                 _as_jnp(dataset.labels_mask), False, None)
+        return float(loss)
+
+    def evaluate(self, data, batch_size: int = 32):
+        """Classification evaluation (DL4J evaluate(DataSetIterator))."""
+        from deeplearning4j_tpu.eval.evaluation import Evaluation
+        iterator = self._as_iterator(data, batch_size)
+        ev = Evaluation()
+        for ds in iterator:
+            preds = np.asarray(self.output(ds.features))
+            ev.eval(np.asarray(ds.labels), preds,
+                    mask=None if ds.labels_mask is None else np.asarray(ds.labels_mask))
+        iterator.reset()
+        return ev
+
+    def evaluate_regression(self, data, batch_size: int = 32):
+        from deeplearning4j_tpu.eval.regression import RegressionEvaluation
+        iterator = self._as_iterator(data, batch_size)
+        ev = RegressionEvaluation()
+        for ds in iterator:
+            preds = np.asarray(self.output(ds.features))
+            ev.eval(np.asarray(ds.labels), preds)
+        iterator.reset()
+        return ev
+
+    # ----------------------------------------------------- recurrent state
+    def rnn_time_step(self, x):
+        """Stateful single/multi-step streaming inference
+        (DL4J rnnTimeStep, MultiLayerNetwork.java:2806). x: (B, F) one step or
+        (B, T, F) several steps; recurrent layer state persists across calls."""
+        x = _as_jnp(x, self._compute_dtype)
+        single = x.ndim == 2
+        if single:
+            x = x[:, None, :]
+        y, _, new_carries = self._forward(self.params, self.state, x, False,
+                                          None, carries=self._rnn_carries)
+        self._rnn_carries = new_carries
+        return y[:, -1, :] if single and y.ndim == 3 else y
+
+    def rnn_clear_previous_state(self):
+        self._rnn_carries = {}
+
+    # ------------------------------------------------------------ params
+    def num_params(self) -> int:
+        return param_util.num_params(self.params)
+
+    def params_flat(self):
+        """Canonical flat parameter vector (DL4J's flattenedParams view)."""
+        return param_util.params_to_flat(self.params)
+
+    def set_params_flat(self, flat):
+        self.params = param_util.flat_to_params(flat, self.params)
+
+    def copy(self) -> "MultiLayerNetwork":
+        clone = MultiLayerNetwork(self.conf)
+        if self.params is not None:
+            clone._input_types = self._resolve_types()
+            clone.params = jax.tree_util.tree_map(lambda a: a, self.params)
+            clone.state = jax.tree_util.tree_map(lambda a: a, self.state)
+            clone._build_optimizer()
+        return clone
+
+

@@ -1,0 +1,164 @@
+"""Training listeners — the observability callback bus.
+
+Parity with DL4J's TrainingListener/IterationListener framework
+(deeplearning4j-nn/.../optimize/api/ + optimize/listeners/):
+- ScoreIterationListener          (prints score every N iterations)
+- PerformanceListener             (samples/sec, batches/sec, ETL time;
+                                   PerformanceListener.java:22-87)
+- CollectScoresIterationListener  (score history collection)
+- TimeIterationListener           (ETA logging)
+- EvaluativeListener              (periodic held-out evaluation)
+- CheckpointListener              (periodic checkpoints w/ keepLast(n);
+                                   checkpoint/CheckpointListener.java:72-144)
+"""
+from __future__ import annotations
+
+import logging
+import os
+import time
+from typing import Callable, List, Optional
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+
+class TrainingListener:
+    def on_epoch_start(self, model, epoch: int):
+        pass
+
+    def on_epoch_end(self, model, epoch: int):
+        pass
+
+    def iteration_done(self, model, iteration: int, epoch: int,
+                       score: float, etl_ms: float = 0.0,
+                       batch_size: int = 0):
+        pass
+
+
+class ScoreIterationListener(TrainingListener):
+    def __init__(self, print_iterations: int = 10):
+        self.n = max(int(print_iterations), 1)
+
+    def iteration_done(self, model, iteration, epoch, score, etl_ms=0.0,
+                       batch_size=0):
+        if iteration % self.n == 0:
+            log.info("Score at iteration %d is %s", iteration, score)
+
+
+class PerformanceListener(TrainingListener):
+    """Reports throughput per iteration (DL4J PerformanceListener.java:22-87)."""
+
+    def __init__(self, frequency: int = 1, report: bool = True):
+        self.frequency = max(int(frequency), 1)
+        self.report = report
+        self._last_time: Optional[float] = None
+        self.history: List[dict] = []
+
+    def iteration_done(self, model, iteration, epoch, score, etl_ms=0.0,
+                       batch_size=0):
+        now = time.perf_counter()
+        if self._last_time is not None and iteration % self.frequency == 0:
+            dt = now - self._last_time
+            rec = {
+                "iteration": iteration,
+                "batches_per_sec": 1.0 / dt if dt > 0 else float("inf"),
+                "samples_per_sec": batch_size / dt if dt > 0 else float("inf"),
+                "etl_ms": etl_ms,
+                "iteration_ms": dt * 1e3,
+            }
+            self.history.append(rec)
+            if self.report:
+                log.info("ETL: %.0f ms; iteration %d; iteration time: %.1f ms; "
+                         "samples/sec: %.1f; batches/sec: %.2f",
+                         etl_ms, iteration, rec["iteration_ms"],
+                         rec["samples_per_sec"], rec["batches_per_sec"])
+        self._last_time = now
+
+
+class CollectScoresIterationListener(TrainingListener):
+    def __init__(self, frequency: int = 1):
+        self.frequency = max(int(frequency), 1)
+        self.scores: List[tuple] = []
+
+    def iteration_done(self, model, iteration, epoch, score, etl_ms=0.0,
+                       batch_size=0):
+        if iteration % self.frequency == 0:
+            self.scores.append((iteration, float(score)))
+
+
+class TimeIterationListener(TrainingListener):
+    """Logs remaining-time estimate (DL4J TimeIterationListener)."""
+
+    def __init__(self, total_iterations: int, frequency: int = 50):
+        self.total = total_iterations
+        self.frequency = max(int(frequency), 1)
+        self._start: Optional[float] = None
+
+    def iteration_done(self, model, iteration, epoch, score, etl_ms=0.0,
+                       batch_size=0):
+        if self._start is None:
+            self._start = time.perf_counter()
+            return
+        if iteration % self.frequency == 0 and iteration > 0:
+            elapsed = time.perf_counter() - self._start
+            rate = elapsed / iteration
+            remaining = (self.total - iteration) * rate
+            log.info("Remaining time estimate: %.1f s (iteration %d/%d)",
+                     remaining, iteration, self.total)
+
+
+class EvaluativeListener(TrainingListener):
+    """Periodic evaluation on a held-out iterator (DL4J EvaluativeListener)."""
+
+    def __init__(self, iterator, frequency: int = 1, unit: str = "epoch"):
+        self.iterator = iterator
+        self.frequency = max(int(frequency), 1)
+        self.unit = unit
+        self.results: List[tuple] = []
+
+    def iteration_done(self, model, iteration, epoch, score, etl_ms=0.0,
+                       batch_size=0):
+        if self.unit == "iteration" and iteration % self.frequency == 0:
+            self._evaluate(model, iteration)
+
+    def on_epoch_end(self, model, epoch):
+        if self.unit == "epoch" and (epoch + 1) % self.frequency == 0:
+            self._evaluate(model, epoch)
+
+    def _evaluate(self, model, at):
+        ev = model.evaluate(self.iterator)
+        self.results.append((at, ev))
+        log.info("Evaluation at %s %d: accuracy=%.4f", self.unit, at, ev.accuracy())
+
+
+class CheckpointListener(TrainingListener):
+    """Periodic checkpoint saver with retention policy
+    (DL4J checkpoint/CheckpointListener.java:46-144: saveEveryNIterations /
+    saveEveryNEpochs + keepLast)."""
+
+    def __init__(self, directory: str, save_every_n_iterations: Optional[int] = None,
+                 save_every_n_epochs: Optional[int] = None, keep_last: int = 3):
+        self.dir = directory
+        self.every_iter = save_every_n_iterations
+        self.every_epoch = save_every_n_epochs
+        self.keep_last = keep_last
+        self._saved: List[str] = []
+        os.makedirs(directory, exist_ok=True)
+
+    def _save(self, model, tag: str):
+        from deeplearning4j_tpu.util.serialization import save_model
+        path = os.path.join(self.dir, f"checkpoint_{tag}.zip")
+        save_model(model, path)
+        self._saved.append(path)
+        while len(self._saved) > self.keep_last:
+            old = self._saved.pop(0)
+            if os.path.exists(old):
+                os.remove(old)
+
+    def iteration_done(self, model, iteration, epoch, score, etl_ms=0.0,
+                       batch_size=0):
+        if self.every_iter and iteration > 0 and iteration % self.every_iter == 0:
+            self._save(model, f"iter_{iteration}")
+
+    def on_epoch_end(self, model, epoch):
+        if self.every_epoch and (epoch + 1) % self.every_epoch == 0:
+            self._save(model, f"epoch_{epoch}")

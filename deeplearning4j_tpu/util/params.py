@@ -1,0 +1,80 @@
+"""Canonical flat parameter view.
+
+DL4J stores all network parameters as ONE flat buffer with per-layer views
+(MultiLayerNetwork.java:114,603-627) — enabling whole-model averaging,
+encoding, and serialization as single-array ops. Here params are a pytree;
+these helpers provide the equivalent canonical flattening (deterministic
+order: layer key sorted numerically, then param name lexicographically),
+used by checkpointing, parameter averaging, and transfer learning.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _sorted_items(tree: dict):
+    def keyfn(k):
+        try:
+            return (0, int(k), "")
+        except (TypeError, ValueError):
+            return (1, 0, str(k))
+    return sorted(tree.items(), key=lambda kv: keyfn(kv[0]))
+
+
+def iter_leaves(tree, prefix=()):
+    """Deterministic (path, leaf) iteration."""
+    if isinstance(tree, dict):
+        for k, v in _sorted_items(tree):
+            yield from iter_leaves(v, prefix + (str(k),))
+    elif isinstance(tree, (list, tuple)):
+        for i, v in enumerate(tree):
+            yield from iter_leaves(v, prefix + (str(i),))
+    elif tree is not None:
+        yield prefix, tree
+
+
+def num_params(tree) -> int:
+    return int(sum(np.prod(leaf.shape) for _, leaf in iter_leaves(tree)))
+
+
+def params_to_flat(tree) -> jnp.ndarray:
+    """Flatten a param pytree to one 1-D vector in canonical order."""
+    leaves = [jnp.ravel(leaf) for _, leaf in iter_leaves(tree)]
+    if not leaves:
+        return jnp.zeros((0,), jnp.float32)
+    return jnp.concatenate(leaves)
+
+
+def flat_to_params(flat, template):
+    """Inverse of params_to_flat given a template pytree with shapes/dtypes."""
+    rebuilt = _clone_structure(template)
+    offset = 0
+
+    def assign(tree, path, value):
+        node = tree
+        for p in path[:-1]:
+            node = node[int(p)] if isinstance(node, list) else node[p]
+        last = path[-1]
+        if isinstance(node, list):
+            node[int(last)] = value
+        else:
+            node[last] = value
+
+    for path, leaf in iter_leaves(template):
+        size = int(np.prod(leaf.shape))
+        chunk = flat[offset:offset + size].reshape(leaf.shape).astype(leaf.dtype)
+        assign(rebuilt, path, chunk)
+        offset += size
+    return rebuilt
+
+
+def _clone_structure(tree):
+    if isinstance(tree, dict):
+        return {k: _clone_structure(v) for k, v in tree.items()}
+    if isinstance(tree, (list, tuple)):
+        return [_clone_structure(v) for v in tree]
+    return None

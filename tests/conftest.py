@@ -1,0 +1,17 @@
+"""Test configuration: force an 8-device virtual CPU mesh BEFORE jax import.
+
+This mirrors the reference's strategy of testing distributed code without a
+real cluster (SURVEY.md §4: Spark local[N] masters) — multi-chip sharding
+logic runs on 8 virtual CPU devices; the driver separately dry-runs the
+multi-chip path, and bench.py runs on real TPU.
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", False)

@@ -1,0 +1,234 @@
+"""Per-layer behavior tests: shape inference matches actual forward shapes,
+basic semantics (masking, pooling values, BN statistics)."""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.conf.base import InputType, Kind, layer_from_dict, layer_to_dict
+from deeplearning4j_tpu.nn.layers import (
+    LSTM, ActivationLayer, AutoEncoder, BatchNormalization, Bidirectional,
+    Convolution1DLayer, ConvolutionLayer, Cropping2D, Deconvolution2D,
+    DenseLayer, DepthwiseConvolution2D, DropoutLayer, EmbeddingLayer,
+    GlobalPoolingLayer, GravesLSTM, LastTimeStep, LocalResponseNormalization,
+    LossLayer, OutputLayer, RnnOutputLayer, SeparableConvolution2D,
+    SimpleRnn, SpaceToDepthLayer, SubsamplingLayer, Upsampling2D,
+    VariationalAutoencoder, ZeroPaddingLayer,
+)
+
+KEY = jax.random.PRNGKey(0)
+
+
+def run_layer(layer, input_type, batch=2, train=False, rng=None, mask=None,
+              x=None):
+    params, state = layer.init(KEY, input_type)
+    if x is None:
+        x = jax.random.normal(jax.random.PRNGKey(1), (batch,) + input_type.shape)
+    y, new_state = layer.apply(params, state, x, train=train, rng=rng, mask=mask)
+    return y, params, new_state
+
+
+FF_CASES = [
+    (DenseLayer(n_out=16, activation="relu"), InputType.feed_forward(8)),
+    (OutputLayer(n_out=5), InputType.feed_forward(8)),
+    (ActivationLayer(activation="tanh"), InputType.feed_forward(8)),
+    (AutoEncoder(n_out=4), InputType.feed_forward(8)),
+    (VariationalAutoencoder(n_out=3, encoder_layer_sizes=(8,),
+                            decoder_layer_sizes=(8,)), InputType.feed_forward(6)),
+]
+
+CNN_CASES = [
+    (ConvolutionLayer(n_out=4, kernel=(3, 3), convolution_mode="same"),
+     InputType.convolutional(8, 8, 2)),
+    (ConvolutionLayer(n_out=4, kernel=(3, 3), stride=(2, 2),
+                      convolution_mode="truncate"),
+     InputType.convolutional(9, 9, 2)),
+    (ConvolutionLayer(n_out=4, kernel=(3, 3), dilation=(2, 2),
+                      convolution_mode="same"), InputType.convolutional(8, 8, 2)),
+    (Deconvolution2D(n_out=3, kernel=(2, 2), stride=(2, 2),
+                     convolution_mode="same"), InputType.convolutional(4, 4, 2)),
+    (SeparableConvolution2D(n_out=6, kernel=(3, 3), convolution_mode="same"),
+     InputType.convolutional(8, 8, 4)),
+    (DepthwiseConvolution2D(depth_multiplier=2, kernel=(3, 3),
+                            convolution_mode="same"),
+     InputType.convolutional(8, 8, 3)),
+    (SubsamplingLayer(kernel=(2, 2), stride=(2, 2)),
+     InputType.convolutional(8, 8, 3)),
+    (SubsamplingLayer(kernel=(2, 2), stride=(2, 2), pooling_type="avg"),
+     InputType.convolutional(8, 8, 3)),
+    (Upsampling2D(size=(2, 2)), InputType.convolutional(4, 4, 3)),
+    (ZeroPaddingLayer(padding=(1, 2, 3, 4)), InputType.convolutional(8, 8, 2)),
+    (Cropping2D(cropping=(1, 1, 2, 2)), InputType.convolutional(8, 8, 2)),
+    (SpaceToDepthLayer(block_size=2), InputType.convolutional(8, 8, 3)),
+    (LocalResponseNormalization(), InputType.convolutional(6, 6, 8)),
+    (BatchNormalization(), InputType.convolutional(6, 6, 4)),
+]
+
+RNN_CASES = [
+    (LSTM(n_out=12), InputType.recurrent(5, 7)),
+    (GravesLSTM(n_out=12), InputType.recurrent(5, 7)),
+    (SimpleRnn(n_out=6), InputType.recurrent(5, 7)),
+    (Bidirectional(layer=LSTM(n_out=4)), InputType.recurrent(5, 7)),
+    (RnnOutputLayer(n_out=9), InputType.recurrent(5, 7)),
+    (Convolution1DLayer(n_out=6, kernel=3), InputType.recurrent(5, 7)),
+]
+
+
+@pytest.mark.parametrize("layer,itype", FF_CASES + CNN_CASES + RNN_CASES,
+                         ids=lambda v: type(v).__name__ if hasattr(v, "apply")
+                         else str(v.shape))
+def test_shape_inference_matches_forward(layer, itype):
+    out_t = layer.output_type(itype)
+    y, _, _ = run_layer(layer, itype, batch=2)
+    assert y.shape == (2,) + out_t.shape, \
+        f"{type(layer).__name__}: inferred {out_t.shape}, got {y.shape[1:]}"
+    assert jnp.all(jnp.isfinite(y))
+
+
+@pytest.mark.parametrize("layer,itype", FF_CASES + CNN_CASES + RNN_CASES,
+                         ids=lambda v: type(v).__name__ if hasattr(v, "apply")
+                         else str(v.shape))
+def test_serde_roundtrip(layer, itype):
+    d = layer_to_dict(layer)
+    back = layer_from_dict(d)
+    assert back == layer
+
+
+class TestMaxPoolValues:
+    def test_known(self):
+        x = jnp.arange(16.0).reshape(1, 4, 4, 1)
+        layer = SubsamplingLayer(kernel=(2, 2), stride=(2, 2))
+        y, _, _ = run_layer(layer, InputType.convolutional(4, 4, 1), x=x)
+        np.testing.assert_allclose(y[0, :, :, 0], [[5, 7], [13, 15]])
+
+    def test_avg(self):
+        x = jnp.arange(16.0).reshape(1, 4, 4, 1)
+        layer = SubsamplingLayer(kernel=(2, 2), stride=(2, 2), pooling_type="avg")
+        y, _, _ = run_layer(layer, InputType.convolutional(4, 4, 1), x=x)
+        np.testing.assert_allclose(y[0, :, :, 0], [[2.5, 4.5], [10.5, 12.5]])
+
+
+class TestBatchNorm:
+    def test_train_normalizes(self):
+        layer = BatchNormalization()
+        itype = InputType.feed_forward(4)
+        x = jax.random.normal(jax.random.PRNGKey(2), (256, 4)) * 5 + 3
+        params, state = layer.init(KEY, itype)
+        y, new_state = layer.apply(params, state, x, train=True)
+        np.testing.assert_allclose(jnp.mean(y, axis=0), jnp.zeros(4), atol=1e-4)
+        np.testing.assert_allclose(jnp.std(y, axis=0), jnp.ones(4), atol=1e-2)
+        # running stats moved toward batch stats
+        assert float(jnp.max(jnp.abs(new_state["mean"]))) > 0
+
+    def test_inference_uses_running_stats(self):
+        layer = BatchNormalization(decay=0.0)   # running = batch stats directly
+        itype = InputType.feed_forward(4)
+        x = jax.random.normal(jax.random.PRNGKey(2), (256, 4)) * 5 + 3
+        params, state = layer.init(KEY, itype)
+        _, state1 = layer.apply(params, state, x, train=True)
+        y, _ = layer.apply(params, state1, x, train=False)
+        np.testing.assert_allclose(jnp.mean(y, axis=0), jnp.zeros(4), atol=1e-2)
+
+
+class TestRecurrentSemantics:
+    def test_mask_stops_state(self):
+        """Masked steps must output zeros and zero the cell state
+        (DL4J LSTMHelpers.java:355-357 semantics)."""
+        layer = LSTM(n_out=4)
+        itype = InputType.recurrent(3, 6)
+        params, state = layer.init(KEY, itype)
+        x = jax.random.normal(jax.random.PRNGKey(5), (2, 6, 3))
+        mask = jnp.array([[1, 1, 1, 0, 0, 0], [1, 1, 1, 1, 1, 1]], jnp.float32)
+        y, _ = layer.apply(params, state, x, mask=mask)
+        np.testing.assert_allclose(y[0, 3:], jnp.zeros((3, 4)), atol=1e-6)
+        assert float(jnp.max(jnp.abs(y[1, 3:]))) > 0
+
+    def test_rnn_step_matches_full_forward(self):
+        """Streaming rnn_step must reproduce the full-sequence forward
+        (rnnTimeStep contract, MultiLayerNetwork.java:2806)."""
+        layer = GravesLSTM(n_out=5)
+        itype = InputType.recurrent(4, 8)
+        params, state = layer.init(KEY, itype)
+        x = jax.random.normal(jax.random.PRNGKey(6), (3, 8, 4))
+        full, _ = layer.apply(params, state, x)
+        carry = None
+        for t in range(8):
+            step_out, carry = layer.rnn_step(params, x[:, t, :], carry)
+            np.testing.assert_allclose(step_out, full[:, t, :], rtol=1e-5,
+                                       atol=1e-5)
+
+    def test_apply_seq_chunks_match_full(self):
+        """tBPTT chunking must equal the unchunked forward."""
+        layer = LSTM(n_out=4)
+        itype = InputType.recurrent(3, 8)
+        params, state = layer.init(KEY, itype)
+        x = jax.random.normal(jax.random.PRNGKey(7), (2, 8, 3))
+        full, _ = layer.apply(params, state, x)
+        y1, carry = layer.apply_seq(params, x[:, :4], None)
+        y2, _ = layer.apply_seq(params, x[:, 4:], carry)
+        chunked = jnp.concatenate([y1, y2], axis=1)
+        np.testing.assert_allclose(chunked, full, rtol=1e-5, atol=1e-5)
+
+    def test_bidirectional_concat_width(self):
+        layer = Bidirectional(layer=LSTM(n_out=4), mode="concat")
+        y, _, _ = run_layer(layer, InputType.recurrent(3, 6))
+        assert y.shape == (2, 6, 8)
+
+    def test_last_time_step_mask(self):
+        inner = SimpleRnn(n_out=3)
+        layer = LastTimeStep(layer=inner)
+        itype = InputType.recurrent(2, 5)
+        params, state = layer.init(KEY, itype)
+        x = jax.random.normal(jax.random.PRNGKey(8), (2, 5, 2))
+        mask = jnp.array([[1, 1, 0, 0, 0], [1, 1, 1, 1, 1]], jnp.float32)
+        y, _ = layer.apply(params, state, x, mask=mask)
+        full, _ = inner.apply(params, {}, x, mask=mask)
+        np.testing.assert_allclose(y[0], full[0, 1], rtol=1e-5)
+        np.testing.assert_allclose(y[1], full[1, 4], rtol=1e-5)
+
+
+class TestEmbedding:
+    def test_lookup(self):
+        layer = EmbeddingLayer(n_in=10, n_out=4)
+        params, state = layer.init(KEY, InputType.feed_forward(10))
+        idx = jnp.array([0, 3, 9])
+        y, _ = layer.apply(params, state, idx)
+        np.testing.assert_allclose(y, params["W"][jnp.array([0, 3, 9])])
+
+
+class TestDropout:
+    def test_train_vs_inference(self):
+        layer = DropoutLayer(dropout=0.5)
+        x = jnp.ones((4, 100))
+        y_inf, _ = layer.apply({}, {}, x, train=False)
+        np.testing.assert_allclose(y_inf, x)
+        y_tr, _ = layer.apply({}, {}, x, train=True, rng=jax.random.PRNGKey(0))
+        frac_zero = float(jnp.mean(y_tr == 0))
+        assert 0.3 < frac_zero < 0.7
+        # inverted scaling preserves expectation
+        assert abs(float(jnp.mean(y_tr)) - 1.0) < 0.1
+
+
+class TestGlobalPooling:
+    def test_rnn_masked_avg(self):
+        layer = GlobalPoolingLayer(pooling_type="avg")
+        x = jnp.stack([jnp.ones((4, 3)), 2 * jnp.ones((4, 3))])
+        mask = jnp.array([[1, 1, 0, 0], [1, 1, 1, 1]], jnp.float32)
+        y, _ = layer.apply({}, {}, x, mask=mask)
+        np.testing.assert_allclose(y[0], jnp.ones(3))
+        np.testing.assert_allclose(y[1], 2 * jnp.ones(3))
+
+
+class TestVAE:
+    def test_pretrain_score_finite_and_differentiable(self):
+        layer = VariationalAutoencoder(n_out=3, encoder_layer_sizes=(8,),
+                                       decoder_layer_sizes=(8,))
+        params, _ = layer.init(KEY, InputType.feed_forward(6))
+        x = jax.random.normal(jax.random.PRNGKey(9), (10, 6))
+        score = layer.pretrain_score(params, x, jax.random.PRNGKey(10))
+        assert jnp.isfinite(score)
+        grads = jax.grad(lambda p: layer.pretrain_score(p, x, jax.random.PRNGKey(10)))(params)
+        flat = jax.tree_util.tree_leaves(grads)
+        assert all(jnp.all(jnp.isfinite(g)) for g in flat)

@@ -1,0 +1,291 @@
+"""End-to-end MultiLayerNetwork tests: the minimum slice of SURVEY.md §7
+build order — config -> init -> fit -> eval -> serialize."""
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.data.iterator import ArrayDataSetIterator
+from deeplearning4j_tpu.nn.conf import (
+    InputType, MultiLayerConfiguration, NeuralNetConfiguration,
+)
+from deeplearning4j_tpu.nn.layers import (
+    LSTM, BatchNormalization, ConvolutionLayer, DenseLayer, OutputLayer,
+    RnnOutputLayer, SubsamplingLayer,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updaters import Adam, Nesterovs, Sgd
+from deeplearning4j_tpu.train.listeners import CollectScoresIterationListener
+from deeplearning4j_tpu.util.serialization import (
+    load_model, restore_multilayer_network, save_model,
+)
+
+
+def make_blobs(n=256, nc=3, nf=4, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(size=(nc, nf)) * 4
+    X, Y = [], []
+    for c in range(nc):
+        X.append(rng.normal(size=(n // nc, nf)) + centers[c])
+        y = np.zeros((n // nc, nc))
+        y[:, c] = 1
+        Y.append(y)
+    X = np.concatenate(X).astype(np.float32)
+    Y = np.concatenate(Y).astype(np.float32)
+    idx = rng.permutation(len(X))
+    return X[idx], Y[idx]
+
+
+def mlp_conf(nf=4, nc=3, updater=None):
+    return (NeuralNetConfiguration.Builder()
+            .seed(42)
+            .updater(updater or Adam(1e-2))
+            .list()
+            .layer(DenseLayer(n_out=32, activation="relu"))
+            .layer(DenseLayer(n_out=32, activation="relu"))
+            .layer(OutputLayer(n_out=nc, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(nf))
+            .build())
+
+
+class TestMLP:
+    def test_fit_reduces_score_and_learns(self):
+        X, Y = make_blobs()
+        net = MultiLayerNetwork(mlp_conf()).init()
+        scores = CollectScoresIterationListener()
+        net.set_listeners(scores)
+        net.fit(ArrayDataSetIterator(X, Y, batch_size=64), epochs=30)
+        first = scores.scores[0][1]
+        last = scores.scores[-1][1]
+        assert last < first * 0.5, f"loss did not drop: {first} -> {last}"
+        ev = net.evaluate(ArrayDataSetIterator(X, Y, batch_size=64))
+        assert ev.accuracy() > 0.9
+
+    def test_output_shape_and_softmax(self):
+        X, Y = make_blobs(n=30)
+        net = MultiLayerNetwork(mlp_conf()).init()
+        out = net.output(X)
+        assert out.shape == (30, 3)
+        np.testing.assert_allclose(np.sum(np.asarray(out), axis=1),
+                                   np.ones(30), rtol=1e-5)
+
+    def test_feed_forward_collects_all_activations(self):
+        X, _ = make_blobs(n=16)
+        net = MultiLayerNetwork(mlp_conf()).init()
+        acts = net.feed_forward(X[:4])
+        assert len(acts) == 3
+        assert acts[0].shape == (4, 32)
+        assert acts[-1].shape == (4, 3)
+
+    def test_num_params(self):
+        net = MultiLayerNetwork(mlp_conf()).init()
+        # 4*32+32 + 32*32+32 + 32*3+3 = 160 + 1056 + 99
+        assert net.num_params() == 4 * 32 + 32 + 32 * 32 + 32 + 32 * 3 + 3
+
+    def test_params_flat_roundtrip(self):
+        net = MultiLayerNetwork(mlp_conf()).init()
+        flat = net.params_flat()
+        assert flat.shape == (net.num_params(),)
+        X, _ = make_blobs(n=16)
+        before = np.asarray(net.output(X[:4]))
+        net.set_params_flat(flat)
+        after = np.asarray(net.output(X[:4]))
+        np.testing.assert_allclose(before, after, rtol=1e-6)
+
+    def test_l2_regularization_increases_score(self):
+        X, Y = make_blobs(n=64)
+        conf_plain = mlp_conf()
+        conf_l2 = (NeuralNetConfiguration.Builder().seed(42).updater(Adam(1e-2))
+                   .l2(0.1).list()
+                   .layer(DenseLayer(n_out=32, activation="relu"))
+                   .layer(DenseLayer(n_out=32, activation="relu"))
+                   .layer(OutputLayer(n_out=3))
+                   .set_input_type(InputType.feed_forward(4)).build())
+        ds = DataSet(X, Y)
+        n1 = MultiLayerNetwork(conf_plain).init()
+        n2 = MultiLayerNetwork(conf_l2).init()
+        assert n2.score(ds) > n1.score(ds)
+
+
+class TestCNN:
+    def test_lenet_slice_trains(self):
+        """Minimum end-to-end slice: LeNet-style CNN on synthetic 'MNIST'
+        (SURVEY.md §7 build order step 3; reference LeNet.java:83-95)."""
+        rng = np.random.default_rng(0)
+        n, nc = 128, 4
+        X = rng.normal(size=(n, 12, 12, 1)).astype(np.float32)
+        # separable-by-class data: class = quadrant with max energy
+        labels = np.argmax([
+            np.abs(X[:, :6, :6, 0]).sum((1, 2)),
+            np.abs(X[:, :6, 6:, 0]).sum((1, 2)),
+            np.abs(X[:, 6:, :6, 0]).sum((1, 2)),
+            np.abs(X[:, 6:, 6:, 0]).sum((1, 2))], axis=0)
+        Y = np.eye(nc, dtype=np.float32)[labels]
+        conf = (NeuralNetConfiguration.Builder()
+                .seed(1).updater(Adam(3e-3))
+                .list()
+                .layer(ConvolutionLayer(n_out=8, kernel=(3, 3),
+                                        convolution_mode="same",
+                                        activation="relu"))
+                .layer(SubsamplingLayer(kernel=(2, 2), stride=(2, 2)))
+                .layer(ConvolutionLayer(n_out=16, kernel=(3, 3),
+                                        convolution_mode="same",
+                                        activation="relu"))
+                .layer(SubsamplingLayer(kernel=(2, 2), stride=(2, 2)))
+                .layer(DenseLayer(n_out=32, activation="relu"))
+                .layer(OutputLayer(n_out=nc))
+                .set_input_type(InputType.convolutional(12, 12, 1))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        s = CollectScoresIterationListener()
+        net.set_listeners(s)
+        net.fit(ArrayDataSetIterator(X, Y, batch_size=32), epochs=20)
+        assert s.scores[-1][1] < s.scores[0][1] * 0.7
+
+    def test_batchnorm_in_net(self):
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(64, 8, 8, 2)).astype(np.float32)
+        Y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 64)]
+        conf = (NeuralNetConfiguration.Builder().seed(1).updater(Sgd(1e-2))
+                .list()
+                .layer(ConvolutionLayer(n_out=4, kernel=(3, 3),
+                                        convolution_mode="same"))
+                .layer(BatchNormalization())
+                .layer(OutputLayer(n_out=2))
+                .set_input_type(InputType.convolutional(8, 8, 2))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        state_before = np.asarray(net.state["1"]["mean"]).copy()
+        net.fit(ArrayDataSetIterator(X, Y, batch_size=32), epochs=2)
+        state_after = np.asarray(net.state["1"]["mean"])
+        assert not np.allclose(state_before, state_after), \
+            "BN running stats must update during fit"
+
+
+class TestRnnNet:
+    def _seq_data(self, n=64, t=6, f=3, nc=2, seed=0):
+        rng = np.random.default_rng(seed)
+        X = rng.normal(size=(n, t, f)).astype(np.float32)
+        labels = (X.sum((1, 2)) > 0).astype(int)
+        Y = np.tile(np.eye(nc, dtype=np.float32)[labels][:, None, :], (1, t, 1))
+        return X, Y
+
+    def test_lstm_net_trains(self):
+        X, Y = self._seq_data()
+        conf = (NeuralNetConfiguration.Builder().seed(3).updater(Adam(1e-2))
+                .list()
+                .layer(LSTM(n_out=8))
+                .layer(RnnOutputLayer(n_out=2))
+                .set_input_type(InputType.recurrent(3, 6))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        s = CollectScoresIterationListener()
+        net.set_listeners(s)
+        net.fit(ArrayDataSetIterator(X, Y, batch_size=32), epochs=15)
+        assert s.scores[-1][1] < s.scores[0][1]
+
+    def test_tbptt_matches_epochs(self):
+        X, Y = self._seq_data(t=8)
+        conf = (NeuralNetConfiguration.Builder().seed(3).updater(Adam(1e-2))
+                .list()
+                .layer(LSTM(n_out=8))
+                .layer(RnnOutputLayer(n_out=2))
+                .set_input_type(InputType.recurrent(3, 8))
+                .tbptt(4)
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        s = CollectScoresIterationListener()
+        net.set_listeners(s)
+        net.fit(ArrayDataSetIterator(X, Y, batch_size=32), epochs=5)
+        # 2 batches * 2 chunks * 5 epochs = 20 iterations
+        assert net.iteration_count == 20
+        assert s.scores[-1][1] < s.scores[0][1]
+
+    def test_rnn_time_step_stateful(self):
+        X, Y = self._seq_data(n=4, t=6)
+        conf = (NeuralNetConfiguration.Builder().seed(3).updater(Adam(1e-2))
+                .list()
+                .layer(LSTM(n_out=8))
+                .layer(RnnOutputLayer(n_out=2))
+                .set_input_type(InputType.recurrent(3, 6))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        full = np.asarray(net.output(X))
+        net.rnn_clear_previous_state()
+        outs = []
+        for t in range(6):
+            outs.append(np.asarray(net.rnn_time_step(X[:, t, :])))
+        stepped = np.stack(outs, axis=1)
+        np.testing.assert_allclose(stepped, full, rtol=1e-4, atol=1e-5)
+
+    def test_masked_training_runs(self):
+        X, Y = self._seq_data(t=6)
+        mask = np.ones((64, 6), np.float32)
+        mask[:, 4:] = 0
+        it = ArrayDataSetIterator(X, Y, batch_size=32, features_mask=mask,
+                                  labels_mask=mask)
+        conf = (NeuralNetConfiguration.Builder().seed(3).updater(Adam(1e-2))
+                .list()
+                .layer(LSTM(n_out=8))
+                .layer(RnnOutputLayer(n_out=2))
+                .set_input_type(InputType.recurrent(3, 6))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        net.fit(it, epochs=2)
+        assert np.isfinite(net.score())
+
+
+class TestSerde:
+    def test_conf_json_roundtrip(self):
+        conf = mlp_conf(updater=Nesterovs(learning_rate=0.05, momentum=0.8))
+        j = conf.to_json()
+        back = MultiLayerConfiguration.from_json(j)
+        assert back == conf
+
+    def test_model_zip_roundtrip(self, tmp_path):
+        X, Y = make_blobs(n=64)
+        net = MultiLayerNetwork(mlp_conf()).init()
+        net.fit(ArrayDataSetIterator(X, Y, batch_size=32), epochs=3)
+        path = str(tmp_path / "model.zip")
+        save_model(net, path)
+        restored = restore_multilayer_network(path)
+        np.testing.assert_allclose(np.asarray(net.output(X[:8])),
+                                   np.asarray(restored.output(X[:8])),
+                                   rtol=1e-5)
+        assert restored.iteration_count == net.iteration_count
+
+    def test_training_resumes_identically(self, tmp_path):
+        """Checkpoint must capture updater state: resume == uninterrupted
+        (ModelSerializer updaterState.bin semantics)."""
+        X, Y = make_blobs(n=64)
+        it = lambda: ArrayDataSetIterator(X, Y, batch_size=32)
+        netA = MultiLayerNetwork(mlp_conf()).init()
+        netA.fit(it(), epochs=2)
+        path = str(tmp_path / "ckpt.zip")
+        save_model(netA, path)
+        netA.fit(it(), epochs=2)
+
+        netB = load_model(path)
+        netB.fit(it(), epochs=2)
+        np.testing.assert_allclose(np.asarray(netA.params_flat()),
+                                   np.asarray(netB.params_flat()),
+                                   rtol=1e-4, atol=1e-6)
+
+    def test_frozen_layer_params_do_not_move(self):
+        import dataclasses as dc
+        X, Y = make_blobs(n=64)
+        conf = (NeuralNetConfiguration.Builder().seed(42).updater(Adam(1e-2))
+                .list()
+                .layer(DenseLayer(n_out=16, activation="relu", frozen=True))
+                .layer(OutputLayer(n_out=3))
+                .set_input_type(InputType.feed_forward(4))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        w_before = np.asarray(net.params["0"]["W"]).copy()
+        net.fit(ArrayDataSetIterator(X, Y, batch_size=32), epochs=3)
+        np.testing.assert_allclose(np.asarray(net.params["0"]["W"]), w_before)
+        assert not np.allclose(np.asarray(net.params["1"]["W"]),
+                               np.asarray(MultiLayerNetwork(conf).init().params["1"]["W"]))

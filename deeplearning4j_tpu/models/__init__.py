@@ -1,0 +1,29 @@
+"""Model zoo — canonical architectures (DL4J deeplearning4j-zoo parity).
+
+Reference: /root/reference/deeplearning4j-zoo/src/main/java/org/deeplearning4j/zoo/
+(`ZooModel.java`, `model/*.java`). Architectures are re-expressed TPU-first:
+NHWC layouts, bf16-friendly compute, builders produce jit-compiled networks.
+"""
+from deeplearning4j_tpu.models.zoo import (
+    ZooModel,
+    LeNet,
+    SimpleCNN,
+    AlexNet,
+    VGG16,
+    VGG19,
+    ResNet50,
+    GoogLeNet,
+    Darknet19,
+    TinyYOLO,
+    YOLO2,
+    TextGenerationLSTM,
+    InceptionResNetV1,
+    FaceNetNN4Small2,
+    UNet,
+)
+
+__all__ = [
+    "ZooModel", "LeNet", "SimpleCNN", "AlexNet", "VGG16", "VGG19",
+    "ResNet50", "GoogLeNet", "Darknet19", "TinyYOLO", "YOLO2",
+    "TextGenerationLSTM", "InceptionResNetV1", "FaceNetNN4Small2", "UNet",
+]

@@ -17,6 +17,7 @@ from deeplearning4j_tpu.nn.layers.recurrent import (
 )
 from deeplearning4j_tpu.nn.layers.variational import VariationalAutoencoder
 from deeplearning4j_tpu.nn.layers.samediff import SameDiffLayer, FrozenLayerWrapper
+from deeplearning4j_tpu.nn.layers.objdetect import Yolo2OutputLayer
 
 __all__ = [
     "DenseLayer", "EmbeddingLayer", "ActivationLayer", "DropoutLayer",
@@ -30,5 +31,5 @@ __all__ = [
     "LSTM", "GravesLSTM", "GravesBidirectionalLSTM", "SimpleRnn",
     "Bidirectional", "RnnOutputLayer", "RnnLossLayer", "LastTimeStep",
     "MaskZeroLayer", "VariationalAutoencoder", "SameDiffLayer",
-    "FrozenLayerWrapper",
+    "FrozenLayerWrapper", "Yolo2OutputLayer",
 ]

@@ -91,7 +91,10 @@ class EmbeddingLayer(LayerConf):
 @register_layer
 @dataclasses.dataclass(frozen=True)
 class ActivationLayer(LayerConf):
+    """Standalone activation (DL4J ActivationLayer). `alpha` parameterizes
+    leaky/elu-style activations (DL4J ActivationLReLU alpha, default 0.01)."""
     activation: str = "relu"
+    alpha: Optional[float] = None
 
     def output_type(self, input_type: InputType) -> InputType:
         return input_type
@@ -100,7 +103,10 @@ class ActivationLayer(LayerConf):
         return False
 
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
-        return get_activation(self.activation)(x), state
+        fn = get_activation(self.activation)
+        if self.alpha is not None:
+            return fn(x, self.alpha), state
+        return fn(x), state
 
 
 @register_layer

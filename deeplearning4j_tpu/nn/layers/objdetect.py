@@ -1,0 +1,213 @@
+"""Object detection output layer (YOLOv2).
+
+Parity target: DL4J `nn/layers/objdetect/Yolo2OutputLayer.java` +
+`nn/conf/layers/objdetect/Yolo2OutputLayer.java` — the YOLOv2 loss head used
+by the TinyYOLO / YOLO2 zoo models, plus `DetectedObject` /
+`YoloUtils`-style decoding (non-max suppression).
+
+TPU-native design notes:
+- Activations are NHWC (B, H, W, A*(5+C)); DL4J is NCHW. Labels are
+  (B, H, W, 4+C): [x1, y1, x2, y2] in *grid units* plus one-hot class —
+  the same logical content as DL4J's (mb, 4+C, H, W) label format.
+- The whole loss (responsible-anchor assignment via IOU argmax, coordinate
+  SSE, confidence and class terms) is branch-free vectorized XLA; there is
+  no per-cell Python loop, so it fuses into the surrounding training step.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.base import (
+    InputType, LayerConf, register_layer,
+)
+
+
+def _split_predictions(x, n_anchors: int, n_classes: int):
+    """(B,H,W,A*(5+C)) -> xy (sig), wh (raw), conf (sig), class logits."""
+    b, h, w, _ = x.shape
+    x = x.reshape(b, h, w, n_anchors, 5 + n_classes)
+    txy = jax.nn.sigmoid(x[..., 0:2])          # offset within cell
+    twh = x[..., 2:4]                          # raw; box = anchor * exp(twh)
+    conf = jax.nn.sigmoid(x[..., 4])
+    cls_logits = x[..., 5:]
+    return txy, twh, conf, cls_logits
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class Yolo2OutputLayer(LayerConf):
+    """YOLOv2 loss head (DL4J Yolo2OutputLayer).
+
+    lambda_coord / lambda_no_obj mirror DL4J's `lambdaCoord` (5.0) and
+    `lambdaNoObj` (0.5) defaults.
+    """
+    anchors: Tuple[Tuple[float, float], ...] = ()
+    n_classes: int = 20
+    lambda_coord: float = 5.0
+    lambda_no_obj: float = 0.5
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return input_type
+
+    def has_params(self):
+        return False
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        """Activated predictions: sigmoid(xy, conf), anchor*exp(wh),
+        softmax(class) — DL4J Yolo2OutputLayer.activate()."""
+        b, h, w, _ = x.shape
+        n_a = len(self.anchors)
+        txy, twh, conf, cls_logits = _split_predictions(x, n_a, self.n_classes)
+        anchors = jnp.asarray(self.anchors, x.dtype)          # (A, 2)
+        wh = anchors * jnp.exp(twh)
+        probs = jax.nn.softmax(cls_logits, axis=-1)
+        out = jnp.concatenate(
+            [txy, wh, conf[..., None], probs], axis=-1)
+        return out.reshape(b, h, w, n_a * (5 + self.n_classes)), state
+
+    # ----------------------------------------------------------------- loss
+    def score(self, params, x, labels, *, train=False, rng=None, mask=None):
+        """YOLOv2 loss (DL4J Yolo2OutputLayer.computeScore):
+        coordinate SSE (responsible anchors, lambda_coord) + confidence
+        (IOU target for responsible, lambda_no_obj elsewhere) + class SSE.
+        labels: (B, H, W, 4+C), boxes as [x1,y1,x2,y2] in grid units."""
+        f32 = jnp.float32
+        x = x.astype(f32)
+        labels = labels.astype(f32)
+        b, h, w, _ = x.shape
+        n_a = len(self.anchors)
+        txy, twh, conf, cls_logits = _split_predictions(x, n_a, self.n_classes)
+
+        lab_box = labels[..., 0:4]                       # (B,H,W,4) grid units
+        lab_cls = labels[..., 4:]                        # (B,H,W,C)
+        # object mask: a cell has an object iff its label box has area > 0
+        gt_wh = lab_box[..., 2:4] - lab_box[..., 0:2]
+        obj = (gt_wh[..., 0] * gt_wh[..., 1] > 0).astype(f32)   # (B,H,W)
+
+        gt_center = 0.5 * (lab_box[..., 0:2] + lab_box[..., 2:4])
+        gt_xy_in_cell = gt_center - jnp.floor(gt_center)        # (B,H,W,2)
+
+        anchors = jnp.asarray(self.anchors, f32)                # (A,2)
+        pred_wh = anchors * jnp.exp(twh)                        # (B,H,W,A,2)
+
+        # Predicted box corners in grid units: center = cell index +
+        # sigmoid(txy) (DL4J predictedXYCenterGrid, Yolo2OutputLayer.java:153).
+        cell_x = jax.lax.broadcasted_iota(f32, (h, w), 1)[None, :, :, None]
+        cell_y = jax.lax.broadcasted_iota(f32, (h, w), 0)[None, :, :, None]
+        pred_cx = cell_x + txy[..., 0]
+        pred_cy = cell_y + txy[..., 1]
+        pred_x1 = pred_cx - pred_wh[..., 0] * 0.5
+        pred_x2 = pred_cx + pred_wh[..., 0] * 0.5
+        pred_y1 = pred_cy - pred_wh[..., 1] * 0.5
+        pred_y2 = pred_cy + pred_wh[..., 1] * 0.5
+
+        # IOU against the actual label corner positions (DL4J
+        # calculateIOULabelPredicted): overlap of true rectangles.
+        ix = (jnp.minimum(pred_x2, lab_box[..., None, 2]) -
+              jnp.maximum(pred_x1, lab_box[..., None, 0]))
+        iy = (jnp.minimum(pred_y2, lab_box[..., None, 3]) -
+              jnp.maximum(pred_y1, lab_box[..., None, 1]))
+        inter = jnp.maximum(ix, 0.0) * jnp.maximum(iy, 0.0)
+        union = (pred_wh[..., 0] * pred_wh[..., 1] +
+                 (gt_wh[..., 0] * gt_wh[..., 1])[..., None] - inter)
+        iou = inter / (union + 1e-9)                            # (B,H,W,A)
+        responsible = jax.nn.one_hot(jnp.argmax(iou, axis=-1), n_a,
+                                     dtype=f32) * obj[..., None]  # (B,H,W,A)
+
+        # coordinate loss: xy SSE within the cell; wh SSE on sqrt of actual
+        # grid-unit sizes (DL4J Yolo2OutputLayer.java:128,147 — sqrt(w),
+        # sqrt(h), NOT sqrt(w/anchor)).
+        xy_err = jnp.sum((txy - gt_xy_in_cell[..., None, :]) ** 2, axis=-1)
+        wh_err = jnp.sum((jnp.sqrt(jnp.maximum(pred_wh, 1e-9)) -
+                          jnp.sqrt(jnp.maximum(gt_wh[..., None, :], 1e-9)))
+                         ** 2, axis=-1)
+        coord_loss = self.lambda_coord * jnp.sum(
+            responsible * (xy_err + wh_err))
+
+        # confidence: target IOU where responsible, 0 elsewhere
+        conf_obj = jnp.sum(responsible * (conf - iou) ** 2)
+        conf_noobj = self.lambda_no_obj * jnp.sum(
+            (1.0 - responsible) * conf ** 2)
+
+        # class loss: softmax SSE over responsible cells (DL4J default
+        # LossL2 on softmax output)
+        probs = jax.nn.softmax(cls_logits, axis=-1)
+        cls_err = jnp.sum((probs - lab_cls[..., None, :]) ** 2, axis=-1)
+        cls_loss = jnp.sum(responsible * cls_err)
+
+        total = coord_loss + conf_obj + conf_noobj + cls_loss
+        return total / jnp.asarray(b, f32)
+
+
+def decode_detections(activated, anchors, n_classes: int,
+                      conf_threshold: float = 0.5):
+    """Decode activated YOLO output into (boxes, scores, classes) per image —
+    the analog of DL4J `Yolo2OutputLayer.getPredictedObjects`.
+
+    activated: (B, H, W, A*(5+C)) from Yolo2OutputLayer.apply. Returns numpy
+    lists (host-side postprocessing, like DL4J's DetectedObject list)."""
+    import numpy as np
+    activated = np.asarray(activated)
+    b, h, w, _ = activated.shape
+    n_a = len(anchors)
+    act = activated.reshape(b, h, w, n_a, 5 + n_classes)
+    results = []
+    for i in range(b):
+        boxes, scores, classes = [], [], []
+        xy = act[i, ..., 0:2]
+        wh = act[i, ..., 2:4]
+        conf = act[i, ..., 4]
+        probs = act[i, ..., 5:]
+        for yy in range(h):
+            for xx in range(w):
+                for a in range(n_a):
+                    if conf[yy, xx, a] < conf_threshold:
+                        continue
+                    cx = xx + xy[yy, xx, a, 0]
+                    cy = yy + xy[yy, xx, a, 1]
+                    bw, bh = wh[yy, xx, a]
+                    boxes.append([cx - bw / 2, cy - bh / 2,
+                                  cx + bw / 2, cy + bh / 2])
+                    scores.append(float(conf[yy, xx, a]))
+                    classes.append(int(np.argmax(probs[yy, xx, a])))
+        results.append((np.asarray(boxes, np.float32),
+                        np.asarray(scores, np.float32),
+                        np.asarray(classes, np.int32)))
+    return results
+
+
+def non_max_suppression(boxes, scores, classes=None,
+                        iou_threshold: float = 0.45):
+    """Greedy NMS over decoded boxes (DL4J YoloUtils.nms). Host-side.
+
+    Like DL4J (YoloUtils.java:105-124), suppression only applies between
+    boxes of the same predicted class; pass `classes=None` to treat all
+    boxes as one class."""
+    import numpy as np
+    if len(boxes) == 0:
+        return []
+    order = np.argsort(-scores)
+    keep = []
+    suppressed = np.zeros(len(boxes), bool)
+    for idx in order:
+        if suppressed[idx]:
+            continue
+        keep.append(int(idx))
+        x1 = np.maximum(boxes[idx, 0], boxes[:, 0])
+        y1 = np.maximum(boxes[idx, 1], boxes[:, 1])
+        x2 = np.minimum(boxes[idx, 2], boxes[:, 2])
+        y2 = np.minimum(boxes[idx, 3], boxes[:, 3])
+        inter = np.maximum(0, x2 - x1) * np.maximum(0, y2 - y1)
+        area_i = ((boxes[idx, 2] - boxes[idx, 0]) *
+                  (boxes[idx, 3] - boxes[idx, 1]))
+        areas = ((boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1]))
+        iou = inter / (area_i + areas - inter + 1e-9)
+        over = iou > iou_threshold
+        if classes is not None:
+            over &= np.asarray(classes) == classes[idx]
+        suppressed |= over
+    return keep

@@ -50,6 +50,7 @@ _KIND_BY_CLASS = {
     "SubsamplingLayer": Kind.CNN, "Upsampling2D": Kind.CNN,
     "ZeroPaddingLayer": Kind.CNN, "Cropping2D": Kind.CNN,
     "SpaceToDepthLayer": Kind.CNN, "SpaceToBatchLayer": Kind.CNN,
+    "Yolo2OutputLayer": Kind.CNN,
     "LocalResponseNormalization": Kind.CNN, "CnnLossLayer": Kind.CNN,
     "LSTM": Kind.RNN, "GravesLSTM": Kind.RNN, "SimpleRnn": Kind.RNN,
     "Bidirectional": Kind.RNN, "GravesBidirectionalLSTM": Kind.RNN,

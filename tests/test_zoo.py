@@ -1,0 +1,113 @@
+"""Model zoo tests (DL4J deeplearning4j-zoo/src/test TestModels analog):
+every zoo architecture builds, serializes its config round-trip, and the
+small ones run a forward pass + one training step."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models import (
+    AlexNet, Darknet19, GoogLeNet, LeNet, ResNet50, SimpleCNN,
+    TextGenerationLSTM, TinyYOLO, UNet, VGG16, VGG19, YOLO2,
+    InceptionResNetV1, FaceNetNN4Small2,
+)
+from deeplearning4j_tpu.nn.conf.network import (
+    ComputationGraphConfiguration, MultiLayerConfiguration,
+)
+
+ALL_MODELS = [
+    LeNet(), SimpleCNN(), AlexNet(), VGG16(), VGG19(), ResNet50(),
+    GoogLeNet(), Darknet19(), TinyYOLO(), YOLO2(), TextGenerationLSTM(),
+    InceptionResNetV1(), FaceNetNN4Small2(), UNet(),
+]
+
+
+@pytest.mark.parametrize("model", ALL_MODELS, ids=lambda m: type(m).__name__)
+def test_conf_builds_and_roundtrips(model):
+    conf = model.conf()
+    js = conf.to_json()
+    if isinstance(conf, ComputationGraphConfiguration):
+        conf2 = ComputationGraphConfiguration.from_json(js)
+    else:
+        conf2 = MultiLayerConfiguration.from_json(js)
+    assert conf2.to_json() == js
+
+
+def test_lenet_forward_and_fit():
+    net = LeNet().init()
+    x = np.random.RandomState(0).rand(4, 28, 28, 1).astype("float32")
+    y = np.eye(10, dtype="float32")[np.random.RandomState(1).randint(0, 10, 4)]
+    out = np.asarray(net.output(x))
+    assert out.shape == (4, 10)
+    np.testing.assert_allclose(out.sum(axis=1), 1.0, atol=1e-4)
+    net.fit((x, y), epochs=1, batch_size=4)
+    assert np.isfinite(net.score())
+
+
+def test_simplecnn_forward():
+    m = SimpleCNN(input_shape=(32, 32, 3))
+    net = m.init()
+    x = np.random.RandomState(0).rand(2, 32, 32, 3).astype("float32")
+    out = np.asarray(net.output(x))
+    assert out.shape == (2, 10)
+
+
+def test_darknet19_small_input_forward():
+    m = Darknet19(num_classes=12, input_shape=(64, 64, 3))
+    net = m.init()
+    x = np.random.RandomState(0).rand(2, 64, 64, 3).astype("float32")
+    out = np.asarray(net.output(x))
+    assert out.shape == (2, 12)
+
+
+def test_tinyyolo_small_forward_and_loss():
+    m = TinyYOLO(num_classes=3, input_shape=(64, 64, 3))
+    net = m.init()
+    x = np.random.RandomState(0).rand(2, 64, 64, 3).astype("float32")
+    out = np.asarray(net.output(x))
+    # 64/32 = 2x2 grid, 5 anchors * (5 + 3 classes)
+    assert out.shape == (2, 2, 2, 5 * 8)
+    # one train step with a single labeled box
+    labels = np.zeros((2, 2, 2, 4 + 3), "float32")
+    labels[0, 0, 0] = [0.1, 0.2, 0.9, 1.1, 1, 0, 0]
+    from deeplearning4j_tpu.data.dataset import DataSet
+    net.fit(DataSet(x, labels))
+    assert np.isfinite(net.score())
+
+
+def test_textgen_lstm_fit():
+    m = TextGenerationLSTM(total_unique_characters=12, max_length=16, units=8)
+    net = m.init()
+    rs = np.random.RandomState(0)
+    x = np.eye(12, dtype="float32")[rs.randint(0, 12, (2, 16))]
+    y = np.eye(12, dtype="float32")[rs.randint(0, 12, (2, 16))]
+    net.fit((x, y), epochs=1, batch_size=2)
+    assert np.isfinite(net.score())
+
+
+def test_resnet50_init_params():
+    """ResNet-50 initializes with the canonical parameter count (~25.6M)."""
+    m = ResNet50(num_classes=1000, input_shape=(64, 64, 3))
+    net = m.init()
+    n = net.num_params()
+    assert 25.4e6 < n < 25.8e6, n
+
+
+def test_yolo_loss_prefers_accurate_boxes():
+    """The rewritten YOLOv2 loss must score a well-aimed prediction lower
+    than a badly-aimed one (IOU uses true predicted/label corners)."""
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.nn.layers.objdetect import Yolo2OutputLayer
+    layer = Yolo2OutputLayer(anchors=((1.0, 1.0), (2.0, 2.0)), n_classes=2)
+    h = w = 2
+    labels = np.zeros((1, h, w, 4 + 2), "float32")
+    labels[0, 0, 0] = [0.0, 0.0, 1.0, 1.0, 1, 0]   # unit box in cell (0,0), class 0
+    good = np.zeros((1, h, w, 2 * 7), "float32")
+    good[0, 0, 0, 0:2] = 0.0      # sigmoid(0)=0.5 -> center of cell
+    good[0, 0, 0, 2:4] = 0.0      # wh = anchor(1,1)*exp(0) = 1x1 (exact)
+    good[0, 0, 0, 4] = 4.0        # high confidence
+    good[0, 0, 0, 5] = 4.0        # class 0 logit
+    bad = good.copy()
+    bad[0, 0, 0, 2:4] = 2.0       # wh = e^2 ~ 7.4x too large
+    bad[0, 0, 0, 5:7] = [0.0, 4.0]  # wrong class
+    l_good = float(layer.score(None, jnp.asarray(good), jnp.asarray(labels)))
+    l_bad = float(layer.score(None, jnp.asarray(bad), jnp.asarray(labels)))
+    assert l_good < l_bad, (l_good, l_bad)

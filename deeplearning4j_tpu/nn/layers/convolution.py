@@ -88,6 +88,11 @@ class ConvolutionLayer(LayerConf):
         return params, {}
 
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        # No preferred_element_type here (or in the other conv variants):
+        # JAX's conv transpose rule rejects the mixed-dtype cotangent it
+        # produces under bf16 compute, and the TPU MXU accumulates bf16
+        # convolutions in f32 regardless — the f32-accumulation invariant
+        # holds without requesting it.
         x = self.maybe_dropout_input(x, train, rng)
         y = lax.conv_general_dilated(
             x, params["W"],
@@ -95,8 +100,7 @@ class ConvolutionLayer(LayerConf):
             padding=_padding(self.convolution_mode),
             rhs_dilation=_pair(self.dilation),
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
-            preferred_element_type=jnp.float32,
-        ).astype(x.dtype)
+        )
         if self.has_bias:
             y = y + params["b"]
         return get_activation(self.activation)(y), state
@@ -127,7 +131,7 @@ class Deconvolution2D(ConvolutionLayer):
             padding=_padding(self.convolution_mode),
             rhs_dilation=_pair(self.dilation),
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
-        ).astype(x.dtype)
+        )
         if self.has_bias:
             y = y + params["b"]
         return get_activation(self.activation)(y), state
@@ -177,8 +181,7 @@ class DepthwiseConvolution2D(LayerConf):
             rhs_dilation=_pair(self.dilation),
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
             feature_group_count=c_in,
-            preferred_element_type=jnp.float32,
-        ).astype(x.dtype)
+        )
         if self.has_bias:
             y = y + params["b"]
         return get_activation(self.activation)(y), state
@@ -229,13 +232,12 @@ class SeparableConvolution2D(LayerConf):
             padding=_padding(self.convolution_mode),
             rhs_dilation=_pair(self.dilation),
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
-            feature_group_count=c_in, preferred_element_type=jnp.float32,
-        ).astype(x.dtype)
+            feature_group_count=c_in,
+        )
         y = lax.conv_general_dilated(
             y, params["pW"], window_strides=(1, 1), padding="VALID",
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
-            preferred_element_type=jnp.float32,
-        ).astype(x.dtype)
+        )
         if self.has_bias:
             y = y + params["b"]
         return get_activation(self.activation)(y), state
@@ -473,8 +475,7 @@ class Convolution1DLayer(LayerConf):
             padding=_padding(self.convolution_mode),
             rhs_dilation=(self.dilation,),
             dimension_numbers=("NWC", "WIO", "NWC"),
-            preferred_element_type=jnp.float32,
-        ).astype(x.dtype)
+        )
         if self.has_bias:
             y = y + params["b"]
         return get_activation(self.activation)(y), state

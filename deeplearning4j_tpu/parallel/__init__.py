@@ -1,0 +1,37 @@
+"""Parallelism & distribution — TPU-native (DL4J deeplearning4j-scaleout parity).
+
+The reference's four data-parallel variants (ParallelWrapper AVERAGING /
+SHARED_GRADIENTS, Spark ParameterAveragingTrainingMaster, Aeron
+SharedTrainingMaster — SURVEY.md §2.5) collapse onto one mesh data-parallel
+trainer: gradients all-reduce over ICI inside the compiled step
+(SYNC_GRADIENTS) or per-replica parameters average every N iterations
+(AVERAGING, exact DL4J semantics). ParallelInference maps to replica serving
+over mesh devices with dynamic batching.
+"""
+from deeplearning4j_tpu.parallel.mesh import (
+    MeshConfig, build_mesh, data_sharding, replicated_sharding,
+)
+from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper, TrainingMode
+from deeplearning4j_tpu.parallel.inference import (
+    InferenceMode, ParallelInference,
+)
+from deeplearning4j_tpu.parallel.encoding import (
+    EncodingHandler, bitmap_decode, bitmap_encode, threshold_decode,
+    threshold_encode,
+)
+from deeplearning4j_tpu.parallel.sharding import (
+    ShardingRules, shard_params, logical_to_mesh,
+)
+from deeplearning4j_tpu.parallel.distributed import (
+    DistributedConfig, initialize_distributed,
+)
+
+__all__ = [
+    "MeshConfig", "build_mesh", "data_sharding", "replicated_sharding",
+    "ParallelWrapper", "TrainingMode",
+    "ParallelInference", "InferenceMode",
+    "EncodingHandler", "threshold_encode", "threshold_decode",
+    "bitmap_encode", "bitmap_decode",
+    "ShardingRules", "shard_params", "logical_to_mesh",
+    "DistributedConfig", "initialize_distributed",
+]

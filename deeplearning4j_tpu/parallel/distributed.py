@@ -1,0 +1,97 @@
+"""Multi-host distributed runtime (DCN control plane).
+
+Parity target: the reference's distributed control planes — Spark
+driver/executor (`ParameterAveragingTrainingMaster.java:308-479`) and the
+Aeron `VoidParameterServer` mesh (`SharedTrainingWrapper.java:206-244`,
+`VoidConfiguration`/`NodeRole.SHARD`, SURVEY.md §2.6).
+
+TPU-native mapping: the whole role/shard/transport machinery collapses into
+`jax.distributed.initialize(coordinator, num_processes, process_id)` — the
+coordinator plays the Spark-driver/TrainingMaster role, each host process is
+a worker, and gradient traffic rides compiled ICI/DCN collectives instead of
+Aeron UDP. Failure handling = checkpoint + restart (SURVEY.md §5.3: the
+reference has no better story either; we layer checkpoint/resume on top).
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+from typing import Optional
+
+import jax
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+
+@dataclasses.dataclass
+class DistributedConfig:
+    """The analog of DL4J VoidConfiguration (networkMask, shardAddresses,
+    controllerAddress...) reduced to what the JAX runtime actually needs."""
+    coordinator_address: Optional[str] = None   # "host:port" of process 0
+    num_processes: Optional[int] = None
+    process_id: Optional[int] = None
+    local_device_ids: Optional[list] = None
+    initialization_timeout_s: int = 300
+
+    @staticmethod
+    def from_env() -> "DistributedConfig":
+        """Read the standard JAX/cloud-TPU env (COORDINATOR_ADDRESS etc.) —
+        the analog of Spark conf / VoidConfiguration discovery."""
+        env = os.environ
+        cfg = DistributedConfig()
+        if "COORDINATOR_ADDRESS" in env:
+            cfg.coordinator_address = env["COORDINATOR_ADDRESS"]
+        if "NUM_PROCESSES" in env:
+            cfg.num_processes = int(env["NUM_PROCESSES"])
+        if "PROCESS_ID" in env:
+            cfg.process_id = int(env["PROCESS_ID"])
+        return cfg
+
+
+_initialized = False
+
+
+def initialize_distributed(config: Optional[DistributedConfig] = None) -> bool:
+    """Join (or form) the multi-host cluster. Idempotent. Returns True if a
+    multi-process runtime is active after the call.
+
+    On Cloud TPU pods, `jax.distributed.initialize()` auto-discovers
+    coordinator/process info from the TPU metadata; explicit config covers
+    the general DCN case. Single-process (one host, however many chips) is
+    a no-op — same code runs unchanged, like ParallelWrapper running with
+    workers=1."""
+    global _initialized
+    if _initialized:
+        return jax.process_count() > 1
+    config = config or DistributedConfig.from_env()
+    try:
+        if config.coordinator_address is not None:
+            jax.distributed.initialize(
+                coordinator_address=config.coordinator_address,
+                num_processes=config.num_processes,
+                process_id=config.process_id,
+                local_device_ids=config.local_device_ids,
+            )
+            _initialized = True
+        elif os.environ.get("TPU_WORKER_HOSTNAMES") or \
+                os.environ.get("MEGASCALE_COORDINATOR_ADDRESS"):
+            jax.distributed.initialize()
+            _initialized = True
+    except Exception as e:     # pragma: no cover - depends on environment
+        log.warning("distributed init failed (%s); continuing single-process",
+                    e)
+    return jax.process_count() > 1
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def is_coordinator() -> bool:
+    """True on the TrainingMaster-role process (process 0)."""
+    return jax.process_index() == 0

@@ -1,0 +1,150 @@
+"""Quantized gradient encoding (threshold + bitmap).
+
+Parity target: ND4J's compression ops consumed by DL4J's data-parallel
+paths — `Nd4j.getExecutioner().thresholdEncode/bitmapEncode`
+(`optimize/solvers/accumulation/EncodingHandler.java:136-178`), including the
+adaptive-threshold logic, and the residual ("left-overs") accumulation the
+reference keeps inside the encoder.
+
+Role in the TPU framework: within a pod, gradients all-reduce over ICI at
+full precision inside the compiled step — encoding adds nothing (SURVEY.md
+§5.8). These encoders exist for the **DCN / multi-pod** path, where
+bandwidth is scarce: sparse threshold updates across pods, exactly like the
+reference uses them across Aeron/UDP. Encode/decode are jit-compiled XLA
+(static output sizes via a max_elements cap — TPU-friendly fixed shapes).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def threshold_encode(grad: jnp.ndarray, threshold: float,
+                     max_elements: Optional[int] = None
+                     ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Sparse sign encoding: elements with |g| >= threshold are transmitted
+    as +-threshold; the remainder stays in the residual.
+
+    Returns (indices, signs, residual). indices/signs have static length
+    `max_elements` (default 1% of size, min 16) with -1 padding — static
+    shapes keep this compilable on TPU (ND4J's variable-length encode is a
+    host-side luxury XLA does not allow).
+
+    ND4J analog: thresholdEncode (EncodingHandler.java:136-178).
+    """
+    flat = grad.reshape(-1)
+    n = flat.shape[0]
+    if max_elements is None:
+        # 1/16 density cap: beyond that the reference switches to bitmap
+        # encoding anyway (EncodingHandler bitmap branch)
+        max_elements = max(16, n // 16)
+    max_elements = min(max_elements, n)
+    mask = jnp.abs(flat) >= threshold
+    # top-|max_elements| by magnitude among those over threshold
+    score = jnp.where(mask, jnp.abs(flat), -1.0)
+    _, idx = jax.lax.top_k(score, max_elements)
+    valid = score[idx] > 0
+    indices = jnp.where(valid, idx, -1)
+    signs = jnp.where(valid, jnp.sign(flat[idx]), 0.0)
+    delta = jnp.zeros_like(flat).at[jnp.where(valid, idx, 0)].add(
+        jnp.where(valid, jnp.sign(flat[idx]) * threshold, 0.0))
+    residual = (flat - delta).reshape(grad.shape)
+    return indices, signs.astype(jnp.int8), residual
+
+
+def threshold_decode(indices: jnp.ndarray, signs: jnp.ndarray,
+                     threshold: float, shape) -> jnp.ndarray:
+    """Rebuild the dense update from a sparse encoding."""
+    n = int(np.prod(shape))
+    flat = jnp.zeros((n,), jnp.float32)
+    valid = indices >= 0
+    flat = flat.at[jnp.where(valid, indices, 0)].add(
+        jnp.where(valid, signs.astype(jnp.float32) * threshold, 0.0))
+    return flat.reshape(shape)
+
+
+def bitmap_encode(grad: jnp.ndarray, threshold: float
+                  ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Dense 2-bit encoding: per element, {0: below threshold, 1: +thr,
+    2: -thr} packed 16 per int32 — ND4J bitmapEncode analog, used by the
+    reference when >~1/16 of elements exceed the threshold."""
+    flat = grad.reshape(-1)
+    n = flat.shape[0]
+    pad = (-n) % 16
+    codes = jnp.where(flat >= threshold, 1,
+                      jnp.where(flat <= -threshold, 2, 0)).astype(jnp.uint32)
+    codes = jnp.concatenate([codes, jnp.zeros((pad,), jnp.uint32)])
+    codes = codes.reshape(-1, 16)
+    shifts = jnp.arange(16, dtype=jnp.uint32) * 2
+    # disjoint 2-bit fields: sum == bitwise OR
+    packed = jnp.sum(codes << shifts, axis=1, dtype=jnp.uint32)
+    residual = jnp.where(jnp.abs(flat) >= threshold,
+                         flat - jnp.sign(flat) * threshold, flat)
+    return packed, residual.reshape(grad.shape)
+
+
+def bitmap_decode(packed: jnp.ndarray, threshold: float, shape) -> jnp.ndarray:
+    n = int(np.prod(shape))
+    shifts = jnp.arange(16, dtype=jnp.uint32) * 2
+    codes = (packed[:, None] >> shifts) & 3
+    codes = codes.reshape(-1)[:n]
+    vals = jnp.where(codes == 1, threshold,
+                     jnp.where(codes == 2, -threshold, 0.0))
+    return vals.reshape(shape).astype(jnp.float32)
+
+
+@dataclasses.dataclass
+class EncodingHandler:
+    """Adaptive-threshold gradient encoder with residual accumulation.
+
+    Mirrors DL4J EncodingHandler: initial threshold, per-iteration adaptation
+    toward a target sparsity band (boundary), residual carry between steps
+    (the reference's encoder leaves sub-threshold values in the updates
+    buffer for later rounds).
+    """
+    threshold: float = 1e-3
+    min_threshold: float = 1e-5
+    boundary: float = 0.02          # target fraction of elements transmitted
+    decay: float = 0.98
+
+    def __post_init__(self):
+        self._residual = None
+        self.iterations = 0
+        self.last_sparsity = 0.0
+
+    def encode(self, grad):
+        """Returns (indices, signs, threshold_used). Residual is carried.
+        The returned threshold is the one this gradient was ENCODED with —
+        adaptation only affects the next call (decoding with the adapted
+        value would mis-scale the update vs. the residual accounting)."""
+        g = jnp.asarray(grad, jnp.float32)
+        if self._residual is not None:
+            g = g + self._residual
+        used_threshold = self.threshold
+        # capacity sized to 4x the target density band (beyond that the
+        # reference would flip to bitmap encoding)
+        cap = max(16, int(g.size * min(1.0, self.boundary * 4)))
+        idx, signs, residual = threshold_encode(g, used_threshold, cap)
+        self._residual = residual
+        self.iterations += 1
+        sent = float(jnp.sum(idx >= 0))
+        self.last_sparsity = sent / g.size
+        # adaptive threshold (EncodingHandler adaptive logic): too dense ->
+        # raise threshold; too sparse -> lower toward min_threshold
+        if self.last_sparsity > self.boundary:
+            self.threshold = self.threshold / self.decay
+        elif self.last_sparsity < self.boundary / 4:
+            self.threshold = max(self.min_threshold,
+                                 self.threshold * self.decay)
+        return idx, signs, used_threshold
+
+    def decode(self, idx, signs, threshold, shape):
+        return threshold_decode(idx, signs, threshold, shape)
+
+    def reset(self):
+        self._residual = None
+        self.iterations = 0

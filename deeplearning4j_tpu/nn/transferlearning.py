@@ -1,0 +1,178 @@
+"""Transfer learning — graph surgery on trained networks.
+
+Parity target: DL4J `nn/transferlearning/`:
+- `TransferLearning.Builder` (MultiLayerNetwork): `setFeatureExtractor(n)`
+  freeze up to layer n, `removeOutputLayer`/`removeLayersFromOutput`,
+  `addLayer`, `nOutReplace`, `fineTuneConfiguration`.
+- `TransferLearning.GraphBuilder` (ComputationGraph): same by vertex name.
+- `FineTuneConfiguration`: override updater/lr/dropout on retained layers.
+- `TransferLearningHelper`: featurize — split frozen body from trainable
+  head and train only the head on cached features.
+
+Params are pytrees here, so "surgery" is dict manipulation + re-init of new
+layers; frozen layers keep weights via FrozenLayerWrapper (stop_gradient +
+NoOp updater — MultiLayerNetwork._label_params).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+import jax
+
+from deeplearning4j_tpu.nn.conf.base import LayerConf
+from deeplearning4j_tpu.nn.conf.network import MultiLayerConfiguration
+from deeplearning4j_tpu.nn.layers.samediff import FrozenLayerWrapper
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+@dataclasses.dataclass
+class FineTuneConfiguration:
+    """Global overrides applied to every retained layer (DL4J
+    FineTuneConfiguration: updater, l1/l2, dropout, seed...)."""
+    updater: Optional[Any] = None
+    l1: Optional[float] = None
+    l2: Optional[float] = None
+    dropout: Optional[float] = None
+    seed: Optional[int] = None
+
+    def apply_to_layer(self, layer: LayerConf) -> LayerConf:
+        changes = {}
+        for f in ("l1", "l2", "dropout"):
+            v = getattr(self, f)
+            if v is not None and hasattr(layer, f):
+                changes[f] = v
+        return dataclasses.replace(layer, **changes) if changes else layer
+
+
+class TransferLearning:
+    """Builder for surgically-modified networks (DL4J TransferLearning.Builder)."""
+
+    def __init__(self, network: MultiLayerNetwork):
+        if network.params is None:
+            raise ValueError("source network must be initialized/trained")
+        self._net = network
+        self._fine_tune: Optional[FineTuneConfiguration] = None
+        self._freeze_until: Optional[int] = None
+        self._remove_from: Optional[int] = None
+        self._appended: List[LayerConf] = []
+        self._n_out_replace: Dict[int, int] = {}
+
+    def fine_tune_configuration(self, cfg: FineTuneConfiguration):
+        self._fine_tune = cfg
+        return self
+
+    def set_feature_extractor(self, layer_index: int):
+        """Freeze layers [0..layer_index] (DL4J setFeatureExtractor)."""
+        self._freeze_until = layer_index
+        return self
+
+    def remove_output_layer(self):
+        return self.remove_layers_from_output(1)
+
+    def remove_layers_from_output(self, n: int):
+        self._remove_from = len(self._net.layers) - n
+        return self
+
+    def n_out_replace(self, layer_index: int, n_out: int):
+        """Change a layer's width; its params and the next layer's input
+        params are re-initialized (DL4J nOutReplace)."""
+        self._n_out_replace[layer_index] = n_out
+        return self
+
+    def add_layer(self, layer: LayerConf):
+        self._appended.append(layer)
+        return self
+
+    def build(self) -> MultiLayerNetwork:
+        src = self._net
+        keep = len(src.layers) if self._remove_from is None else self._remove_from
+        reinit: set = set()
+        new_layers: List[LayerConf] = []
+        for i in range(keep):
+            layer = src.layers[i]
+            base = layer.layer if isinstance(layer, FrozenLayerWrapper) else layer
+            if i in self._n_out_replace:
+                base = dataclasses.replace(base,
+                                           n_out=self._n_out_replace[i])
+                reinit.add(i)
+                if i + 1 < keep:
+                    reinit.add(i + 1)   # fan-in changed
+            if self._fine_tune is not None:
+                base = self._fine_tune.apply_to_layer(base)
+            if self._freeze_until is not None and i <= self._freeze_until:
+                new_layers.append(FrozenLayerWrapper(layer=base))
+            else:
+                new_layers.append(base)
+        n_kept = len(new_layers)
+        new_layers.extend(self._appended)
+
+        conf_changes = {"layers": tuple(new_layers)}
+        if self._fine_tune is not None:
+            if self._fine_tune.updater is not None:
+                conf_changes["updater"] = self._fine_tune.updater
+            if self._fine_tune.seed is not None:
+                conf_changes["seed"] = self._fine_tune.seed
+        new_conf = dataclasses.replace(src.conf, **conf_changes)
+        net = MultiLayerNetwork(new_conf).init()
+        # copy weights for retained, non-reinitialized layers
+        for i in range(n_kept):
+            if i in reinit:
+                continue
+            net.params[str(i)] = jax.tree_util.tree_map(
+                lambda a: a, src.params[str(i)])
+            net.state[str(i)] = jax.tree_util.tree_map(
+                lambda a: a, src.state[str(i)])
+        net._build_optimizer()
+        return net
+
+
+class TransferLearningHelper:
+    """Featurization workflow (DL4J TransferLearningHelper): run the frozen
+    body once per input, train only the head on the features."""
+
+    def __init__(self, network: MultiLayerNetwork, frozen_until: int):
+        """frozen_until: last frozen layer index (inclusive)."""
+        self.src = network
+        self.frozen_until = frozen_until
+        self._split = frozen_until + 1
+        head_layers = network.layers[self._split:]
+        import dataclasses as dc
+        # head input type = output type of the frozen body
+        types = network._resolve_types()
+        if self._split < len(network.layers):
+            body_out = network.layers[self._split - 1].output_type(
+                types[self._split - 1])
+        else:
+            raise ValueError("frozen_until leaves no trainable head")
+        head_conf = dc.replace(network.conf, layers=tuple(head_layers),
+                               input_type=body_out)
+        self.head = MultiLayerNetwork(head_conf).init()
+        for i, _ in enumerate(head_layers):
+            self.head.params[str(i)] = jax.tree_util.tree_map(
+                lambda a: a, network.params[str(self._split + i)])
+            self.head.state[str(i)] = jax.tree_util.tree_map(
+                lambda a: a, network.state[str(self._split + i)])
+        self.head._build_optimizer()
+
+    def featurize(self, features):
+        """Frozen-body forward (cache these — DL4J featurize())."""
+        import jax.numpy as jnp
+        x, _, _ = self.src._forward(self.src.params, self.src.state,
+                                    jnp.asarray(features), False, None,
+                                    upto=self._split)
+        return x
+
+    def fit_featurized(self, features, labels, epochs: int = 1,
+                       batch_size: int = 32):
+        self.head.fit((features, labels), epochs=epochs,
+                      batch_size=batch_size)
+        return self.head
+
+    def unfrozen_network(self) -> MultiLayerNetwork:
+        """Write the trained head back into a full network copy."""
+        net = self.src.copy()
+        for i in range(self._split, len(net.layers)):
+            net.params[str(i)] = jax.tree_util.tree_map(
+                lambda a: a, self.head.params[str(i - self._split)])
+        return net

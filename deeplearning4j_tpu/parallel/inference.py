@@ -102,8 +102,10 @@ class ParallelInference:
         x = np.asarray(x)
         if self.mode == InferenceMode.SEQUENTIAL or self._worker is None:
             return self._run_batch(x)
+        if self._stop.is_set() or not self._worker.is_alive():
+            raise RuntimeError("ParallelInference has been shut down")
         req = _Request(x)
-        self._queue.put(req)
+        self._queue.put(req, timeout=timeout)
         if not req.event.wait(timeout):
             raise TimeoutError("inference request timed out")
         if req.error is not None:
@@ -111,18 +113,27 @@ class ParallelInference:
         return req.result
 
     def _serve_loop(self):
+        pending = None      # request popped but deferred to the next batch
         while not self._stop.is_set():
-            try:
-                first = self._queue.get(timeout=0.1)
-            except queue.Empty:
-                continue
+            if pending is not None:
+                first, pending = pending, None
+            else:
+                try:
+                    first = self._queue.get(timeout=0.1)
+                except queue.Empty:
+                    continue
             reqs = [first]
             total = first.x.shape[0]
             # coalesce whatever is queued right now, up to max_batch_size
+            # (a request that would overflow the cap waits for the next
+            # device batch — the cap bounds device memory / compile shapes)
             while total < self.max_batch_size:
                 try:
                     nxt = self._queue.get_nowait()
                 except queue.Empty:
+                    break
+                if total + nxt.x.shape[0] > self.max_batch_size:
+                    pending = nxt
                     break
                 reqs.append(nxt)
                 total += nxt.x.shape[0]
@@ -139,6 +150,16 @@ class ParallelInference:
             finally:
                 for r in reqs:
                     r.event.set()
+        # drain: fail any stranded waiters instead of leaving them blocked
+        leftovers = [] if pending is None else [pending]
+        while True:
+            try:
+                leftovers.append(self._queue.get_nowait())
+            except queue.Empty:
+                break
+        for r in leftovers:
+            r.error = RuntimeError("ParallelInference has been shut down")
+            r.event.set()
 
     def update_model(self, model):
         """Hot-swap weights (DL4J ParallelInference.updateModel)."""

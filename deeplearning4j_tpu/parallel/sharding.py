@@ -63,19 +63,16 @@ def logical_to_mesh(mesh: Mesh, spec: P) -> NamedSharding:
 
 def shard_params(params, mesh: Mesh, rules: Optional[ShardingRules] = None):
     """Place a parameter pytree onto the mesh according to the rules.
-    Unmatched params replicate (pure DP default)."""
+    Unmatched params replicate (pure DP default). Structure-preserving:
+    empty dicts (paramless layers) survive untouched, so the result is
+    interchangeable with the input for optimizer state."""
     rules = rules or ShardingRules.data_parallel()
-    flat = dict(_iter_paths(params))
-    placed = {}
-    for path, leaf in flat.items():
-        spec = rules.spec_for(path, getattr(leaf, "ndim", 0))
-        placed[path] = jax.device_put(leaf, NamedSharding(mesh, spec))
-    # rebuild the nested dict
-    out: dict = {}
-    for path, leaf in placed.items():
-        parts = path.split("/")
-        d = out
-        for p in parts[:-1]:
-            d = d.setdefault(p, {})
-        d[parts[-1]] = leaf
-    return out
+
+    def walk(node, prefix=""):
+        if isinstance(node, dict):
+            return {k: walk(v, f"{prefix}{k}/") for k, v in node.items()}
+        path = prefix[:-1]
+        spec = rules.spec_for(path, getattr(node, "ndim", 0))
+        return jax.device_put(node, NamedSharding(mesh, spec))
+
+    return walk(params)

@@ -90,6 +90,7 @@ class ParallelWrapper:
         self._avg_fn = None
         self._stacked = None      # (params, opt_state, state) in AVERAGING mode
         self._local_steps = 0
+        self._warned_ragged = False
 
     # ------------------------------------------------------------- plumbing
     @property
@@ -240,40 +241,54 @@ class ParallelWrapper:
             self._stacked = (place(net.params), place(net.opt_state),
                              place(net.state))
         sp, so, ss = self._stacked
+        # the jitted step donates sp/so/ss; clear the stale reference so a
+        # mid-fit exception can't leave self._stacked pointing at deleted
+        # buffers — the finally block below re-saves whatever is live
+        self._stacked = None
         rng = jax.random.PRNGKey(net.conf.seed + 131071)
-        for _ in range(epochs):
-            for lst in net.listeners:
-                lst.on_epoch_start(net, net.epoch_count)
-            for x, y, fm, lm in self._batches(source):
-                bs = self._batch_count(x)
-                x, y, fm, lm = self._split_batch(x, y, fm, lm)
-                rng, sub = jax.random.split(rng)
-                subs = jax.random.split(sub, n)
-                sp, so, ss, losses = self._step_fn(sp, so, ss, x, y, fm, lm,
-                                                   subs)
-                self._local_steps += 1
-                if self._local_steps % self.averaging_frequency == 0:
-                    sp, so, ss = self._avg_fn(sp, so, ss)
-                    if self.report_score_after_averaging:
-                        net._score = float(jnp.mean(losses))
-                if not self.report_score_after_averaging:
-                    net._score = float(jnp.mean(losses))
+        try:
+            for _ in range(epochs):
                 for lst in net.listeners:
-                    lst.iteration_done(net, net.iteration_count,
-                                       net.epoch_count, net._score, 0.0, bs)
-                net.iteration_count += 1
-            for lst in net.listeners:
-                lst.on_epoch_end(net, net.epoch_count)
-            net.epoch_count += 1
-            self._reset(source)
-        # final average + write back to the wrapped network
-        sp, so, ss = self._avg_fn(sp, so, ss)
-        self._stacked = (sp, so, ss)
-        net.params = _unreplicate(sp)
-        net.opt_state = _unreplicate(so)
-        net.state = _unreplicate(ss)
-        net._train_step = None
-        net._output_fn = None
+                    lst.on_epoch_start(net, net.epoch_count)
+                for x, y, fm, lm in self._batches(source):
+                    bs = self._batch_count(x)
+                    x, y, fm, lm = self._split_batch(x, y, fm, lm)
+                    rng, sub = jax.random.split(rng)
+                    subs = jax.random.split(sub, n)
+                    sp, so, ss, losses = self._step_fn(sp, so, ss, x, y, fm,
+                                                       lm, subs)
+                    self._local_steps += 1
+                    if self._local_steps % self.averaging_frequency == 0:
+                        sp, so, ss = self._avg_fn(sp, so, ss)
+                        if self.report_score_after_averaging:
+                            net._score = float(jnp.mean(losses))
+                    if not self.report_score_after_averaging:
+                        net._score = float(jnp.mean(losses))
+                    for lst in net.listeners:
+                        lst.iteration_done(net, net.iteration_count,
+                                           net.epoch_count, net._score, 0.0,
+                                           bs)
+                    net.iteration_count += 1
+                for lst in net.listeners:
+                    lst.on_epoch_end(net, net.epoch_count)
+                net.epoch_count += 1
+                self._reset(source)
+        finally:
+            # final average + write back to the wrapped network; preserves
+            # progress even when fit is interrupted between steps
+            try:
+                sp, so, ss = self._avg_fn(sp, so, ss)
+                self._stacked = (sp, so, ss)
+                net.params = _unreplicate(sp)
+                net.opt_state = _unreplicate(so)
+                net.state = _unreplicate(ss)
+            except RuntimeError:
+                # buffers were donated into a step that failed mid-flight;
+                # nothing recoverable — leave the network at its last state
+                log.warning("AVERAGING fit interrupted mid-step; stacked "
+                            "replica state lost")
+            net._train_step = None
+            net._output_fn = None
 
     # ------------------------------------------------------------- batching
     def _map_entry(self, v, fn):
@@ -283,17 +298,29 @@ class ParallelWrapper:
             return tuple(None if a is None else fn(a) for a in v)
         return fn(v)
 
+    def _pad_to_workers(self, a):
+        """Ragged final batches wrap-pad with leading examples so every
+        worker gets an even shard (DL4J round-robins leftovers to a subset
+        of workers; XLA needs uniform shards — the duplicated examples get
+        double weight in that one step, which is the closest SPMD analog)."""
+        n = self.n_workers
+        b = a.shape[0]
+        if b % n == 0:
+            return a
+        pad = n - b % n
+        if not self._warned_ragged:
+            log.warning(
+                "batch of %d not divisible by %d workers; wrap-padding "
+                "(last partial batch of each epoch)", b, n)
+            self._warned_ragged = True
+        reps = int(np.ceil(pad / b))
+        extra = np.concatenate([np.asarray(a)] * reps)[:pad]
+        return np.concatenate([np.asarray(a), extra])
+
     def _device_batch(self, x, y, fm, lm, shard):
         """Global-view batch, placed sharded over the data axis."""
-        n = self.n_workers
-
         def put(a):
-            a = jnp.asarray(a)
-            if a.shape[0] % n:
-                raise ValueError(
-                    f"batch {a.shape[0]} not divisible by {n} "
-                    "data-parallel workers")
-            return jax.device_put(a, shard)
+            return jax.device_put(jnp.asarray(self._pad_to_workers(a)), shard)
 
         return (self._map_entry(x, put), self._map_entry(y, put),
                 self._map_entry(fm, put), self._map_entry(lm, put))
@@ -305,10 +332,7 @@ class ParallelWrapper:
         stacked = stacked_sharding(self.mesh)
 
         def split(a):
-            a = np.asarray(a)
-            if a.shape[0] % n:
-                raise ValueError(
-                    f"batch {a.shape[0]} not divisible by {n} workers")
+            a = np.asarray(self._pad_to_workers(np.asarray(a)))
             return jax.device_put(
                 jnp.asarray(a.reshape(n, a.shape[0] // n, *a.shape[1:])),
                 stacked)

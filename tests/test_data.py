@@ -1,0 +1,159 @@
+"""Dataset fetcher + record reader tests (DL4J deeplearning4j-data tests)."""
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data.fetchers import (
+    Cifar10DataSetIterator, EmnistDataSetIterator, IrisDataSetIterator,
+    MnistDataSetIterator, UciSequenceDataSetIterator, iris_dataset, read_idx,
+)
+from deeplearning4j_tpu.data.records import (
+    CollectionRecordReader, CollectionSequenceRecordReader, CSVRecordReader,
+    RecordReaderDataSetIterator, RecordReaderMultiDataSetIterator,
+    SequenceRecordReaderDataSetIterator,
+)
+
+
+def test_iris_real_data():
+    ds = iris_dataset()
+    assert ds.features.shape == (150, 4)
+    assert ds.labels.shape == (150, 3)
+    assert ds.labels.sum() == 150
+    # canonical first row of Fisher's data
+    np.testing.assert_allclose(ds.features[0], [5.1, 3.5, 1.4, 0.2])
+
+
+def test_iris_trains_to_high_accuracy():
+    from deeplearning4j_tpu.nn.conf.base import InputType
+    from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.updaters import Adam
+    it = IrisDataSetIterator(batch_size=50)
+    conf = (NeuralNetConfiguration.Builder().seed(0).updater(Adam(5e-2))
+            .list()
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4)).build())
+    net = MultiLayerNetwork(conf).init()
+    net.fit(it, epochs=40)
+    acc = net.evaluate(it).accuracy()
+    assert acc > 0.95, acc
+
+
+def test_mnist_synthetic_shapes():
+    it = MnistDataSetIterator(batch_size=64, n_synthetic=256)
+    batches = list(it)
+    assert batches[0].features.shape == (64, 28, 28, 1)
+    assert batches[0].labels.shape == (64, 10)
+    assert 0.0 <= batches[0].features.min() and batches[0].features.max() <= 1.3
+
+
+def test_mnist_missing_cache_raises_when_synthetic_disabled(tmp_path):
+    old = os.environ.get("DL4J_TPU_DATA_DIR")
+    os.environ["DL4J_TPU_DATA_DIR"] = str(tmp_path)
+    try:
+        with pytest.raises(FileNotFoundError):
+            MnistDataSetIterator(batch_size=8, synthetic=False)
+    finally:
+        if old is None:
+            del os.environ["DL4J_TPU_DATA_DIR"]
+        else:
+            os.environ["DL4J_TPU_DATA_DIR"] = old
+
+
+def test_idx_roundtrip(tmp_path):
+    import struct
+    arr = np.arange(24, dtype=np.uint8).reshape(2, 3, 4)
+    p = str(tmp_path / "test-idx3-ubyte")
+    with open(p, "wb") as f:
+        f.write(bytes([0, 0, 0x08, 3]))
+        f.write(struct.pack(">3I", 2, 3, 4))
+        f.write(arr.tobytes())
+    np.testing.assert_array_equal(read_idx(p), arr)
+
+
+def test_emnist_and_cifar_synthetic():
+    e = EmnistDataSetIterator("letters", batch_size=32, n_synthetic=64)
+    b = next(iter(e))
+    assert b.labels.shape == (32, 26)
+    c = Cifar10DataSetIterator(batch_size=16, n_synthetic=64)
+    b = next(iter(c))
+    assert b.features.shape == (16, 32, 32, 3)
+
+
+def test_uci_sequence_shapes():
+    it = UciSequenceDataSetIterator(batch_size=50)
+    b = next(iter(it))
+    assert b.features.shape == (50, 60, 1)
+    assert b.labels.shape == (50, 6)
+
+
+# ---------------------------------------------------------------- record IO
+def test_csv_record_reader_classification(tmp_path):
+    p = tmp_path / "data.csv"
+    rows = [[0.1, 0.2, 0], [0.3, 0.4, 1], [0.5, 0.6, 2], [0.7, 0.8, 1]]
+    p.write_text("\n".join(",".join(str(v) for v in r) for r in rows))
+    rr = CSVRecordReader(str(p))
+    it = RecordReaderDataSetIterator(rr, batch_size=2, label_index=2,
+                                     num_classes=3)
+    batches = list(it)
+    assert len(batches) == 2
+    assert batches[0].features.shape == (2, 2)
+    assert batches[0].labels.shape == (2, 3)
+    np.testing.assert_allclose(batches[0].labels[1], [0, 1, 0])
+
+
+def test_record_reader_regression_multi_column():
+    rows = [[1, 2, 10, 20], [3, 4, 30, 40]]
+    it = RecordReaderDataSetIterator(CollectionRecordReader(rows),
+                                     batch_size=2, label_index=2,
+                                     label_index_to=3, regression=True)
+    ds = next(iter(it))
+    np.testing.assert_allclose(ds.features, [[1, 2], [3, 4]])
+    np.testing.assert_allclose(ds.labels, [[10, 20], [30, 40]])
+
+
+def test_sequence_reader_align_end_masks():
+    seqs = [
+        [[0.1, 0], [0.2, 1], [0.3, 2]],
+        [[0.4, 1]],
+    ]
+    it = SequenceRecordReaderDataSetIterator(
+        CollectionSequenceRecordReader(seqs), batch_size=2, num_classes=3,
+        label_index=1)
+    ds = next(iter(it))
+    assert ds.features.shape == (2, 3, 1)
+    assert ds.labels.shape == (2, 3, 3)
+    # ALIGN_END: short sequence padded at the front
+    np.testing.assert_allclose(ds.features_mask, [[1, 1, 1], [0, 0, 1]])
+    np.testing.assert_allclose(ds.features[1, 2], [0.4])
+    np.testing.assert_allclose(ds.labels[1, 2], [0, 1, 0])
+
+
+def test_sequence_reader_dual_readers():
+    feats = [[[0.1], [0.2]], [[0.3], [0.4]]]
+    labs = [[[0], [1]], [[1], [0]]]
+    it = SequenceRecordReaderDataSetIterator(
+        CollectionSequenceRecordReader(feats), batch_size=2, num_classes=2,
+        labels_reader=CollectionSequenceRecordReader(labs))
+    ds = next(iter(it))
+    assert ds.features.shape == (2, 2, 1)
+    assert ds.labels.shape == (2, 2, 2)
+    assert ds.features_mask is None
+
+
+def test_multi_dataset_iterator():
+    r1 = CollectionRecordReader([[1, 2, 0], [3, 4, 1], [5, 6, 2],
+                                 [7, 8, 0]])
+    it = (RecordReaderMultiDataSetIterator(batch_size=2)
+          .add_reader("r", r1)
+          .add_input("r", 0, 1)
+          .add_output_one_hot("r", 2, 3))
+    batches = list(it)
+    assert len(batches) == 2
+    mds = batches[0]
+    assert mds.features[0].shape == (2, 2)
+    assert mds.labels[0].shape == (2, 3)
+    np.testing.assert_allclose(mds.labels[0][0], [1, 0, 0])

@@ -95,8 +95,6 @@ def test_averaging_freq1_close_to_sync():
     """AVERAGING with frequency=1 should track sync-DP closely (same data
     order, same seed): parameters equal after each averaged step for SGD."""
     X, Y = _blob_data(n=128)
-    net_a = MultiLayerNetwork(_mlp(seed=5, lr=1e-2)).init()
-    net_s = MultiLayerNetwork(_mlp(seed=5, lr=1e-2)).init()
     # use plain SGD so averaging params == averaging gradients exactly
     conf = (NeuralNetConfiguration.Builder()
             .seed(5).updater(Sgd(1e-2)).list()
@@ -139,6 +137,48 @@ def test_parallel_inference_odd_batch_padding():
     out = pi.output(X[:13])           # 13 not divisible by 8 -> padded
     assert out.shape == (13, 4)
     np.testing.assert_allclose(out, np.asarray(net.output(X[:13])), atol=1e-5)
+
+
+def test_parallel_inference_rejects_after_shutdown():
+    X, _ = _blob_data(n=16)
+    net = MultiLayerNetwork(_mlp()).init()
+    pi = ParallelInference(net, mode=InferenceMode.BATCHED)
+    pi.output(X[:8])
+    pi.shutdown()
+    with pytest.raises(RuntimeError):
+        pi.output(X[:8])
+
+
+def test_ragged_final_batch_wrap_pads():
+    """100 samples, batch 64 on 8 workers: final batch of 36 trains via
+    wrap-padding instead of crashing (DL4J handles ragged batches too)."""
+    X, Y = _blob_data(n=320)
+    net = MultiLayerNetwork(_mlp()).init()
+    w = ParallelWrapper(net, mode=TrainingMode.SYNC_GRADIENTS)
+    w.fit(ArrayDataSetIterator(X[:100], Y[:100], batch_size=64), epochs=2)
+    assert np.isfinite(net.score())
+    net2 = MultiLayerNetwork(_mlp()).init()
+    w2 = ParallelWrapper(net2, mode=TrainingMode.AVERAGING,
+                         averaging_frequency=2)
+    w2.fit(ArrayDataSetIterator(X[:100], Y[:100], batch_size=64), epochs=2)
+    assert np.isfinite(net2.score())
+
+
+def test_shard_params_preserves_empty_layers():
+    from deeplearning4j_tpu.nn.layers import ActivationLayer
+    import jax
+    conf = (NeuralNetConfiguration.Builder().seed(0).updater(Sgd(1e-2))
+            .list()
+            .layer(DenseLayer(n_out=8, activation="identity"))
+            .layer(ActivationLayer(activation="relu"))
+            .layer(OutputLayer(n_out=4, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(8)).build())
+    net = MultiLayerNetwork(conf).init()
+    mesh = build_mesh(MeshConfig())
+    placed = shard_params(net.params, mesh)
+    assert (jax.tree_util.tree_structure(placed) ==
+            jax.tree_util.tree_structure(net.params))
+    assert placed["1"] == {}
 
 
 # ---------------------------------------------------------------- encoding

@@ -1,0 +1,215 @@
+"""Early stopping, transfer learning, gradient checks (DL4J
+earlystopping/ + transferlearning/ + gradientcheck/ test strategy)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data.iterator import ArrayDataSetIterator
+from deeplearning4j_tpu.gradientcheck import check_gradients
+from deeplearning4j_tpu.nn.conf.base import InputType
+from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import (
+    BatchNormalization, ConvolutionLayer, DenseLayer, LSTM, OutputLayer,
+    RnnOutputLayer, SubsamplingLayer,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.transferlearning import (
+    FineTuneConfiguration, TransferLearning, TransferLearningHelper,
+)
+from deeplearning4j_tpu.nn.updaters import Adam, Sgd
+from deeplearning4j_tpu.train.earlystopping import (
+    DataSetLossCalculator, EarlyStoppingConfiguration, EarlyStoppingTrainer,
+    MaxEpochsTerminationCondition, MaxScoreIterationTerminationCondition,
+    ScoreImprovementEpochTerminationCondition,
+)
+
+
+def _blobs(n=240, d=6, k=3, seed=0):
+    rs = np.random.RandomState(seed)
+    centers = rs.randn(k, d) * 3
+    X = np.concatenate([centers[i] + rs.randn(n // k, d)
+                        for i in range(k)]).astype("float32")
+    Y = np.eye(k, dtype="float32")[np.repeat(np.arange(k), n // k)]
+    perm = rs.permutation(n)
+    return X[perm], Y[perm]
+
+
+def _mlp(k=3, d=6, lr=2e-2, seed=0):
+    return (NeuralNetConfiguration.Builder()
+            .seed(seed).updater(Adam(lr)).list()
+            .layer(DenseLayer(n_out=24, activation="relu"))
+            .layer(DenseLayer(n_out=16, activation="tanh"))
+            .layer(OutputLayer(n_out=k, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(d)).build())
+
+
+# ------------------------------------------------------------ early stopping
+def test_early_stopping_max_epochs():
+    X, Y = _blobs()
+    net = MultiLayerNetwork(_mlp()).init()
+    cfg = EarlyStoppingConfiguration(
+        score_calculator=DataSetLossCalculator((X, Y)),
+        epoch_termination_conditions=[MaxEpochsTerminationCondition(4)],
+    )
+    result = EarlyStoppingTrainer(cfg, net, (X, Y)).fit()
+    assert result.termination_reason == "epoch"
+    assert result.total_epochs == 4
+    assert result.best_model is not None
+    assert len(result.score_vs_epoch) == 4
+
+
+def test_early_stopping_score_improvement():
+    """Training on pure noise stops when validation loss stops improving."""
+    rs = np.random.RandomState(1)
+    X = rs.randn(120, 6).astype("float32")
+    Y = np.eye(3, dtype="float32")[rs.randint(0, 3, 120)]
+    net = MultiLayerNetwork(_mlp(lr=1e-3, seed=1)).init()
+    cfg = EarlyStoppingConfiguration(
+        score_calculator=DataSetLossCalculator((X, Y)),
+        epoch_termination_conditions=[
+            ScoreImprovementEpochTerminationCondition(2),
+            MaxEpochsTerminationCondition(50),
+        ],
+    )
+    result = EarlyStoppingTrainer(cfg, net, (X, Y)).fit()
+    assert result.total_epochs <= 50
+    assert result.best_model_score <= min(result.score_vs_epoch.values()) + 1e-9
+
+
+def test_early_stopping_divergence_guard():
+    X, Y = _blobs()
+    net = MultiLayerNetwork(_mlp(lr=2e-2)).init()
+    cfg = EarlyStoppingConfiguration(
+        epoch_termination_conditions=[MaxEpochsTerminationCondition(10)],
+        iteration_termination_conditions=[
+            MaxScoreIterationTerminationCondition(max_score=1e-6)],
+    )
+    result = EarlyStoppingTrainer(cfg, net, (X, Y)).fit()
+    assert result.termination_reason == "iteration"
+
+
+# --------------------------------------------------------- transfer learning
+def test_transfer_learning_freeze_and_replace_head():
+    X, Y = _blobs()
+    src = MultiLayerNetwork(_mlp()).init()
+    src.fit((X, Y), epochs=3, batch_size=60)
+    # new task with 5 classes: freeze features, new head
+    net2 = (TransferLearning(src)
+            .fine_tune_configuration(FineTuneConfiguration(updater=Sgd(1e-2)))
+            .set_feature_extractor(1)
+            .remove_output_layer()
+            .add_layer(OutputLayer(n_out=5, activation="softmax",
+                                   loss="mcxent"))
+            .build())
+    assert len(net2.layers) == 3
+    # frozen layer params match source
+    np.testing.assert_allclose(np.asarray(net2.params["0"]["W"]),
+                               np.asarray(src.params["0"]["W"]))
+    frozen_w_before = np.asarray(net2.params["0"]["W"]).copy()
+    Y5 = np.eye(5, dtype="float32")[np.random.RandomState(3).randint(0, 5, len(X))]
+    net2.fit((X, Y5), epochs=2, batch_size=60)
+    # frozen layer untouched by training
+    np.testing.assert_allclose(np.asarray(net2.params["0"]["W"]),
+                               frozen_w_before)
+
+
+def test_transfer_learning_n_out_replace():
+    X, Y = _blobs()
+    src = MultiLayerNetwork(_mlp()).init()
+    net2 = (TransferLearning(src)
+            .n_out_replace(1, 32)
+            .build())
+    assert net2.layers[1].n_out == 32
+    out = np.asarray(net2.output(X[:4]))
+    assert out.shape == (4, 3)
+    # layer 0 weights retained, layer 1/2 reinitialized with new shapes
+    np.testing.assert_allclose(np.asarray(net2.params["0"]["W"]),
+                               np.asarray(src.params["0"]["W"]))
+    assert net2.params["1"]["W"].shape == (24, 32)
+    assert net2.params["2"]["W"].shape == (32, 3)
+
+
+def test_transfer_learning_helper_featurize():
+    X, Y = _blobs()
+    src = MultiLayerNetwork(_mlp()).init()
+    src.fit((X, Y), epochs=3, batch_size=60)     # pretrain the body
+    helper = TransferLearningHelper(src, frozen_until=1)
+    feats = np.asarray(helper.featurize(X))
+    assert feats.shape == (len(X), 16)
+    helper.fit_featurized(feats, Y, epochs=10, batch_size=60)
+    full = helper.unfrozen_network()
+    acc = full.evaluate((X, Y)).accuracy()
+    assert acc > 0.85, acc
+    # featurized-head training must agree with full-network forward
+    np.testing.assert_allclose(
+        np.asarray(helper.head.output(feats[:8])),
+        np.asarray(full.output(X[:8])), atol=1e-5)
+
+
+# ------------------------------------------------------------ gradient check
+def test_gradient_check_mlp():
+    X, Y = _blobs(n=12)
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(0).updater(Sgd(1e-2)).l2(1e-3).list()
+            .layer(DenseLayer(n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(6)).build())
+    net = MultiLayerNetwork(conf).init()
+    res = check_gradients(net, X[:6], Y[:6], max_per_param=16)
+    assert res.passed, res.failures[:3]
+
+
+def test_gradient_check_cnn():
+    rs = np.random.RandomState(0)
+    X = rs.rand(4, 8, 8, 2).astype("float32")
+    Y = np.eye(3, dtype="float32")[rs.randint(0, 3, 4)]
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(0).updater(Sgd(1e-2)).list()
+            .layer(ConvolutionLayer(n_out=4, kernel=(3, 3),
+                                    convolution_mode="same",
+                                    activation="tanh"))
+            .layer(SubsamplingLayer(kernel=(2, 2), stride=(2, 2),
+                                    pooling_type="max"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.convolutional(8, 8, 2)).build())
+    net = MultiLayerNetwork(conf).init()
+    res = check_gradients(net, X, Y, max_per_param=12)
+    assert res.passed, res.failures[:3]
+
+
+def test_gradient_check_lstm_masked():
+    rs = np.random.RandomState(0)
+    X = rs.rand(3, 5, 4).astype("float32")
+    Y = np.eye(2, dtype="float32")[rs.randint(0, 2, (3, 5))]
+    fmask = np.ones((3, 5), "float32")
+    fmask[1, 3:] = 0
+    fmask[2, 2:] = 0
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(0).updater(Sgd(1e-2)).list()
+            .layer(LSTM(n_out=6, activation="tanh"))
+            .layer(RnnOutputLayer(n_out=2, activation="softmax",
+                                  loss="mcxent"))
+            .set_input_type(InputType.recurrent(4, 5)).build())
+    net = MultiLayerNetwork(conf).init()
+    res = check_gradients(net, X, Y, features_mask=fmask, max_per_param=10)
+    assert res.passed, res.failures[:3]
+
+
+def test_gradient_check_graph_residual():
+    from deeplearning4j_tpu.nn.conf.network import GraphBuilder
+    from deeplearning4j_tpu.nn.conf.graph_vertices import ElementWiseVertex
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    rs = np.random.RandomState(0)
+    X = rs.rand(4, 6).astype("float32")
+    Y = np.eye(2, dtype="float32")[rs.randint(0, 2, 4)]
+    g = (GraphBuilder(NeuralNetConfiguration.Builder().seed(0)
+                      .updater(Sgd(1e-2)))
+         .add_inputs("in")
+         .set_input_types(InputType.feed_forward(6)))
+    g.add_layer("d1", DenseLayer(n_out=6, activation="tanh"), "in")
+    g.add_vertex("res", ElementWiseVertex(op="add"), "d1", "in")
+    g.add_layer("out", OutputLayer(n_out=2, activation="softmax",
+                                   loss="mcxent"), "res")
+    g.set_outputs("out")
+    net = ComputationGraph(g.build()).init()
+    res = check_gradients(net, X, Y, max_per_param=16)
+    assert res.passed, res.failures[:3]

@@ -21,9 +21,11 @@ from deeplearning4j_tpu.models.zoo import (
     FaceNetNN4Small2,
     UNet,
 )
+from deeplearning4j_tpu.models.transformer import TransformerLM, TransformerLMMoE
 
 __all__ = [
     "ZooModel", "LeNet", "SimpleCNN", "AlexNet", "VGG16", "VGG19",
     "ResNet50", "GoogLeNet", "Darknet19", "TinyYOLO", "YOLO2",
     "TextGenerationLSTM", "InceptionResNetV1", "FaceNetNN4Small2", "UNet",
+    "TransformerLM", "TransformerLMMoE",
 ]

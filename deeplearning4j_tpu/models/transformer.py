@@ -1,0 +1,108 @@
+"""TransformerLM — the long-context flagship model family.
+
+No architecture analog in the DL4J zoo (its sequence model is
+TextGenerationLSTM, `zoo/model/TextGenerationLSTM.java`); this is the
+TPU-native successor: a decoder-only transformer LM designed around the
+mesh —
+
+- dp  : batch over "data" (ParallelWrapper),
+- tp  : Megatron-style tensor parallelism over "model" via sharding rules
+        (column-parallel Wq/Wk/Wv/W1, row-parallel Wo/W2 — XLA inserts the
+        matched all-reduce pair),
+- sp  : ring attention over "seq" (ContextParallelTrainer),
+- ep  : MoE expert dim over "model" (MoEFeedForward stacks experts on a
+        leading axis).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+from jax.sharding import PartitionSpec as P
+
+from deeplearning4j_tpu.models.zoo import ZooModel
+from deeplearning4j_tpu.nn.conf.base import InputType
+from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import (
+    EmbeddingSequenceLayer, LayerNormLayer, MoEFeedForward, RnnOutputLayer,
+    TransformerBlock,
+)
+from deeplearning4j_tpu.nn.updaters import AdamW
+from deeplearning4j_tpu.parallel.mesh import MODEL_AXIS
+from deeplearning4j_tpu.parallel.sharding import ShardingRules
+
+
+@dataclasses.dataclass
+class TransformerLM(ZooModel):
+    """Decoder-only LM: token embedding -> n_layers TransformerBlocks
+    (optionally interleaved MoE FFN blocks) -> LN -> tied-untied softmax head.
+
+    Defaults sized for quick experiments; scale n_embd/n_layers/seq_length
+    for real runs (keep n_embd a multiple of 128 for MXU tiling)."""
+    vocab_size: int = 1024
+    seq_length: int = 256
+    n_layers: int = 4
+    n_embd: int = 256
+    n_heads: int = 8
+    mlp_ratio: int = 4
+    causal: bool = True
+    use_rope: bool = True
+    moe_every: int = 0          # 0 = dense; k>0 = every k-th block is MoE
+    n_experts: int = 8
+    dropout: float = 0.0
+    learning_rate: float = 3e-4
+    seed: int = 123
+    attention_impl: str = "dense"
+    block_size: int = 512
+
+    def conf(self):
+        b = (NeuralNetConfiguration.Builder()
+             .seed(self.seed)
+             .updater(AdamW(self.learning_rate))
+             .grad_clip_norm(1.0)
+             .list())
+        b.layer(EmbeddingSequenceLayer(n_out=self.n_embd,
+                                       n_in=self.vocab_size))
+        for i in range(self.n_layers):
+            b.layer(TransformerBlock(
+                n_out=self.n_embd, n_heads=self.n_heads,
+                mlp_ratio=self.mlp_ratio, causal=self.causal,
+                use_rope=self.use_rope,
+                attention_dropout=self.dropout,
+                residual_dropout=self.dropout))
+            if self.moe_every and (i + 1) % self.moe_every == 0:
+                b.layer(MoEFeedForward(n_out=self.n_embd,
+                                       n_experts=self.n_experts,
+                                       mlp_ratio=self.mlp_ratio))
+        b.layer(LayerNormLayer())
+        b.layer(RnnOutputLayer(n_out=self.vocab_size, activation="softmax",
+                               loss="mcxent"))
+        b.set_input_type(InputType.recurrent(1, self.seq_length))
+        return b.build()
+
+    @staticmethod
+    def sharding_rules() -> ShardingRules:
+        """Megatron tp + ep rules for the stack above. Paths look like
+        "1/attn/Wq" (block params are nested dicts)."""
+        return ShardingRules((
+            # attention: column-parallel QKV, row-parallel output
+            (r".*/attn/W[qkv]$", P(None, MODEL_AXIS)),
+            (r".*/attn/Wo$", P(MODEL_AXIS, None)),
+            # MoE (3D, leading expert dim): expert parallelism over "model".
+            # Listed before the dense rules — spec_for skips a rule whose
+            # spec is longer than the leaf's ndim, so 2D kernels fall through.
+            (r".*/W1$", P(MODEL_AXIS, None, None)),
+            (r".*/W2$", P(MODEL_AXIS, None, None)),
+            # dense MLP: column-parallel up, row-parallel down
+            (r".*/W1$", P(None, MODEL_AXIS)),
+            (r".*/W2$", P(MODEL_AXIS, None)),
+            # embedding: vocab-sharded
+            (r"^0/W$", P(MODEL_AXIS, None)),
+        ))
+
+
+@dataclasses.dataclass
+class TransformerLMMoE(TransformerLM):
+    """Expert-parallel variant: every 2nd block followed by a top-2 MoE FFN."""
+    moe_every: int = 2
+    n_experts: int = 8

@@ -18,6 +18,10 @@ from deeplearning4j_tpu.nn.layers.recurrent import (
 from deeplearning4j_tpu.nn.layers.variational import VariationalAutoencoder
 from deeplearning4j_tpu.nn.layers.samediff import SameDiffLayer, FrozenLayerWrapper
 from deeplearning4j_tpu.nn.layers.objdetect import Yolo2OutputLayer
+from deeplearning4j_tpu.nn.layers.attention import (
+    EmbeddingSequenceLayer, LayerNormLayer, MoEFeedForward,
+    MultiHeadAttention, PositionalEmbeddingLayer, TransformerBlock,
+)
 
 __all__ = [
     "DenseLayer", "EmbeddingLayer", "ActivationLayer", "DropoutLayer",
@@ -32,4 +36,6 @@ __all__ = [
     "Bidirectional", "RnnOutputLayer", "RnnLossLayer", "LastTimeStep",
     "MaskZeroLayer", "VariationalAutoencoder", "SameDiffLayer",
     "FrozenLayerWrapper", "Yolo2OutputLayer",
+    "MultiHeadAttention", "TransformerBlock", "MoEFeedForward",
+    "LayerNormLayer", "PositionalEmbeddingLayer", "EmbeddingSequenceLayer",
 ]

@@ -51,6 +51,9 @@ _KIND_BY_CLASS = {
     "ZeroPaddingLayer": Kind.CNN, "Cropping2D": Kind.CNN,
     "SpaceToDepthLayer": Kind.CNN, "SpaceToBatchLayer": Kind.CNN,
     "Yolo2OutputLayer": Kind.CNN,
+    "MultiHeadAttention": Kind.RNN, "TransformerBlock": Kind.RNN,
+    "MoEFeedForward": Kind.RNN,
+    "PositionalEmbeddingLayer": Kind.RNN, "EmbeddingSequenceLayer": Kind.RNN,
     "LocalResponseNormalization": Kind.CNN, "CnnLossLayer": Kind.CNN,
     "LSTM": Kind.RNN, "GravesLSTM": Kind.RNN, "SimpleRnn": Kind.RNN,
     "Bidirectional": Kind.RNN, "GravesBidirectionalLSTM": Kind.RNN,

@@ -25,6 +25,10 @@ from deeplearning4j_tpu.parallel.sharding import (
 from deeplearning4j_tpu.parallel.distributed import (
     DistributedConfig, initialize_distributed,
 )
+from deeplearning4j_tpu.parallel.ring import (
+    blockwise_attention, make_ring_attention, ring_self_attention,
+)
+from deeplearning4j_tpu.parallel.context import ContextParallelTrainer
 
 __all__ = [
     "MeshConfig", "build_mesh", "data_sharding", "replicated_sharding",
@@ -34,4 +38,6 @@ __all__ = [
     "bitmap_encode", "bitmap_decode",
     "ShardingRules", "shard_params", "logical_to_mesh",
     "DistributedConfig", "initialize_distributed",
+    "ring_self_attention", "make_ring_attention", "blockwise_attention",
+    "ContextParallelTrainer",
 ]

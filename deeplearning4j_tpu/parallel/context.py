@@ -1,0 +1,192 @@
+"""Context-parallel training — the sequence axis sharded over the mesh.
+
+No DL4J analog (SURVEY.md §5.7): the reference bounds sequence memory only
+via truncated BPTT. Here the FULL training step runs under `shard_map` with
+activations sharded on the time axis over the mesh "seq" axis:
+
+- pointwise layers (embeddings, layer norm, MLP, MoE) run unchanged on
+  their local sequence shard;
+- `MultiHeadAttention` detects context-parallel mode (attention.py
+  `context_parallel`) and switches to ring attention — K/V blocks rotate
+  over ICI with online-softmax accumulation (`parallel/ring.py`);
+- position-dependent layers (RoPE, learned positions) offset by the
+  shard's global start;
+- the loss is averaged across shards with `pmean`, and parameter gradients
+  are `pmean`-ed so every shard applies the identical update to its
+  replicated parameter copy.
+
+Memory per device scales O(T / seq_degree) — sequences the reference could
+never touch fit a pod. Combine with the "data" axis for dp x sp.
+
+Restrictions (checked at build): standard backprop only (no tBPTT), every
+layer must be sequence-local (recurrent scan layers like LSTM are NOT —
+their hidden state crosses shard boundaries; use attention stacks).
+"""
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from deeplearning4j_tpu.nn.layers.attention import context_parallel
+from deeplearning4j_tpu.parallel.mesh import (
+    DATA_AXIS, SEQ_AXIS, build_mesh, compat_shard_map, MeshConfig,
+)
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+# layers whose state/computation crosses sequence-shard boundaries
+_SEQ_CROSSING = {"LSTM", "GravesLSTM", "SimpleRnn", "Bidirectional",
+                 "GravesBidirectionalLSTM", "Convolution1DLayer",
+                 "Subsampling1DLayer", "LastTimeStep"}
+
+
+class ContextParallelTrainer:
+    """Data x sequence parallel trainer for attention-based
+    MultiLayerNetworks.
+
+    Usage:
+        mesh = build_mesh(MeshConfig(data=2, seq=4))
+        trainer = ContextParallelTrainer(net, mesh)
+        trainer.fit(iterator, epochs=1)
+    """
+
+    def __init__(self, model, mesh: Optional[Mesh] = None):
+        if model.params is None:
+            model.init()
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        if isinstance(model, ComputationGraph):
+            raise NotImplementedError(
+                "context parallelism currently supports MultiLayerNetwork")
+        for layer in model.layers:
+            inner = layer
+            # unwrap FrozenLayerWrapper (and any future wrapper exposing
+            # .layer) — the wrapped layer still computes across time
+            while getattr(inner, "layer", None) is not None:
+                inner = inner.layer
+            if type(inner).__name__ in _SEQ_CROSSING:
+                raise ValueError(
+                    f"{type(inner).__name__} carries state across sequence "
+                    "shards and cannot run context-parallel; use "
+                    "attention/transformer layers")
+        if model.conf.backprop_type != "standard":
+            raise ValueError("context parallelism requires standard backprop")
+        self.model = model
+        if mesh is None:
+            # default: every device on the sequence axis (pure CP)
+            mesh = build_mesh(MeshConfig(data=1, model=1,
+                                         seq=len(jax.devices())))
+        self.mesh = mesh
+        self.seq_degree = self.mesh.shape[SEQ_AXIS]
+        self.data_degree = self.mesh.shape[DATA_AXIS]
+        self._step = None
+
+    # ---------------------------------------------------------------- build
+    def _build_step(self, with_mask):
+        net = self.model
+        tx = net._tx
+        mesh = self.mesh
+
+        def local_step(params, opt_state, state, x, y, fmask, rng):
+            """Runs on one (data, seq) shard; params replicated."""
+            # decorrelate dropout across shards
+            rng = jax.random.fold_in(
+                rng, jax.lax.axis_index(DATA_AXIS) * 8191 +
+                jax.lax.axis_index(SEQ_AXIS))
+
+            def loss_fn(p):
+                with context_parallel(SEQ_AXIS):
+                    loss, (new_state, _) = net._score_fn(
+                        p, state, x, y, fmask, fmask, True, rng)
+                if fmask is not None:
+                    # shards hold different numbers of VALID tokens: the
+                    # global masked mean is psum(local_sum)/psum(count),
+                    # where local_sum = local_masked_mean * local_count
+                    # (fully-masked shards have loss 0, count 0). The
+                    # replicated l1/l2 term passes through unchanged:
+                    # psum(reg*cnt)/psum(cnt) == reg.
+                    cnt = jnp.sum(fmask)
+                    num = jax.lax.psum(loss * cnt, (DATA_AXIS, SEQ_AXIS))
+                    den = jax.lax.psum(cnt, (DATA_AXIS, SEQ_AXIS))
+                    loss = num / jnp.maximum(den, 1.0)
+                else:
+                    # uniform shards: mean of means is exact
+                    loss = jax.lax.pmean(loss, DATA_AXIS)
+                    loss = jax.lax.pmean(loss, SEQ_AXIS)
+                return loss, new_state
+
+            (loss, new_state), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            # grads of the pmean'd loss still need cross-shard reduction:
+            # each shard saw only its slice of the batch/sequence
+            grads = jax.lax.pmean(grads, DATA_AXIS)
+            grads = jax.lax.pmean(grads, SEQ_AXIS)
+            updates, new_opt = tx.update(grads, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+            return new_params, new_opt, new_state, loss
+
+        repl = P()
+        xspec = P(DATA_AXIS, SEQ_AXIS)          # (B, T, ...) batch+seq sharded
+        out_specs = (repl, repl, repl, repl)
+        if with_mask:
+            in_specs = (repl, repl, repl, xspec, xspec, xspec, repl)
+            sm = compat_shard_map(local_step, mesh, in_specs, out_specs)
+        else:
+            def no_mask_step(params, opt_state, state, x, y, rng):
+                return local_step(params, opt_state, state, x, y, None, rng)
+
+            in_specs = (repl, repl, repl, xspec, xspec, repl)
+            inner = compat_shard_map(no_mask_step, mesh, in_specs, out_specs)
+
+            def sm(params, opt_state, state, x, y, fmask, rng):
+                return inner(params, opt_state, state, x, y, rng)
+
+        return jax.jit(sm, donate_argnums=(0, 1, 2))
+
+    # ------------------------------------------------------------------ fit
+    def fit(self, data, epochs: int = 1, batch_size: int = 32):
+        net = self.model
+        source = net._as_iterator(data, batch_size)
+        rng = jax.random.PRNGKey(net.conf.seed + 524287)
+        for _ in range(epochs):
+            for lst in net.listeners:
+                lst.on_epoch_start(net, net.epoch_count)
+            for ds in source:
+                x = jnp.asarray(ds.features)
+                y = jnp.asarray(ds.labels)
+                fm = None if ds.features_mask is None \
+                    else jnp.asarray(ds.features_mask)
+                self._check_divisible(x)
+                with_mask = fm is not None
+                if self._step is None or self._step[0] != with_mask:
+                    self._step = (with_mask, self._build_step(with_mask))
+                rng, sub = jax.random.split(rng)
+                net.params, net.opt_state, net.state, loss = self._step[1](
+                    net.params, net.opt_state, net.state, x, y, fm, sub)
+                net._score = float(loss)
+                for lst in net.listeners:
+                    lst.iteration_done(net, net.iteration_count,
+                                       net.epoch_count, net._score, 0.0,
+                                       int(x.shape[0]))
+                net.iteration_count += 1
+            for lst in net.listeners:
+                lst.on_epoch_end(net, net.epoch_count)
+            net.epoch_count += 1
+            source.reset()
+        net._train_step = None
+        net._output_fn = None
+        return net
+
+    def _check_divisible(self, x):
+        if x.shape[0] % self.data_degree:
+            raise ValueError(
+                f"batch {x.shape[0]} not divisible by data degree "
+                f"{self.data_degree}")
+        if x.shape[1] % self.seq_degree:
+            raise ValueError(
+                f"sequence length {x.shape[1]} not divisible by seq degree "
+                f"{self.seq_degree}")

@@ -1,0 +1,256 @@
+"""Attention, ring attention, context parallelism, TransformerLM tests."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.conf.base import InputType
+from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import (
+    EmbeddingSequenceLayer, LayerNormLayer, MoEFeedForward,
+    MultiHeadAttention, PositionalEmbeddingLayer, RnnOutputLayer,
+    TransformerBlock,
+)
+from deeplearning4j_tpu.nn.layers.attention import (
+    dot_product_attention, rope,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updaters import Adam
+from deeplearning4j_tpu.models import TransformerLM, TransformerLMMoE
+from deeplearning4j_tpu.parallel import (
+    ContextParallelTrainer, MeshConfig, ParallelWrapper, TrainingMode,
+    blockwise_attention, build_mesh, make_ring_attention, shard_params,
+)
+
+
+def _qkv(b=2, t=16, h=4, d=8, seed=0):
+    rs = np.random.RandomState(seed)
+    mk = lambda: jnp.asarray(rs.randn(b, t, h, d).astype("float32"))
+    return mk(), mk(), mk()
+
+
+# ------------------------------------------------------------ core attention
+def test_dot_product_attention_softmax_weights():
+    q, k, v = _qkv()
+    out = dot_product_attention(q, k, v)
+    assert out.shape == q.shape
+    # single-key sanity: attention over one key returns that value
+    out1 = dot_product_attention(q[:, :1], k[:, :1], v[:, :1])
+    np.testing.assert_allclose(np.asarray(out1), np.asarray(v[:, :1]),
+                               atol=1e-5)
+
+
+def test_causal_masking_blocks_future():
+    q, k, v = _qkv(t=8)
+    out = dot_product_attention(q, k, v, causal=True)
+    # first position can only attend to itself
+    np.testing.assert_allclose(np.asarray(out[:, 0]), np.asarray(v[:, 0]),
+                               atol=1e-5)
+    # changing future values must not change past outputs
+    v2 = v.at[:, 4:].set(0.0)
+    out2 = dot_product_attention(q, k, v2, causal=True)
+    np.testing.assert_allclose(np.asarray(out[:, :4]),
+                               np.asarray(out2[:, :4]), atol=1e-6)
+
+
+def test_key_mask_excludes_padded_steps():
+    q, k, v = _qkv(t=8)
+    mask = jnp.asarray(np.array([[1] * 8, [1] * 4 + [0] * 4], "float32"))
+    out = dot_product_attention(q, k, v, mask=mask)
+    # batch 1: zeroing masked-out v positions changes nothing
+    v2 = v.at[1, 4:].set(123.0)
+    out2 = dot_product_attention(q, k, v2, mask=mask)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(out2), atol=1e-5)
+
+
+def test_blockwise_matches_dense():
+    q, k, v = _qkv(t=32)
+    dense = dot_product_attention(q, k, v, causal=True)
+    block = blockwise_attention(q, k, v, block_size=8, causal=True)
+    np.testing.assert_allclose(np.asarray(dense), np.asarray(block),
+                               atol=2e-5)
+
+
+def test_blockwise_masked_matches_dense():
+    q, k, v = _qkv(t=32)
+    rs = np.random.RandomState(3)
+    mask = jnp.asarray((rs.rand(2, 32) > 0.3).astype("float32"))
+    dense = dot_product_attention(q, k, v, mask=mask)
+    block = blockwise_attention(q, k, v, block_size=8, causal=False,
+                                mask=mask)
+    np.testing.assert_allclose(np.asarray(dense), np.asarray(block),
+                               atol=2e-5)
+
+
+def test_rope_preserves_norm_and_relativity():
+    q, _, _ = _qkv(t=8)
+    pos = jnp.arange(8)[None]
+    r = rope(q, pos)
+    np.testing.assert_allclose(np.linalg.norm(np.asarray(r), axis=-1),
+                               np.linalg.norm(np.asarray(q), axis=-1),
+                               rtol=1e-4)
+
+
+# ------------------------------------------------------------ ring attention
+def test_ring_attention_matches_dense():
+    mesh = build_mesh(MeshConfig(data=1, model=1, seq=8))
+    q, k, v = _qkv(t=64)
+    dense = dot_product_attention(q, k, v, causal=True)
+    ring = make_ring_attention(mesh, causal=True)(q, k, v)
+    np.testing.assert_allclose(np.asarray(dense), np.asarray(ring),
+                               atol=2e-5)
+
+
+def test_ring_attention_masked_matches_dense():
+    mesh = build_mesh(MeshConfig(data=1, model=1, seq=8))
+    q, k, v = _qkv(t=64)
+    rs = np.random.RandomState(5)
+    mask = jnp.asarray((rs.rand(2, 64) > 0.25).astype("float32"))
+    dense = dot_product_attention(q, k, v, mask=mask)
+    ring = make_ring_attention(mesh, causal=False)(q, k, v, mask)
+    np.testing.assert_allclose(np.asarray(dense), np.asarray(ring),
+                               atol=2e-5)
+
+
+# ------------------------------------------------------- layers / LM models
+def _char_data(vocab=32, b=8, t=32, seed=0):
+    rs = np.random.RandomState(seed)
+    x = rs.randint(0, vocab, (b, t)).astype("float32")
+    # next-token labels: shift by one (predictable structure: y = x+1 mod V)
+    y_ids = (x.astype(int) + 1) % vocab
+    y = np.eye(vocab, dtype="float32")[y_ids]
+    return x, y
+
+
+def test_transformer_lm_trains():
+    model = TransformerLM(vocab_size=32, seq_length=32, n_layers=2,
+                          n_embd=64, n_heads=4, learning_rate=3e-3)
+    net = model.init()
+    x, y = _char_data()
+    losses = []
+    for _ in range(30):
+        net.fit((x, y), epochs=1, batch_size=8)
+        losses.append(net.score())
+    assert losses[-1] < losses[0] * 0.6, (losses[0], losses[-1])
+
+
+def test_transformer_block_and_moe_shapes():
+    conf = (NeuralNetConfiguration.Builder().seed(0).updater(Adam(1e-3))
+            .list()
+            .layer(EmbeddingSequenceLayer(n_out=32, n_in=16))
+            .layer(PositionalEmbeddingLayer(max_length=64))
+            .layer(TransformerBlock(n_out=32, n_heads=4, use_rope=False))
+            .layer(MoEFeedForward(n_out=32, n_experts=4))
+            .layer(LayerNormLayer())
+            .layer(RnnOutputLayer(n_out=16, activation="softmax",
+                                  loss="mcxent"))
+            .set_input_type(InputType.recurrent(1, 8)).build())
+    net = MultiLayerNetwork(conf).init()
+    x = np.random.RandomState(0).randint(0, 16, (4, 8)).astype("float32")
+    out = np.asarray(net.output(x))
+    assert out.shape == (4, 8, 16)
+    np.testing.assert_allclose(out.sum(-1), 1.0, atol=1e-4)
+
+
+def test_lm_conf_roundtrips():
+    from deeplearning4j_tpu.nn.conf.network import MultiLayerConfiguration
+    conf = TransformerLMMoE(vocab_size=64, seq_length=16, n_layers=2,
+                            n_embd=32, n_heads=4).conf()
+    js = conf.to_json()
+    assert MultiLayerConfiguration.from_json(js).to_json() == js
+
+
+# ------------------------------------------------------- context parallelism
+def test_context_parallel_step_matches_single_device():
+    """One CP step over an 8-way seq mesh == one single-device step."""
+    model = TransformerLM(vocab_size=16, seq_length=32, n_layers=1,
+                          n_embd=32, n_heads=4, learning_rate=1e-2, seed=3)
+    x, y = _char_data(vocab=16, b=4, t=32, seed=7)
+    net_a = model.init()
+    net_b = model.init()
+    # single device
+    net_b.fit((x, y), epochs=1, batch_size=4)
+    # context parallel over seq=8
+    mesh = build_mesh(MeshConfig(data=1, model=1, seq=8))
+    ContextParallelTrainer(net_a, mesh).fit((x, y), epochs=1, batch_size=4)
+    np.testing.assert_allclose(np.asarray(net_a.params_flat()),
+                               np.asarray(net_b.params_flat()), atol=2e-4)
+
+
+def test_context_parallel_dp_sp_mesh_trains():
+    model = TransformerLM(vocab_size=16, seq_length=16, n_layers=1,
+                          n_embd=32, n_heads=4, learning_rate=3e-3)
+    net = model.init()
+    mesh = build_mesh(MeshConfig(data=2, model=1, seq=4))
+    trainer = ContextParallelTrainer(net, mesh)
+    x, y = _char_data(vocab=16, b=8, t=16)
+    for _ in range(5):
+        trainer.fit((x, y), epochs=1, batch_size=8)
+    assert np.isfinite(net.score())
+
+
+def test_context_parallel_rejects_lstm():
+    from deeplearning4j_tpu.nn.layers import LSTM
+    conf = (NeuralNetConfiguration.Builder().seed(0).updater(Adam(1e-3))
+            .list()
+            .layer(LSTM(n_out=8))
+            .layer(RnnOutputLayer(n_out=4, activation="softmax",
+                                  loss="mcxent"))
+            .set_input_type(InputType.recurrent(4, 8)).build())
+    net = MultiLayerNetwork(conf).init()
+    with pytest.raises(ValueError):
+        ContextParallelTrainer(net, build_mesh(MeshConfig()))
+
+
+# --------------------------------------------------------------- tp sharding
+def test_transformer_tp_sharded_step():
+    """dp x tp: params sharded by the megatron rules, one wrapper step."""
+    mesh = build_mesh(MeshConfig(data=4, model=2))
+    model = TransformerLM(vocab_size=16, seq_length=16, n_layers=2,
+                          n_embd=32, n_heads=4)
+    net = model.init()
+    net.params = shard_params(net.params, mesh, TransformerLM.sharding_rules())
+    spec = net.params["1"]["attn"]["Wq"].sharding.spec
+    assert tuple(spec) == (None, "model"), spec
+    w = ParallelWrapper(net, mesh=mesh, mode=TrainingMode.SYNC_GRADIENTS)
+    x, y = _char_data(vocab=16, b=8, t=16)
+    w.fit((x, y), epochs=1, batch_size=8)
+    assert np.isfinite(net.score())
+
+
+def test_moe_expert_parallel_sharding():
+    mesh = build_mesh(MeshConfig(data=4, model=2))
+    model = TransformerLMMoE(vocab_size=16, seq_length=16, n_layers=2,
+                             n_embd=32, n_heads=4, n_experts=4)
+    net = model.init()
+    placed = shard_params(net.params, mesh, TransformerLM.sharding_rules())
+    # MoE layer index 3 (emb=0, block=1, block=2, moe=3): W1 (E, f, h), expert
+    # dim sharded over "model"
+    moe_w1 = placed["3"]["W1"]
+    assert tuple(moe_w1.sharding.spec) == ("model", None, None)
+    # dense block W1 is 2D column-parallel
+    blk_w1 = placed["1"]["W1"]
+    assert tuple(blk_w1.sharding.spec) == (None, "model")
+
+
+def test_context_parallel_masked_matches_single_device():
+    """Masked CP step == masked single-device step: valid tokens are
+    distributed unevenly across sequence shards, so the psum-weighted
+    masked mean must reproduce the global objective exactly."""
+    model = TransformerLM(vocab_size=16, seq_length=32, n_layers=1,
+                          n_embd=32, n_heads=4, learning_rate=1e-2, seed=9)
+    x, y = _char_data(vocab=16, b=4, t=32, seed=11)
+    mask = np.zeros((4, 32), "float32")
+    mask[:, :5] = 1.0          # valid tokens concentrated in early shards
+    mask[:, 31] = 1.0
+    from deeplearning4j_tpu.data.dataset import DataSet
+    ds = DataSet(x, y, mask, mask)
+    net_a = model.init()
+    net_b = model.init()
+    net_b.fit(ds, epochs=1)
+    mesh = build_mesh(MeshConfig(data=1, model=1, seq=8))
+    ContextParallelTrainer(net_a, mesh).fit(ds, epochs=1, batch_size=4)
+    assert abs(net_a.score() - net_b.score()) < 1e-4, \
+        (net_a.score(), net_b.score())
+    np.testing.assert_allclose(np.asarray(net_a.params_flat()),
+                               np.asarray(net_b.params_flat()), atol=2e-4)

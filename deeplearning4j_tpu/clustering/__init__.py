@@ -1,0 +1,18 @@
+"""Nearest neighbors + clustering (DL4J deeplearning4j-nearestneighbors parity).
+
+Reference: `deeplearning4j-nearestneighbors-parent/nearestneighbor-core/
+.../clustering/{vptree,kdtree,kmeans,lsh,randomprojection,sptree}`.
+
+Placement policy (SURVEY.md §7 hard parts): tree construction and traversal
+are host algorithms and stay host-side (numpy); the distance kernels that
+dominate k-means and brute-force search run on device (one jit-compiled
+pairwise-distance matmul per iteration — the MXU eats these).
+"""
+from deeplearning4j_tpu.clustering.kmeans import KMeansClustering
+from deeplearning4j_tpu.clustering.vptree import VPTree
+from deeplearning4j_tpu.clustering.kdtree import KDTree
+from deeplearning4j_tpu.clustering.lsh import RandomProjectionLSH
+from deeplearning4j_tpu.clustering.randomprojection import RandomProjection
+
+__all__ = ["KMeansClustering", "VPTree", "KDTree", "RandomProjectionLSH",
+           "RandomProjection"]

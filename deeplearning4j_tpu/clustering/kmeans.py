@@ -1,0 +1,81 @@
+"""K-means (DL4J `clustering/kmeans/KMeansClustering.java` + the
+clustering/algorithm framework it instantiates).
+
+Lloyd's algorithm with k-means++ seeding; the assignment step (pairwise
+distances + argmin) is one jit-compiled device program per iteration.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def _assign(points, centers):
+    """(N, D) x (K, D) -> (N,) nearest-center ids + distances (device)."""
+    d2 = (jnp.sum(points ** 2, 1)[:, None]
+          - 2.0 * points @ centers.T
+          + jnp.sum(centers ** 2, 1)[None, :])
+    idx = jnp.argmin(d2, axis=1)
+    return idx, jnp.sqrt(jnp.maximum(jnp.take_along_axis(
+        d2, idx[:, None], 1)[:, 0], 0.0))
+
+
+class KMeansClustering:
+    def __init__(self, k: int, max_iterations: int = 100,
+                 tolerance: float = 1e-4, seed: int = 0,
+                 distance: str = "euclidean"):
+        if distance not in ("euclidean",):
+            raise ValueError("only euclidean distance is supported")
+        self.k = k
+        self.max_iterations = max_iterations
+        self.tolerance = tolerance
+        self.seed = seed
+        self.centers: Optional[np.ndarray] = None
+        self.iterations_done = 0
+
+    def _init_centers(self, X, rs):
+        """k-means++ seeding."""
+        n = len(X)
+        centers = [X[rs.randint(n)]]
+        for _ in range(1, self.k):
+            d2 = np.min(
+                ((X[:, None, :] - np.asarray(centers)[None]) ** 2).sum(-1),
+                axis=1)
+            probs = d2 / max(d2.sum(), 1e-12)
+            centers.append(X[rs.choice(n, p=probs)])
+        return np.asarray(centers, np.float32)
+
+    def fit(self, X) -> "KMeansClustering":
+        X = np.asarray(X, np.float32)
+        rs = np.random.RandomState(self.seed)
+        centers = self._init_centers(X, rs)
+        Xd = jnp.asarray(X)
+        for it in range(self.max_iterations):
+            idx, _ = _assign(Xd, jnp.asarray(centers))
+            idx = np.asarray(idx)
+            new_centers = centers.copy()
+            for c in range(self.k):
+                members = X[idx == c]
+                if len(members):
+                    new_centers[c] = members.mean(0)
+            shift = float(np.linalg.norm(new_centers - centers))
+            centers = new_centers
+            self.iterations_done = it + 1
+            if shift < self.tolerance:
+                break
+        self.centers = centers
+        return self
+
+    def predict(self, X) -> np.ndarray:
+        idx, _ = _assign(jnp.asarray(np.asarray(X, np.float32)),
+                         jnp.asarray(self.centers))
+        return np.asarray(idx)
+
+    def inertia(self, X) -> float:
+        _, d = _assign(jnp.asarray(np.asarray(X, np.float32)),
+                       jnp.asarray(self.centers))
+        return float(jnp.sum(d ** 2))

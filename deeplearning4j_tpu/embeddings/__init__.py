@@ -1,0 +1,23 @@
+"""Word/sequence embeddings (DL4J deeplearning4j-nlp models/ parity).
+
+Reference: `models/sequencevectors/SequenceVectors.java:109-299`,
+`models/word2vec/Word2Vec.java`, `models/paragraphvectors/`,
+`models/glove/Glove.java`, `models/embeddings/` (lookup tables, loaders).
+
+TPU-native redesign: the reference trains with lock-free HogWild host
+threads over a shared table (`SkipGram.java:224-272` native aggregates).
+Here training is mini-batched device compute — (center, context, negative)
+id batches hit one jit-compiled step doing embedding gathers + sigmoid
+losses + optimizer update; the host side only builds vocabs and samples
+batches. Same models, same hyperparameters, same output artifact (word
+vectors + similarity queries + word2vec-format serde).
+"""
+from deeplearning4j_tpu.embeddings.vocab import VocabCache, VocabWord
+from deeplearning4j_tpu.embeddings.wordvectors import WordVectors
+from deeplearning4j_tpu.embeddings.sequencevectors import SequenceVectors
+from deeplearning4j_tpu.embeddings.word2vec import Word2Vec
+from deeplearning4j_tpu.embeddings.paragraphvectors import ParagraphVectors
+from deeplearning4j_tpu.embeddings.glove import Glove
+
+__all__ = ["VocabCache", "VocabWord", "WordVectors", "SequenceVectors",
+           "Word2Vec", "ParagraphVectors", "Glove"]

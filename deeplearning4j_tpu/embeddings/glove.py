@@ -1,0 +1,131 @@
+"""GloVe (DL4J `models/glove/Glove.java` + `learning/impl/elements/GloVe.java`).
+
+Co-occurrence counting on the host (the reference's AbstractCoOccurrences),
+then batched AdaGrad weighted-least-squares updates on device:
+
+    J = f(X_ij) (w_i . w~_j + b_i + b~_j - log X_ij)^2,
+    f(x) = (x / x_max)^alpha clipped at 1.
+
+The final vectors are w + w~ (standard GloVe practice; DL4J exposes syn0).
+"""
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Iterable, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.embeddings.sequencevectors import SequenceVectors
+
+
+@jax.jit
+def _glove_step(w, wc, b, bc, gw, gwc, gb, gbc, rows, cols, logx, fx, lr):
+    """One AdaGrad batch update. rows/cols: (N,) ids; logx/fx: (N,)."""
+    wi = w[rows]
+    wj = wc[cols]
+    diff = jnp.einsum("nd,nd->n", wi, wj) + b[rows] + bc[cols] - logx
+    fdiff = fx * diff                                   # (N,)
+    loss = 0.5 * jnp.mean(fx * diff * diff)
+    grad_wi = fdiff[:, None] * wj
+    grad_wj = fdiff[:, None] * wi
+    # AdaGrad accumulators
+    gw = gw.at[rows].add(grad_wi ** 2)
+    gwc = gwc.at[cols].add(grad_wj ** 2)
+    gb = gb.at[rows].add(fdiff ** 2)
+    gbc = gbc.at[cols].add(fdiff ** 2)
+    w = w.at[rows].add(-lr * grad_wi / jnp.sqrt(gw[rows] + 1e-8))
+    wc = wc.at[cols].add(-lr * grad_wj / jnp.sqrt(gwc[cols] + 1e-8))
+    b = b.at[rows].add(-lr * fdiff / jnp.sqrt(gb[rows] + 1e-8))
+    bc = bc.at[cols].add(-lr * fdiff / jnp.sqrt(gbc[cols] + 1e-8))
+    return w, wc, b, bc, gw, gwc, gb, gbc, loss
+
+
+class Glove(SequenceVectors):
+    def __init__(self, tokenizer=None, x_max: float = 100.0,
+                 alpha: float = 0.75, symmetric: bool = True, **kwargs):
+        kwargs.setdefault("learning_rate", 0.05)
+        kwargs.setdefault("epochs", 25)
+        super().__init__(**kwargs)
+        if tokenizer is None:
+            from deeplearning4j_tpu.text.tokenization import (
+                DefaultTokenizerFactory,
+            )
+            tokenizer = DefaultTokenizerFactory()
+        self.tokenizer = tokenizer
+        self.x_max = x_max
+        self.alpha = alpha
+        self.symmetric = symmetric
+
+    def _sequences(self, source) -> Iterable[List[str]]:
+        if hasattr(source, "reset"):
+            source.reset()
+        for sentence in source:
+            toks = self.tokenizer.tokenize(sentence) \
+                if isinstance(sentence, str) else list(sentence)
+            if toks:
+                yield toks
+
+    def _cooccurrences(self, source):
+        """Distance-weighted co-occurrence counts (AbstractCoOccurrences)."""
+        co = defaultdict(float)
+        for toks in self._sequences(source):
+            ids = [self.vocab.index_of(t) for t in toks]
+            ids = [i for i in ids if i >= 0]
+            n = len(ids)
+            for pos in range(n):
+                for off in range(1, self.window + 1):
+                    j = pos + off
+                    if j >= n:
+                        break
+                    w = 1.0 / off
+                    co[(ids[pos], ids[j])] += w
+                    if self.symmetric:
+                        co[(ids[j], ids[pos])] += w
+        return co
+
+    def fit(self, source):
+        if len(self.vocab) == 0:
+            self.build_vocab(source)
+        co = self._cooccurrences(source)
+        if not co:
+            raise ValueError("empty co-occurrence matrix")
+        pairs = np.asarray(list(co.keys()), np.int32)
+        counts = np.asarray(list(co.values()), np.float32)
+        logx = np.log(counts)
+        fx = np.minimum((counts / self.x_max) ** self.alpha, 1.0) \
+            .astype(np.float32)
+        V, D = len(self.vocab), self.layer_size
+        rs = self._rs
+        w = jnp.asarray((rs.rand(V, D).astype(np.float32) - 0.5) / D)
+        wc = jnp.asarray((rs.rand(V, D).astype(np.float32) - 0.5) / D)
+        b = jnp.zeros((V,), jnp.float32)
+        bc = jnp.zeros((V,), jnp.float32)
+        gw = jnp.full((V, D), 1e-8, jnp.float32)
+        gwc = jnp.full((V, D), 1e-8, jnp.float32)
+        gb = jnp.full((V,), 1e-8, jnp.float32)
+        gbc = jnp.full((V,), 1e-8, jnp.float32)
+        n = len(pairs)
+        bs = self.batch_size
+        self.last_loss = None
+        for _ in range(self.epochs):
+            order = rs.permutation(n)
+            for lo in range(0, n, bs):
+                sel = order[lo:lo + bs]
+                if len(sel) < bs:       # pad to static shape (weight 0)
+                    pad = rs.randint(0, n, bs - len(sel))
+                    selp = np.concatenate([sel, pad])
+                    fxb = np.concatenate(
+                        [fx[sel], np.zeros(bs - len(sel), np.float32)])
+                else:
+                    selp = sel
+                    fxb = fx[sel]
+                w, wc, b, bc, gw, gwc, gb, gbc, loss = _glove_step(
+                    w, wc, b, bc, gw, gwc, gb, gbc,
+                    jnp.asarray(pairs[selp, 0]), jnp.asarray(pairs[selp, 1]),
+                    jnp.asarray(logx[selp]), jnp.asarray(fxb),
+                    jnp.float32(self.learning_rate))
+                self.last_loss = float(loss)
+        self.vectors = np.asarray(w) + np.asarray(wc)
+        return self

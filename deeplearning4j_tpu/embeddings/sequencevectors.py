@@ -1,0 +1,296 @@
+"""SequenceVectors — the generic embedding trainer.
+
+Parity: DL4J `models/sequencevectors/SequenceVectors.java:109-299` (fit():
+buildVocab -> epoch loop) with the learning algorithms of
+`models/embeddings/learning/impl/elements/{SkipGram,CBOW}.java` (skip-gram
+and CBOW, each with negative sampling and/or hierarchical softmax, dynamic
+window shrinking, frequent-word subsampling, linear lr decay).
+
+TPU-native redesign (SURVEY.md §7): DL4J spawns HogWild threads calling
+native AggregateSkipGram ops on a shared table. Here the host samples
+(center, context, negatives) id batches and ONE jit-compiled step per batch
+does the gathers, sigmoid losses and scatter-add SGD updates on device —
+embarrassingly batched, deterministic, and the tables stay in HBM.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Iterable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.embeddings.vocab import VocabCache
+from deeplearning4j_tpu.embeddings.wordvectors import WordVectors
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+
+# ------------------------------------------------------------- device steps
+@jax.jit
+def _sg_ns_step(w_in, w_out, centers, targets, labels, lr):
+    """Skip-gram / negative-sampling SGD step.
+
+    centers: (N,) int32; targets: (N, 1+K) [context | negatives];
+    labels: (N, 1+K) 1 for the true context, 0 for negatives.
+    Returns (w_in, w_out, mean loss). DL4J analog: AggregateSkipGram's
+    inner loop, batched."""
+    vc = w_in[centers]                                  # (N, D)
+    ut = w_out[targets]                                 # (N, K+1, D)
+    logits = jnp.einsum("nd,nkd->nk", vc, ut)
+    # batch-MEAN gradients: with small vocabularies the same row appears
+    # many times per batch and the scatter-adds sum — per-pair word2vec
+    # SGD scaled by 1/N keeps the effective step bounded
+    g = (jax.nn.sigmoid(logits) - labels) / labels.shape[0]
+    grad_vc = jnp.einsum("nk,nkd->nd", g, ut)
+    grad_ut = g[..., None] * vc[:, None, :]
+    n, kp1 = targets.shape
+    d = w_in.shape[1]
+    w_in = w_in.at[centers].add(-lr * grad_vc)
+    w_out = w_out.at[targets.reshape(-1)].add(
+        -lr * grad_ut.reshape(n * kp1, d))
+    loss = jnp.mean(
+        -labels * jax.nn.log_sigmoid(logits)
+        - (1.0 - labels) * jax.nn.log_sigmoid(-logits))
+    return w_in, w_out, loss
+
+
+@jax.jit
+def _sg_hs_step(w_in, syn1, centers, points, codes, mask, lr):
+    """Skip-gram / hierarchical-softmax step. points: (N, L) inner-node ids
+    (0 where padded), codes: (N, L) Huffman bits, mask: (N, L)."""
+    vc = w_in[centers]                                  # (N, D)
+    un = syn1[points]                                   # (N, L, D)
+    logits = jnp.einsum("nd,nld->nl", vc, un)
+    labels = 1.0 - codes                                # word2vec convention
+    g = (jax.nn.sigmoid(logits) - labels) * mask / codes.shape[0]
+    grad_vc = jnp.einsum("nl,nld->nd", g, un)
+    grad_un = g[..., None] * vc[:, None, :]
+    n, L = points.shape
+    d = w_in.shape[1]
+    w_in = w_in.at[centers].add(-lr * grad_vc)
+    syn1 = syn1.at[points.reshape(-1)].add(-lr * grad_un.reshape(n * L, d))
+    loss = jnp.sum(mask * (-labels * jax.nn.log_sigmoid(logits)
+                           - (1 - labels) * jax.nn.log_sigmoid(-logits))) \
+        / jnp.maximum(jnp.sum(mask), 1.0)
+    return w_in, syn1, loss
+
+
+@jax.jit
+def _cbow_ns_step(w_in, w_out, ctx_ids, ctx_mask, targets, labels, lr):
+    """CBOW / negative sampling: the context mean predicts the center.
+    ctx_ids: (N, W) window word ids (0-padded), ctx_mask: (N, W),
+    targets: (N, 1+K) [center | negatives]."""
+    ctx = w_in[ctx_ids] * ctx_mask[..., None]           # (N, W, D)
+    denom = jnp.maximum(jnp.sum(ctx_mask, axis=1, keepdims=True), 1.0)
+    h = jnp.sum(ctx, axis=1) / denom                    # (N, D)
+    ut = w_out[targets]
+    logits = jnp.einsum("nd,nkd->nk", h, ut)
+    g = (jax.nn.sigmoid(logits) - labels) / labels.shape[0]
+    grad_h = jnp.einsum("nk,nkd->nd", g, ut)            # (N, D)
+    grad_ut = g[..., None] * h[:, None, :]
+    # distribute grad_h back to each context word (divided by window size)
+    grad_ctx = (grad_h / denom)[:, None, :] * ctx_mask[..., None]
+    n, w = ctx_ids.shape
+    d = w_in.shape[1]
+    w_in = w_in.at[ctx_ids.reshape(-1)].add(
+        -lr * grad_ctx.reshape(n * w, d))
+    w_out = w_out.at[targets.reshape(-1)].add(
+        -lr * grad_ut.reshape(-1, d))
+    loss = jnp.mean(
+        -labels * jax.nn.log_sigmoid(logits)
+        - (1.0 - labels) * jax.nn.log_sigmoid(-logits))
+    return w_in, w_out, loss
+
+
+class SequenceVectors(WordVectors):
+    """Generic embedding trainer over element sequences.
+
+    elements_learning_algorithm: "skipgram" | "cbow"
+    negative > 0 enables negative sampling; use_hierarchic_softmax enables
+    HS (both may be on, like DL4J; HS-only needs negative=0).
+
+    learning_rate is batch-mean scaled (gradients divide by batch size), so
+    it sits ~an order of magnitude above word2vec's classic per-pair 0.025.
+    """
+
+    def __init__(self, layer_size: int = 100, window: int = 5,
+                 min_count: int = 1, negative: int = 5,
+                 use_hierarchic_softmax: bool = False,
+                 subsampling: float = 0.0,
+                 learning_rate: float = 0.5,
+                 min_learning_rate: float = 1e-4,
+                 epochs: int = 1, batch_size: int = 512,
+                 elements_learning_algorithm: str = "skipgram",
+                 seed: int = 42):
+        super().__init__(VocabCache(), np.zeros((0, layer_size), np.float32))
+        self.layer_size = layer_size
+        self.window = window
+        self.min_count = min_count
+        self.negative = negative
+        self.use_hs = use_hierarchic_softmax
+        self.subsampling = subsampling
+        self.learning_rate = learning_rate
+        self.min_learning_rate = min_learning_rate
+        self.epochs = epochs
+        self.batch_size = batch_size
+        self.algorithm = elements_learning_algorithm
+        self.seed = seed
+        self._rs = np.random.RandomState(seed)
+        self.syn1 = None            # HS inner-node table
+        self.w_out = None           # NS output table
+
+    # ------------------------------------------------------------ sequences
+    def _sequences(self, source) -> Iterable[List[str]]:
+        raise NotImplementedError
+
+    # ----------------------------------------------------------------- fit
+    def build_vocab(self, source):
+        for seq in self._sequences(source):
+            for tok in seq:
+                self.vocab.add_token(tok)
+        self.vocab.build(self.min_count)
+        if self.use_hs:
+            self.vocab.build_huffman()
+        return self
+
+    def fit(self, source):
+        if len(self.vocab) == 0:
+            self.build_vocab(source)
+        V, D = len(self.vocab), self.layer_size
+        rs = self._rs
+        w_in = jnp.asarray(
+            (rs.rand(V, D).astype(np.float32) - 0.5) / D)
+        w_out = jnp.zeros((V, D), jnp.float32)
+        syn1 = jnp.zeros((max(V - 1, 1), D), jnp.float32)
+        table = self.vocab.unigram_table()
+        total_words = max(self.vocab.total_count(), 1)
+        max_code = self.vocab.max_code_length() if self.use_hs else 0
+        seen = 0
+        # pairs per word ~ (window+1) with the dynamic-window average
+        expected = total_words * (self.window + 1) * self.epochs
+        for _ in range(self.epochs):
+            for batch in self._batches(source, rs):
+                frac = min(seen / expected, 1.0)
+                lr = max(self.min_learning_rate,
+                         self.learning_rate * (1.0 - frac))
+                if self.negative > 0:
+                    if self.algorithm == "cbow":
+                        ctx_ids, ctx_mask, centers = batch
+                        negs = rs.choice(V, (len(centers), self.negative),
+                                         p=table)
+                        targets = np.concatenate(
+                            [centers[:, None], negs], axis=1)
+                        labels = np.zeros_like(targets, np.float32)
+                        labels[:, 0] = 1.0
+                        w_in, w_out, loss = _cbow_ns_step(
+                            w_in, w_out, jnp.asarray(ctx_ids),
+                            jnp.asarray(ctx_mask, jnp.float32),
+                            jnp.asarray(targets), jnp.asarray(labels),
+                            jnp.float32(lr))
+                        seen += len(centers)
+                    else:
+                        centers, contexts = batch
+                        negs = rs.choice(V, (len(centers), self.negative),
+                                         p=table)
+                        targets = np.concatenate(
+                            [contexts[:, None], negs], axis=1)
+                        labels = np.zeros_like(targets, np.float32)
+                        labels[:, 0] = 1.0
+                        w_in, w_out, loss = _sg_ns_step(
+                            w_in, w_out, jnp.asarray(centers),
+                            jnp.asarray(targets), jnp.asarray(labels),
+                            jnp.float32(lr))
+                        seen += len(centers)
+                if self.use_hs:
+                    centers, contexts = batch if self.algorithm != "cbow" \
+                        else (batch[2], batch[2])
+                    pts, cds, msk = self._hs_arrays(contexts, max_code)
+                    w_in, syn1, _ = _sg_hs_step(
+                        w_in, syn1, jnp.asarray(centers), jnp.asarray(pts),
+                        jnp.asarray(cds, jnp.float32),
+                        jnp.asarray(msk, jnp.float32), jnp.float32(lr))
+        self.vectors = np.asarray(w_in)
+        self.w_out = np.asarray(w_out)
+        self.syn1 = np.asarray(syn1)
+        return self
+
+    # ------------------------------------------------------------- sampling
+    def _encode(self, seq: List[str], rs) -> np.ndarray:
+        ids = [self.vocab.index_of(t) for t in seq]
+        ids = [i for i in ids if i >= 0]
+        if self.subsampling > 0 and ids:
+            total = self.vocab.total_count()
+            keep = []
+            for i in ids:
+                f = self.vocab.count_of(self.vocab.word_for(i)) / total
+                p = (np.sqrt(f / self.subsampling) + 1) * self.subsampling / f
+                if rs.rand() < p:
+                    keep.append(i)
+            ids = keep
+        return np.asarray(ids, np.int32)
+
+    def _batches(self, source, rs):
+        if self.algorithm == "cbow":
+            yield from self._cbow_batches(source, rs)
+            return
+        centers, contexts = [], []
+        for seq in self._sequences(source):
+            ids = self._encode(seq, rs)
+            n = len(ids)
+            for pos in range(n):
+                b = rs.randint(1, self.window + 1)    # dynamic window
+                for off in range(-b, b + 1):
+                    j = pos + off
+                    if off == 0 or j < 0 or j >= n:
+                        continue
+                    centers.append(ids[pos])
+                    contexts.append(ids[j])
+                    if len(centers) == self.batch_size:
+                        yield (np.asarray(centers, np.int32),
+                               np.asarray(contexts, np.int32))
+                        centers, contexts = [], []
+        if centers:
+            yield (np.asarray(centers, np.int32),
+                   np.asarray(contexts, np.int32))
+
+    def _cbow_batches(self, source, rs):
+        W = 2 * self.window
+        ctx_rows, mask_rows, centers = [], [], []
+        for seq in self._sequences(source):
+            ids = self._encode(seq, rs)
+            n = len(ids)
+            for pos in range(n):
+                b = rs.randint(1, self.window + 1)
+                row = [ids[pos + off] for off in range(-b, b + 1)
+                       if off != 0 and 0 <= pos + off < n]
+                if not row:
+                    continue
+                pad = W - len(row)
+                ctx_rows.append(row + [0] * pad)
+                mask_rows.append([1.0] * len(row) + [0.0] * pad)
+                centers.append(ids[pos])
+                if len(centers) == self.batch_size:
+                    yield (np.asarray(ctx_rows, np.int32),
+                           np.asarray(mask_rows, np.float32),
+                           np.asarray(centers, np.int32))
+                    ctx_rows, mask_rows, centers = [], [], []
+        if centers:
+            yield (np.asarray(ctx_rows, np.int32),
+                   np.asarray(mask_rows, np.float32),
+                   np.asarray(centers, np.int32))
+
+    def _hs_arrays(self, word_ids, max_code):
+        n = len(word_ids)
+        pts = np.zeros((n, max_code), np.int32)
+        cds = np.zeros((n, max_code), np.float32)
+        msk = np.zeros((n, max_code), np.float32)
+        vws = self.vocab.vocab_words()
+        for r, wid in enumerate(word_ids):
+            vw = vws[int(wid)]
+            L = len(vw.codes or [])
+            pts[r, :L] = vw.points
+            cds[r, :L] = vw.codes
+            msk[r, :L] = 1.0
+        return pts, cds, msk

@@ -1,0 +1,39 @@
+"""Word2Vec (DL4J `models/word2vec/Word2Vec.java:32`).
+
+SequenceVectors specialization over a sentence iterator + tokenizer —
+the classic skip-gram / CBOW with negative sampling and/or hierarchical
+softmax. Usage mirrors DL4J's builder:
+
+    w2v = Word2Vec(layer_size=100, window=5, min_count=5, negative=5,
+                   tokenizer=DefaultTokenizerFactory(CommonPreprocessor()))
+    w2v.fit(BasicLineIterator("corpus.txt"))
+    w2v.words_nearest("day", 10)
+"""
+from __future__ import annotations
+
+from typing import Iterable, List, Optional
+
+from deeplearning4j_tpu.embeddings.sequencevectors import SequenceVectors
+
+
+class Word2Vec(SequenceVectors):
+    def __init__(self, tokenizer=None, stop_words=(), **kwargs):
+        super().__init__(**kwargs)
+        if tokenizer is None:
+            from deeplearning4j_tpu.text.tokenization import (
+                DefaultTokenizerFactory,
+            )
+            tokenizer = DefaultTokenizerFactory()
+        self.tokenizer = tokenizer
+        self.stop_words = frozenset(stop_words)
+
+    def _sequences(self, source) -> Iterable[List[str]]:
+        if hasattr(source, "reset"):
+            source.reset()
+        for sentence in source:
+            toks = self.tokenizer.tokenize(sentence) \
+                if isinstance(sentence, str) else list(sentence)
+            if self.stop_words:
+                toks = [t for t in toks if t not in self.stop_words]
+            if toks:
+                yield toks

@@ -1,0 +1,11 @@
+"""Graph data structures + embeddings (DL4J deeplearning4j-graph parity).
+
+Reference: `deeplearning4j-graph/.../graph/{api,data,iterator,models}/` —
+IGraph, random-walk iterators, DeepWalk with hierarchical-softmax.
+DeepWalk here reuses the TPU-batched SequenceVectors machinery: walks are
+"sentences", vertices are "words" (exactly the DeepWalk reduction).
+"""
+from deeplearning4j_tpu.graph.graph import Graph
+from deeplearning4j_tpu.graph.deepwalk import DeepWalk, GraphVectors
+
+__all__ = ["Graph", "DeepWalk", "GraphVectors"]

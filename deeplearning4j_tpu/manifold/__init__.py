@@ -1,0 +1,4 @@
+"""Manifold learning (DL4J deeplearning4j-manifold parity)."""
+from deeplearning4j_tpu.manifold.tsne import Tsne
+
+__all__ = ["Tsne"]

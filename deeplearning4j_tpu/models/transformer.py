@@ -69,7 +69,9 @@ class TransformerLM(ZooModel):
                 mlp_ratio=self.mlp_ratio, causal=self.causal,
                 use_rope=self.use_rope,
                 attention_dropout=self.dropout,
-                residual_dropout=self.dropout))
+                residual_dropout=self.dropout,
+                attention_impl=self.attention_impl,
+                block_size=self.block_size))
             if self.moe_every and (i + 1) % self.moe_every == 0:
                 b.layer(MoEFeedForward(n_out=self.n_embd,
                                        n_experts=self.n_experts,

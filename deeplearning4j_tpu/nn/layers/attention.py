@@ -174,6 +174,11 @@ class MultiHeadAttention(LayerConf):
         if self.n_out % self.n_heads:
             raise ValueError(f"n_out {self.n_out} not divisible by "
                              f"n_heads {self.n_heads}")
+        if self.use_rope and (self.n_out // self.n_heads) % 2:
+            raise ValueError(
+                f"rotary embeddings need an even head dim; got "
+                f"{self.n_out // self.n_heads} (n_out={self.n_out}, "
+                f"n_heads={self.n_heads}) — disable use_rope or resize")
         f_in = self.n_in or input_type.features
         w_init = get_initializer(self.weight_init)
         ks = jax.random.split(key, 4)
@@ -199,6 +204,9 @@ class MultiHeadAttention(LayerConf):
         return _split_heads(q, h), _split_heads(k, h), _split_heads(v, h)
 
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        attn_rng = None
+        if rng is not None:
+            rng, attn_rng = jax.random.split(rng)
         x = self.maybe_dropout_input(x, train, rng)
         q, k, v = self._qkv(params, x)
         t_loc = x.shape[1]
@@ -213,16 +221,16 @@ class MultiHeadAttention(LayerConf):
             out = ring_self_attention(q, k, v,
                                       axis_name=_CONTEXT_PARALLEL_AXIS,
                                       causal=self.causal, mask=mask,
-                                      dropout=drop, rng=rng)
+                                      dropout=drop, rng=attn_rng)
         elif self.attention_impl == "blockwise":
             from deeplearning4j_tpu.parallel.ring import blockwise_attention
             out = blockwise_attention(q, k, v, block_size=self.block_size,
                                       causal=self.causal, mask=mask,
-                                      dropout=drop, rng=rng)
+                                      dropout=drop, rng=attn_rng)
         else:
             out = dot_product_attention(
                 q, k, v, mask=mask, causal=self.causal,
-                dropout=self.attention_dropout if train else 0.0, rng=rng)
+                dropout=drop, rng=attn_rng)
         y = _merge_heads(out) @ params["Wo"]
         if self.has_bias:
             y = y + params["bo"]
@@ -247,6 +255,8 @@ class TransformerBlock(LayerConf):
     attention_dropout: float = 0.0
     residual_dropout: float = 0.0
     weight_init: str = "xavier"
+    attention_impl: str = "dense"       # forwarded to MultiHeadAttention
+    block_size: int = 512
 
     def output_type(self, input_type: InputType) -> InputType:
         t = input_type.shape[0]
@@ -256,7 +266,8 @@ class TransformerBlock(LayerConf):
         attn = MultiHeadAttention(
             n_out=self.n_out, n_heads=self.n_heads, causal=self.causal,
             use_rope=self.use_rope, attention_dropout=self.attention_dropout,
-            weight_init=self.weight_init)
+            weight_init=self.weight_init, attention_impl=self.attention_impl,
+            block_size=self.block_size)
         ln = LayerNormLayer()
         return ln, attn
 
@@ -389,8 +400,20 @@ class PositionalEmbeddingLayer(LayerConf):
         t = x.shape[1]
         start = _seq_offset(t)
         if isinstance(start, int) and start == 0:
+            if t > self.max_length:
+                raise ValueError(
+                    f"sequence length {t} exceeds max_length "
+                    f"{self.max_length}")
             pos = params["P"][:t]
         else:    # context-parallel shard: take this shard's slice
+            # the global length is static (shard count x local length);
+            # reject overflow at trace time — dynamic_slice would silently
+            # clamp late shards onto the tail rows
+            global_t = t * jax.lax.psum(1, _CONTEXT_PARALLEL_AXIS)
+            if int(global_t) > self.max_length:
+                raise ValueError(
+                    f"global sequence length {int(global_t)} exceeds "
+                    f"max_length {self.max_length}")
             pos = jax.lax.dynamic_slice_in_dim(params["P"], start, t)
         return x + pos[None], state
 

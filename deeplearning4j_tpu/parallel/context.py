@@ -63,16 +63,17 @@ class ContextParallelTrainer:
             raise NotImplementedError(
                 "context parallelism currently supports MultiLayerNetwork")
         for layer in model.layers:
+            # check every level of the wrapper chain: both a crossing
+            # wrapper (LastTimeStep, Bidirectional) and a crossing wrapped
+            # layer (FrozenLayerWrapper(LSTM)) are rejected
             inner = layer
-            # unwrap FrozenLayerWrapper (and any future wrapper exposing
-            # .layer) — the wrapped layer still computes across time
-            while getattr(inner, "layer", None) is not None:
-                inner = inner.layer
-            if type(inner).__name__ in _SEQ_CROSSING:
-                raise ValueError(
-                    f"{type(inner).__name__} carries state across sequence "
-                    "shards and cannot run context-parallel; use "
-                    "attention/transformer layers")
+            while inner is not None:
+                if type(inner).__name__ in _SEQ_CROSSING:
+                    raise ValueError(
+                        f"{type(inner).__name__} carries state across "
+                        "sequence shards and cannot run context-parallel; "
+                        "use attention/transformer layers")
+                inner = getattr(inner, "layer", None)
         if model.conf.backprop_type != "standard":
             raise ValueError("context parallelism requires standard backprop")
         self.model = model
@@ -151,7 +152,10 @@ class ContextParallelTrainer:
     def fit(self, data, epochs: int = 1, batch_size: int = 32):
         net = self.model
         source = net._as_iterator(data, batch_size)
-        rng = jax.random.PRNGKey(net.conf.seed + 524287)
+        # vary by epoch_count so repeated fit() calls draw fresh dropout
+        # masks (matches MultiLayerNetwork._fit_epoch keying)
+        rng = jax.random.fold_in(
+            jax.random.PRNGKey(net.conf.seed + 524287), net.epoch_count)
         for _ in range(epochs):
             for lst in net.listeners:
                 lst.on_epoch_start(net, net.epoch_count)
@@ -162,11 +166,14 @@ class ContextParallelTrainer:
                     else jnp.asarray(ds.features_mask)
                 self._check_divisible(x)
                 with_mask = fm is not None
-                if self._step is None or self._step[0] != with_mask:
-                    self._step = (with_mask, self._build_step(with_mask))
+                if self._step is None:
+                    self._step = {}
+                if with_mask not in self._step:
+                    self._step[with_mask] = self._build_step(with_mask)
                 rng, sub = jax.random.split(rng)
-                net.params, net.opt_state, net.state, loss = self._step[1](
-                    net.params, net.opt_state, net.state, x, y, fm, sub)
+                net.params, net.opt_state, net.state, loss = \
+                    self._step[with_mask](
+                        net.params, net.opt_state, net.state, x, y, fm, sub)
                 net._score = float(loss)
                 for lst in net.listeners:
                     lst.iteration_done(net, net.iteration_count,

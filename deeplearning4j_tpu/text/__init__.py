@@ -1,0 +1,22 @@
+"""Text pipeline (DL4J deeplearning4j-nlp text/ parity).
+
+Reference: `deeplearning4j-nlp-parent/deeplearning4j-nlp/.../text/`
+{tokenization, sentenceiterator, documentiterator, stopwords}. Host-side
+string processing stays host-side (SURVEY.md §7 hard parts: HogWild-class
+algorithms don't belong on TPU); devices only see tokenized id batches.
+"""
+from deeplearning4j_tpu.text.tokenization import (
+    DefaultTokenizerFactory, NGramTokenizerFactory, RegexTokenizerFactory,
+    CommonPreprocessor, LowCasePreprocessor,
+)
+from deeplearning4j_tpu.text.sentenceiterator import (
+    BasicLineIterator, CollectionSentenceIterator, FileSentenceIterator,
+)
+from deeplearning4j_tpu.text.stopwords import STOP_WORDS
+
+__all__ = [
+    "DefaultTokenizerFactory", "NGramTokenizerFactory",
+    "RegexTokenizerFactory", "CommonPreprocessor", "LowCasePreprocessor",
+    "BasicLineIterator", "CollectionSentenceIterator",
+    "FileSentenceIterator", "STOP_WORDS",
+]

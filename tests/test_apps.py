@@ -1,0 +1,121 @@
+"""Clustering / t-SNE / graph embedding tests (DL4J nearestneighbor-core,
+deeplearning4j-tsne, deeplearning4j-graph test strategies)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.clustering import (
+    KDTree, KMeansClustering, RandomProjection, RandomProjectionLSH, VPTree,
+)
+from deeplearning4j_tpu.graph import DeepWalk, Graph
+from deeplearning4j_tpu.manifold import Tsne
+
+
+def _three_blobs(n_per=50, d=8, seed=0):
+    rs = np.random.RandomState(seed)
+    centers = rs.randn(3, d) * 8
+    X = np.concatenate([centers[i] + rs.randn(n_per, d)
+                        for i in range(3)]).astype("float32")
+    y = np.repeat(np.arange(3), n_per)
+    return X, y
+
+
+def test_kmeans_recovers_blobs():
+    X, y = _three_blobs()
+    km = KMeansClustering(k=3, seed=1).fit(X)
+    pred = km.predict(X)
+    # cluster purity: every true blob maps to one dominant cluster
+    for c in range(3):
+        counts = np.bincount(pred[y == c], minlength=3)
+        assert counts.max() / counts.sum() > 0.95
+    assert km.inertia(X) < KMeansClustering(k=1, seed=1).fit(X).inertia(X)
+
+
+def test_vptree_matches_bruteforce():
+    X, _ = _three_blobs(n_per=30)
+    tree = VPTree(X)
+    rs = np.random.RandomState(3)
+    for _ in range(5):
+        q = rs.randn(X.shape[1]).astype("float32") * 4
+        idxs, dists = tree.knn(q, k=5)
+        brute = np.argsort(np.linalg.norm(X - q, axis=1))[:5]
+        assert set(idxs) == set(int(i) for i in brute)
+        assert dists == sorted(dists)
+
+
+def test_kdtree_matches_bruteforce():
+    X, _ = _three_blobs(n_per=30, d=4)
+    tree = KDTree(X)
+    rs = np.random.RandomState(4)
+    for _ in range(5):
+        q = rs.randn(4).astype("float32") * 4
+        idxs, _ = tree.knn(q, k=3)
+        brute = np.argsort(np.linalg.norm(X - q, axis=1))[:3]
+        assert set(idxs) == set(int(i) for i in brute)
+
+
+def test_lsh_finds_close_neighbors():
+    X, _ = _three_blobs(n_per=60)
+    lsh = RandomProjectionLSH(hash_length=8, num_tables=6, seed=0).fit(X)
+    idxs, dists = lsh.query(X[0], k=5)
+    assert idxs[0] == 0 and abs(dists[0]) < 1e-5
+    # returned neighbors are genuinely close (same blob radius)
+    assert all(d < 10.0 for d in dists)
+
+
+def test_random_projection_preserves_distances():
+    X, _ = _three_blobs(n_per=40, d=64)
+    rp = RandomProjection(target_dim=32, seed=0).fit(X)
+    Z = rp.transform(X)
+    assert Z.shape == (120, 32)
+    rs = np.random.RandomState(0)
+    pairs = rs.randint(0, 120, (30, 2))
+    dx = np.linalg.norm(X[pairs[:, 0]] - X[pairs[:, 1]], axis=1)
+    dz = np.linalg.norm(Z[pairs[:, 0]] - Z[pairs[:, 1]], axis=1)
+    ratio = dz / np.maximum(dx, 1e-9)
+    assert 0.6 < ratio.mean() < 1.4
+
+
+def test_tsne_separates_blobs():
+    X, y = _three_blobs(n_per=30)
+    ts = Tsne(perplexity=10, max_iter=300, seed=0)
+    Y = ts.fit_transform(X)
+    assert Y.shape == (90, 2)
+    assert np.isfinite(ts.kl_divergence_)
+    # blob centroids in the embedding are farther apart than intra spread
+    cents = np.stack([Y[y == c].mean(0) for c in range(3)])
+    intra = np.mean([np.linalg.norm(Y[y == c] - cents[c], axis=1).mean()
+                     for c in range(3)])
+    inter = np.mean([np.linalg.norm(cents[a] - cents[b])
+                     for a in range(3) for b in range(a + 1, 3)])
+    assert inter > 2 * intra, (inter, intra)
+
+
+def test_graph_and_walks():
+    g = Graph.from_edges([(0, 1), (1, 2), (2, 0), (3, 4), (4, 5), (5, 3)])
+    assert g.n_vertices == 6
+    assert g.num_edges() == 6
+    assert set(g.neighbors(0)) == {1, 2}
+    walks = list(g.random_walks(walk_length=10, walks_per_vertex=2, seed=0))
+    assert len(walks) == 12
+    # walks never cross between the two triangle components
+    for w in walks:
+        comp = set(w)
+        assert comp <= {0, 1, 2} or comp <= {3, 4, 5}, w
+
+
+def test_deepwalk_embeds_components_apart():
+    """Two disconnected cliques: intra-component similarity must dominate."""
+    edges = []
+    for comp, base in ((0, 0), (1, 6)):
+        for i in range(6):
+            for j in range(i + 1, 6):
+                edges.append((base + i, base + j))
+    g = Graph.from_edges(edges)
+    dw = DeepWalk(layer_size=16, window=3, walk_length=20,
+                  walks_per_vertex=8, epochs=10, seed=0)
+    dw.fit_graph(g)
+    intra = np.mean([dw.vertex_similarity(0, j) for j in range(1, 6)])
+    inter = np.mean([dw.vertex_similarity(0, j) for j in range(6, 12)])
+    assert intra > inter, (intra, inter)
+    near = dw.verts_nearest(0, 5)
+    assert set(near) <= set(range(1, 6)), near

@@ -254,3 +254,39 @@ def test_context_parallel_masked_matches_single_device():
         (net_a.score(), net_b.score())
     np.testing.assert_allclose(np.asarray(net_a.params_flat()),
                                np.asarray(net_b.params_flat()), atol=2e-4)
+
+
+def test_review_fixes_guards():
+    """Regression guards from review: rope odd head dim fails at init;
+    LastTimeStep wrapper rejected by CP; blockwise impl wired through
+    TransformerLM; positional overflow raises."""
+    from deeplearning4j_tpu.nn.layers import LastTimeStep, DenseLayer, OutputLayer
+    with pytest.raises(ValueError, match="even head dim"):
+        conf = (NeuralNetConfiguration.Builder().seed(0).updater(Adam(1e-3))
+                .list()
+                .layer(MultiHeadAttention(n_out=36, n_heads=4))
+                .layer(RnnOutputLayer(n_out=4, activation="softmax",
+                                      loss="mcxent"))
+                .set_input_type(InputType.recurrent(8, 4)).build())
+        MultiLayerNetwork(conf).init()
+    # LastTimeStep wrapping attention still rejected by the CP guard
+    conf2 = (NeuralNetConfiguration.Builder().seed(0).updater(Adam(1e-3))
+             .list()
+             .layer(LastTimeStep(layer=MultiHeadAttention(n_out=8, n_heads=2)))
+             .layer(OutputLayer(n_out=4, activation="softmax", loss="mcxent"))
+             .set_input_type(InputType.recurrent(8, 4)).build())
+    net = MultiLayerNetwork(conf2).init()
+    with pytest.raises(ValueError, match="sequence shards"):
+        ContextParallelTrainer(net, build_mesh(MeshConfig()))
+    # blockwise plumbed through the zoo model
+    lm = TransformerLM(vocab_size=8, seq_length=16, n_layers=1, n_embd=16,
+                       n_heads=2, attention_impl="blockwise", block_size=4)
+    conf3 = lm.conf()
+    assert conf3.layers[1].attention_impl == "blockwise"
+    assert conf3.layers[1].block_size == 4
+    # positional embedding overflow fails loudly
+    with pytest.raises(ValueError, match="max_length"):
+        p = PositionalEmbeddingLayer(max_length=4)
+        import jax.numpy as jnp
+        params, _ = p.init(jax.random.PRNGKey(0), InputType.recurrent(3, 8))
+        p.apply(params, {}, jnp.zeros((1, 8, 3)))

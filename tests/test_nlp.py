@@ -1,0 +1,139 @@
+"""NLP tests (DL4J deeplearning4j-nlp test strategy: small corpora, check
+vocab/similarity structure rather than absolute numbers)."""
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.embeddings import (
+    Glove, ParagraphVectors, VocabCache, Word2Vec, WordVectors,
+)
+from deeplearning4j_tpu.text import (
+    BasicLineIterator, CollectionSentenceIterator, CommonPreprocessor,
+    DefaultTokenizerFactory, NGramTokenizerFactory, STOP_WORDS,
+)
+
+
+def _toy_corpus(n_sent=300, seed=0):
+    """Two topic clusters: {cat, dog, pet} and {car, bus, road} co-occur
+    within topics, never across — embeddings must separate them."""
+    rs = np.random.RandomState(seed)
+    animals = ["cat", "dog", "pet", "fur", "tail"]
+    vehicles = ["car", "bus", "road", "wheel", "engine"]
+    sents = []
+    for _ in range(n_sent):
+        pool = animals if rs.rand() < 0.5 else vehicles
+        sents.append(" ".join(rs.choice(pool, 6)))
+    return sents
+
+
+# ------------------------------------------------------------------- text
+def test_tokenizer_and_preprocessor():
+    tf = DefaultTokenizerFactory(CommonPreprocessor())
+    assert tf.tokenize("Hello, World! 123") == ["hello", "world"]
+    ng = NGramTokenizerFactory(min_n=1, max_n=2)
+    toks = ng.tokenize("a b c")
+    assert "a b" in toks and "b c" in toks and "a" in toks
+
+
+def test_sentence_iterators(tmp_path):
+    p = tmp_path / "corpus.txt"
+    p.write_text("first sentence\n\nsecond sentence\n")
+    it = BasicLineIterator(str(p))
+    assert list(it) == ["first sentence", "second sentence"]
+    ci = CollectionSentenceIterator(["a", "b"])
+    assert list(ci) == ["a", "b"]
+    assert "the" in STOP_WORDS
+
+
+# ------------------------------------------------------------------ vocab
+def test_vocab_build_and_huffman():
+    v = VocabCache()
+    for w, c in (("the", 100), ("cat", 10), ("dog", 8), ("rare", 1)):
+        v.add_token(w, c)
+    v.build(min_count=2)
+    assert len(v) == 3
+    assert v.index_of("the") == 0          # most frequent first
+    assert v.index_of("rare") == -1
+    v.build_huffman()
+    vws = v.vocab_words()
+    # frequent word gets a shorter code
+    assert len(vws[0].codes) <= len(vws[-1].codes)
+    # codes are prefix-free: no code is a prefix of another
+    codes = ["".join(map(str, w.codes)) for w in vws]
+    for i, a in enumerate(codes):
+        for j, b in enumerate(codes):
+            if i != j:
+                assert not b.startswith(a)
+
+
+def test_unigram_table_powers():
+    v = VocabCache()
+    v.add_token("a", 100)
+    v.add_token("b", 1)
+    v.build()
+    t = v.unigram_table()
+    assert t[0] > t[1] and abs(t.sum() - 1) < 1e-6
+
+
+# --------------------------------------------------------------- word2vec
+def test_word2vec_separates_topics():
+    w2v = Word2Vec(layer_size=32, window=3, min_count=2, negative=5,
+                   epochs=40, seed=1)
+    w2v.fit(CollectionSentenceIterator(_toy_corpus()))
+    assert len(w2v.vocab) == 10
+    same = w2v.similarity("cat", "dog")
+    cross = w2v.similarity("cat", "car")
+    assert same > cross, (same, cross)
+    near = w2v.words_nearest("cat", 4)
+    assert set(near).issubset({"dog", "pet", "fur", "tail"}), near
+
+
+def test_word2vec_cbow_and_hs():
+    corpus = CollectionSentenceIterator(_toy_corpus(200, seed=2))
+    cbow = Word2Vec(layer_size=24, window=3, min_count=2, negative=5,
+                    elements_learning_algorithm="cbow", epochs=40, seed=2)
+    cbow.fit(corpus)
+    assert cbow.similarity("bus", "road") > cbow.similarity("bus", "dog")
+    hs = Word2Vec(layer_size=24, window=3, min_count=2, negative=0,
+                  use_hierarchic_softmax=True, epochs=40, seed=3)
+    hs.fit(corpus)
+    assert hs.similarity("cat", "pet") > hs.similarity("cat", "engine")
+
+
+def test_word_vectors_serde(tmp_path):
+    w2v = Word2Vec(layer_size=16, min_count=1, epochs=1, seed=0)
+    w2v.fit(CollectionSentenceIterator(_toy_corpus(50)))
+    p = str(tmp_path / "vecs.txt")
+    w2v.save_text(p)
+    loaded = WordVectors.load_text(p)
+    assert len(loaded.vocab) == len(w2v.vocab)
+    np.testing.assert_allclose(loaded.get_word_vector("cat"),
+                               w2v.get_word_vector("cat"), atol=1e-5)
+
+
+# ------------------------------------------------------- paragraph vectors
+def test_paragraph_vectors_labels():
+    docs = []
+    rs = np.random.RandomState(0)
+    animals = ["cat", "dog", "pet", "fur"]
+    vehicles = ["car", "bus", "road", "wheel"]
+    for i in range(40):
+        docs.append((f"animal_{i}", " ".join(rs.choice(animals, 8))))
+        docs.append((f"vehicle_{i}", " ".join(rs.choice(vehicles, 8))))
+    pv = ParagraphVectors(layer_size=24, min_count=1, negative=5, epochs=20,
+                          learning_rate=0.5, seed=4)
+    pv.fit(docs)
+    assert len(pv.labels) == 80
+    near = pv.nearest_labels("cat dog fur pet cat dog", top_n=10)
+    animal_hits = sum(1 for lbl in near if lbl.startswith("animal"))
+    assert animal_hits >= 7, near
+
+
+# ------------------------------------------------------------------ glove
+def test_glove_separates_topics():
+    g = Glove(layer_size=24, window=4, min_count=2, epochs=20,
+              batch_size=256, seed=5)
+    g.fit(CollectionSentenceIterator(_toy_corpus(300, seed=5)))
+    assert np.isfinite(g.last_loss)
+    assert g.similarity("cat", "dog") > g.similarity("cat", "car")

@@ -1,0 +1,4 @@
+"""Model import (DL4J deeplearning4j-modelimport parity)."""
+from deeplearning4j_tpu.modelimport.keras import KerasModelImport
+
+__all__ = ["KerasModelImport"]

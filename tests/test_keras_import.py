@@ -1,0 +1,123 @@
+"""Keras import tests — numerical parity against live Keras models
+(the analog of DL4J's modelimport fixture tests, but generating fixtures
+on the fly instead of downloading dl4j-test-resources)."""
+import os
+
+import numpy as np
+import pytest
+
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+keras = pytest.importorskip("keras")
+
+from deeplearning4j_tpu.modelimport import KerasModelImport  # noqa: E402
+
+
+def _save(model, tmp_path, name="m.h5"):
+    p = str(tmp_path / name)
+    model.save(p)
+    return p
+
+
+def test_sequential_mlp_parity(tmp_path):
+    m = keras.Sequential([
+        keras.layers.Input((12,)),
+        keras.layers.Dense(16, activation="relu"),
+        keras.layers.Dropout(0.2),
+        keras.layers.Dense(3, activation="softmax"),
+    ])
+    p = _save(m, tmp_path)
+    net = KerasModelImport.import_keras_sequential_model_and_weights(p)
+    x = np.random.RandomState(0).randn(5, 12).astype("float32")
+    expected = np.asarray(m(x))
+    got = np.asarray(net.output(x))
+    np.testing.assert_allclose(got, expected, atol=1e-5)
+
+
+def test_sequential_cnn_parity(tmp_path):
+    m = keras.Sequential([
+        keras.layers.Input((16, 16, 3)),
+        keras.layers.Conv2D(8, 3, activation="relu", padding="same"),
+        keras.layers.MaxPooling2D(2),
+        keras.layers.Conv2D(4, 3, padding="valid", activation="tanh"),
+        keras.layers.Flatten(),
+        keras.layers.Dense(5, activation="softmax"),
+    ])
+    p = _save(m, tmp_path)
+    net = KerasModelImport.import_keras_sequential_model_and_weights(p)
+    x = np.random.RandomState(1).rand(3, 16, 16, 3).astype("float32")
+    np.testing.assert_allclose(np.asarray(net.output(x)),
+                               np.asarray(m(x)), atol=1e-4)
+
+
+def test_sequential_batchnorm_parity(tmp_path):
+    m = keras.Sequential([
+        keras.layers.Input((8, 8, 2)),
+        keras.layers.Conv2D(4, 3, padding="same"),
+        keras.layers.BatchNormalization(),
+        keras.layers.Activation("relu"),
+        keras.layers.GlobalAveragePooling2D(),
+        keras.layers.Dense(2, activation="softmax"),
+    ])
+    # make BN stats non-trivial
+    m.compile(optimizer="sgd", loss="categorical_crossentropy")
+    rs = np.random.RandomState(2)
+    m.fit(rs.rand(32, 8, 8, 2), np.eye(2)[rs.randint(0, 2, 32)],
+          epochs=1, verbose=0)
+    p = _save(m, tmp_path)
+    net = KerasModelImport.import_keras_sequential_model_and_weights(p)
+    x = rs.rand(4, 8, 8, 2).astype("float32")
+    np.testing.assert_allclose(np.asarray(net.output(x)),
+                               np.asarray(m(x)), atol=1e-4)
+
+
+def test_sequential_lstm_parity(tmp_path):
+    m = keras.Sequential([
+        keras.layers.Input((6, 4)),
+        keras.layers.LSTM(5, return_sequences=True),
+        keras.layers.Dense(3, activation="softmax"),
+    ])
+    p = _save(m, tmp_path)
+    net = KerasModelImport.import_keras_sequential_model_and_weights(p)
+    x = np.random.RandomState(3).rand(2, 6, 4).astype("float32")
+    np.testing.assert_allclose(np.asarray(net.output(x)),
+                               np.asarray(m(x)), atol=1e-4)
+
+
+def test_functional_residual_parity(tmp_path):
+    inp = keras.layers.Input((10,), name="inp")
+    h = keras.layers.Dense(10, activation="tanh", name="h1")(inp)
+    s = keras.layers.Add(name="res")([h, inp])
+    out = keras.layers.Dense(4, activation="softmax", name="out")(s)
+    m = keras.Model(inp, out)
+    p = _save(m, tmp_path)
+    net = KerasModelImport.import_keras_model_and_weights(p)
+    x = np.random.RandomState(4).randn(3, 10).astype("float32")
+    np.testing.assert_allclose(np.asarray(net.output(x)),
+                               np.asarray(m(x)), atol=1e-5)
+
+
+def test_imported_model_can_finetune(tmp_path):
+    m = keras.Sequential([
+        keras.layers.Input((6,)),
+        keras.layers.Dense(8, activation="relu"),
+        keras.layers.Dense(2, activation="softmax"),
+    ])
+    p = _save(m, tmp_path)
+    net = KerasModelImport.import_keras_sequential_model_and_weights(p)
+    rs = np.random.RandomState(5)
+    X = rs.randn(64, 6).astype("float32")
+    Y = np.eye(2, dtype="float32")[(X[:, 0] > 0).astype(int)]
+    net.fit((X, Y), epochs=40, batch_size=16)
+    assert net.evaluate((X, Y)).accuracy() > 0.8
+
+
+def test_unsupported_layer_raises(tmp_path):
+    m = keras.Sequential([
+        keras.layers.Input((4, 4, 1)),
+        keras.layers.Conv2DTranspose(2, 3),
+        keras.layers.Flatten(),
+        keras.layers.Dense(2),
+    ])
+    p = _save(m, tmp_path)
+    with pytest.raises(ValueError, match="Unsupported Keras layer"):
+        KerasModelImport.import_keras_model_and_weights(p)

@@ -219,7 +219,11 @@ class UciSequenceDataSetIterator(ArrayDataSetIterator):
             ys = np.repeat(np.arange(6), 100)
         X = X.astype("float32")[..., None]          # (600, 60, 1)
         Y = np.eye(6, dtype="float32")[ys]
-        cut = 450 if train is not None else len(X)
+        # The file is class-ordered (6 blocks of 100): shuffle with a fixed
+        # seed before the 450/150 split so both splits see all classes
+        # (UciSequenceDataFetcher.java:143, Random(12345)).
+        perm = np.random.RandomState(12345).permutation(len(X))
+        X, Y = X[perm], Y[perm]
         sl = slice(0, 450) if train else slice(450, 600)
         super().__init__(X[sl], Y[sl], batch_size=batch_size)
 

@@ -271,11 +271,23 @@ class RecordReaderMultiDataSetIterator(DataSetIterator):
     def __iter__(self):
         iters = {n: r.records() for n, r in self.readers.items()}
         while True:
-            rows = {}
-            try:
-                batch_rows = {n: [next(it) for _ in range(self._batch)]
-                              for n, it in iters.items()}
-            except StopIteration:
+            # Collect up to batch_size rows per reader, keeping the final
+            # partial batch (DL4J emits it) and erroring on length-mismatched
+            # readers instead of silently dropping rows.
+            batch_rows = {}
+            for n, it in iters.items():
+                rows = []
+                for _ in range(self._batch):
+                    try:
+                        rows.append(next(it))
+                    except StopIteration:
+                        break
+                batch_rows[n] = rows
+            counts = {n: len(v) for n, v in batch_rows.items()}
+            if len(set(counts.values())) > 1:
+                raise ValueError(
+                    f"record readers are misaligned: {counts}")
+            if not next(iter(counts.values()), 0):
                 return
             arrays = {n: np.asarray(v, "float32")
                       for n, v in batch_rows.items()}

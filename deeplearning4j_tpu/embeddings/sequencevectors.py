@@ -78,6 +78,35 @@ def _sg_hs_step(w_in, syn1, centers, points, codes, mask, lr):
 
 
 @jax.jit
+def _cbow_hs_step(w_in, syn1, ctx_ids, ctx_mask, points, codes, mask, lr):
+    """CBOW / hierarchical-softmax: the context-window mean predicts the
+    CENTER word's Huffman path (DL4J CBOW.java HS path — the input vector
+    is the averaged context, not the center itself).
+    ctx_ids: (N, W) 0-padded window ids, ctx_mask: (N, W);
+    points/codes/mask: (N, L) for the center word's Huffman code."""
+    ctx = w_in[ctx_ids] * ctx_mask[..., None]           # (N, W, D)
+    denom = jnp.maximum(jnp.sum(ctx_mask, axis=1, keepdims=True), 1.0)
+    h = jnp.sum(ctx, axis=1) / denom                    # (N, D)
+    un = syn1[points]                                   # (N, L, D)
+    logits = jnp.einsum("nd,nld->nl", h, un)
+    labels = 1.0 - codes
+    g = (jax.nn.sigmoid(logits) - labels) * mask / codes.shape[0]
+    grad_h = jnp.einsum("nl,nld->nd", g, un)
+    grad_un = g[..., None] * h[:, None, :]
+    grad_ctx = (grad_h / denom)[:, None, :] * ctx_mask[..., None]
+    n, w = ctx_ids.shape
+    _, L = points.shape
+    d = w_in.shape[1]
+    w_in = w_in.at[ctx_ids.reshape(-1)].add(
+        -lr * grad_ctx.reshape(n * w, d))
+    syn1 = syn1.at[points.reshape(-1)].add(-lr * grad_un.reshape(n * L, d))
+    loss = jnp.sum(mask * (-labels * jax.nn.log_sigmoid(logits)
+                           - (1 - labels) * jax.nn.log_sigmoid(-logits))) \
+        / jnp.maximum(jnp.sum(mask), 1.0)
+    return w_in, syn1, loss
+
+
+@jax.jit
 def _cbow_ns_step(w_in, w_out, ctx_ids, ctx_mask, targets, labels, lr):
     """CBOW / negative sampling: the context mean predicts the center.
     ctx_ids: (N, W) window word ids (0-padded), ctx_mask: (N, W),
@@ -204,13 +233,26 @@ class SequenceVectors(WordVectors):
                             jnp.float32(lr))
                         seen += len(centers)
                 if self.use_hs:
-                    centers, contexts = batch if self.algorithm != "cbow" \
-                        else (batch[2], batch[2])
-                    pts, cds, msk = self._hs_arrays(contexts, max_code)
-                    w_in, syn1, _ = _sg_hs_step(
-                        w_in, syn1, jnp.asarray(centers), jnp.asarray(pts),
-                        jnp.asarray(cds, jnp.float32),
-                        jnp.asarray(msk, jnp.float32), jnp.float32(lr))
+                    if self.algorithm == "cbow":
+                        # context-window mean predicts the center word's
+                        # Huffman path (DL4J CBOW.java HS path)
+                        ctx_ids, ctx_mask, centers = batch
+                        pts, cds, msk = self._hs_arrays(centers, max_code)
+                        w_in, syn1, _ = _cbow_hs_step(
+                            w_in, syn1, jnp.asarray(ctx_ids),
+                            jnp.asarray(ctx_mask, jnp.float32),
+                            jnp.asarray(pts), jnp.asarray(cds, jnp.float32),
+                            jnp.asarray(msk, jnp.float32), jnp.float32(lr))
+                    else:
+                        centers, contexts = batch
+                        pts, cds, msk = self._hs_arrays(contexts, max_code)
+                        w_in, syn1, _ = _sg_hs_step(
+                            w_in, syn1, jnp.asarray(centers),
+                            jnp.asarray(pts),
+                            jnp.asarray(cds, jnp.float32),
+                            jnp.asarray(msk, jnp.float32), jnp.float32(lr))
+                    if self.negative <= 0:   # NS branch didn't count these
+                        seen += len(centers)
         self.vectors = np.asarray(w_in)
         self.w_out = np.asarray(w_out)
         self.syn1 = np.asarray(syn1)

@@ -34,6 +34,15 @@ class GradientCheckResult:
     failures: list
 
 
+def path_key(k):
+    """Container key for a tree_flatten_with_path entry: DictKey -> .key,
+    SequenceKey -> .idx, GetAttrKey -> .name."""
+    for attr in ("key", "idx", "name"):
+        if hasattr(k, attr):
+            return getattr(k, attr)
+    return k
+
+
 def _rel_error(a: float, n: float, min_abs: float) -> float:
     if abs(a - n) < min_abs:
         return 0.0
@@ -79,6 +88,7 @@ def check_gradients(model, features, labels, *,
         model._compute_dtype = jnp.dtype(jnp.float64)
         model._param_dtype = jnp.dtype(jnp.float64)
         try:
+            @jax.jit
             def loss_fn(p):
                 if is_graph:
                     loss, _ = model._score_fn(
@@ -90,15 +100,17 @@ def check_gradients(model, features, labels, *,
                                               False, None)
                 return loss
 
-            analytic = jax.grad(loss_fn)(params64)
+            analytic = jax.jit(jax.grad(loss_fn))(params64)
             rs = np.random.RandomState(seed)
             failures = []
             worst = ("", 0.0)
             checked = 0
             flat_params, treedef = jax.tree_util.tree_flatten_with_path(params64)
+            leaves = [leaf for _, leaf in flat_params]
             analytic_leaves = jax.tree_util.tree_leaves(analytic)
-            for (path, leaf), a_leaf in zip(flat_params, analytic_leaves):
-                name = "/".join(str(getattr(k, "key", k)) for k in path)
+            for leaf_idx, ((path, leaf), a_leaf) in enumerate(
+                    zip(flat_params, analytic_leaves)):
+                name = "/".join(str(path_key(k)) for k in path)
                 a_grad = np.asarray(a_leaf)
                 leaf_np = np.asarray(leaf)
                 size = leaf_np.size
@@ -111,13 +123,10 @@ def check_gradients(model, features, labels, *,
                     def perturbed(v):
                         pl = leaf_np.copy()
                         pl[i] = v
-                        p2 = jax.tree_util.tree_map(lambda a: a, params64)
-                        # write back along path
-                        d = p2
-                        for k in path[:-1]:
-                            d = d[getattr(k, "key", k)]
-                        d[getattr(path[-1], "key", path[-1])] = jnp.asarray(pl)
-                        return p2
+                        new_leaves = list(leaves)
+                        new_leaves[leaf_idx] = jnp.asarray(pl)
+                        return jax.tree_util.tree_unflatten(treedef,
+                                                            new_leaves)
 
                     lp = float(loss_fn(perturbed(orig + eps)))
                     lm_ = float(loss_fn(perturbed(orig - eps)))

@@ -101,7 +101,9 @@ class KerasModelImport:
             if isinstance(raw, bytes):
                 raw = raw.decode("utf-8")
             cfg = json.loads(raw)
-            net, importers = _build_from_config(cfg)
+            updater = _updater_from_training_config(f.attrs.get(
+                "training_config"))
+            net, importers = _build_from_config(cfg, updater=updater)
             net.init()
             weights_root = f["model_weights"] if "model_weights" in f else f
             for name, load in importers:
@@ -163,13 +165,60 @@ def _collect_datasets(g, out):
 
 
 # --------------------------------------------------------------- conf build
-def _build_from_config(cfg: dict):
+def _updater_from_training_config(raw):
+    """Map a compiled model's saved optimizer onto our updaters (DL4J
+    `enforceTrainingConfig` path, KerasModel.java:276 optimizer import).
+    Returns None when the model was saved uncompiled."""
+    if raw is None:
+        return None
+    if isinstance(raw, bytes):
+        raw = raw.decode("utf-8")
+    try:
+        tc = json.loads(raw)
+    except (TypeError, ValueError):
+        return None
+    opt = tc.get("optimizer_config") or {}
+    ocls = str(opt.get("class_name", "")).rsplit(">", 1)[-1].lower()
+    ocfg = opt.get("config", {})
+    lr = ocfg.get("learning_rate", ocfg.get("lr", 1e-3))
+    if isinstance(lr, dict):        # LR schedule object — use its base rate
+        lr = lr.get("config", {}).get("initial_learning_rate", 1e-3)
+    lr = float(lr)
+    from deeplearning4j_tpu.nn import updaters as U
+    if ocls == "sgd":
+        mom = float(ocfg.get("momentum", 0.0))
+        if mom and ocfg.get("nesterov"):
+            return U.Nesterovs(lr, momentum=mom)
+        if mom:
+            return U.Momentum(lr, momentum=mom)
+        return U.Sgd(lr)
+    if ocls == "rmsprop":
+        return U.RmsProp(lr, decay=float(ocfg.get("rho", 0.9)))
+    if ocls == "adagrad":
+        return U.AdaGrad(lr)
+    if ocls == "adamax":
+        return U.AdaMax(lr)
+    if ocls == "nadam":
+        return U.Nadam(lr)
+    if ocls == "adadelta":
+        return U.AdaDelta(rho=float(ocfg.get("rho", 0.95)))
+    if ocls == "adamw":
+        wd = ocfg.get("weight_decay")
+        return U.AdamW(lr, weight_decay=4e-3 if wd is None else float(wd))
+    if ocfg.get("amsgrad"):
+        return U.AMSGrad(lr, beta1=float(ocfg.get("beta_1", 0.9)),
+                         beta2=float(ocfg.get("beta_2", 0.999)))
+    return U.Adam(lr, beta1=float(ocfg.get("beta_1", 0.9)),
+                  beta2=float(ocfg.get("beta_2", 0.999)))
+
+
+def _build_from_config(cfg: dict, updater=None):
     cls = cfg.get("class_name")
     inner = cfg.get("config", cfg)
     if cls == "Sequential":
-        return _build_sequential(inner)
+        return _build_sequential(inner, updater=updater)
     if cls in ("Model", "Functional"):
-        return _build_functional(inner)
+        return _build_functional(inner, updater=updater)
     raise ValueError(f"Unsupported Keras model class '{cls}'")
 
 
@@ -184,12 +233,13 @@ def _input_type_from_shape(shape) -> InputType:
     raise ValueError(f"Unsupported input shape {shape}")
 
 
-def _build_sequential(cfg: dict):
+def _build_sequential(cfg: dict, updater=None):
     from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
     from deeplearning4j_tpu.nn.updaters import Adam
     layers_cfg = cfg["layers"]
     input_type = None
-    b = (NeuralNetConfiguration.Builder().updater(Adam(1e-3)).list())
+    b = (NeuralNetConfiguration.Builder()
+         .updater(updater if updater is not None else Adam(1e-3)).list())
     importers: List[Tuple[Optional[str], Any]] = []
     n_real = sum(1 for lc in layers_cfg
                  if lc["class_name"] not in ("InputLayer", "Flatten",
@@ -248,7 +298,7 @@ def _bind_mln_loader(loader, index):
     return load
 
 
-def _build_functional(cfg: dict):
+def _build_functional(cfg: dict, updater=None):
     from deeplearning4j_tpu.nn.conf.network import (
         GraphBuilder, NeuralNetConfiguration,
     )
@@ -256,7 +306,8 @@ def _build_functional(cfg: dict):
         ElementWiseVertex, MergeVertex,
     )
     from deeplearning4j_tpu.nn.updaters import Adam
-    g = GraphBuilder(NeuralNetConfiguration.Builder().updater(Adam(1e-3)))
+    g = GraphBuilder(NeuralNetConfiguration.Builder()
+                     .updater(updater if updater is not None else Adam(1e-3)))
     inputs = []
     input_types = []
     importers = []

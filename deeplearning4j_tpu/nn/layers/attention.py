@@ -96,8 +96,11 @@ def rope(x, positions, base: float = 10000.0):
     """Rotary position embedding on (B, T, H, D)."""
     d = x.shape[-1]
     half = d // 2
-    freqs = base ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
-    angles = positions[..., None].astype(jnp.float32) * freqs   # (B?, T, half)
+    # trig in >= f32 (f64 under float64 gradient checking — a hard f32 cast
+    # here corrupts the finite-difference oracle)
+    acc_t = jnp.promote_types(jnp.float32, x.dtype)
+    freqs = base ** (-jnp.arange(0, half, dtype=acc_t) / half)
+    angles = positions[..., None].astype(acc_t) * freqs   # (B?, T, half)
     while angles.ndim < x.ndim:
         angles = angles[..., None, :] if angles.ndim == x.ndim - 1 \
             else angles[None]
@@ -117,10 +120,13 @@ def dot_product_attention(q, k, v, *, mask=None, causal=False,
     apply causal masking across sequence shards)."""
     b, tq, h, d = q.shape
     tk = k.shape[1]
-    scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    # accumulate scores in >= f32 (bf16 inputs -> f32 on the MXU; f64 stays
+    # f64 so float64 gradient checks keep a clean numeric oracle)
+    acc_t = jnp.promote_types(jnp.float32, q.dtype)
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, acc_t))
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
-                        preferred_element_type=jnp.float32) * scale
-    neg = jnp.asarray(-1e30, jnp.float32)
+                        preferred_element_type=acc_t) * scale
+    neg = jnp.asarray(-1e30, acc_t)
     if causal:
         qpos = q_offset + jnp.arange(tq)
         kpos = k_offset + jnp.arange(tk)

@@ -43,9 +43,10 @@ class BatchNormalization(LayerConf):
 
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
         axes = tuple(range(x.ndim - 1))    # all but channel/feature dim
+        stat_t = jnp.promote_types(jnp.float32, x.dtype)
         if train:
-            mean = jnp.mean(x.astype(jnp.float32), axis=axes)
-            var = jnp.var(x.astype(jnp.float32), axis=axes)
+            mean = jnp.mean(x.astype(stat_t), axis=axes)
+            var = jnp.var(x.astype(stat_t), axis=axes)
             new_state = {
                 "mean": self.decay * state["mean"] + (1.0 - self.decay) * mean,
                 "var": self.decay * state["var"] + (1.0 - self.decay) * var,

@@ -75,7 +75,8 @@ class Yolo2OutputLayer(LayerConf):
         coordinate SSE (responsible anchors, lambda_coord) + confidence
         (IOU target for responsible, lambda_no_obj elsewhere) + class SSE.
         labels: (B, H, W, 4+C), boxes as [x1,y1,x2,y2] in grid units."""
-        f32 = jnp.float32
+        # accumulate in >= f32 (f64 under float64 gradient checking)
+        f32 = jnp.promote_types(jnp.float32, x.dtype)
         x = x.astype(f32)
         labels = labels.astype(f32)
         b, h, w, _ = x.shape

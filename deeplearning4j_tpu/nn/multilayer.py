@@ -303,7 +303,8 @@ class MultiLayerNetwork:
         return acts
 
     # ----------------------------------------------------------------- fit
-    def _make_train_step(self, with_fmask, with_lmask, with_carries):
+    def _make_train_step(self, with_fmask, with_lmask, with_carries,
+                         with_stats=False):
         tx = self._tx
 
         def step(params, opt_state, state, x, y, fmask, lmask, rng, carries):
@@ -314,12 +315,20 @@ class MultiLayerNetwork:
                 loss_fn, has_aux=True)(params)
             updates, new_opt = tx.update(grads, opt_state, params)
             new_params = optax.apply_updates(params, updates)
+            if with_stats:
+                # StatsListener capture iterations also return the raw
+                # gradient and update pytrees (DL4J onGradientCalculation /
+                # onBackwardPass hooks); a separate jit variant so the fast
+                # path transfers nothing extra
+                return (new_params, new_opt, new_state, loss, new_carries,
+                        grads, updates)
             return new_params, new_opt, new_state, loss, new_carries
 
         return jax.jit(step, donate_argnums=(0, 1, 2))
 
-    def _get_train_step(self, fmask, lmask, carries):
-        sig = (fmask is not None, lmask is not None, carries is not None)
+    def _get_train_step(self, fmask, lmask, carries, with_stats=False):
+        sig = (fmask is not None, lmask is not None, carries is not None,
+               with_stats)
         if self._train_step is None:
             self._train_step = {}
         if sig not in self._train_step:
@@ -358,17 +367,31 @@ class MultiLayerNetwork:
     def _fit_epoch(self, iterator):
         etl_start = time.perf_counter()
         rng = jax.random.PRNGKey(self.conf.seed + 7919 * (self.epoch_count + 1))
+        grad_listeners = [lst for lst in self.listeners
+                          if getattr(lst, "wants_gradients", False)]
         for ds in iterator:
             etl_ms = (time.perf_counter() - etl_start) * 1e3
             rng, sub = jax.random.split(rng)
-            step = self._get_train_step(ds.features_mask, ds.labels_mask, None)
-            self.params, self.opt_state, self.state, loss, _ = step(
+            capture = [lst for lst in grad_listeners
+                       if lst.should_capture(self.iteration_count)]
+            step = self._get_train_step(ds.features_mask, ds.labels_mask,
+                                        None, with_stats=bool(capture))
+            out = step(
                 self.params, self.opt_state, self.state,
                 _as_jnp(ds.features, self._compute_dtype),
                 _as_jnp(ds.labels, self._compute_dtype),
                 _as_jnp(ds.features_mask), _as_jnp(ds.labels_mask), sub, None)
+            grads = updates = None
+            if capture:
+                (self.params, self.opt_state, self.state, loss, _,
+                 grads, updates) = out
+            else:
+                self.params, self.opt_state, self.state, loss, _ = out
             self._score = float(loss)
             bs = int(np.shape(ds.features)[0])
+            for lst in capture:
+                lst.on_gradients(self, self.iteration_count, self.epoch_count,
+                                 grads, updates)
             for lst in self.listeners:
                 lst.iteration_done(self, self.iteration_count,
                                    self.epoch_count, self._score, etl_ms, bs)

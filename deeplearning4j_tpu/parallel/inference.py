@@ -67,7 +67,8 @@ class ParallelInference:
         self._shard = NamedSharding(self.mesh, P(DATA_AXIS))
         self._queue: "queue.Queue[_Request]" = queue.Queue(maxsize=queue_limit)
         self._stop = threading.Event()
-        self._fn = jax.jit(self._forward)
+        self._fn = jax.jit(self._make_forward(model))
+        self._swap_lock = threading.Lock()
         self._worker = None
         if self.mode == InferenceMode.BATCHED:
             self._worker = threading.Thread(target=self._serve_loop,
@@ -76,13 +77,18 @@ class ParallelInference:
             self._worker.start()
 
     # ---------------------------------------------------------------- device
-    def _forward(self, params, state, x):
+    @staticmethod
+    def _make_forward(model):
         from deeplearning4j_tpu.nn.graph import ComputationGraph
-        if isinstance(self.model, ComputationGraph):
-            acts, _ = self.model._forward(params, state, (x,), False, None)
-            return acts[self.model.conf.network_outputs[0]]
-        y, _, _ = self.model._forward(params, state, x, False, None)
-        return y
+        if isinstance(model, ComputationGraph):
+            def forward(params, state, x):
+                acts, _ = model._forward(params, state, (x,), False, None)
+                return acts[model.conf.network_outputs[0]]
+        else:
+            def forward(params, state, x):
+                y, _, _ = model._forward(params, state, x, False, None)
+                return y
+        return forward
 
     def _run_batch(self, x):
         """Pad to a multiple of the data-parallel degree, shard, run, slice."""
@@ -92,7 +98,14 @@ class ParallelInference:
             pad = np.zeros((pad_to - n,) + x.shape[1:], x.dtype)
             x = np.concatenate([x, pad], axis=0)
         xd = jax.device_put(jnp.asarray(x), self._shard)
-        out = self._fn(self.model.params, self.model.state, xd)
+        with self._swap_lock:   # (fn, params, state) read atomically vs swap
+            fn, params, state = self._fn, self.model.params, self.model.state
+        # replicate weights over the mesh (no-op when already placed —
+        # required when update_model swapped in a single-device model)
+        rep = NamedSharding(self.mesh, P())
+        params = jax.device_put(params, rep)
+        state = jax.device_put(state, rep)
+        out = fn(params, state, xd)
         return np.asarray(out)[:n]
 
     # ------------------------------------------------------------------ API
@@ -162,8 +175,19 @@ class ParallelInference:
             r.event.set()
 
     def update_model(self, model):
-        """Hot-swap weights (DL4J ParallelInference.updateModel)."""
-        self.model = model
+        """Hot-swap the served model (DL4J ParallelInference.updateModel).
+
+        The jitted forward is re-created for the new model — the old one
+        closed over the previous model's `_forward`. The (fn, model) pair is
+        swapped atomically with respect to any batch in flight; batches
+        already running finish on the old model. Only same-input-shape swaps
+        avoid recompilation, but any architecture is correct."""
+        if model.params is None:
+            raise RuntimeError("replacement model must be initialized")
+        new_fn = jax.jit(self._make_forward(model))
+        with self._swap_lock:
+            self.model = model
+            self._fn = new_fn
 
     def shutdown(self):
         self._stop.set()

@@ -34,9 +34,12 @@ def _online_block(q, k, v, o, m, l, *, causal, q_start, k_start, scale,
     every key): out = sum(p*bern/keep @ v)/sum(p) — algebraically identical
     to dropping the normalized weights in dense attention.
     """
+    # accumulate in >= f32 (f64 under float64 gradient checking; a hard f32
+    # cast would corrupt the finite-difference oracle)
+    acc_t = jnp.promote_types(jnp.float32, q.dtype)
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
-                        preferred_element_type=jnp.float32) * scale
-    neg = jnp.asarray(-1e30, jnp.float32)
+                        preferred_element_type=acc_t) * scale
+    neg = jnp.asarray(-1e30, acc_t)
     if causal:
         qpos = q_start + jnp.arange(q.shape[1])
         kpos = k_start + jnp.arange(k.shape[1])
@@ -70,7 +73,8 @@ def ring_self_attention(q, k, v, *, axis_name: str = SEQ_AXIS,
     size = jax.lax.psum(1, axis_name)
     idx = jax.lax.axis_index(axis_name)
     b, t_loc, h, d = q.shape
-    scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    acc_t = jnp.promote_types(jnp.float32, q.dtype)
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, acc_t))
     q_start = idx * t_loc
 
     def rotate(x):
@@ -78,9 +82,9 @@ def ring_self_attention(q, k, v, *, axis_name: str = SEQ_AXIS,
             x, axis_name,
             [(j, (j + 1) % size) for j in range(size)])
 
-    o = jnp.zeros((b, t_loc, h, d), jnp.float32)
-    m = jnp.full((b, h, t_loc), -jnp.inf, jnp.float32)
-    l = jnp.zeros((b, h, t_loc), jnp.float32)
+    o = jnp.zeros((b, t_loc, h, d), acc_t)
+    m = jnp.full((b, h, t_loc), -jnp.inf, acc_t)
+    l = jnp.zeros((b, h, t_loc), acc_t)
 
     def body(s, carry):
         o, m, l, k_cur, v_cur, mask_cur = carry
@@ -144,14 +148,15 @@ def blockwise_attention(q, k, v, *, block_size: int = 512,
     if t % block_size:
         raise ValueError(f"sequence {t} not divisible by block {block_size}")
     n_blocks = t // block_size
-    scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    acc_t = jnp.promote_types(jnp.float32, q.dtype)
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, acc_t))
     kb = k.reshape(b, n_blocks, block_size, h, d)
     vb = v.reshape(b, n_blocks, block_size, h, d)
     maskb = None if mask is None else mask.reshape(b, n_blocks, block_size)
 
-    o = jnp.zeros((b, t, h, d), jnp.float32)
-    m = jnp.full((b, h, t), -jnp.inf, jnp.float32)
-    l = jnp.zeros((b, h, t), jnp.float32)
+    o = jnp.zeros((b, t, h, d), acc_t)
+    m = jnp.full((b, h, t), -jnp.inf, acc_t)
+    l = jnp.zeros((b, h, t), acc_t)
 
     def body(carry, s):
         o, m, l = carry

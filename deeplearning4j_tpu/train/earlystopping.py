@@ -68,6 +68,12 @@ class ClassificationScoreCalculator(ScoreCalculator):
 
 # ------------------------------------------------------ termination conditions
 class EpochTerminationCondition:
+    # Conditions that compare the epoch SCORE set uses_score = True; they are
+    # only consulted on epochs where a score was actually computed (eval
+    # epochs when a score calculator is configured). Epoch/time-count
+    # conditions leave it False and run every epoch.
+    uses_score = False
+
     def terminate(self, epoch: int, score: float) -> bool:
         raise NotImplementedError
 
@@ -90,6 +96,7 @@ class ScoreImprovementEpochTerminationCondition(EpochTerminationCondition):
     """Stop after N epochs without (min_improvement) improvement."""
     max_epochs_without_improvement: int
     min_improvement: float = 0.0
+    uses_score = True
 
     def __post_init__(self):
         self._best: Optional[float] = None
@@ -111,6 +118,7 @@ class BestScoreEpochTerminationCondition(EpochTerminationCondition):
     """Stop as soon as the score is at least as good as a target."""
     best_expected_score: float
     minimize: bool = True
+    uses_score = True
 
     def terminate(self, epoch, score):
         return score <= self.best_expected_score if self.minimize \
@@ -253,8 +261,28 @@ class EarlyStoppingTrainer:
                     reason, details = "iteration", guard.why
                     break
                 do_eval = (epoch % cfg.evaluate_every_n_epochs == 0)
-                score = calc.calculate(model) if (calc and do_eval) \
-                    else model.score()
+                if calc and not do_eval:
+                    # With a score calculator configured, skipped-eval epochs
+                    # do NOT substitute the training loss — it's on a
+                    # different scale (and direction) than the validation
+                    # score, so best-model selection and score-based
+                    # termination only run on eval epochs (DL4J
+                    # BaseEarlyStoppingTrainer behavior).
+                    if cfg.save_last_model:
+                        cfg.model_saver.save_latest(model)
+                    fired = None
+                    for c in cfg.epoch_termination_conditions:
+                        if not c.uses_score:    # epoch/time-count conditions
+                            if c.terminate(epoch, float("nan")):
+                                fired = c
+                                break
+                    if fired is not None:
+                        reason = "epoch"
+                        details = f"{type(fired).__name__} at epoch {epoch}"
+                        break
+                    epoch += 1
+                    continue
+                score = calc.calculate(model) if calc else model.score()
                 minimize = calc.minimize if calc else True
                 score_history[epoch] = float(score)
                 better = (best_score is None or
@@ -267,7 +295,7 @@ class EarlyStoppingTrainer:
                     cfg.model_saver.save_latest(model)
                 fired = None
                 for c in cfg.epoch_termination_conditions:
-                    if hasattr(c, "minimize"):
+                    if c.uses_score and hasattr(c, "minimize"):
                         c.minimize = minimize
                     if c.terminate(epoch, float(score)):
                         fired = c

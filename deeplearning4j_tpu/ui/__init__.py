@@ -1,0 +1,26 @@
+"""Observability UI stack — training stats capture, storage, dashboard.
+
+Parity targets (SURVEY.md L7 / §2.8):
+- StatsListener            <- deeplearning4j-ui-model/.../stats/BaseStatsListener.java:229-304
+- StatsStorage / router    <- deeplearning4j-core/.../api/storage/StatsStorage.java,
+                              InMemoryStatsStorage.java:20, FileStatsStorage.java:15
+- UIServer dashboard       <- deeplearning4j-play/.../play/PlayUIServer.java +
+                              module/train/TrainModule.java (overview/model/system tabs)
+
+TPU-native redesign: no SBE binary codecs or Play framework — records are
+JSON-serializable dataclasses, the file backend is append-only JSONL, and
+the dashboard is a stdlib ThreadingHTTPServer serving one self-contained
+HTML page that polls JSON endpoints and draws SVG charts (no external JS,
+zero egress).
+"""
+from deeplearning4j_tpu.ui.storage import (
+    FileStatsStorage, InMemoryStatsStorage, StatsRecord, StatsStorage,
+    StatsStorageRouter,
+)
+from deeplearning4j_tpu.ui.stats import StatsListener
+from deeplearning4j_tpu.ui.server import UIServer
+
+__all__ = [
+    "FileStatsStorage", "InMemoryStatsStorage", "StatsRecord",
+    "StatsStorage", "StatsStorageRouter", "StatsListener", "UIServer",
+]

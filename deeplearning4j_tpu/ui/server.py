@@ -1,0 +1,258 @@
+"""UIServer — the training dashboard.
+
+Parity: DL4J `deeplearning4j-play/.../play/PlayUIServer.java` +
+`module/train/TrainModule.java` (overview / model / system tabs fed by an
+attached StatsStorage, live-updating browser charts).
+
+TPU-native redesign: stdlib ThreadingHTTPServer serving ONE self-contained
+HTML page (inline JS+SVG, no external assets — zero egress) that polls JSON
+endpoints. Endpoints mirror TrainModule's routes:
+    /train/sessions            -> session ids
+    /train/data?sid=&after=    -> static info + updates since a timestamp
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional
+from urllib.parse import parse_qs, urlparse
+
+from deeplearning4j_tpu.ui.storage import StatsStorage
+
+_PAGE = """<!DOCTYPE html>
+<html><head><meta charset="utf-8"><title>DL4J-TPU Training UI</title>
+<style>
+ body{font-family:sans-serif;margin:0;background:#f4f6f8;color:#222}
+ header{background:#223;color:#fff;padding:10px 16px;font-size:18px}
+ .row{display:flex;flex-wrap:wrap;gap:12px;padding:12px}
+ .card{background:#fff;border-radius:6px;padding:10px 14px;
+       box-shadow:0 1px 3px rgba(0,0,0,.15)}
+ .card h3{margin:2px 0 8px 0;font-size:14px;color:#445}
+ svg{background:#fafbfc;border:1px solid #e0e4e8}
+ select{margin-left:12px}
+ table{border-collapse:collapse;font-size:12px}
+ td,th{border:1px solid #dde;padding:3px 8px;text-align:right}
+ th{background:#eef}
+ td:first-child,th:first-child{text-align:left}
+</style></head><body>
+<header>DL4J-TPU Training Dashboard
+ <select id="sess"></select>
+ <span id="status" style="font-size:12px;margin-left:12px"></span>
+</header>
+<div class="row">
+ <div class="card"><h3>Score vs iteration</h3><svg id="score" width="460" height="220"></svg></div>
+ <div class="card"><h3>Samples/sec</h3><svg id="perf" width="460" height="220"></svg></div>
+ <div class="card"><h3>Device memory (MB in use)</h3><svg id="mem" width="460" height="220"></svg></div>
+</div>
+<div class="row">
+ <div class="card"><h3>Parameter mean magnitudes (log10)</h3><svg id="pmag" width="700" height="240"></svg></div>
+ <div class="card"><h3>Update:param ratio (log10, healthy ~ -3)</h3><svg id="ratio" width="700" height="240"></svg></div>
+</div>
+<div class="row">
+ <div class="card"><h3>Model / session info</h3><div id="info" style="font-size:12px"></div></div>
+ <div class="card"><h3>Last gradient histogram <select id="hsel"></select></h3>
+  <svg id="hist" width="460" height="220"></svg></div>
+</div>
+<script>
+let updates=[], statics={}, after=0, sid=null, histKey=null;
+const colors=["#3366cc","#dc3912","#ff9900","#109618","#990099","#0099c6",
+  "#dd4477","#66aa00","#b82e2e","#316395","#994499","#22aa99"];
+function line(svgId, series, names){
+  const svg=document.getElementById(svgId); svg.innerHTML="";
+  const W=svg.width.baseVal.value,H=svg.height.baseVal.value,P=36;
+  let xs=[],ys=[];
+  series.forEach(s=>s.forEach(p=>{xs.push(p[0]);ys.push(p[1]);}));
+  if(!xs.length)return;
+  const x0=Math.min(...xs),x1=Math.max(...xs),y0=Math.min(...ys),y1=Math.max(...ys);
+  const fx=v=>P+(W-2*P)*(x1>x0?(v-x0)/(x1-x0):0.5);
+  const fy=v=>H-P-(H-2*P)*(y1>y0?(v-y0)/(y1-y0):0.5);
+  let g='';
+  for(let i=0;i<=4;i++){const y=y0+(y1-y0)*i/4, py=fy(y);
+    g+=`<line x1="${P}" y1="${py}" x2="${W-P}" y2="${py}" stroke="#eee"/>`+
+       `<text x="2" y="${py+4}" font-size="9">${y.toPrecision(3)}</text>`;}
+  g+=`<text x="${W/2}" y="${H-4}" font-size="9">${x0.toFixed(0)} .. ${x1.toFixed(0)}</text>`;
+  series.forEach((s,i)=>{
+    if(!s.length)return;
+    const d=s.map((p,j)=>(j?'L':'M')+fx(p[0]).toFixed(1)+','+fy(p[1]).toFixed(1)).join(' ');
+    g+=`<path d="${d}" fill="none" stroke="${colors[i%colors.length]}" stroke-width="1.5"/>`;
+    if(names&&names[i])g+=`<text x="${W-P+2}" y="${16+12*i}" font-size="9" fill="${colors[i%colors.length]}">${names[i]}</text>`;
+  });
+  svg.innerHTML=g;
+}
+function bars(svgId, counts, lo, hi){
+  const svg=document.getElementById(svgId); svg.innerHTML="";
+  if(!counts||!counts.length)return;
+  const W=svg.width.baseVal.value,H=svg.height.baseVal.value,P=26;
+  const m=Math.max(...counts,1),bw=(W-2*P)/counts.length;
+  let g='';
+  counts.forEach((c,i)=>{const h=(H-2*P)*c/m;
+    g+=`<rect x="${P+i*bw}" y="${H-P-h}" width="${Math.max(bw-1,1)}" height="${h}" fill="#3366cc"/>`;});
+  g+=`<text x="${P}" y="${H-6}" font-size="9">${lo!==undefined?lo.toPrecision(3):''}</text>`;
+  g+=`<text x="${W-P-40}" y="${H-6}" font-size="9">${hi!==undefined?hi.toPrecision(3):''}</text>`;
+  svg.innerHTML=g;
+}
+async function refreshSessions(){
+  const r=await fetch('train/sessions'); const j=await r.json();
+  const sel=document.getElementById('sess');
+  const cur=sel.value;
+  sel.innerHTML=j.sessions.map(s=>`<option>${s}</option>`).join('');
+  if(j.sessions.includes(cur))sel.value=cur;
+  if(!sid&&j.sessions.length){sid=sel.value;}
+}
+async function poll(){
+  try{
+    await refreshSessions();
+    const sel=document.getElementById('sess');
+    if(sel.value&&sel.value!==sid){sid=sel.value;updates=[];after=0;}
+    if(!sid){setTimeout(poll,2000);return;}
+    const r=await fetch(`train/data?sid=${encodeURIComponent(sid)}&after=${after}`);
+    const j=await r.json();
+    statics=j.static||{};
+    if(j.updates.length){
+      updates=updates.concat(j.updates);
+      after=j.updates[j.updates.length-1].timestamp;
+      if(updates.length>2000)updates=updates.slice(-2000);
+    }
+    render();
+    document.getElementById('status').textContent=
+      `${updates.length} records | live`;
+  }catch(e){document.getElementById('status').textContent='disconnected';}
+  setTimeout(poll,2000);
+}
+function render(){
+  const d=updates.map(u=>u.data);
+  line('score',[d.map(u=>[u.iteration,u.score])]);
+  line('perf',[d.filter(u=>u.samples_sec>0).map(u=>[u.iteration,u.samples_sec])]);
+  line('mem',[d.filter(u=>u.memory&&u.memory.device_bytes_in_use)
+     .map(u=>[u.iteration,u.memory.device_bytes_in_use/1048576])]);
+  const last=d[d.length-1]; if(!last)return;
+  const keys=Object.keys(last.params||{});
+  line('pmag',keys.map(k=>d.filter(u=>u.params&&u.params[k])
+     .map(u=>[u.iteration,Math.log10(u.params[k].mean_mag+1e-12)])),keys);
+  line('ratio',keys.map(k=>d.filter(u=>u.updates&&u.updates[k]&&u.params[k])
+     .map(u=>[u.iteration,Math.log10((u.updates[k].mean_mag+1e-12)/(u.params[k].mean_mag+1e-12))])),keys);
+  const hsel=document.getElementById('hsel');
+  const gkeys=Object.keys(last.gradients||{});
+  if(hsel.options.length!==gkeys.length){
+    hsel.innerHTML=gkeys.map(k=>`<option>${k}</option>`).join('');}
+  histKey=hsel.value||gkeys[0];
+  if(histKey&&last.gradients&&last.gradients[histKey]){
+    const h=last.gradients[histKey];
+    bars('hist',h.hist,h.lo,h.hi);}
+  const si=statics.data||{};
+  document.getElementById('info').innerHTML=
+    `<table><tr><th>field</th><th>value</th></tr>`+
+    ['model_class','num_params','num_layers','devices'].map(k=>
+      `<tr><td>${k}</td><td>${JSON.stringify(si[k])}</td></tr>`).join('')+
+    `<tr><td>score (last)</td><td>${last.score.toPrecision(5)}</td></tr>`+
+    `<tr><td>iteration</td><td>${last.iteration}</td></tr></table>`;
+}
+poll();
+</script></body></html>
+"""
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "DL4JTPU-UI/1.0"
+
+    def log_message(self, *a):       # silence request logging
+        pass
+
+    def _json(self, obj, code=200):
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        ui: "UIServer" = self.server.ui           # type: ignore[attr-defined]
+        url = urlparse(self.path)
+        if url.path in ("/", "/train", "/train/overview"):
+            body = _PAGE.encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/html; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        if url.path == "/train/sessions":
+            self._json({"sessions": ui.session_ids()})
+            return
+        if url.path == "/train/data":
+            q = parse_qs(url.query)
+            sid = q.get("sid", [""])[0]
+            after = float(q.get("after", ["0"])[0])
+            self._json(ui.session_data(sid, after))
+            return
+        self._json({"error": "not found"}, code=404)
+
+
+class UIServer:
+    """Singleton dashboard server (PlayUIServer.getInstance() parity).
+
+    Usage:
+        server = UIServer.get_instance()     # starts on a free port
+        server.attach(storage)
+        print(server.url)
+    """
+
+    _instance: Optional["UIServer"] = None
+
+    def __init__(self, port: int = 0):
+        self._storages: list = []
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
+        self._httpd.ui = self                    # type: ignore[attr-defined]
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True, name="UIServer")
+        self._thread.start()
+
+    @classmethod
+    def get_instance(cls, port: int = 0) -> "UIServer":
+        if cls._instance is None:
+            cls._instance = UIServer(port)
+        return cls._instance
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}/"
+
+    def attach(self, storage: StatsStorage):
+        """Attach a stats storage to visualize (UIServer.attach parity)."""
+        if storage not in self._storages:
+            self._storages.append(storage)
+
+    def detach(self, storage: StatsStorage):
+        if storage in self._storages:
+            self._storages.remove(storage)
+
+    # ----------------------------------------------------------- queries
+    def session_ids(self):
+        out = []
+        for s in self._storages:
+            out.extend(s.list_session_ids())
+        return sorted(set(out))
+
+    def session_data(self, sid: str, after: float) -> Dict:
+        static = None
+        updates = []
+        for s in self._storages:
+            for tid in s.list_type_ids(sid):
+                for wid in s.list_worker_ids(sid):
+                    st = s.get_static_info(sid, tid, wid)
+                    if st is not None and static is None:
+                        static = {"timestamp": st.timestamp, "data": st.data}
+                    for r in s.get_all_updates_after(sid, tid, wid, after):
+                        updates.append({"timestamp": r.timestamp,
+                                        "data": r.data})
+        updates.sort(key=lambda r: r["timestamp"])
+        return {"static": static, "updates": updates[:500]}
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if UIServer._instance is self:
+            UIServer._instance = None

@@ -1,0 +1,199 @@
+"""Stats storage — the pub/sub layer decoupling stats producers from UIs.
+
+Parity: DL4J's storage abstraction
+(`deeplearning4j-core/.../api/storage/StatsStorage.java` + `StatsStorageRouter`,
+`Persistable`), with the two standard backends
+(`deeplearning4j-ui-model/.../storage/InMemoryStatsStorage.java:20`,
+`FileStatsStorage.java:15` — MapDB there, append-only JSONL here).
+
+Records are keyed (session_id, type_id, worker_id, timestamp) exactly like
+the reference's Persistable contract; static info and updates are separate
+spaces (putStaticInfo vs putUpdate). Listeners receive StatsStorageEvent-
+style callbacks (NewSessionID/NewTypeID/NewWorkerID/PostUpdate/PostStatic).
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class StatsRecord:
+    """One persistable record (DL4J api/storage/Persistable)."""
+    session_id: str
+    type_id: str
+    worker_id: str
+    timestamp: float
+    data: Dict[str, Any]
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), sort_keys=True)
+
+    @staticmethod
+    def from_json(s: str) -> "StatsRecord":
+        return StatsRecord(**json.loads(s))
+
+
+class StatsStorageRouter:
+    """Write-side API (DL4J StatsStorageRouter) — what listeners see."""
+
+    def put_static_info(self, record: StatsRecord):
+        raise NotImplementedError
+
+    def put_update(self, record: StatsRecord):
+        raise NotImplementedError
+
+
+class StatsStorage(StatsStorageRouter):
+    """Readable storage + pub/sub (DL4J StatsStorage).
+
+    Query API mirrors the reference: listSessionIDs,
+    getAllUpdatesAfter, getLatestUpdate, getStaticInfo...
+    """
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._static: Dict[Tuple[str, str, str], StatsRecord] = {}
+        self._updates: Dict[Tuple[str, str, str], List[StatsRecord]] = {}
+        self._listeners: List[Callable[[str, StatsRecord], None]] = []
+
+    # ------------------------------------------------------------- write
+    def put_static_info(self, record: StatsRecord):
+        key = (record.session_id, record.type_id, record.worker_id)
+        with self._lock:
+            is_new_session = not any(
+                k[0] == record.session_id
+                for k in list(self._static) + list(self._updates))
+            self._static[key] = record
+            self._persist("static", record)
+        if is_new_session:
+            self._emit("new_session", record)
+        self._emit("post_static", record)
+
+    def put_update(self, record: StatsRecord):
+        key = (record.session_id, record.type_id, record.worker_id)
+        with self._lock:
+            is_new_session = not any(
+                k[0] == record.session_id
+                for k in list(self._static) + list(self._updates))
+            self._updates.setdefault(key, []).append(record)
+            self._persist("update", record)
+        if is_new_session:
+            self._emit("new_session", record)
+        self._emit("post_update", record)
+
+    # -------------------------------------------------------------- read
+    def list_session_ids(self) -> List[str]:
+        with self._lock:
+            return sorted({k[0] for k in
+                           list(self._static) + list(self._updates)})
+
+    def list_type_ids(self, session_id: str) -> List[str]:
+        with self._lock:
+            return sorted({k[1] for k in
+                           list(self._static) + list(self._updates)
+                           if k[0] == session_id})
+
+    def list_worker_ids(self, session_id: str) -> List[str]:
+        with self._lock:
+            return sorted({k[2] for k in
+                           list(self._static) + list(self._updates)
+                           if k[0] == session_id})
+
+    def get_static_info(self, session_id: str, type_id: str,
+                        worker_id: str) -> Optional[StatsRecord]:
+        with self._lock:
+            return self._static.get((session_id, type_id, worker_id))
+
+    def get_all_updates_after(self, session_id: str, type_id: str,
+                              worker_id: str,
+                              timestamp: float) -> List[StatsRecord]:
+        with self._lock:
+            recs = self._updates.get((session_id, type_id, worker_id), [])
+            return [r for r in recs if r.timestamp > timestamp]
+
+    def get_latest_update(self, session_id: str, type_id: str,
+                          worker_id: str) -> Optional[StatsRecord]:
+        with self._lock:
+            recs = self._updates.get((session_id, type_id, worker_id), [])
+            return recs[-1] if recs else None
+
+    def num_updates(self, session_id: str, type_id: str,
+                    worker_id: str) -> int:
+        with self._lock:
+            return len(self._updates.get((session_id, type_id, worker_id), []))
+
+    # ------------------------------------------------------------ pub/sub
+    def register_stats_storage_listener(
+            self, fn: Callable[[str, StatsRecord], None]):
+        """fn(event, record); event in {new_session, post_static,
+        post_update} (DL4J StatsStorageListener events)."""
+        self._listeners.append(fn)
+
+    def deregister_stats_storage_listener(self, fn):
+        if fn in self._listeners:
+            self._listeners.remove(fn)
+
+    def _emit(self, event: str, record: StatsRecord):
+        for fn in list(self._listeners):
+            try:
+                fn(event, record)
+            except Exception:       # listener errors never break training
+                pass
+
+    # --------------------------------------------------------- persistence
+    def _persist(self, kind: str, record: StatsRecord):
+        pass                        # in-memory backend: no-op
+
+    def close(self):
+        pass
+
+
+class InMemoryStatsStorage(StatsStorage):
+    """Pure in-memory backend (InMemoryStatsStorage.java:20)."""
+
+
+class FileStatsStorage(StatsStorage):
+    """File-backed storage: append-only JSONL, reloaded on open
+    (FileStatsStorage.java:15 — MapDB there; JSONL keeps it dependency-free
+    and makes records greppable)."""
+
+    def __init__(self, path: str):
+        super().__init__()
+        self._path = path
+        self._file = None
+        if os.path.exists(path):
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    entry = json.loads(line)
+                    rec = StatsRecord(**entry["record"])
+                    key = (rec.session_id, rec.type_id, rec.worker_id)
+                    if entry["kind"] == "static":
+                        self._static[key] = rec
+                    else:
+                        self._updates.setdefault(key, []).append(rec)
+        self._file = open(path, "a")
+
+    def _persist(self, kind: str, record: StatsRecord):
+        if self._file is None:      # during __init__ replay
+            return
+        self._file.write(json.dumps(
+            {"kind": kind, "record": dataclasses.asdict(record)},
+            sort_keys=True) + "\n")
+        self._file.flush()
+
+    def close(self):
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+
+
+def new_session_id(prefix: str = "train") -> str:
+    return f"{prefix}-{int(time.time() * 1000):x}-{os.getpid()}"

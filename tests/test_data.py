@@ -90,6 +90,17 @@ def test_uci_sequence_shapes():
     assert b.labels.shape == (50, 6)
 
 
+def test_uci_sequence_split_sees_all_classes():
+    # the raw file is class-ordered; the fixed-seed shuffle before the
+    # 450/150 split must leave every class in both splits
+    # (UciSequenceDataFetcher.java:143)
+    for train in (True, False):
+        it = UciSequenceDataSetIterator(batch_size=600, train=train)
+        b = next(iter(it))
+        classes_present = (b.labels.sum(axis=0) > 0)
+        assert classes_present.all(), b.labels.sum(axis=0)
+
+
 # ---------------------------------------------------------------- record IO
 def test_csv_record_reader_classification(tmp_path):
     p = tmp_path / "data.csv"
@@ -157,3 +168,22 @@ def test_multi_dataset_iterator():
     assert mds.features[0].shape == (2, 2)
     assert mds.labels[0].shape == (2, 3)
     np.testing.assert_allclose(mds.labels[0][0], [1, 0, 0])
+
+
+def test_multi_dataset_iterator_partial_final_batch():
+    # 5 rows, batch 2 -> batches of 2, 2, 1 (final partial batch emitted,
+    # DL4J RecordReaderMultiDataSetIterator behavior); and a dataset
+    # SMALLER than batch_size still yields one batch
+    rows = [[1, 2, 0], [3, 4, 1], [5, 6, 2], [7, 8, 0], [9, 10, 1]]
+    it = (RecordReaderMultiDataSetIterator(batch_size=2)
+          .add_reader("r", CollectionRecordReader(rows))
+          .add_input("r", 0, 1)
+          .add_output_one_hot("r", 2, 3))
+    sizes = [b.features[0].shape[0] for b in it]
+    assert sizes == [2, 2, 1]
+    small = (RecordReaderMultiDataSetIterator(batch_size=8)
+             .add_reader("r", CollectionRecordReader(rows[:3]))
+             .add_input("r", 0, 1)
+             .add_output_one_hot("r", 2, 3))
+    sizes = [b.features[0].shape[0] for b in small]
+    assert sizes == [3]

@@ -97,6 +97,33 @@ def test_functional_residual_parity(tmp_path):
 
 
 def test_imported_model_can_finetune(tmp_path):
+    # Compiled model: import honors the saved optimizer (training_config),
+    # the analog of DL4J's enforceTrainingConfig optimizer import.
+    m = keras.Sequential([
+        keras.layers.Input((6,)),
+        keras.layers.Dense(8, activation="relu"),
+        keras.layers.Dense(2, activation="softmax"),
+    ])
+    m.compile(optimizer=keras.optimizers.Adam(0.02),
+              loss="categorical_crossentropy")
+    p = _save(m, tmp_path)
+    net = KerasModelImport.import_keras_sequential_model_and_weights(p)
+    assert abs(net.conf.updater.learning_rate - 0.02) < 1e-9
+    rs = np.random.RandomState(5)
+    X = rs.randn(64, 6).astype("float32")
+    Y = np.eye(2, dtype="float32")[(X[:, 0] > 0).astype(int)]
+    net.fit((X, Y), epochs=40, batch_size=16)
+    assert net.evaluate((X, Y)).accuracy() > 0.8
+
+
+def test_imported_model_transfer_learning_finetune(tmp_path):
+    # Uncompiled model: fine-tune via the TransferLearning surgery path
+    # with an explicit updater (DL4J TransferLearning.Builder +
+    # FineTuneConfiguration workflow on an imported net).
+    from deeplearning4j_tpu.nn.transferlearning import (
+        FineTuneConfiguration, TransferLearning,
+    )
+    from deeplearning4j_tpu.nn.updaters import Adam
     m = keras.Sequential([
         keras.layers.Input((6,)),
         keras.layers.Dense(8, activation="relu"),
@@ -104,11 +131,14 @@ def test_imported_model_can_finetune(tmp_path):
     ])
     p = _save(m, tmp_path)
     net = KerasModelImport.import_keras_sequential_model_and_weights(p)
+    tuned = (TransferLearning(net)
+             .fine_tune_configuration(FineTuneConfiguration(updater=Adam(0.02)))
+             .build())
     rs = np.random.RandomState(5)
     X = rs.randn(64, 6).astype("float32")
     Y = np.eye(2, dtype="float32")[(X[:, 0] > 0).astype(int)]
-    net.fit((X, Y), epochs=40, batch_size=16)
-    assert net.evaluate((X, Y)).accuracy() > 0.8
+    tuned.fit((X, Y), epochs=40, batch_size=16)
+    assert tuned.evaluate((X, Y)).accuracy() > 0.8
 
 
 def test_unsupported_layer_raises(tmp_path):

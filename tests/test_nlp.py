@@ -101,6 +101,18 @@ def test_word2vec_cbow_and_hs():
     assert hs.similarity("cat", "pet") > hs.similarity("cat", "engine")
 
 
+def test_word2vec_cbow_hs_learns():
+    # CBOW + hierarchical softmax: context-window mean predicts the center
+    # word's Huffman path (was degenerate self-prediction pre-round-2)
+    corpus = CollectionSentenceIterator(_toy_corpus(200, seed=4))
+    m = Word2Vec(layer_size=24, window=3, min_count=2, negative=0,
+                 use_hierarchic_softmax=True,
+                 elements_learning_algorithm="cbow", epochs=40, seed=4)
+    m.fit(corpus)
+    assert m.similarity("cat", "pet") > m.similarity("cat", "engine")
+    assert m.similarity("bus", "road") > m.similarity("bus", "fur")
+
+
 def test_word_vectors_serde(tmp_path):
     w2v = Word2Vec(layer_size=16, min_count=1, epochs=1, seed=0)
     w2v.fit(CollectionSentenceIterator(_toy_corpus(50)))

@@ -139,6 +139,20 @@ def test_parallel_inference_odd_batch_padding():
     np.testing.assert_allclose(out, np.asarray(net.output(X[:13])), atol=1e-5)
 
 
+def test_parallel_inference_update_model_swaps_compiled_fn():
+    # update_model must re-jit: the old compiled graph closed over the old
+    # model's forward; after a swap, outputs must come from the NEW model
+    X, _ = _blob_data(n=16)
+    net_a = MultiLayerNetwork(_mlp()).init()
+    net_b = MultiLayerNetwork(_mlp()).init()
+    with ParallelInference(net_a, mode=InferenceMode.BATCHED) as pi:
+        np.testing.assert_allclose(np.asarray(pi.output(X[:8])),
+                                   np.asarray(net_a.output(X[:8])), atol=1e-5)
+        pi.update_model(net_b)
+        np.testing.assert_allclose(np.asarray(pi.output(X[:8])),
+                                   np.asarray(net_b.output(X[:8])), atol=1e-5)
+
+
 def test_parallel_inference_rejects_after_shutdown():
     X, _ = _blob_data(n=16)
     net = MultiLayerNetwork(_mlp()).init()

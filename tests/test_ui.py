@@ -1,0 +1,127 @@
+"""Observability stack tests — StatsListener -> StatsStorage -> UIServer
+(the analog of DL4J's TestStatsListener / TestStatsStorage / ui tests)."""
+import json
+import urllib.request
+
+import numpy as np
+
+from deeplearning4j_tpu.nn.conf.base import InputType
+from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updaters import Sgd
+from deeplearning4j_tpu.ui import (
+    FileStatsStorage, InMemoryStatsStorage, StatsListener, StatsRecord,
+    UIServer,
+)
+
+
+def _train_net(listener, epochs=2):
+    conf = (NeuralNetConfiguration.Builder().seed(7).updater(Sgd(0.1)).list()
+            .layer(DenseLayer(n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(5)).build())
+    net = MultiLayerNetwork(conf).init()
+    net.set_listeners(listener)
+    rs = np.random.RandomState(0)
+    X = rs.randn(48, 5).astype("float32")
+    Y = np.eye(3, dtype="float32")[rs.randint(0, 3, 48)]
+    net.fit((X, Y), epochs=epochs, batch_size=16)
+    return net
+
+
+# ------------------------------------------------------------------ storage
+def test_stats_storage_round_trip_and_events():
+    st = InMemoryStatsStorage()
+    events = []
+    st.register_stats_storage_listener(lambda ev, r: events.append(ev))
+    rec = StatsRecord("sess1", "StatsListener", "w0", 1.0, {"score": 0.5})
+    st.put_update(rec)
+    st.put_static_info(StatsRecord("sess1", "StatsListener", "w0", 0.5,
+                                   {"model_class": "X"}))
+    assert st.list_session_ids() == ["sess1"]
+    assert st.list_type_ids("sess1") == ["StatsListener"]
+    assert st.list_worker_ids("sess1") == ["w0"]
+    assert st.get_latest_update("sess1", "StatsListener", "w0").data["score"] == 0.5
+    assert st.get_all_updates_after("sess1", "StatsListener", "w0", 0.9)
+    assert not st.get_all_updates_after("sess1", "StatsListener", "w0", 1.5)
+    assert "new_session" in events and "post_update" in events \
+        and "post_static" in events
+
+
+def test_file_stats_storage_persists(tmp_path):
+    p = str(tmp_path / "stats.jsonl")
+    st = FileStatsStorage(p)
+    for i in range(5):
+        st.put_update(StatsRecord("s", "T", "w", float(i), {"i": i}))
+    st.put_static_info(StatsRecord("s", "T", "w", 0.0, {"static": True}))
+    st.close()
+    re = FileStatsStorage(p)       # reload from disk
+    assert re.num_updates("s", "T", "w") == 5
+    assert re.get_static_info("s", "T", "w").data["static"] is True
+    assert re.get_latest_update("s", "T", "w").data["i"] == 4
+    re.close()
+
+
+def test_stats_record_json_round_trip():
+    rec = StatsRecord("s", "T", "w", 3.25, {"a": [1, 2], "b": "x"})
+    assert StatsRecord.from_json(rec.to_json()) == rec
+
+
+# ----------------------------------------------------------------- listener
+def test_stats_listener_captures_full_stats():
+    st = InMemoryStatsStorage()
+    lst = StatsListener(st, frequency=1, session_id="t1")
+    _train_net(lst)
+    static = st.get_static_info("t1", "StatsListener", "worker-0")
+    assert static is not None
+    assert static.data["model_class"] == "MultiLayerNetwork"
+    assert static.data["num_params"] > 0
+    n = st.num_updates("t1", "StatsListener", "worker-0")
+    assert n == 6            # 48/16 * 2 epochs
+    last = st.get_latest_update("t1", "StatsListener", "worker-0").data
+    assert np.isfinite(last["score"])
+    # per-leaf param/grad/update summaries with histograms
+    for group in ("params", "gradients", "updates"):
+        assert "0/W" in last[group] and "1/b" in last[group], last[group].keys()
+        e = last[group]["0/W"]
+        assert e["norm"] > 0 or group == "updates"
+        assert len(e["hist"]) == 20
+        assert sum(e["hist"]) == 5 * 8     # W is (5, 8)
+
+
+def test_stats_listener_frequency_thins_records():
+    st = InMemoryStatsStorage()
+    lst = StatsListener(st, frequency=3, session_id="t2", histograms=False)
+    _train_net(lst)                # 6 iterations -> captures at 0 and 3
+    assert st.num_updates("t2", "StatsListener", "worker-0") == 2
+    last = st.get_latest_update("t2", "StatsListener", "worker-0").data
+    assert "hist" not in last["params"]["0/W"]
+
+
+# ------------------------------------------------------------------- server
+def test_ui_server_serves_dashboard_and_data():
+    st = InMemoryStatsStorage()
+    lst = StatsListener(st, frequency=1, session_id="ui-sess")
+    _train_net(lst, epochs=1)
+    server = UIServer(port=0)
+    try:
+        server.attach(st)
+        page = urllib.request.urlopen(server.url, timeout=10).read().decode()
+        assert "Training Dashboard" in page
+        sessions = json.loads(urllib.request.urlopen(
+            server.url + "train/sessions", timeout=10).read())
+        assert "ui-sess" in sessions["sessions"]
+        data = json.loads(urllib.request.urlopen(
+            server.url + "train/data?sid=ui-sess&after=0", timeout=10).read())
+        assert data["static"]["data"]["num_layers"] == 2
+        assert len(data["updates"]) == 3
+        assert data["updates"][0]["data"]["iteration"] == 0
+        # incremental polling: after=last timestamp -> nothing new
+        after = data["updates"][-1]["timestamp"]
+        data2 = json.loads(urllib.request.urlopen(
+            server.url + f"train/data?sid=ui-sess&after={after}",
+            timeout=10).read())
+        assert data2["updates"] == []
+    finally:
+        server.stop()

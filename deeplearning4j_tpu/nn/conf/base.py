@@ -174,11 +174,17 @@ class LayerConf:
     backpropGradient has no analog; gradient checks are the oracle).
     """
     name: Optional[str] = None
-    dropout: float = 0.0        # input dropout probability (0 disables)
+    dropout: Any = 0.0          # input dropout: probability float, or an
+    # IDropout object (AlphaDropout/GaussianDropout/GaussianNoise,
+    # nn/regularization.py — DL4J nn/conf/dropout/)
     l1: float = 0.0             # L1 regularization coefficient on weights
     l2: float = 0.0             # L2 regularization coefficient on weights
     updater: Optional[Any] = None   # per-layer updater override (DL4J .updater)
     frozen: bool = False        # FrozenLayer semantics (transfer learning)
+    weight_noise: Optional[Any] = None  # DropConnect/WeightNoise
+    # (DL4J nn/conf/weightnoise/), applied to params in the train forward
+    constraints: Tuple[Any, ...] = ()   # post-update projections
+    # (DL4J nn/conf/constraint/), applied inside the compiled train step
 
     # ---- shape inference -------------------------------------------------
     def output_type(self, input_type: InputType) -> InputType:
@@ -197,12 +203,18 @@ class LayerConf:
     # ---- helpers ---------------------------------------------------------
     def maybe_dropout_input(self, x, train, rng):
         """DL4J applies layer `dropOut` to the layer *input* during training
-        (Dropout in nn/conf/dropout applied via BaseLayer.applyDropOutIfNecessary)."""
-        if not train or self.dropout <= 0.0 or rng is None:
+        (Dropout in nn/conf/dropout applied via BaseLayer.applyDropOutIfNecessary).
+        Accepts a float probability or an IDropout variant object."""
+        if not train or rng is None:
             return x
-        keep = 1.0 - self.dropout
-        mask = jax.random.bernoulli(rng, keep, x.shape)
-        return jnp.where(mask, x / keep, 0.0)
+        if isinstance(self.dropout, (int, float)):
+            if self.dropout <= 0.0:
+                return x
+            keep = 1.0 - self.dropout
+            mask = jax.random.bernoulli(rng, keep, x.shape)
+            return jnp.where(mask, x / keep, 0.0)
+        from deeplearning4j_tpu.nn.regularization import apply_input_dropout
+        return apply_input_dropout(self.dropout, x, train, rng)
 
     def regularization_score(self, params) -> jnp.ndarray:
         """L1/L2 penalty contribution (DL4J BaseLayer.calcRegularizationScore).

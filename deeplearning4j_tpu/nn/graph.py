@@ -188,7 +188,16 @@ class ComputationGraph:
             m = mask if need == Kind.RNN else None
             if name in out_set:
                 acts["__pre__" + name] = x
-            y, s = vd.vertex.apply(params.get(name, {}), state.get(name, {}),
+            layer_params = params.get(name, {})
+            if train and sub_rng is not None and \
+                    getattr(vd.vertex, "weight_noise", None) is not None:
+                from deeplearning4j_tpu.nn.regularization import (
+                    apply_weight_noise,
+                )
+                sub_rng, noise_rng = jax.random.split(sub_rng)
+                layer_params = apply_weight_noise(vd.vertex, layer_params,
+                                                  train, noise_rng)
+            y, s = vd.vertex.apply(layer_params, state.get(name, {}),
                                    x, train=train, rng=sub_rng, mask=m)
             new_state[name] = s
             acts[name] = y
@@ -238,7 +247,13 @@ class ComputationGraph:
         return total, new_state
 
     def _make_train_step(self):
+        from deeplearning4j_tpu.nn.regularization import (
+            apply_constraints, has_constraints,
+        )
         tx = self._tx
+        layer_map = {name: vd.vertex for name, vd in self.conf.vertices.items()
+                     if isinstance(vd.vertex, LayerConf)}
+        constrained = has_constraints(layer_map.values())
 
         def step(params, opt_state, state, inputs, labels, fmasks, lmasks, rng):
             def loss_fn(p):
@@ -247,6 +262,8 @@ class ComputationGraph:
             (loss, new_state), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
             updates, new_opt = tx.update(grads, opt_state, params)
             new_params = optax.apply_updates(params, updates)
+            if constrained:     # post-update projection (DL4J applyConstraints)
+                new_params = apply_constraints(layer_map, new_params)
             return new_params, new_opt, new_state, loss
 
         return jax.jit(step, donate_argnums=(0, 1, 2))

@@ -1,6 +1,6 @@
 from deeplearning4j_tpu.nn.layers.feedforward import (
     DenseLayer, EmbeddingLayer, ActivationLayer, DropoutLayer,
-    OutputLayer, LossLayer, AutoEncoder,
+    OutputLayer, CenterLossOutputLayer, LossLayer, AutoEncoder,
 )
 from deeplearning4j_tpu.nn.layers.convolution import (
     ConvolutionLayer, Convolution1DLayer, SubsamplingLayer,
@@ -25,7 +25,7 @@ from deeplearning4j_tpu.nn.layers.attention import (
 
 __all__ = [
     "DenseLayer", "EmbeddingLayer", "ActivationLayer", "DropoutLayer",
-    "OutputLayer", "LossLayer", "AutoEncoder",
+    "OutputLayer", "CenterLossOutputLayer", "LossLayer", "AutoEncoder",
     "ConvolutionLayer", "Convolution1DLayer", "SubsamplingLayer",
     "Subsampling1DLayer", "Upsampling2D", "ZeroPaddingLayer",
     "GlobalPoolingLayer", "Deconvolution2D", "SeparableConvolution2D",

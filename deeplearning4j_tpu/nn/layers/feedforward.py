@@ -169,6 +169,61 @@ class OutputLayer(LayerConf):
 
 @register_layer
 @dataclasses.dataclass(frozen=True)
+class CenterLossOutputLayer(LayerConf):
+    """Softmax head + center loss (DL4J nn/layers/training/
+    CenterLossOutputLayer.java): loss = primary + lambda/2 * ||f - c_y||^2,
+    pulling each class's features toward a learned per-class center.
+
+    Design deviation, documented: DL4J updates centers by a non-gradient
+    EMA c_y <- (1-alpha) c_y + alpha f. Here centers are ordinary params —
+    the gradient of the center term w.r.t. c_y is lambda*(c_y - f), so SGD
+    performs the same pull with alpha = lr * lambda (DL4J's own
+    gradientCheck mode treats centers exactly this way)."""
+    n_out: int = 0
+    n_in: Optional[int] = None
+    activation: str = "softmax"
+    loss: str = "mcxent"
+    alpha: float = 0.05             # kept for DL4J config parity
+    lambda_: float = 2e-4           # center-loss weight (DL4J lambda)
+    weight_init: str = "xavier"
+    bias_init: float = 0.0
+    has_bias: bool = True
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.feed_forward(self.n_out)
+
+    def init(self, key, input_type: InputType, dtype=jnp.float32):
+        n_in = self.n_in or input_type.features
+        w_init = get_initializer(self.weight_init)
+        params = {"W": w_init(key, (n_in, self.n_out), n_in, self.n_out,
+                              dtype),
+                  "cL": jnp.zeros((self.n_out, n_in), dtype)}   # class centers
+        if self.has_bias:
+            params["b"] = jnp.full((self.n_out,), self.bias_init, dtype)
+        return params, {}
+
+    def preout(self, params, x, train=False, rng=None):
+        x = self.maybe_dropout_input(x, train, rng)
+        y = x @ params["W"]
+        if self.has_bias:
+            y = y + params["b"]
+        return y
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        return get_activation(self.activation)(
+            self.preout(params, x, train, rng)), state
+
+    def score(self, params, x, labels, *, train=False, rng=None, mask=None):
+        z = self.preout(params, x, train, rng)
+        primary = get_loss(self.loss)(labels, z, self.activation, mask=mask)
+        c_y = labels @ params["cL"]                  # (B, n_in) via one-hot
+        center = 0.5 * self.lambda_ * jnp.mean(
+            jnp.sum((x - c_y) ** 2, axis=-1))
+        return primary + center
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
 class LossLayer(LayerConf):
     """Parameter-free loss head (DL4J LossLayer)."""
     activation: str = "identity"

@@ -231,13 +231,21 @@ class MultiLayerNetwork:
                 rng, sub_rng = jax.random.split(rng)
             mask = fmask if cur_type.kind == Kind.RNN else None
             key = str(i)
+            layer_params = params[key]
+            if train and sub_rng is not None and layer.weight_noise is not None:
+                from deeplearning4j_tpu.nn.regularization import (
+                    apply_weight_noise,
+                )
+                sub_rng, noise_rng = jax.random.split(sub_rng)
+                layer_params = apply_weight_noise(layer, layer_params, train,
+                                                  noise_rng)
             if carries is not None and type(layer).__name__ in _RECURRENT_CLASSES:
-                y, carry = layer.apply_seq(params[key], x, carries.get(key),
+                y, carry = layer.apply_seq(layer_params, x, carries.get(key),
                                            train=train, rng=sub_rng, mask=mask)
                 new_carries[key] = carry
                 new_state[key] = state[key]
             else:
-                y, s = layer.apply(params[key], state[key], x, train=train,
+                y, s = layer.apply(layer_params, state[key], x, train=train,
                                    rng=sub_rng, mask=mask)
                 new_state[key] = s
             x = y
@@ -305,7 +313,12 @@ class MultiLayerNetwork:
     # ----------------------------------------------------------------- fit
     def _make_train_step(self, with_fmask, with_lmask, with_carries,
                          with_stats=False):
+        from deeplearning4j_tpu.nn.regularization import (
+            apply_constraints, has_constraints,
+        )
         tx = self._tx
+        constrained = has_constraints(self.layers)
+        layer_map = {str(i): l for i, l in enumerate(self.layers)}
 
         def step(params, opt_state, state, x, y, fmask, lmask, rng, carries):
             def loss_fn(p):
@@ -315,6 +328,8 @@ class MultiLayerNetwork:
                 loss_fn, has_aux=True)(params)
             updates, new_opt = tx.update(grads, opt_state, params)
             new_params = optax.apply_updates(params, updates)
+            if constrained:     # post-update projection (DL4J applyConstraints)
+                new_params = apply_constraints(layer_map, new_params)
             if with_stats:
                 # StatsListener capture iterations also return the raw
                 # gradient and update pytrees (DL4J onGradientCalculation /
@@ -352,6 +367,58 @@ class MultiLayerNetwork:
                 lst.on_epoch_end(self, self.epoch_count)
             self.epoch_count += 1
             iterator.reset()
+        return self
+
+    def fit_pretrain(self, data, epochs: int = 1, batch_size: int = 32):
+        """Greedy layerwise unsupervised pretraining (the `pretrain` branch
+        of DL4J MultiLayerNetwork.fit, MultiLayerNetwork.java:1344-1346 over
+        nn/layers/BasePretrainNetwork.java).
+
+        For each layer exposing `pretrain_score` (AutoEncoder, VAE), in
+        order: features are computed through the already-(pre)trained layers
+        below in eval mode, and only that layer's params are optimized on
+        its unsupervised objective. Supervised layers are skipped — follow
+        with fit() to fine-tune end-to-end."""
+        if self.params is None:
+            self.init()
+        iterator = self._as_iterator(data, batch_size)
+        rng = jax.random.PRNGKey(self.conf.seed + 52711)
+        for i, layer in enumerate(self.layers):
+            if not hasattr(layer, "pretrain_score"):
+                continue
+            tx = build_optimizer(layer.updater or self.conf.updater,
+                                 self.conf.grad_clip_norm,
+                                 self.conf.grad_clip_value)
+            lp = self.params[str(i)]
+            opt_state = tx.init(lp)
+
+            @jax.jit
+            def feats_fn(params, state, x, _i=i):
+                f, _, _ = self._forward(params, state, x, False, None,
+                                        upto=_i)
+                return f
+
+            @jax.jit
+            def pretrain_step(lp, opt_state, x, sub, _layer=layer, _tx=tx):
+                loss, grads = jax.value_and_grad(
+                    lambda p: _layer.pretrain_score(p, x, sub))(lp)
+                updates, new_opt = _tx.update(grads, opt_state, lp)
+                return optax.apply_updates(lp, updates), new_opt, loss
+
+            for _ in range(epochs):
+                for ds in iterator:
+                    feats = feats_fn(self.params, self.state,
+                                     _as_jnp(ds.features,
+                                             self._compute_dtype))
+                    rng, sub = jax.random.split(rng)
+                    lp, opt_state, loss = pretrain_step(lp, opt_state,
+                                                        feats, sub)
+                iterator.reset()
+            self.params[str(i)] = lp
+            self._score = float(loss)
+            log.info("pretrained layer %d (%s): score %.5f", i,
+                     type(layer).__name__, self._score)
+        self._build_optimizer()     # fresh opt state for supervised fit()
         return self
 
     def _as_iterator(self, data, batch_size) -> DataSetIterator:

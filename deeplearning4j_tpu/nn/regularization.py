@@ -1,0 +1,255 @@
+"""Dropout variants, weight noise, and parameter constraints.
+
+Parity targets:
+- `nn/conf/dropout/{Dropout,AlphaDropout,GaussianDropout,GaussianNoise}.java`
+- `nn/conf/weightnoise/{DropConnect,WeightNoise}.java`
+- `nn/conf/constraint/{MaxNormConstraint,MinMaxNormConstraint,
+  NonNegativeConstraint,UnitNormConstraint}.java` (applied post-update via
+  `BaseConstraint.applyConstraint`)
+
+Wiring (TPU-native): a LayerConf's `dropout` field takes a float (plain
+inverted dropout, the DL4J default) or one of the IDropout objects below;
+`weight_noise` transforms the layer's weight params inside the training
+forward (DL4J `getParamWithNoise`); `constraints` are projected onto the
+params right after the optimizer update inside the SAME jit-compiled train
+step — no extra device round-trip.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.base import register_layer
+
+
+# ------------------------------------------------------------ dropout family
+@dataclasses.dataclass(frozen=True)
+class IDropout:
+    """Base input-dropout schedule; subclasses implement apply()."""
+
+    def apply(self, x, rng):
+        raise NotImplementedError
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class Dropout(IDropout):
+    """Standard inverted dropout (nn/conf/dropout/Dropout.java)."""
+    p: float = 0.5          # DROP probability (DL4J stores keep prob; the
+    # float-valued LayerConf.dropout field keeps DL4J's semantics — this
+    # object form uses drop probability like every modern framework)
+
+    def apply(self, x, rng):
+        keep = 1.0 - self.p
+        mask = jax.random.bernoulli(rng, keep, x.shape)
+        return jnp.where(mask, x / keep, 0.0)
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class AlphaDropout(IDropout):
+    """SELU-preserving dropout (nn/conf/dropout/AlphaDropout.java):
+    dropped units are set to alpha' and the result is affinely corrected so
+    self-normalizing activations keep zero mean / unit variance."""
+    p: float = 0.05
+
+    # fixed-point constants of SELU (Klambauer et al.)
+    _ALPHA = 1.6732632423543772
+    _SCALE = 1.0507009873554805
+
+    def apply(self, x, rng):
+        keep = 1.0 - self.p
+        alpha_p = -self._ALPHA * self._SCALE          # value dropped units take
+        a = (keep + alpha_p ** 2 * keep * (1 - keep)) ** -0.5
+        b = -a * alpha_p * (1 - keep)
+        mask = jax.random.bernoulli(rng, keep, x.shape)
+        return a * jnp.where(mask, x, alpha_p) + b
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class GaussianDropout(IDropout):
+    """Multiplicative Gaussian noise N(1, rate/(1-rate))
+    (nn/conf/dropout/GaussianDropout.java)."""
+    rate: float = 0.1
+
+    def apply(self, x, rng):
+        std = (self.rate / (1.0 - self.rate)) ** 0.5
+        return x * (1.0 + std * jax.random.normal(rng, x.shape, x.dtype))
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class GaussianNoise(IDropout):
+    """Additive Gaussian noise (nn/conf/dropout/GaussianNoise.java)."""
+    stddev: float = 0.1
+
+    def apply(self, x, rng):
+        return x + self.stddev * jax.random.normal(rng, x.shape, x.dtype)
+
+
+def apply_input_dropout(dropout, x, train, rng):
+    """Dispatch for LayerConf.dropout: float (DL4J drop-prob semantics) or
+    IDropout object. Called from LayerConf.maybe_dropout_input."""
+    if not train or rng is None or dropout is None:
+        return x
+    if isinstance(dropout, IDropout):
+        return dropout.apply(x, rng)
+    p = float(dropout)
+    if p <= 0.0:
+        return x
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(rng, keep, x.shape)
+    return jnp.where(mask, x / keep, 0.0)
+
+
+# -------------------------------------------------------- weight noise family
+@dataclasses.dataclass(frozen=True)
+class IWeightNoise:
+    apply_to_bias: bool = False
+
+    def transform(self, params: dict, rng):
+        """Returns a transformed COPY of the layer's params for this forward
+        (DL4J BaseLayer.getParamWithNoise)."""
+        out = {}
+        for i, (k, v) in enumerate(sorted(params.items())):
+            if k.startswith("b") and not self.apply_to_bias:
+                out[k] = v
+            else:
+                out[k] = self._transform_one(v, jax.random.fold_in(rng, i))
+        return out
+
+    def _transform_one(self, w, rng):
+        raise NotImplementedError
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class DropConnect(IWeightNoise):
+    """Randomly zero WEIGHTS during training (nn/conf/weightnoise/
+    DropConnect.java); inverted scaling keeps the expectation."""
+    p: float = 0.5          # drop probability
+
+    def _transform_one(self, w, rng):
+        keep = 1.0 - self.p
+        mask = jax.random.bernoulli(rng, keep, w.shape)
+        return jnp.where(mask, w / keep, 0.0)
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class WeightNoise(IWeightNoise):
+    """Additive or multiplicative Gaussian weight noise
+    (nn/conf/weightnoise/WeightNoise.java)."""
+    stddev: float = 0.05
+    additive: bool = True
+
+    def _transform_one(self, w, rng):
+        noise = jax.random.normal(rng, w.shape, w.dtype) * self.stddev
+        return w + noise if self.additive else w * (1.0 + noise)
+
+
+def apply_weight_noise(layer, params, train, rng):
+    """Network-forward hook: transform a layer's params when training."""
+    noise = getattr(layer, "weight_noise", None)
+    if not train or rng is None or noise is None:
+        return params
+    return noise.transform(params, rng)
+
+
+# ---------------------------------------------------------- constraint family
+@dataclasses.dataclass(frozen=True)
+class BaseConstraint:
+    """Projection applied to weight params right after the optimizer update
+    (DL4J BaseConstraint.applyConstraint; StochasticGradientDescent calls
+    applyConstraints post-step). `apply_to_bias` mirrors DL4J's
+    constrainBias flag."""
+    apply_to_bias: bool = False
+
+    def project(self, w):
+        raise NotImplementedError
+
+    def _norms(self, w):
+        """L2 norm per output unit: all axes except the last (fan-in /
+        spatial dims for conv HWIO kernels — DL4J getBroadcastDims)."""
+        axes = tuple(range(w.ndim - 1)) or (0,)
+        return jnp.sqrt(jnp.sum(w * w, axis=axes, keepdims=True)), axes
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class MaxNormConstraint(BaseConstraint):
+    """Clip each output unit's weight-vector L2 norm to max_norm
+    (nn/conf/constraint/MaxNormConstraint.java)."""
+    max_norm: float = 2.0
+
+    def project(self, w):
+        norms, _ = self._norms(w)
+        scale = jnp.minimum(1.0, self.max_norm / jnp.maximum(norms, 1e-12))
+        return w * scale
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class MinMaxNormConstraint(BaseConstraint):
+    """Force norms into [min, max], interpolated by rate
+    (nn/conf/constraint/MinMaxNormConstraint.java)."""
+    min_norm: float = 0.0
+    max_norm: float = 2.0
+    rate: float = 1.0
+
+    def project(self, w):
+        norms, _ = self._norms(w)
+        clipped = jnp.clip(norms, self.min_norm, self.max_norm)
+        target = self.rate * clipped + (1.0 - self.rate) * norms
+        return w * target / jnp.maximum(norms, 1e-12)
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class NonNegativeConstraint(BaseConstraint):
+    """Project weights onto the non-negative orthant
+    (nn/conf/constraint/NonNegativeConstraint.java)."""
+
+    def project(self, w):
+        return jnp.maximum(w, 0.0)
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class UnitNormConstraint(BaseConstraint):
+    """Rescale each output unit's weight vector to unit L2 norm
+    (nn/conf/constraint/UnitNormConstraint.java)."""
+
+    def project(self, w):
+        norms, _ = self._norms(w)
+        return w / jnp.maximum(norms, 1e-12)
+
+
+def apply_constraints(layer_map, params):
+    """Post-update projection for every constrained layer.
+
+    layer_map: {param-dict key: LayerConf}; params: the full network params
+    pytree. Runs INSIDE the jit-compiled train step (pure function)."""
+    new_params = dict(params)
+    for key, layer in layer_map.items():
+        cons: Tuple = getattr(layer, "constraints", ()) or ()
+        if not cons or key not in new_params:
+            continue
+        lp = dict(new_params[key])
+        for pname, w in lp.items():
+            if not hasattr(w, "ndim"):
+                continue
+            for c in cons:
+                if pname.startswith("b") and not c.apply_to_bias:
+                    continue
+                lp[pname] = c.project(lp[pname])
+        new_params[key] = lp
+    return new_params
+
+
+def has_constraints(layers) -> bool:
+    return any(getattr(l, "constraints", ()) for l in layers)

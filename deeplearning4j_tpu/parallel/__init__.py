@@ -29,6 +29,9 @@ from deeplearning4j_tpu.parallel.ring import (
     blockwise_attention, make_ring_attention, ring_self_attention,
 )
 from deeplearning4j_tpu.parallel.context import ContextParallelTrainer
+from deeplearning4j_tpu.parallel.shared import (
+    LoopbackTransport, SharedGradientsTrainer,
+)
 
 __all__ = [
     "MeshConfig", "build_mesh", "data_sharding", "replicated_sharding",
@@ -40,4 +43,5 @@ __all__ = [
     "DistributedConfig", "initialize_distributed",
     "ring_self_attention", "make_ring_attention", "blockwise_attention",
     "ContextParallelTrainer",
+    "SharedGradientsTrainer", "LoopbackTransport",
 ]

@@ -1,0 +1,193 @@
+"""Shared encoded-gradient training — the DCN / multi-pod parity path.
+
+Parity targets: DL4J's asynchronous quantized gradient sharing —
+`spark/dl4j-spark-parameterserver/.../SharedTrainingMaster.java:475` (the
+Aeron parameter-server init), `networking/WiredEncodingHandler.java:20-89`
+(each worker threshold-encodes its update and multicasts it) and
+`networking/SilentTrainingDriver.java:112-121` (incoming remote updates are
+applied into the local accumulator).
+
+TPU-native redesign (SURVEY.md §5.8): within a pod, ICI all-reduce strictly
+dominates — use ParallelWrapper. This trainer is the CROSS-POD story, where
+bandwidth is scarce: each logical pod computes gradients on its batch
+shard, threshold-encodes them (with per-pod residual carry, exactly the
+EncodingHandler semantics), and the sparse messages are exchanged host-side
+over a pluggable transport. Every pod applies the same decoded sum through
+the same updater, so replicas stay bit-identical without parameter
+broadcast — the property DL4J's accumulator design relies on.
+
+The in-process LoopbackTransport mirrors the reference's own test strategy
+(loopback parameter server in one JVM, SURVEY.md §4); a real deployment
+swaps in a socket/DCN transport with the same 3-array message.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from deeplearning4j_tpu.parallel.encoding import EncodingHandler
+from deeplearning4j_tpu.util import params as param_util
+
+
+class LoopbackTransport:
+    """In-process message exchange between logical pods (the stand-in for
+    Aeron UDP / DCN; message = (indices, signs, threshold) triple per pod,
+    SilentUpdatesMessage analog)."""
+
+    def __init__(self, n_workers: int):
+        self.n_workers = n_workers
+        self._inbox: List[list] = [[] for _ in range(n_workers)]
+        self.messages_sent = 0
+        self.bytes_sent = 0
+
+    def broadcast(self, sender: int, message: Tuple):
+        idx, signs, thr = message
+        self.messages_sent += self.n_workers - 1
+        # int32 index + int8 sign per transmitted element + the threshold
+        self.bytes_sent += (self.n_workers - 1) * (idx.size * 5 + 4)
+        for w in range(self.n_workers):
+            if w != sender:
+                self._inbox[w].append(message)
+
+    def drain(self, worker: int) -> List[Tuple]:
+        msgs, self._inbox[worker] = self._inbox[worker], []
+        return msgs
+
+
+@dataclasses.dataclass
+class SharedGradientsTrainer:
+    """Multi-pod data parallelism with threshold-encoded gradient exchange.
+
+    Usage:
+        trainer = SharedGradientsTrainer(net, n_workers=2, threshold=1e-3)
+        trainer.fit(iterator, epochs=2)
+        trainer.compression_ratio()   # bytes on the wire vs dense f32
+    """
+    model: object
+    n_workers: int = 2
+    threshold: float = 1e-3
+    boundary: float = 0.02
+    transport: Optional[LoopbackTransport] = None
+
+    def __post_init__(self):
+        if self.model.params is None:
+            raise ValueError("model must be init()ed first")
+        if self.transport is None:
+            self.transport = LoopbackTransport(self.n_workers)
+        # per-pod encoder: residuals are pod-local state (EncodingHandler
+        # "left-overs" buffer)
+        self.handlers = [EncodingHandler(threshold=self.threshold,
+                                         boundary=self.boundary)
+                         for _ in range(self.n_workers)]
+        self._grad_fn = None
+        self._apply_fn = None
+        self._dense_bytes = 0
+        self.iteration_count = 0
+
+    # ------------------------------------------------------------- compiled
+    def _build(self):
+        net = self.model
+        n = self.n_workers
+
+        @jax.jit
+        def grad_fn(params, state, x, y, rng):
+            def lf(p):
+                loss, (new_state, _) = net._score_fn(
+                    p, state, x, y, None, None, True, rng)
+                return loss, new_state
+            (loss, new_state), grads = jax.value_and_grad(
+                lf, has_aux=True)(params)
+            # pre-scale by 1/n so the decoded SUM across pods equals the
+            # dense gradient average (keeps residual accounting consistent)
+            flat = param_util.params_to_flat(grads) / n
+            return flat, loss, new_state
+
+        @jax.jit
+        def apply_fn(params, opt_state, flat_update):
+            grads = param_util.flat_to_params(flat_update, params)
+            updates, new_opt = net._tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), new_opt
+
+        self._grad_fn, self._apply_fn = grad_fn, apply_fn
+
+    # ------------------------------------------------------------------ fit
+    def fit(self, data, epochs: int = 1, batch_size: int = 32):
+        net = self.model
+        if self._grad_fn is None:
+            self._build()
+        source = net._as_iterator(data, batch_size)
+        rng = jax.random.PRNGKey(net.conf.seed + 86243)
+        for _ in range(epochs):
+            for ds in source:
+                rng, sub = jax.random.split(rng)
+                self._iteration(ds, sub)
+            source.reset()
+            net.epoch_count += 1
+        return net
+
+    def _iteration(self, ds, rng):
+        net = self.model
+        shards = self._split(ds.features, ds.labels)
+        n_params = int(param_util.params_to_flat(net.params).shape[0])
+        # 1. every pod: local gradients on its shard (same start params)
+        encoded = []
+        loss = None
+        for w, (xw, yw) in enumerate(shards):
+            flat, loss, new_state = self._grad_fn(
+                net.params, net.state, xw, yw, jax.random.fold_in(rng, w))
+            idx, signs, thr = self.handlers[w].encode(flat)
+            encoded.append((idx, signs, thr))
+            self.transport.broadcast(w, (idx, signs, thr))
+            net.state = new_state         # BN stats etc. from the last pod
+        self._dense_bytes += self.n_workers * (self.n_workers - 1) * \
+            n_params * 4
+        # 2. every pod decodes its own + received messages and applies the
+        #    identical sum -> replicas stay in lockstep; we keep ONE params
+        #    copy and apply once (SilentTrainingDriver.startTraining)
+        total = jnp.zeros((n_params,), jnp.float32)
+        own = encoded[0]
+        msgs = [own] + self.transport.drain(0)
+        for idx, signs, thr in msgs:
+            total = total + self.handlers[0].decode(idx, signs, thr,
+                                                    (n_params,))
+        for w in range(1, self.n_workers):   # other pods just drain inboxes
+            self.transport.drain(w)
+        net.params, net.opt_state = self._apply_fn(net.params, net.opt_state,
+                                                   total)
+        net._score = float(loss)
+        for lst in net.listeners:
+            lst.iteration_done(net, self.iteration_count, net.epoch_count,
+                               net._score, 0.0, int(ds.features.shape[0]))
+        self.iteration_count += 1
+        net.iteration_count += 1
+
+    def _split(self, x, y):
+        """Contiguous batch shards, one per pod (ragged tail goes to the
+        last pod)."""
+        x = np.asarray(x)
+        y = np.asarray(y)
+        n = x.shape[0]
+        per = max(1, n // self.n_workers)
+        shards = []
+        for w in range(self.n_workers):
+            lo = min(w * per, n)
+            hi = n if w == self.n_workers - 1 else min((w + 1) * per, n)
+            if hi <= lo:            # more pods than samples: reuse the batch
+                lo, hi = 0, n
+            shards.append((jnp.asarray(x[lo:hi]), jnp.asarray(y[lo:hi])))
+        return shards
+
+    # ------------------------------------------------------------ reporting
+    def compression_ratio(self) -> float:
+        """Wire bytes vs dense float32 exchange (lower is better)."""
+        if self._dense_bytes == 0:
+            return 1.0
+        return self.transport.bytes_sent / self._dense_bytes
+
+    def sparsity(self) -> float:
+        return float(np.mean([h.last_sparsity for h in self.handlers]))

@@ -1,0 +1,137 @@
+"""Layerwise pretraining + center loss tests
+(the analog of DL4J's pretrain-branch tests and CenterLossOutputLayerTest)."""
+import numpy as np
+
+from deeplearning4j_tpu.gradientcheck import check_gradients
+from deeplearning4j_tpu.nn.conf.base import InputType
+from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import (
+    AutoEncoder, CenterLossOutputLayer, DenseLayer, OutputLayer,
+    VariationalAutoencoder,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updaters import Adam, Sgd
+
+RS = np.random.RandomState(7)
+
+
+def _structured_data(n=240, f=12, c=3, noise=1.6):
+    """Class information lives in a low-dim subspace + heavy noise — the
+    regime where unsupervised feature learning helps a short fine-tune."""
+    protos = RS.randn(c, f) * 2.0
+    ys = RS.randint(0, c, n)
+    X = protos[ys] + noise * RS.randn(n, f)
+    return X.astype("float32"), np.eye(c, dtype="float32")[ys], ys
+
+
+def _stacked_ae_conf(seed):
+    return (NeuralNetConfiguration.Builder().seed(seed).updater(Adam(5e-3))
+            .list()
+            .layer(AutoEncoder(n_out=8, activation="sigmoid",
+                               corruption_level=0.2))
+            .layer(AutoEncoder(n_out=6, activation="sigmoid",
+                               corruption_level=0.2))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(12)).build())
+
+
+def test_fit_pretrain_trains_each_pretrainable_layer():
+    X, Y, _ = _structured_data()
+    net = MultiLayerNetwork(_stacked_ae_conf(0)).init()
+    w0_before = np.asarray(net.params["0"]["W"]).copy()
+    w1_before = np.asarray(net.params["1"]["W"]).copy()
+    w2_before = np.asarray(net.params["2"]["W"]).copy()
+    net.fit_pretrain((X, Y), epochs=5, batch_size=48)
+    # both AE layers moved; the supervised head did NOT
+    assert np.abs(np.asarray(net.params["0"]["W"]) - w0_before).max() > 1e-3
+    assert np.abs(np.asarray(net.params["1"]["W"]) - w1_before).max() > 1e-3
+    np.testing.assert_allclose(np.asarray(net.params["2"]["W"]), w2_before)
+    assert np.isfinite(net.score())
+
+
+def test_pretraining_beats_random_init():
+    """Greedy AE pretraining must learn measurably better features than
+    random init: a linear head trained on the pretrained stack's encoding
+    beats the same head on the random stack's encoding (the point of the
+    pretrain branch, with the end-to-end fine-tune seed noise factored
+    out)."""
+    X, Y, _ = _structured_data(n=600)
+
+    def head_acc(feats):
+        hc = (NeuralNetConfiguration.Builder().seed(0).updater(Adam(0.05))
+              .list()
+              .layer(OutputLayer(n_out=3, activation="softmax",
+                                 loss="mcxent"))
+              .set_input_type(InputType.feed_forward(feats.shape[1]))
+              .build())
+        h = MultiLayerNetwork(hc).init()
+        h.fit((feats, Y), epochs=30, batch_size=64)
+        return h.evaluate((feats, Y)).accuracy()
+
+    net = MultiLayerNetwork(_stacked_ae_conf(3)).init()
+    random_feats = np.asarray(net.feed_forward(X)[1])
+    net.fit_pretrain((X, Y), epochs=30, batch_size=64)
+    pre_feats = np.asarray(net.feed_forward(X)[1])
+    pre_acc, random_acc = head_acc(pre_feats), head_acc(random_feats)
+    assert pre_acc > random_acc, (pre_acc, random_acc)
+
+
+def test_vae_pretrain_via_driver():
+    X, Y, _ = _structured_data()
+    conf = (NeuralNetConfiguration.Builder().seed(1).updater(Adam(3e-3))
+            .list()
+            .layer(VariationalAutoencoder(n_out=4, encoder_layer_sizes=(10,),
+                                          decoder_layer_sizes=(10,)))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(12)).build())
+    net = MultiLayerNetwork(conf).init()
+    net.fit_pretrain((X, Y), epochs=3, batch_size=48)
+    s1 = net.score()
+    net.fit_pretrain((X, Y), epochs=6, batch_size=48)
+    assert net.score() < s1          # ELBO keeps improving
+    net.fit((X, Y), epochs=3, batch_size=48)
+    assert np.isfinite(net.score())
+
+
+# ----------------------------------------------------------------- center loss
+def _center_net(lmbda=0.01):
+    conf = (NeuralNetConfiguration.Builder().seed(2).updater(Sgd(0.1)).list()
+            .layer(DenseLayer(n_out=6, activation="tanh"))
+            .layer(CenterLossOutputLayer(n_out=3, activation="softmax",
+                                         loss="mcxent", lambda_=lmbda))
+            .set_input_type(InputType.feed_forward(12)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def test_center_loss_gradcheck():
+    X, Y, _ = _structured_data(n=8)
+    net = _center_net(lmbda=0.1)
+    # give centers a nonzero start so their gradient is exercised
+    import jax.numpy as jnp
+    net.params["1"]["cL"] = jnp.asarray(RS.randn(3, 6).astype("float32"))
+    res = check_gradients(net, X[:6], Y[:6], max_per_param=12)
+    assert res.passed, (res.worst_param, res.max_rel_error, res.failures[:3])
+
+
+def test_center_loss_tightens_class_clusters():
+    X, Y, ys = _structured_data(noise=1.0)
+    net = _center_net(lmbda=0.05)
+    net.fit((X, Y), epochs=40, batch_size=48)
+    assert net.evaluate((X, Y)).accuracy() > 0.8
+    # centers moved from zero toward the class feature means
+    centers = np.asarray(net.params["1"]["cL"])
+    assert np.abs(centers).max() > 0.05
+    feats = np.asarray(net.feed_forward(X)[0])
+    # intra-class scatter around the learned center < scatter around origin
+    for k in range(3):
+        fk = feats[ys == k]
+        around_center = np.mean(np.sum((fk - centers[k]) ** 2, axis=1))
+        around_origin = np.mean(np.sum(fk ** 2, axis=1))
+        assert around_center < around_origin
+
+
+def test_center_loss_serde_round_trip():
+    from deeplearning4j_tpu.nn.conf.network import MultiLayerConfiguration
+    net = _center_net()
+    back = MultiLayerConfiguration.from_json(net.conf.to_json())
+    assert back.layers[1] == net.conf.layers[1]

@@ -1,0 +1,182 @@
+"""Dropout variants, weight noise, and constraints — behavioral tests
+(the analog of DL4J's TestDropout / TestWeightNoise / TestConstraints)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nn.conf.base import InputType
+from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.regularization import (
+    AlphaDropout, DropConnect, Dropout, GaussianDropout, GaussianNoise,
+    MaxNormConstraint, MinMaxNormConstraint, NonNegativeConstraint,
+    UnitNormConstraint, WeightNoise,
+)
+from deeplearning4j_tpu.nn.updaters import Sgd
+
+RS = np.random.RandomState(0)
+
+
+def _blobs(n=96, f=6, c=3):
+    X = RS.randn(n, f).astype("float32")
+    Y = np.eye(c, dtype="float32")[RS.randint(0, c, n)]
+    return X, Y
+
+
+def _fit_net(layer0, layer1=None, epochs=4):
+    conf = (NeuralNetConfiguration.Builder().seed(1).updater(Sgd(0.1)).list()
+            .layer(layer0)
+            .layer(layer1 or OutputLayer(n_out=3, activation="softmax",
+                                         loss="mcxent"))
+            .set_input_type(InputType.feed_forward(6)).build())
+    net = MultiLayerNetwork(conf).init()
+    X, Y = _blobs()
+    net.fit((X, Y), epochs=epochs, batch_size=32)
+    assert np.isfinite(net.score())
+    return net
+
+
+# ------------------------------------------------------------ dropout family
+def test_alpha_dropout_preserves_selu_statistics():
+    # AlphaDropout on standard-normal input (SELU fixed point): mean/var
+    # preserved to statistical tolerance (AlphaDropout.java contract)
+    x = jnp.asarray(RS.randn(200_000).astype("float32"))
+    y = AlphaDropout(p=0.1).apply(x, jax.random.PRNGKey(0))
+    assert abs(float(y.mean())) < 0.02
+    assert abs(float(y.var()) - 1.0) < 0.05
+    # dropped units take the alpha' value, not zero
+    assert float((y == 0).mean()) < 1e-3
+
+
+def test_gaussian_dropout_preserves_mean():
+    x = jnp.ones((100_000,), "float32") * 3.0
+    y = GaussianDropout(rate=0.25).apply(x, jax.random.PRNGKey(1))
+    assert abs(float(y.mean()) - 3.0) < 0.02
+    expected_std = 3.0 * (0.25 / 0.75) ** 0.5
+    assert abs(float(y.std()) - expected_std) < 0.05
+
+
+def test_gaussian_noise_additive():
+    x = jnp.zeros((100_000,), "float32")
+    y = GaussianNoise(stddev=0.5).apply(x, jax.random.PRNGKey(2))
+    assert abs(float(y.std()) - 0.5) < 0.02
+    assert abs(float(y.mean())) < 0.02
+
+
+def test_dropout_object_matches_float_semantics():
+    x = jnp.ones((100_000,), "float32")
+    y = Dropout(p=0.3).apply(x, jax.random.PRNGKey(3))
+    drop_frac = float((y == 0).mean())
+    assert abs(drop_frac - 0.3) < 0.02
+    assert abs(float(y.mean()) - 1.0) < 0.02       # inverted scaling
+
+
+def test_dropout_variants_train_only_and_nets_train():
+    for do in (AlphaDropout(p=0.1), GaussianDropout(rate=0.1),
+               GaussianNoise(stddev=0.1), Dropout(p=0.2)):
+        net = _fit_net(DenseLayer(n_out=10, activation="selu", dropout=do))
+        X, _ = _blobs()
+        # eval-mode forward is deterministic (no dropout applied)
+        a = np.asarray(net.output(X[:8]))
+        b = np.asarray(net.output(X[:8]))
+        np.testing.assert_allclose(a, b)
+
+
+# -------------------------------------------------------- weight noise family
+def test_dropconnect_transform_and_training():
+    w = jnp.ones((50, 50), "float32")
+    out = DropConnect(p=0.4).transform({"W": w}, jax.random.PRNGKey(0))
+    dropped = float((out["W"] == 0).mean())
+    assert abs(dropped - 0.4) < 0.03
+    kept = np.asarray(out["W"])[np.asarray(out["W"]) != 0]
+    np.testing.assert_allclose(kept, 1.0 / 0.6, rtol=1e-5)
+    # biases untouched by default
+    out2 = DropConnect(p=0.9).transform({"W": w, "b": jnp.ones(5)},
+                                        jax.random.PRNGKey(1))
+    np.testing.assert_allclose(np.asarray(out2["b"]), 1.0)
+    net = _fit_net(DenseLayer(n_out=10, activation="relu",
+                              weight_noise=DropConnect(p=0.3)))
+    # weight noise is train-only: eval forward deterministic
+    X, _ = _blobs()
+    np.testing.assert_allclose(np.asarray(net.output(X[:4])),
+                               np.asarray(net.output(X[:4])))
+
+
+def test_weight_noise_additive_and_multiplicative():
+    w = jnp.full((80, 80), 2.0, "float32")
+    add = WeightNoise(stddev=0.1, additive=True).transform(
+        {"W": w}, jax.random.PRNGKey(0))["W"]
+    assert abs(float(add.mean()) - 2.0) < 0.01
+    assert abs(float(add.std()) - 0.1) < 0.01
+    mul = WeightNoise(stddev=0.1, additive=False).transform(
+        {"W": w}, jax.random.PRNGKey(1))["W"]
+    assert abs(float(mul.std()) - 0.2) < 0.02      # 2.0 * 0.1
+    _fit_net(DenseLayer(n_out=10, activation="relu",
+                        weight_noise=WeightNoise(stddev=0.05)))
+
+
+# ---------------------------------------------------------- constraint family
+def _col_norms(W):
+    return np.linalg.norm(np.asarray(W), axis=0)
+
+
+def test_max_norm_constraint_enforced_after_updates():
+    net = _fit_net(DenseLayer(n_out=10, activation="tanh",
+                              constraints=(MaxNormConstraint(max_norm=0.5),)))
+    assert (_col_norms(net.params["0"]["W"]) <= 0.5 + 1e-5).all()
+
+
+def test_unit_norm_constraint():
+    net = _fit_net(DenseLayer(n_out=10, activation="tanh",
+                              constraints=(UnitNormConstraint(),)))
+    np.testing.assert_allclose(_col_norms(net.params["0"]["W"]), 1.0,
+                               atol=1e-5)
+
+
+def test_min_max_norm_constraint():
+    net = _fit_net(DenseLayer(
+        n_out=10, activation="tanh",
+        constraints=(MinMaxNormConstraint(min_norm=0.4, max_norm=0.8),)))
+    norms = _col_norms(net.params["0"]["W"])
+    assert (norms >= 0.4 - 1e-5).all() and (norms <= 0.8 + 1e-5).all()
+
+
+def test_non_negative_constraint():
+    net = _fit_net(DenseLayer(n_out=10, activation="sigmoid",
+                              constraints=(NonNegativeConstraint(),)))
+    assert (np.asarray(net.params["0"]["W"]) >= 0).all()
+    # bias unconstrained by default (apply_to_bias=False)
+
+
+def test_constraint_on_output_layer_too():
+    net = _fit_net(
+        DenseLayer(n_out=8, activation="tanh"),
+        OutputLayer(n_out=3, activation="softmax", loss="mcxent",
+                    constraints=(MaxNormConstraint(max_norm=1.0),)))
+    assert (_col_norms(net.params["1"]["W"]) <= 1.0 + 1e-5).all()
+
+
+# -------------------------------------------------------------------- serde
+def test_regularization_serde_round_trip():
+    from deeplearning4j_tpu.nn.conf.network import MultiLayerConfiguration
+    conf = (NeuralNetConfiguration.Builder().seed(5).updater(Sgd(0.1)).list()
+            .layer(DenseLayer(n_out=8, activation="selu",
+                              dropout=AlphaDropout(p=0.07),
+                              weight_noise=DropConnect(p=0.25),
+                              constraints=(MaxNormConstraint(max_norm=1.5),
+                                           NonNegativeConstraint())))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent",
+                               dropout=GaussianNoise(stddev=0.2)))
+            .set_input_type(InputType.feed_forward(6)).build())
+    back = MultiLayerConfiguration.from_json(conf.to_json())
+    assert back.layers[0].dropout == AlphaDropout(p=0.07)
+    assert back.layers[0].weight_noise == DropConnect(p=0.25)
+    assert back.layers[0].constraints == (MaxNormConstraint(max_norm=1.5),
+                                          NonNegativeConstraint())
+    assert back.layers[1].dropout == GaussianNoise(stddev=0.2)
+    # and the deserialized conf actually trains
+    net = MultiLayerNetwork(back).init()
+    X, Y = _blobs()
+    net.fit((X, Y), epochs=2, batch_size=32)
+    assert np.isfinite(net.score())

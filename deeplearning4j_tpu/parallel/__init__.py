@@ -17,8 +17,9 @@ from deeplearning4j_tpu.parallel.inference import (
 )
 from deeplearning4j_tpu.parallel.encoding import (
     EncodingHandler, bitmap_decode, bitmap_encode, threshold_decode,
-    threshold_encode,
+    threshold_encode, threshold_encode_values, values_decode,
 )
+from deeplearning4j_tpu.parallel.transport import SocketTransport
 from deeplearning4j_tpu.parallel.sharding import (
     ShardingRules, shard_params, logical_to_mesh,
 )
@@ -38,7 +39,8 @@ __all__ = [
     "ParallelWrapper", "TrainingMode",
     "ParallelInference", "InferenceMode",
     "EncodingHandler", "threshold_encode", "threshold_decode",
-    "bitmap_encode", "bitmap_decode",
+    "threshold_encode_values", "values_decode",
+    "bitmap_encode", "bitmap_decode", "SocketTransport",
     "ShardingRules", "shard_params", "logical_to_mesh",
     "DistributedConfig", "initialize_distributed",
     "ring_self_attention", "make_ring_attention", "blockwise_attention",

@@ -46,10 +46,11 @@ class LoopbackTransport:
         self.bytes_sent = 0
 
     def broadcast(self, sender: int, message: Tuple):
-        idx, signs, thr = message
+        idx, payload, thr = message
         self.messages_sent += self.n_workers - 1
-        # int32 index + int8 sign per transmitted element + the threshold
-        self.bytes_sent += (self.n_workers - 1) * (idx.size * 5 + 4)
+        # int32 index + payload element (int8 sign or f32 value) + scalar
+        per_el = 4 + jnp.asarray(payload).dtype.itemsize
+        self.bytes_sent += (self.n_workers - 1) * (idx.size * per_el + 4)
         for w in range(self.n_workers):
             if w != sender:
                 self._inbox[w].append(message)
@@ -63,26 +64,43 @@ class LoopbackTransport:
 class SharedGradientsTrainer:
     """Multi-pod data parallelism with threshold-encoded gradient exchange.
 
-    Usage:
+    Usage (in-process simulation of all pods, loopback transport):
         trainer = SharedGradientsTrainer(net, n_workers=2, threshold=1e-3)
         trainer.fit(iterator, epochs=2)
         trainer.compression_ratio()   # bytes on the wire vs dense f32
+
+    Usage (one OS process per pod over the socket/DCN transport):
+        transport = SocketTransport(rank=r, n_workers=2)
+        trainer = SharedGradientsTrainer(net, n_workers=2, rank=r,
+                                         transport=transport)
+        trainer.fit(iterator, epochs=2)   # blocks on peers each iteration
     """
     model: object
     n_workers: int = 2
     threshold: float = 1e-3
-    boundary: float = 0.02
-    transport: Optional[LoopbackTransport] = None
+    # target transmitted-element density; the encoder's hard cap sits at
+    # 20% of elements, keeping worst-case wire cost at 0.4x dense even
+    # with exact-magnitude (8 bytes/element) messages
+    boundary: float = 0.15
+    transport: Optional[object] = None
+    # None = simulate every pod in this process (LoopbackTransport);
+    # an integer = THIS process is pod `rank` and the transport carries
+    # messages to real peers (SocketTransport)
+    rank: Optional[int] = None
 
     def __post_init__(self):
         if self.model.params is None:
             raise ValueError("model must be init()ed first")
         if self.transport is None:
+            if self.rank is not None:
+                raise ValueError("rank-based training needs a transport "
+                                 "(e.g. SocketTransport)")
             self.transport = LoopbackTransport(self.n_workers)
         # per-pod encoder: residuals are pod-local state (EncodingHandler
         # "left-overs" buffer)
         self.handlers = [EncodingHandler(threshold=self.threshold,
-                                         boundary=self.boundary)
+                                         boundary=self.boundary,
+                                         max_density=0.2)
                          for _ in range(self.n_workers)]
         self._grad_fn = None
         self._apply_fn = None
@@ -131,19 +149,30 @@ class SharedGradientsTrainer:
         return net
 
     def _iteration(self, ds, rng):
+        if self.rank is not None:
+            return self._iteration_distributed(ds, rng)
         net = self.model
         shards = self._split(ds.features, ds.labels)
         n_params = int(param_util.params_to_flat(net.params).shape[0])
         # 1. every pod: local gradients on its shard (same start params)
         encoded = []
-        loss = None
+        losses, sizes, new_states = [], [], []
         for w, (xw, yw) in enumerate(shards):
-            flat, loss, new_state = self._grad_fn(
+            flat, loss_w, new_state = self._grad_fn(
                 net.params, net.state, xw, yw, jax.random.fold_in(rng, w))
             idx, signs, thr = self.handlers[w].encode(flat)
             encoded.append((idx, signs, thr))
             self.transport.broadcast(w, (idx, signs, thr))
-            net.state = new_state         # BN stats etc. from the last pod
+            losses.append(float(loss_w))
+            sizes.append(int(xw.shape[0]))
+            new_states.append(new_state)
+        # BN stats etc.: batch-weighted average across pods (every replica
+        # saw a different shard; last-pod-wins would bias running stats)
+        wts = np.asarray(sizes, np.float32) / float(sum(sizes))
+        net.state = jax.tree_util.tree_map(
+            lambda *leaves: sum(w * l for w, l in zip(wts, leaves)),
+            *new_states)
+        loss = float(np.dot(wts, np.asarray(losses)))
         self._dense_bytes += self.n_workers * (self.n_workers - 1) * \
             n_params * 4
         # 2. every pod decodes its own + received messages and applies the
@@ -163,6 +192,42 @@ class SharedGradientsTrainer:
         for lst in net.listeners:
             lst.iteration_done(net, self.iteration_count, net.epoch_count,
                                net._score, 0.0, int(ds.features.shape[0]))
+        self.iteration_count += 1
+        net.iteration_count += 1
+
+    def _iteration_distributed(self, ds, rng):
+        """One lockstep iteration of THIS pod: local gradients on the
+        rank-th shard, broadcast the encoded message, block for the peers'
+        messages, apply the identical decoded sum (SilentTrainingDriver
+        semantics: remote updates land in the local accumulator and every
+        replica applies the same total)."""
+        net = self.model
+        shards = self._split(ds.features, ds.labels)
+        xw, yw = shards[self.rank]
+        n_params = int(param_util.params_to_flat(net.params).shape[0])
+        flat, loss, new_state = self._grad_fn(
+            net.params, net.state, xw, yw,
+            jax.random.fold_in(rng, self.rank))
+        handler = self.handlers[self.rank]
+        own = handler.encode(flat)
+        self.transport.broadcast(self.rank, own)
+        peer_msgs = self.transport.recv(self.n_workers - 1)
+        self._dense_bytes += (self.n_workers - 1) * n_params * 4
+        # summation order differs per replica (own message first, then
+        # arrival order) so f32 non-associativity costs ~1e-7 of agreement;
+        # the reference's accumulator makes the same non-guarantee over UDP
+        total = jnp.zeros((n_params,), jnp.float32)
+        for idx, payload, scalar in [own] + list(peer_msgs):
+            total = total + handler.decode(jnp.asarray(idx),
+                                           jnp.asarray(payload), scalar,
+                                           (n_params,))
+        net.params, net.opt_state = self._apply_fn(net.params, net.opt_state,
+                                                   total)
+        net.state = new_state   # BN stats stay pod-local on the DCN path
+        net._score = float(loss)
+        for lst in net.listeners:
+            lst.iteration_done(net, self.iteration_count, net.epoch_count,
+                               net._score, 0.0, int(xw.shape[0]))
         self.iteration_count += 1
         net.iteration_count += 1
 

@@ -1,0 +1,182 @@
+"""Socket transport for cross-process encoded-gradient exchange.
+
+Parity target: the reference's Aeron UDP mesh — `VoidParameterServer` init at
+`spark/dl4j-spark-parameterserver/.../pw/SharedTrainingWrapper.java:206-244`
+and the peer-to-peer update multicast of
+`networking/WiredEncodingHandler.java:20-89`. Every worker broadcasts its
+threshold-encoded update message to all peers and applies the identical sum,
+so replicas stay in lockstep without parameter broadcast.
+
+TPU-native stance (SURVEY.md §5.8): within a pod, gradients ride ICI inside
+the compiled step; this transport is the host-side DCN path between pods or
+hosts, where the sparse 3-array message (indices, payload, scalar) crosses
+TCP instead of Aeron UDP. TCP is deliberate: the reference's own comment
+("pray for udp broadcast availability", WiredEncodingHandler.java:89)
+documents exactly the delivery problem TCP removes.
+
+Wire format per message:
+    MAGIC (4B) | n_idx uint32 | payload_kind uint8 (0=int8 signs, 1=f32
+    values) | scalar float32 | idx int32[n] | payload bytes
+"""
+from __future__ import annotations
+
+import queue
+import socket
+import struct
+import threading
+import time
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+_MAGIC = b"DTPU"
+_HEADER = struct.Struct("<4sIBf")
+
+
+def _encode_message(message: Tuple) -> bytes:
+    idx, payload, scalar = message
+    idx = np.asarray(idx, np.int32)
+    payload = np.asarray(payload)
+    kind = 0 if payload.dtype == np.int8 else 1
+    payload = payload.astype(np.int8 if kind == 0 else np.float32)
+    head = _HEADER.pack(_MAGIC, idx.size, kind, float(scalar))
+    return head + idx.tobytes() + payload.tobytes()
+
+
+def _read_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed mid-message")
+        buf += chunk
+    return buf
+
+
+def _decode_message(sock: socket.socket) -> Tuple:
+    head = _read_exact(sock, _HEADER.size)
+    magic, n_idx, kind, scalar = _HEADER.unpack(head)
+    if magic != _MAGIC:
+        raise ValueError(f"bad magic {magic!r}")
+    idx = np.frombuffer(_read_exact(sock, n_idx * 4), np.int32)
+    if kind == 0:
+        payload = np.frombuffer(_read_exact(sock, n_idx), np.int8)
+    else:
+        payload = np.frombuffer(_read_exact(sock, n_idx * 4), np.float32)
+    return idx, payload, scalar
+
+
+class SocketTransport:
+    """Full-mesh TCP transport: one instance per OS process (= one logical
+    pod). `broadcast` sends the message to every peer; `recv` blocks until
+    the expected number of peer messages arrive.
+
+    Ports: peer r listens on ``base_port + r``. Outbound connections are
+    established lazily on first broadcast (with retry, so start order
+    doesn't matter — the Aeron mesh's introduction handshake analog,
+    SilentIntroductoryMessage).
+    """
+
+    def __init__(self, rank: int, n_workers: int, base_port: int = 29610,
+                 host: str = "127.0.0.1", connect_timeout: float = 30.0):
+        self.rank = rank
+        self.n_workers = n_workers
+        self.host = host
+        self.base_port = base_port
+        self.connect_timeout = connect_timeout
+        self.messages_sent = 0
+        self.bytes_sent = 0
+        self._inbox: "queue.Queue[Tuple]" = queue.Queue()
+        self._out: dict = {}
+        self._lock = threading.Lock()
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, base_port + rank))
+        self._listener.listen(n_workers)
+        self._closed = False
+        self._accept_thread = threading.Thread(target=self._accept_loop,
+                                               daemon=True)
+        self._accept_thread.start()
+
+    # ------------------------------------------------------------- receive
+    def _accept_loop(self):
+        while not self._closed:
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._reader, args=(conn,),
+                             daemon=True).start()
+
+    def _reader(self, conn: socket.socket):
+        try:
+            while not self._closed:
+                self._inbox.put(_decode_message(conn))
+        except (ConnectionError, OSError, ValueError):
+            pass
+        finally:
+            conn.close()
+
+    def recv(self, n_messages: int, timeout: float = 120.0) -> List[Tuple]:
+        """Block until `n_messages` peer messages arrive (one iteration's
+        worth in lockstep training)."""
+        out = []
+        deadline = time.monotonic() + timeout
+        while len(out) < n_messages:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError(
+                    f"rank {self.rank}: got {len(out)}/{n_messages} messages")
+            try:
+                out.append(self._inbox.get(timeout=min(remaining, 1.0)))
+            except queue.Empty:
+                continue
+        return out
+
+    # ---------------------------------------------------------------- send
+    def _connect(self, peer: int) -> socket.socket:
+        deadline = time.monotonic() + self.connect_timeout
+        last_err = None
+        while time.monotonic() < deadline:
+            try:
+                s = socket.create_connection(
+                    (self.host, self.base_port + peer), timeout=2.0)
+                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                return s
+            except OSError as e:       # peer not up yet — retry
+                last_err = e
+                time.sleep(0.05)
+        raise ConnectionError(
+            f"rank {self.rank} could not reach peer {peer}: {last_err}")
+
+    def broadcast(self, sender: int, message: Tuple):
+        data = _encode_message(message)
+        with self._lock:
+            for peer in range(self.n_workers):
+                if peer == self.rank:
+                    continue
+                if peer not in self._out:
+                    self._out[peer] = self._connect(peer)
+                self._out[peer].sendall(data)
+                self.messages_sent += 1
+                self.bytes_sent += len(data)
+
+    def close(self):
+        self._closed = True
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._lock:
+            for s in self._out.values():
+                try:
+                    s.close()
+                except OSError:
+                    pass
+            self._out.clear()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1,0 +1,75 @@
+"""Worker process for the 2-process encoded-gradient convergence test.
+
+Each OS process is one logical pod: it computes gradients on its own batch
+shard, exchanges threshold-encoded messages with its peer over the TCP
+SocketTransport, and applies the identical decoded sum — the in-tree analog
+of one Spark executor in the reference's SharedTrainingMaster topology
+(SharedTrainingWrapper.java:206-244).
+
+Usage: python tests/_shared_worker.py RANK N_WORKERS BASE_PORT OUT.npz
+"""
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+from deeplearning4j_tpu.data.iterator import ArrayDataSetIterator  # noqa: E402
+from deeplearning4j_tpu.nn.conf.base import InputType  # noqa: E402
+from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration  # noqa: E402
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer  # noqa: E402
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork  # noqa: E402
+from deeplearning4j_tpu.nn.updaters import Sgd  # noqa: E402
+from deeplearning4j_tpu.parallel import (  # noqa: E402
+    SharedGradientsTrainer, SocketTransport,
+)
+from deeplearning4j_tpu.train.listeners import (  # noqa: E402
+    CollectScoresIterationListener,
+)
+
+
+def blob_data(n=256, d=8, k=4, seed=0):
+    rs = np.random.RandomState(seed)
+    centers = rs.randn(k, d) * 3
+    X = np.concatenate([centers[i] + rs.randn(n // k, d)
+                        for i in range(k)]).astype("float32")
+    Y = np.eye(k, dtype="float32")[np.repeat(np.arange(k), n // k)]
+    perm = rs.permutation(n)
+    return X[perm], Y[perm]
+
+
+def main():
+    rank, n_workers, base_port = (int(a) for a in sys.argv[1:4])
+    out_path = sys.argv[4]
+    X, Y = blob_data()
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(9).updater(Sgd(5e-2)).list()
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=4, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(8)).build())
+    net = MultiLayerNetwork(conf).init()
+    scores = CollectScoresIterationListener()
+    net.set_listeners(scores)
+    with SocketTransport(rank=rank, n_workers=n_workers,
+                         base_port=base_port) as transport:
+        trainer = SharedGradientsTrainer(net, n_workers=n_workers,
+                                         threshold=5e-4, rank=rank,
+                                         transport=transport)
+        trainer.fit(ArrayDataSetIterator(X, Y, batch_size=64), epochs=6)
+        acc = net.evaluate((X, Y)).accuracy()
+        np.savez(out_path,
+                 params=np.asarray(net.params_flat()),
+                 scores=np.array([s for _, s in scores.scores]),
+                 accuracy=acc,
+                 bytes_sent=transport.bytes_sent,
+                 messages_sent=transport.messages_sent)
+
+
+if __name__ == "__main__":
+    main()

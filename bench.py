@@ -54,8 +54,9 @@ def main():
     def step():
         nonlocal rng
         rng, sub = jax.random.split(rng)
-        net.params, net.opt_state, net.state, loss = net._train_step(
-            net.params, net.opt_state, net.state, (X,), (Y,), None, None, sub)
+        net.params, net.opt_state, net.state, loss, _ = net._train_step(
+            net.params, net.opt_state, net.state, (X,), (Y,), None, None,
+            sub, None)
         return loss
 
     # warmup / compile (float() is a host fetch = hard barrier; plain

@@ -74,10 +74,23 @@ def check_gradients(model, features, labels, *,
             lambda a: jnp.asarray(np.asarray(a), jnp.float64)
             if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating) else a,
             model.state)
-        x = jnp.asarray(np.asarray(features), jnp.float64)
-        y = jnp.asarray(np.asarray(labels), jnp.float64)
-        fm = None if features_mask is None else jnp.asarray(features_mask)
-        lm = None if labels_mask is None else jnp.asarray(labels_mask)
+        def _to64(v):
+            if isinstance(v, (tuple, list)):
+                return tuple(jnp.asarray(np.asarray(a), jnp.float64)
+                             for a in v)
+            return jnp.asarray(np.asarray(v), jnp.float64)
+
+        def _mask64(v):
+            if v is None:
+                return None
+            if isinstance(v, (tuple, list)):
+                return tuple(None if m is None else jnp.asarray(m) for m in v)
+            return jnp.asarray(v)
+
+        x = _to64(features)
+        y = _to64(labels)
+        fm = _mask64(features_mask)
+        lm = _mask64(labels_mask)
 
         # deterministic loss (train=True for dropout-free nets is fine; nets
         # with dropout should be checked with dropout=0, as DL4J requires)
@@ -91,10 +104,14 @@ def check_gradients(model, features, labels, *,
             @jax.jit
             def loss_fn(p):
                 if is_graph:
-                    loss, _ = model._score_fn(
-                        p, state64, (x,), (y,),
-                        None if fm is None else (fm,),
-                        None if lm is None else (lm,), False, None)
+                    xs = x if isinstance(x, tuple) else (x,)
+                    ys = y if isinstance(y, tuple) else (y,)
+                    fms = (None if fm is None
+                           else fm if isinstance(fm, tuple) else (fm,))
+                    lms = (None if lm is None
+                           else lm if isinstance(lm, tuple) else (lm,))
+                    loss, _ = model._score_fn(p, state64, xs, ys, fms, lms,
+                                              False, None)
                 else:
                     loss, _ = model._score_fn(p, state64, x, y, fm, lm,
                                               False, None)

@@ -152,30 +152,79 @@ class ComputationGraph:
             return a
         return jax.tree_util.tree_map(cast, params)
 
+    @staticmethod
+    def _vertex_out_mask(vertex, in_masks, xs, out_type):
+        """Mask propagation through a non-layer graph vertex (the analog of
+        DL4J GraphVertex.feedForwardMaskArrays): time-collapsing vertices
+        drop the mask, DuplicateToTimeSeries adopts its reference input's
+        mask, Reverse flips it, Stack/Unstack concat/slice along batch,
+        everything else forwards the first non-None input mask."""
+        if out_type.kind != Kind.RNN:
+            return None
+        vname = type(vertex).__name__
+        if vname == "DuplicateToTimeSeriesVertex":
+            return in_masks[1]
+        if vname == "ReverseTimeSeriesVertex":
+            m = in_masks[0]
+            return None if m is None else jnp.flip(m, axis=1)
+        if vname == "StackVertex":
+            # output batch is the concat of input batches; so is its mask
+            # (DL4J StackVertex.feedForwardMaskArrays). All-None stays
+            # None; a mixed case substitutes all-ones for unmasked inputs.
+            if all(m is None for m in in_masks):
+                return None
+            return jnp.concatenate(
+                [jnp.ones(x.shape[:2], jnp.float32) if m is None else m
+                 for m, x in zip(in_masks, xs)], axis=0)
+        if vname == "UnstackVertex":
+            m = in_masks[0]
+            if m is None:
+                return None
+            n = m.shape[0] // vertex.stack_size
+            return m[vertex.from_idx * n:(vertex.from_idx + 1) * n]
+        return next((m for m in in_masks if m is not None), None)
+
     def _forward(self, params, state, inputs: Sequence, train, rng,
-                 fmasks: Optional[Sequence] = None, stash_pre: bool = False):
-        """Execute the DAG. Returns (activations dict, new_state).
+                 fmasks: Optional[Sequence] = None, stash_pre: bool = False,
+                 carries: Optional[dict] = None):
+        """Execute the DAG. Returns (activations dict, new_state,
+        new_carries, per-vertex mask dict).
+
+        Masks are routed per input path (ComputationGraph.setLayerMaskArrays
+        semantics): each vertex sees the mask propagated from ITS inputs,
+        not a globally shared one — a multi-input graph with differently
+        masked sequence inputs applies each mask where it belongs.
+
+        With `carries` (a dict, possibly empty), recurrent layer vertices
+        run stateful via apply_seq and their final carry is returned — the
+        graph analogs of rnnTimeStep / tBPTT stored state
+        (ComputationGraph.java:2720, :2894).
 
         With stash_pre=True, the pre-head activation of each output vertex is
         stored under '__pre__<name>' so score() sees features, not
         post-activation output (the analog of DL4J output layers keeping
         preOutput for computeScore)."""
+        from deeplearning4j_tpu.nn.multilayer import _RECURRENT_CLASSES
         if self._vertex_types is None:
             self._vertex_types = self._resolve_types()
         params = self._cast_params(params)
         acts: Dict[str, Any] = {}
+        masks: Dict[str, Any] = {}
         for i, name in enumerate(self.conf.network_inputs):
             acts[name] = _as_jnp(inputs[i], self._compute_dtype)
-        mask = None
-        if fmasks is not None:
-            mask = next((m for m in fmasks if m is not None), None)
+            masks[name] = (None if fmasks is None or i >= len(fmasks)
+                           else fmasks[i])
         new_state = {}
+        new_carries = {}
         out_set = set(self.conf.network_outputs) if stash_pre else ()
         for name in self._topo:
             vd = self.conf.vertices[name]
             xs = [acts[i] for i in vd.inputs]
+            in_masks = [masks[i] for i in vd.inputs]
             if isinstance(vd.vertex, GraphVertexConf):
                 acts[name] = vd.vertex.apply(*xs)
+                masks[name] = self._vertex_out_mask(
+                    vd.vertex, in_masks, xs, self._vertex_types[name])
                 continue
             x = xs[0]
             need = self._pre_kind[name]
@@ -185,7 +234,7 @@ class ComputationGraph:
             sub_rng = None
             if rng is not None:
                 rng, sub_rng = jax.random.split(rng)
-            m = mask if need == Kind.RNN else None
+            m = in_masks[0] if need == Kind.RNN else None
             if name in out_set:
                 acts["__pre__" + name] = x
             layer_params = params.get(name, {})
@@ -197,11 +246,22 @@ class ComputationGraph:
                 sub_rng, noise_rng = jax.random.split(sub_rng)
                 layer_params = apply_weight_noise(vd.vertex, layer_params,
                                                   train, noise_rng)
-            y, s = vd.vertex.apply(layer_params, state.get(name, {}),
-                                   x, train=train, rng=sub_rng, mask=m)
-            new_state[name] = s
+            if carries is not None and \
+                    type(vd.vertex).__name__ in _RECURRENT_CLASSES:
+                y, carry = vd.vertex.apply_seq(
+                    layer_params, x, carries.get(name), train=train,
+                    rng=sub_rng, mask=m)
+                new_carries[name] = carry
+                new_state[name] = state.get(name, {})
+            else:
+                y, s = vd.vertex.apply(layer_params, state.get(name, {}),
+                                       x, train=train, rng=sub_rng, mask=m)
+                new_state[name] = s
             acts[name] = y
-        return acts, new_state
+            masks[name] = (in_masks[0]
+                           if self._vertex_types[name].kind == Kind.RNN
+                           else None)
+        return acts, new_state, new_carries, masks
 
     def _input_type_of(self, name: str) -> InputType:
         return self._vertex_types[name]
@@ -212,7 +272,8 @@ class ComputationGraph:
         if self._output_fn is None:
             @jax.jit
             def _out(params, state, inputs):
-                acts, _ = self._forward(params, state, inputs, False, None)
+                acts, _, _, _ = self._forward(params, state, inputs, False,
+                                              None)
                 return tuple(acts[o] for o in self.conf.network_outputs)
             self._output_fn = _out
         outs = self._output_fn(self.params, self.state,
@@ -220,14 +281,17 @@ class ComputationGraph:
         return outs[0] if len(outs) == 1 else outs
 
     def feed_forward(self, *inputs, train: bool = False):
-        acts, _ = self._forward(self.params, self.state, inputs, train, None)
+        acts, _, _, _ = self._forward(self.params, self.state, inputs, train,
+                                      None)
         return acts
 
     # ------------------------------------------------------------------ fit
-    def _score_fn(self, params, state, inputs, labels, fmasks, lmasks, train, rng):
+    def _score_fn(self, params, state, inputs, labels, fmasks, lmasks, train,
+                  rng, carries=None):
         params_c = self._cast_params(params)
-        acts, new_state = self._forward(params_c, state, inputs, train, rng,
-                                        fmasks, stash_pre=True)
+        acts, new_state, new_carries, masks = self._forward(
+            params_c, state, inputs, train, rng, fmasks, stash_pre=True,
+            carries=carries)
         total = jnp.asarray(0.0, jnp.float32)
         for i, out_name in enumerate(self.conf.network_outputs):
             vd = self.conf.vertices[out_name]
@@ -235,6 +299,10 @@ class ComputationGraph:
             lmask = None
             if lmasks is not None and lmasks[i] is not None:
                 lmask = lmasks[i]
+            elif self._vertex_types[out_name].kind == Kind.RNN:
+                # RNN output with no label mask: fall back to the feature
+                # mask propagated along THIS output's input path
+                lmask = masks[vd.inputs[0]]
             lab = _as_jnp(labels[i], self._compute_dtype)
             s = vd.vertex.score(params_c.get(out_name, {}), feat, lab,
                                 train=train, rng=None, mask=lmask)
@@ -244,7 +312,7 @@ class ComputationGraph:
             vd = self.conf.vertices[name]
             if isinstance(vd.vertex, LayerConf):
                 total = total + vd.vertex.regularization_score(p)
-        return total, new_state
+        return total, (new_state, new_carries)
 
     def _make_train_step(self):
         from deeplearning4j_tpu.nn.regularization import (
@@ -255,16 +323,18 @@ class ComputationGraph:
                      if isinstance(vd.vertex, LayerConf)}
         constrained = has_constraints(layer_map.values())
 
-        def step(params, opt_state, state, inputs, labels, fmasks, lmasks, rng):
+        def step(params, opt_state, state, inputs, labels, fmasks, lmasks,
+                 rng, carries):
             def loss_fn(p):
-                return self._score_fn(p, state, inputs, labels, fmasks, lmasks,
-                                      True, rng)
-            (loss, new_state), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+                return self._score_fn(p, state, inputs, labels, fmasks,
+                                      lmasks, True, rng, carries=carries)
+            (loss, (new_state, new_carries)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
             updates, new_opt = tx.update(grads, opt_state, params)
             new_params = optax.apply_updates(params, updates)
             if constrained:     # post-update projection (DL4J applyConstraints)
                 new_params = apply_constraints(layer_map, new_params)
-            return new_params, new_opt, new_state, loss
+            return new_params, new_opt, new_state, loss, new_carries
 
         return jax.jit(step, donate_argnums=(0, 1, 2))
 
@@ -276,28 +346,35 @@ class ComputationGraph:
         if self._train_step is None:
             self._train_step = self._make_train_step()
         rng = jax.random.PRNGKey(self.conf.seed + 331 * (self.epoch_count + 1))
+        tbptt = self.conf.backprop_type == "tbptt"
         for _ in range(epochs):
             for lst in self.listeners:
                 lst.on_epoch_start(self, self.epoch_count)
             etl_start = time.perf_counter()
             for mds in self._iter_data(data):
                 etl_ms = (time.perf_counter() - etl_start) * 1e3
-                rng, sub = jax.random.split(rng)
                 inputs = tuple(_as_jnp(f, self._compute_dtype) for f in mds.features)
                 labels = tuple(_as_jnp(l, self._compute_dtype) for l in mds.labels)
                 fmasks = None if mds.features_masks is None else tuple(
                     _as_jnp(m) for m in mds.features_masks)
                 lmasks = None if mds.labels_masks is None else tuple(
                     _as_jnp(m) for m in mds.labels_masks)
-                self.params, self.opt_state, self.state, loss = self._train_step(
-                    self.params, self.opt_state, self.state, inputs, labels,
-                    fmasks, lmasks, sub)
-                self._score = float(loss)
                 bs = int(np.shape(mds.features[0])[0])
-                for lst in self.listeners:
-                    lst.iteration_done(self, self.iteration_count,
-                                       self.epoch_count, self._score, etl_ms, bs)
-                self.iteration_count += 1
+                if tbptt:
+                    rng = self._fit_tbptt_batch(inputs, labels, fmasks,
+                                                lmasks, rng, etl_ms, bs)
+                else:
+                    rng, sub = jax.random.split(rng)
+                    (self.params, self.opt_state, self.state, loss,
+                     _) = self._train_step(
+                        self.params, self.opt_state, self.state, inputs,
+                        labels, fmasks, lmasks, sub, None)
+                    self._score = float(loss)
+                    for lst in self.listeners:
+                        lst.iteration_done(self, self.iteration_count,
+                                           self.epoch_count, self._score,
+                                           etl_ms, bs)
+                    self.iteration_count += 1
                 etl_start = time.perf_counter()
             for lst in self.listeners:
                 lst.on_epoch_end(self, self.epoch_count)
@@ -305,6 +382,62 @@ class ComputationGraph:
             if hasattr(data, "reset"):
                 data.reset()
         return self
+
+    def _fit_tbptt_batch(self, inputs, labels, fmasks, lmasks, rng, etl_ms,
+                         bs):
+        """Truncated BPTT over one batch: chunk the time axis of every
+        sequence input/label/mask, carry RNN state across chunks with
+        stop_gradient at the boundaries (ComputationGraph.java:2894
+        doTruncatedBPTT)."""
+        fwd = self.conf.tbptt_fwd_length
+        in_types = [self._vertex_types[n] for n in self.conf.network_inputs]
+        seq_lengths = [f.shape[1] for t, f in zip(in_types, inputs)
+                       if t.kind == Kind.RNN]
+        if not seq_lengths:
+            raise ValueError("tbptt backprop requires at least one RNN "
+                             "(B, T, F) network input")
+        if len(set(seq_lengths)) > 1:
+            raise ValueError(
+                f"tbptt requires all RNN inputs to share one sequence "
+                f"length, got {seq_lengths} — chunking cannot be aligned "
+                f"across inputs of different T")
+        T = seq_lengths[0]
+
+        def slice_t(arr, t0, t1, is_mask=False):
+            # sequences are rank-3 (B,T,F); masks are rank-2 (B,T). A rank-2
+            # LABEL is per-example (B,C) and must not be time-sliced even if
+            # C happens to equal T (DL4J slices by rank the same way).
+            if arr is None:
+                return arr
+            if np.ndim(arr) >= 3 and arr.shape[1] == T:
+                return arr[:, t0:t1]
+            if is_mask and np.ndim(arr) == 2 and arr.shape[1] == T:
+                return arr[:, t0:t1]
+            return arr
+
+        carries = {}
+        for t0 in range(0, T, fwd):
+            t1 = min(t0 + fwd, T)
+            cin = tuple(slice_t(f, t0, t1) for f in inputs)
+            clab = tuple(slice_t(l, t0, t1) for l in labels)
+            cfm = None if fmasks is None else tuple(
+                slice_t(m, t0, t1, is_mask=True) for m in fmasks)
+            clm = None if lmasks is None else tuple(
+                slice_t(m, t0, t1, is_mask=True) for m in lmasks)
+            rng, sub = jax.random.split(rng)
+            (self.params, self.opt_state, self.state, loss,
+             new_carries) = self._train_step(
+                self.params, self.opt_state, self.state, cin, clab, cfm,
+                clm, sub, carries)
+            carries = jax.tree_util.tree_map(jax.lax.stop_gradient,
+                                             new_carries)
+            self._score = float(loss)
+            for lst in self.listeners:
+                lst.iteration_done(self, self.iteration_count,
+                                   self.epoch_count, self._score, etl_ms, bs)
+            self.iteration_count += 1
+            etl_ms = 0.0
+        return rng
 
     def _iter_data(self, data):
         if isinstance(data, MultiDataSet):
@@ -341,6 +474,49 @@ class ComputationGraph:
         if hasattr(data, "reset"):
             data.reset()
         return ev
+
+    # ----------------------------------------------------- recurrent state
+    def rnn_time_step(self, *inputs):
+        """Stateful streaming inference over the DAG (ComputationGraph
+        rnnTimeStep, ComputationGraph.java:2720). Each input is (B, F) for
+        one step or (B, T, F) for several; recurrent vertex state persists
+        across calls until rnn_clear_previous_state()."""
+        if not hasattr(self, "_rnn_carries"):
+            self._rnn_carries = {}
+        if self._vertex_types is None:
+            self._vertex_types = self._resolve_types()
+        in_types = [self._vertex_types[n] for n in self.conf.network_inputs]
+        singles = []
+        prep = []
+        for t, x in zip(in_types, inputs):
+            x = _as_jnp(x, self._compute_dtype)
+            single = t.kind == Kind.RNN and x.ndim == 2
+            singles.append(single)
+            prep.append(x[:, None, :] if single else x)
+        if getattr(self, "_rnn_step_fn", None) is None:
+            # jitted once; jax re-traces automatically when the carry
+            # pytree structure changes (first call: empty dict)
+            @jax.jit
+            def _stepfn(params, state, prep, carries):
+                acts, _, new_carries, _ = self._forward(
+                    params, state, prep, False, None, carries=carries)
+                return ({o: acts[o] for o in self.conf.network_outputs},
+                        new_carries)
+            self._rnn_step_fn = _stepfn
+        out_acts, new_carries = self._rnn_step_fn(
+            self.params, self.state, tuple(prep), self._rnn_carries)
+        acts = out_acts
+        self._rnn_carries = new_carries
+        outs = []
+        for o in self.conf.network_outputs:
+            y = acts[o]
+            if any(singles) and y.ndim == 3:
+                y = y[:, -1, :]
+            outs.append(y)
+        return outs[0] if len(outs) == 1 else tuple(outs)
+
+    def rnn_clear_previous_state(self):
+        self._rnn_carries = {}
 
     # --------------------------------------------------------------- params
     def num_params(self) -> int:

@@ -82,7 +82,8 @@ class ParallelInference:
         from deeplearning4j_tpu.nn.graph import ComputationGraph
         if isinstance(model, ComputationGraph):
             def forward(params, state, x):
-                acts, _ = model._forward(params, state, (x,), False, None)
+                acts, _, _, _ = model._forward(params, state, (x,), False,
+                                               None)
                 return acts[model.conf.network_outputs[0]]
         else:
             def forward(params, state, x):

@@ -103,8 +103,9 @@ class ParallelWrapper:
         if self._is_graph:
             xs = x if isinstance(x, (list, tuple)) else [x]
             ys = y if isinstance(y, (list, tuple)) else [y]
-            return self.model._score_fn(params, state, list(xs), list(ys),
-                                        fmask, lmask, True, rng)
+            loss, (new_state, _) = self.model._score_fn(
+                params, state, list(xs), list(ys), fmask, lmask, True, rng)
+            return loss, new_state
         loss, (new_state, _) = self.model._score_fn(
             params, state, x, y, fmask, lmask, True, rng)
         return loss, new_state

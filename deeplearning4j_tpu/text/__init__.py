@@ -13,10 +13,24 @@ from deeplearning4j_tpu.text.sentenceiterator import (
     BasicLineIterator, CollectionSentenceIterator, FileSentenceIterator,
 )
 from deeplearning4j_tpu.text.stopwords import STOP_WORDS
+from deeplearning4j_tpu.text.documentiterator import (
+    BasicLabelAwareIterator, FileLabelAwareIterator,
+    FilenamesLabelAwareIterator, LabelAwareIterator, LabelledDocument,
+    LabelsSource, SimpleLabelAwareIterator,
+)
+from deeplearning4j_tpu.text.invertedindex import InMemoryInvertedIndex
+from deeplearning4j_tpu.text.vectorizers import (
+    BagOfWordsVectorizer, BaseTextVectorizer, TfidfVectorizer,
+)
 
 __all__ = [
     "DefaultTokenizerFactory", "NGramTokenizerFactory",
     "RegexTokenizerFactory", "CommonPreprocessor", "LowCasePreprocessor",
     "BasicLineIterator", "CollectionSentenceIterator",
     "FileSentenceIterator", "STOP_WORDS",
+    "LabelledDocument", "LabelsSource", "LabelAwareIterator",
+    "SimpleLabelAwareIterator", "BasicLabelAwareIterator",
+    "FileLabelAwareIterator", "FilenamesLabelAwareIterator",
+    "InMemoryInvertedIndex",
+    "BaseTextVectorizer", "BagOfWordsVectorizer", "TfidfVectorizer",
 ]

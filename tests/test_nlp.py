@@ -149,3 +149,143 @@ def test_glove_separates_topics():
     g.fit(CollectionSentenceIterator(_toy_corpus(300, seed=5)))
     assert np.isfinite(g.last_loss)
     assert g.similarity("cat", "dog") > g.similarity("cat", "car")
+
+
+# ------------------------------------------------- document iterators / BoW
+def _labelled_corpus(n_per=30, seed=11):
+    """Synthetic 3-topic labelled corpus with overlapping filler words."""
+    rs = np.random.RandomState(seed)
+    topics = {
+        "sports": ["ball", "goal", "team", "match", "score", "coach"],
+        "finance": ["stock", "market", "bond", "yield", "bank", "trade"],
+        "cooking": ["oven", "spice", "recipe", "flour", "butter", "salt"],
+    }
+    filler = ["the", "a", "of", "and", "to", "in"]
+    docs = []
+    for label, words in topics.items():
+        for _ in range(n_per):
+            body = list(rs.choice(words, 10)) + list(rs.choice(filler, 5))
+            rs.shuffle(body)
+            docs.append((" ".join(body), label))
+    rs.shuffle(docs)
+    return docs
+
+
+def test_document_iterators(tmp_path):
+    from deeplearning4j_tpu.text import (
+        BasicLabelAwareIterator, FileLabelAwareIterator,
+        SimpleLabelAwareIterator,
+    )
+    it = SimpleLabelAwareIterator([("hello world", "a"), ("bye", "b")])
+    docs = list(it)
+    assert [d.label for d in docs] == ["a", "b"]
+    assert it.labels_source.index_of("b") == 1
+
+    it2 = BasicLabelAwareIterator(["s one", "s two", "s three"])
+    assert [d.label for d in it2] == ["DOC_0", "DOC_1", "DOC_2"]
+
+    (tmp_path / "pos").mkdir()
+    (tmp_path / "neg").mkdir()
+    (tmp_path / "pos" / "0.txt").write_text("good great fine")
+    (tmp_path / "neg" / "0.txt").write_text("bad awful poor")
+    it3 = FileLabelAwareIterator(str(tmp_path))
+    docs3 = {d.label: d.content for d in it3}
+    assert "good" in docs3["pos"] and "awful" in docs3["neg"]
+    assert it3.labels_source.get_labels() == ["neg", "pos"]
+
+
+def test_inverted_index():
+    from deeplearning4j_tpu.text import InMemoryInvertedIndex
+    idx = InMemoryInvertedIndex()
+    idx.add_doc(0, ["cat", "dog", "cat"])
+    idx.add_doc(1, ["dog", "bird"])
+    assert idx.num_documents() == 2
+    assert idx.doc_appeared_in("cat") == 1
+    assert idx.doc_appeared_in("dog") == 2
+    assert idx.term_frequency("cat", 0) == 2
+    assert idx.total_term_frequency("cat") == 2
+    assert idx.search("dog") == [0, 1]
+    assert idx.search("dog", "cat") == [0]
+    assert idx.search("fish") == []
+
+
+def test_bag_of_words_counts():
+    from deeplearning4j_tpu.text import BagOfWordsVectorizer
+    bow = BagOfWordsVectorizer([("cat cat dog", "x"), ("dog bird", "y")])
+    bow.fit()
+    assert bow.vocab == ["bird", "cat", "dog"]
+    row = bow.transform("cat cat cat bird")[0]
+    np.testing.assert_allclose(row, [1.0, 3.0, 0.0])
+
+
+def test_tfidf_reference_formula():
+    """tf = count/len, idf = log10(N/df), weight = tf*idf — the exact
+    MathUtils.java:258-286 arithmetic."""
+    import math
+    from deeplearning4j_tpu.text import TfidfVectorizer
+    tv = TfidfVectorizer([("cat dog", "x"), ("dog bird", "y"),
+                          ("dog dog dog", "z")])
+    tv.fit()
+    assert tv.idf("dog") == 0.0                      # in all 3 docs
+    assert tv.idf("cat") == pytest.approx(math.log10(3.0))
+    row = tv.transform(["cat", "cat", "dog", "bird"])[0]
+    v = {w: row[tv.index_of(w)] for w in ("cat", "dog", "bird")}
+    assert v["cat"] == pytest.approx(0.5 * math.log10(3.0), rel=1e-6)
+    assert v["dog"] == 0.0
+    assert v["bird"] == pytest.approx(0.25 * math.log10(3.0), rel=1e-6)
+
+
+def test_tfidf_min_word_frequency_and_stopwords():
+    from deeplearning4j_tpu.text import TfidfVectorizer
+    tv = TfidfVectorizer([("the cat cat", "x"), ("the dog", "y")],
+                         min_word_frequency=2, stop_words=["the"])
+    tv.fit()
+    assert tv.vocab == ["cat"]        # "the" stopped, "dog" below min freq
+
+
+def test_tfidf_classifier_end_to_end():
+    """The reference's text-classification on-ramp: labelled corpus ->
+    TfidfVectorizer -> OutputLayer softmax classifier trains to high
+    accuracy (TfidfVectorizer feeding MultiLayerNetwork)."""
+    from deeplearning4j_tpu.data.iterator import ArrayDataSetIterator
+    from deeplearning4j_tpu.nn.conf.base import InputType
+    from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.updaters import Adam
+    from deeplearning4j_tpu.text import TfidfVectorizer
+
+    tv = TfidfVectorizer(_labelled_corpus(), min_word_frequency=2)
+    tv.fit()
+    ds = tv.vectorize()
+    assert ds.features.shape[0] == 90
+    assert ds.labels.shape == (90, 3)
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(7).updater(Adam(5e-2)).list()
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(ds.features.shape[1]))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    net.fit(ArrayDataSetIterator(ds.features, ds.labels, batch_size=32),
+            epochs=20)
+    acc = net.evaluate((ds.features, ds.labels)).accuracy()
+    assert acc > 0.95, acc
+    # single-document vectorize round-trip
+    one = tv.vectorize("goal match team ball", "sports")
+    assert one.features.shape == (1, ds.features.shape[1])
+    assert one.labels[0, tv.labels_source.index_of("sports")] == 1.0
+
+
+def test_tfidf_transform_consistent_with_corpus_path():
+    """transform() must filter stop words like fit() did, and fit() must be
+    re-runnable (rebuilds index + labels from scratch)."""
+    from deeplearning4j_tpu.text import TfidfVectorizer
+    tv = TfidfVectorizer([("the cat", "x"), ("the dog", "y")],
+                         stop_words=["the"])
+    tv.fit()
+    corpus = tv.vectorize()
+    row = tv.transform("the cat")[0]
+    np.testing.assert_allclose(row, corpus.features[0], atol=1e-7)
+    tv.fit()                                   # refit does not corrupt
+    assert tv.index.num_documents() == 2
+    np.testing.assert_allclose(tv.transform("the cat")[0], row, atol=1e-7)

@@ -13,6 +13,10 @@ from deeplearning4j_tpu.clustering.vptree import VPTree
 from deeplearning4j_tpu.clustering.kdtree import KDTree
 from deeplearning4j_tpu.clustering.lsh import RandomProjectionLSH
 from deeplearning4j_tpu.clustering.randomprojection import RandomProjection
+from deeplearning4j_tpu.clustering.server import (
+    NearestNeighborsClient, NearestNeighborsServer,
+)
 
 __all__ = ["KMeansClustering", "VPTree", "KDTree", "RandomProjectionLSH",
-           "RandomProjection"]
+           "RandomProjection", "NearestNeighborsServer",
+           "NearestNeighborsClient"]

@@ -4,9 +4,18 @@ from deeplearning4j_tpu.data.iterator import (
     BenchmarkDataSetIterator,
 )
 from deeplearning4j_tpu.data.async_iterator import AsyncDataSetIterator
+from deeplearning4j_tpu.data.fetchers import (
+    Cifar10DataSetIterator, EmnistDataSetIterator, IrisDataSetIterator,
+    LfwDataSetIterator, MnistDataSetIterator, SvhnDataSetIterator,
+    TinyImageNetDataSetIterator, UciSequenceDataSetIterator,
+)
 
 __all__ = [
     "DataSet", "MultiDataSet", "DataSetIterator", "ArrayDataSetIterator",
     "ExistingDataSetIterator", "BenchmarkDataSetIterator",
     "AsyncDataSetIterator",
+    "MnistDataSetIterator", "EmnistDataSetIterator", "Cifar10DataSetIterator",
+    "IrisDataSetIterator", "UciSequenceDataSetIterator",
+    "SvhnDataSetIterator", "TinyImageNetDataSetIterator",
+    "LfwDataSetIterator",
 ]

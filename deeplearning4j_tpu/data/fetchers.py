@@ -1,10 +1,11 @@
-"""Built-in dataset fetchers: MNIST / EMNIST / CIFAR-10 / IRIS / SVHN / UCI.
+"""Built-in dataset fetchers: MNIST / EMNIST / CIFAR-10 / IRIS / UCI /
+SVHN / TinyImageNet / LFW.
 
 Parity target: DL4J `deeplearning4j-data/deeplearning4j-datasets/`:
 `fetchers/MnistDataFetcher.java`, `EmnistDataFetcher`, `Cifar10Fetcher`,
-`IrisDataFetcher`, `SvhnDataFetcher`, raw IDX reading in
-`datasets/mnist/MnistManager.java`, and the `iterator/impl/*DataSetIterator`
-wrappers.
+`IrisDataFetcher`, `SvhnDataFetcher`, `TinyImageNetFetcher`,
+`LFWDataFetcher`, raw IDX reading in `datasets/mnist/MnistManager.java`,
+and the `iterator/impl/*DataSetIterator` wrappers.
 
 Design: binary parsers for the standard on-disk formats (IDX, CIFAR-10
 binary batches, libsvm-ish UCI) against a local cache directory
@@ -234,3 +235,126 @@ def _find(directory: str, stem: str) -> Optional[str]:
         if os.path.exists(cand):
             return cand
     return None
+
+
+# ---------------------------------------------------------------------- SVHN
+class SvhnDataSetIterator(ArrayDataSetIterator):
+    """DL4J SvhnDataFetcher equivalent: Street View House Numbers cropped
+    digits (train_32x32.mat / test_32x32.mat, Matlab v5 format read via
+    scipy) -> NHWC (B, 32, 32, 3) in [0,1], label '10' mapped to class 0
+    as in the published dataset."""
+
+    def __init__(self, batch_size: int = 32, train: bool = True,
+                 synthetic: Optional[bool] = None, n_synthetic: int = 2048,
+                 seed: int = 321):
+        d = os.path.join(data_dir(), "svhn")
+        name = "train_32x32.mat" if train else "test_32x32.mat"
+        path = _find(d, name)
+        if path is None:
+            if synthetic is False:
+                raise FileNotFoundError(
+                    f"SVHN not cached under {d} (expected {name}; "
+                    "http://ufldl.stanford.edu/housenumbers/)")
+            X, Y = _synthetic_images(n_synthetic, 32, 32, 3, 10, seed)
+        else:
+            from scipy.io import loadmat
+            mat = loadmat(path)
+            X = mat["X"].transpose(3, 0, 1, 2).astype("float32") / 255.0
+            ys = mat["y"].reshape(-1).astype(np.int64) % 10   # 10 -> 0
+            Y = np.eye(10, dtype="float32")[ys]
+        super().__init__(X, Y, batch_size=batch_size)
+
+
+# -------------------------------------------------------------- TinyImageNet
+class TinyImageNetDataSetIterator(ArrayDataSetIterator):
+    """DL4J TinyImageNetFetcher equivalent: 200-class 64x64 images from the
+    tiny-imagenet-200 directory layout (train/<wnid>/images/*.JPEG, decoded
+    via PIL) -> NHWC in [0,1]."""
+
+    NUM_CLASSES = 200
+    SIZE = 64
+
+    def __init__(self, batch_size: int = 32, train: bool = True,
+                 synthetic: Optional[bool] = None, n_synthetic: int = 2048,
+                 max_per_class: Optional[int] = None, seed: int = 7):
+        root = os.path.join(data_dir(), "tiny-imagenet-200")
+        split_dir = os.path.join(root, "train" if train else "val")
+        if not os.path.isdir(split_dir):
+            if synthetic is False:
+                raise FileNotFoundError(
+                    f"TinyImageNet not cached under {root} "
+                    "(https://cs231n.stanford.edu/tiny-imagenet-200.zip)")
+            X, Y = _synthetic_images(n_synthetic, self.SIZE, self.SIZE, 3,
+                                     self.NUM_CLASSES, seed)
+        else:
+            from PIL import Image
+            wnids = sorted(os.listdir(os.path.join(root, "train")))
+            idx = {w: i for i, w in enumerate(wnids)}
+            xs, ys = [], []
+            if train:
+                for w in wnids:
+                    img_dir = os.path.join(split_dir, w, "images")
+                    files = sorted(os.listdir(img_dir))[:max_per_class]
+                    for fn in files:
+                        img = Image.open(os.path.join(img_dir, fn)) \
+                            .convert("RGB")
+                        xs.append(np.asarray(img, np.float32) / 255.0)
+                        ys.append(idx[w])
+            else:
+                ann = os.path.join(split_dir, "val_annotations.txt")
+                with open(ann) as f:
+                    rows = [l.split("\t")[:2] for l in f if l.strip()]
+                for fn, w in rows:
+                    img = Image.open(os.path.join(split_dir, "images", fn)) \
+                        .convert("RGB")
+                    xs.append(np.asarray(img, np.float32) / 255.0)
+                    ys.append(idx[w])
+            X = np.stack(xs)
+            Y = np.eye(self.NUM_CLASSES, dtype="float32")[np.asarray(ys)]
+        super().__init__(X, Y, batch_size=batch_size)
+
+
+# ----------------------------------------------------------------------- LFW
+class LfwDataSetIterator(ArrayDataSetIterator):
+    """DL4J LFWDataFetcher equivalent: Labeled Faces in the Wild, one
+    subdirectory per person (lfw/<Person_Name>/*.jpg via PIL). Keeps the
+    `min_faces_per_person` filter; images are resized to `image_size`
+    (the reference trains at scaled-down sizes too)."""
+
+    def __init__(self, batch_size: int = 32, image_size: int = 64,
+                 min_faces_per_person: int = 20,
+                 synthetic: Optional[bool] = None, n_synthetic: int = 512,
+                 n_synthetic_people: int = 8, seed: int = 11):
+        root = os.path.join(data_dir(), "lfw")
+        if not os.path.isdir(root):
+            if synthetic is False:
+                raise FileNotFoundError(
+                    f"LFW not cached under {root} "
+                    "(http://vis-www.cs.umass.edu/lfw/lfw.tgz)")
+            X, Y = _synthetic_images(n_synthetic, image_size, image_size, 3,
+                                     n_synthetic_people, seed)
+            self.label_names = [f"person_{i}"
+                                for i in range(n_synthetic_people)]
+        else:
+            from PIL import Image
+            people = sorted(
+                p for p in os.listdir(root)
+                if os.path.isdir(os.path.join(root, p))
+                and len(os.listdir(os.path.join(root, p)))
+                >= min_faces_per_person)
+            if not people:
+                raise FileNotFoundError(
+                    f"no people with >= {min_faces_per_person} faces "
+                    f"under {root}")
+            xs, ys = [], []
+            for i, person in enumerate(people):
+                pdir = os.path.join(root, person)
+                for fn in sorted(os.listdir(pdir)):
+                    img = Image.open(os.path.join(pdir, fn)).convert("RGB") \
+                        .resize((image_size, image_size))
+                    xs.append(np.asarray(img, np.float32) / 255.0)
+                    ys.append(i)
+            X = np.stack(xs)
+            Y = np.eye(len(people), dtype="float32")[np.asarray(ys)]
+            self.label_names = people
+        super().__init__(X, Y, batch_size=batch_size)

@@ -1,4 +1,5 @@
 """Manifold learning (DL4J deeplearning4j-manifold parity)."""
 from deeplearning4j_tpu.manifold.tsne import Tsne
+from deeplearning4j_tpu.manifold.bhtsne import BarnesHutTsne
 
-__all__ = ["Tsne"]
+__all__ = ["Tsne", "BarnesHutTsne"]

@@ -400,14 +400,15 @@ def _inbound_names(lc) -> List[str]:
 
 def _sequence_after(k_cls: str, cur_seq: bool) -> bool:
     """Does the activation remain/become a (B, T, F) sequence after this
-    layer? LSTM/Embedding emit sequences; pooling/Dense/conv leave them."""
-    if k_cls in ("LSTM", "Embedding"):
+    layer? LSTM/GRU/Embedding emit sequences; pooling/Dense/conv leave
+    them."""
+    if k_cls in ("LSTM", "GRU", "Embedding"):
         return True
     if k_cls in ("GlobalAveragePooling1D", "GlobalMaxPooling1D",
                  "Flatten"):
         return False
     if k_cls in ("Dropout", "Activation", "BatchNormalization",
-                 "LayerNormalization", "Dense"):
+                 "LayerNormalization", "Dense", "TimeDistributed"):
         return cur_seq          # Keras Dense on 3D is time-distributed
     return False
 
@@ -418,10 +419,11 @@ def _map_layer(k_cls: str, k_cfg: dict, is_output: bool,
     """Returns (LayerConf | None, loader | None). loader(params, state,
     weights) copies Keras weights into our pytrees."""
     from deeplearning4j_tpu.nn.layers import (
-        ActivationLayer, BatchNormalization, ConvolutionLayer, DenseLayer,
+        GRU, ActivationLayer, BatchNormalization, ConvolutionLayer,
+        Cropping2D, Deconvolution2D, DenseLayer, DepthwiseConvolution2D,
         DropoutLayer, EmbeddingSequenceLayer, GlobalPoolingLayer,
-        LayerNormLayer, LSTM, OutputLayer, RnnOutputLayer, SubsamplingLayer,
-        ZeroPaddingLayer,
+        LayerNormLayer, LSTM, OutputLayer, RnnOutputLayer,
+        SeparableConvolution2D, SubsamplingLayer, ZeroPaddingLayer,
     )
     import jax.numpy as jnp
 
@@ -527,6 +529,100 @@ def _map_layer(k_cls: str, k_cfg: dict, is_output: bool,
             activation=_act(k_cfg.get("activation", "tanh")),
             gate_activation=_act(
                 k_cfg.get("recurrent_activation", "sigmoid"))), load_lstm
+
+    if k_cls == "GRU":
+        if not k_cfg.get("return_sequences", False):
+            raise ValueError("GRU with return_sequences=False is "
+                             "unsupported; use return_sequences=True")
+        reset_after = bool(k_cfg.get("reset_after", True))
+
+        def load_gru(params, state, w):
+            # Keras: kernel (in, 3H), recurrent_kernel (H, 3H), bias
+            # ((2, 3H) when reset_after else (3H,)); gate order z,r,h ==
+            # ours — verbatim copy
+            params["W"] = jnp.asarray(w[0])
+            params["R"] = jnp.asarray(w[1])
+            if len(w) > 2:
+                b = jnp.asarray(w[2])
+                params["b"] = b.reshape(params["b"].shape)
+        return GRU(
+            n_out=int(k_cfg["units"]),
+            activation=_act(k_cfg.get("activation", "tanh")),
+            gate_activation=_act(
+                k_cfg.get("recurrent_activation", "sigmoid")),
+            reset_after=reset_after), load_gru
+
+    if k_cls == "Conv2DTranspose":
+        def load_deconv(params, state, w):
+            # Keras kernel (kh, kw, out, in), spatial taps stored for the
+            # gradient-of-conv formulation; our conv_transpose consumes an
+            # unflipped HWIO kernel -> flip spatial dims and swap in/out
+            params["W"] = jnp.asarray(
+                np.asarray(w[0])[::-1, ::-1].transpose(0, 1, 3, 2))
+            if len(w) > 1 and "b" in params:
+                params["b"] = jnp.asarray(w[1])
+        return Deconvolution2D(
+            n_out=int(k_cfg["filters"]),
+            kernel=_pair(k_cfg.get("kernel_size", 3)),
+            stride=_pair(k_cfg.get("strides", 1)),
+            dilation=_pair(k_cfg.get("dilation_rate", 1)),
+            convolution_mode=_padding(k_cfg.get("padding", "valid")),
+            activation=_act(k_cfg.get("activation", "linear")),
+            has_bias=k_cfg.get("use_bias", True)), load_deconv
+
+    if k_cls == "SeparableConv2D":
+        def load_sep(params, state, w):
+            # depthwise (kh, kw, in, mult) -> (kh, kw, 1, in*mult); the
+            # C-order reshape maps (c, m) -> channel c*mult + m, matching
+            # XLA's feature_group_count output layout
+            dk = np.asarray(w[0])
+            kh, kw, cin, mult = dk.shape
+            params["dW"] = jnp.asarray(dk.reshape(kh, kw, 1, cin * mult))
+            params["pW"] = jnp.asarray(w[1])
+            if len(w) > 2 and "b" in params:
+                params["b"] = jnp.asarray(w[2])
+        return SeparableConvolution2D(
+            n_out=int(k_cfg["filters"]),
+            depth_multiplier=int(k_cfg.get("depth_multiplier", 1)),
+            kernel=_pair(k_cfg.get("kernel_size", 3)),
+            stride=_pair(k_cfg.get("strides", 1)),
+            dilation=_pair(k_cfg.get("dilation_rate", 1)),
+            convolution_mode=_padding(k_cfg.get("padding", "valid")),
+            activation=_act(k_cfg.get("activation", "linear")),
+            has_bias=k_cfg.get("use_bias", True)), load_sep
+
+    if k_cls == "DepthwiseConv2D":
+        def load_dw(params, state, w):
+            dk = np.asarray(w[0])
+            kh, kw, cin, mult = dk.shape
+            params["W"] = jnp.asarray(dk.reshape(kh, kw, 1, cin * mult))
+            if len(w) > 1 and "b" in params:
+                params["b"] = jnp.asarray(w[1])
+        return DepthwiseConvolution2D(
+            depth_multiplier=int(k_cfg.get("depth_multiplier", 1)),
+            kernel=_pair(k_cfg.get("kernel_size", 3)),
+            stride=_pair(k_cfg.get("strides", 1)),
+            dilation=_pair(k_cfg.get("dilation_rate", 1)),
+            convolution_mode=_padding(k_cfg.get("padding", "valid")),
+            activation=_act(k_cfg.get("activation", "linear")),
+            has_bias=k_cfg.get("use_bias", True)), load_dw
+
+    if k_cls == "Cropping2D":
+        crop = k_cfg.get("cropping", ((0, 0), (0, 0)))
+        if isinstance(crop, int):
+            c = (crop, crop, crop, crop)
+        else:
+            (t, bm), (l, r) = crop
+            c = (t, bm, l, r)
+        return Cropping2D(cropping=tuple(int(x) for x in c)), None
+
+    if k_cls == "TimeDistributed":
+        # unwrap: TimeDistributed(inner) over (B, T, F) == inner applied
+        # per step; our sequence-aware mappers already are
+        inner = k_cfg["layer"]
+        inner_cls = inner.get("class_name")
+        inner_cfg = inner.get("config", {})
+        return _map_layer(inner_cls, inner_cfg, is_output, sequence=True)
 
     raise ValueError(f"Unsupported Keras layer '{k_cls}' "
                      "(KerasModelImport layer mappers)")

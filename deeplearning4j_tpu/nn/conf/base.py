@@ -142,6 +142,14 @@ def layer_from_dict(d: dict):
     # Updaters/schedules are dataclasses registered in their own modules.
     cls = _LAYER_REGISTRY.get(name) or _AUX_REGISTRY.get(name)
     if cls is None:
+        # registration happens at module import; a standalone
+        # load_model() may deserialize before any layer module was
+        # imported — pull in the registration packages once and retry
+        import deeplearning4j_tpu.nn.layers  # noqa: F401
+        import deeplearning4j_tpu.nn.conf.graph_vertices  # noqa: F401
+        import deeplearning4j_tpu.nn.regularization  # noqa: F401
+        cls = _LAYER_REGISTRY.get(name) or _AUX_REGISTRY.get(name)
+    if cls is None:
         raise ValueError(f"Unknown layer/config class '{name}'")
     return _decode_fields(cls, d)
 

@@ -518,6 +518,13 @@ class ComputationGraph:
     def rnn_clear_previous_state(self):
         self._rnn_carries = {}
 
+    # --------------------------------------------------------------- memory
+    def memory_report(self, batch_size: int = 32, with_compiled: bool = True):
+        """Per-vertex analytic memory estimate + exact XLA compiled-step HBM
+        (DL4J NetworkMemoryReport analog — see util/memory.py)."""
+        from deeplearning4j_tpu.util.memory import build_memory_report
+        return build_memory_report(self, batch_size, with_compiled)
+
     # --------------------------------------------------------------- params
     def num_params(self) -> int:
         return param_util.num_params(self.params)

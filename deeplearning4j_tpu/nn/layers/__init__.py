@@ -12,7 +12,7 @@ from deeplearning4j_tpu.nn.layers.normalization import (
     BatchNormalization, LocalResponseNormalization,
 )
 from deeplearning4j_tpu.nn.layers.recurrent import (
-    LSTM, GravesLSTM, GravesBidirectionalLSTM, SimpleRnn, Bidirectional,
+    GRU, LSTM, GravesLSTM, GravesBidirectionalLSTM, SimpleRnn, Bidirectional,
     RnnOutputLayer, RnnLossLayer, LastTimeStep, MaskZeroLayer,
 )
 from deeplearning4j_tpu.nn.layers.variational import VariationalAutoencoder
@@ -32,7 +32,7 @@ __all__ = [
     "DepthwiseConvolution2D", "SpaceToDepthLayer", "SpaceToBatchLayer",
     "Cropping2D", "CnnLossLayer",
     "BatchNormalization", "LocalResponseNormalization",
-    "LSTM", "GravesLSTM", "GravesBidirectionalLSTM", "SimpleRnn",
+    "GRU", "LSTM", "GravesLSTM", "GravesBidirectionalLSTM", "SimpleRnn",
     "Bidirectional", "RnnOutputLayer", "RnnLossLayer", "LastTimeStep",
     "MaskZeroLayer", "VariationalAutoencoder", "SameDiffLayer",
     "FrozenLayerWrapper", "Yolo2OutputLayer",

@@ -232,6 +232,102 @@ class SimpleRnn(LayerConf):
 
 @register_layer
 @dataclasses.dataclass(frozen=True)
+class GRU(LayerConf):
+    """Gated recurrent unit. The reference has no GRU (DL4J of this vintage
+    ships LSTM/GravesLSTM/SimpleRnn only); this exists for Keras-import
+    coverage and stands alone as a layer. Gate order z (update), r (reset),
+    candidate h — Keras weight-layout compatible, including the
+    `reset_after` variant with its separate recurrent bias."""
+    n_out: int = 0
+    n_in: Optional[int] = None
+    activation: str = "tanh"
+    gate_activation: str = "sigmoid"
+    weight_init: str = "xavier"
+    reset_after: bool = True
+
+    def output_type(self, input_type: InputType) -> InputType:
+        t = input_type.shape[0]
+        return InputType(Kind.RNN, (t, self.n_out))
+
+    def init(self, key, input_type: InputType, dtype=jnp.float32):
+        n_in = self.n_in or input_type.features
+        H = self.n_out
+        k1, k2 = jax.random.split(key)
+        w_init = get_initializer(self.weight_init)
+        b_shape = (2, 3 * H) if self.reset_after else (3 * H,)
+        return {
+            "W": w_init(k1, (n_in, 3 * H), n_in, 3 * H, dtype),
+            "R": w_init(k2, (H, 3 * H), H, 3 * H, dtype),
+            "b": jnp.zeros(b_shape, dtype),
+        }, {}
+
+    def _cell(self, params, xw_t, h_prev):
+        """One step given precomputed input projections xw_t (B, 3H)."""
+        H = self.n_out
+        ga = get_activation(self.gate_activation)
+        ca = get_activation(self.activation)
+        xz, xr, xh = jnp.split(xw_t, 3, axis=-1)
+        if self.reset_after:
+            hw = h_prev @ params["R"] + params["b"][1]
+            hz, hr, hh = jnp.split(hw, 3, axis=-1)
+            z = ga(xz + hz)
+            r = ga(xr + hr)
+            cand = ca(xh + r * hh)
+        else:
+            # candidate uses (r*h) @ R_h, so only the z|r blocks of R are
+            # needed against h_prev — skip the wasted third-gemm columns
+            hw = h_prev @ params["R"][:, :2 * H]
+            hz, hr = jnp.split(hw, 2, axis=-1)
+            z = ga(xz + hz)
+            r = ga(xr + hr)
+            cand = ca(xh + (r * h_prev) @ params["R"][:, 2 * H:])
+        return z * h_prev + (1.0 - z) * cand
+
+    def _input_proj(self, params, x):
+        ib = params["b"][0] if self.reset_after else params["b"]
+        return x @ params["W"] + ib
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        hs, _ = self.apply_seq(params, x, None, train=train, rng=rng,
+                               mask=mask)
+        return hs, state
+
+    def rnn_step(self, params, x_t, carry):
+        B = x_t.shape[0]
+        h_prev = carry if carry is not None \
+            else jnp.zeros((B, self.n_out), x_t.dtype)
+        h = self._cell(params, self._input_proj(params, x_t[:, None])[:, 0],
+                       h_prev)
+        return h, h
+
+    def apply_seq(self, params, x, carry, *, train=False, rng=None,
+                  mask=None):
+        x = self.maybe_dropout_input(x, train, rng)
+        B, T, _ = x.shape
+        xw = self._input_proj(params, x)      # hoisted input gemm
+        h0 = carry if carry is not None \
+            else jnp.zeros((B, self.n_out), x.dtype)
+
+        def step(h_prev, inp):
+            if mask is not None:
+                xw_t, m_t = inp
+            else:
+                xw_t = inp
+            h = self._cell(params, xw_t, h_prev)
+            if mask is not None:
+                h = jnp.where(m_t[:, None] > 0, h, 0.0)
+            return h, h
+
+        xs = jnp.swapaxes(xw, 0, 1)
+        if mask is not None:
+            ms = jnp.swapaxes(mask, 0, 1)
+            hT, hs = lax.scan(step, h0, (xs, ms))
+        else:
+            hT, hs = lax.scan(step, h0, xs)
+        return jnp.swapaxes(hs, 0, 1), hT
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
 class Bidirectional(LayerConf):
     """Bidirectional wrapper (DL4J nn/conf/layers/recurrent/Bidirectional.java).
     Runs the wrapped RNN forward and on the time-reversed sequence, then

@@ -56,13 +56,14 @@ _KIND_BY_CLASS = {
     "PositionalEmbeddingLayer": Kind.RNN, "EmbeddingSequenceLayer": Kind.RNN,
     "LocalResponseNormalization": Kind.CNN, "CnnLossLayer": Kind.CNN,
     "LSTM": Kind.RNN, "GravesLSTM": Kind.RNN, "SimpleRnn": Kind.RNN,
+    "GRU": Kind.RNN,
     "Bidirectional": Kind.RNN, "GravesBidirectionalLSTM": Kind.RNN,
     "RnnOutputLayer": Kind.RNN, "RnnLossLayer": Kind.RNN,
     "LastTimeStep": Kind.RNN, "MaskZeroLayer": Kind.RNN,
     "Convolution1DLayer": Kind.RNN, "Subsampling1DLayer": Kind.RNN,
 }
 
-_RECURRENT_CLASSES = {"LSTM", "GravesLSTM", "SimpleRnn"}
+_RECURRENT_CLASSES = {"LSTM", "GravesLSTM", "SimpleRnn", "GRU"}
 
 
 def _required_kind(layer: LayerConf) -> Optional[Kind]:
@@ -545,6 +546,14 @@ class MultiLayerNetwork:
 
     def rnn_clear_previous_state(self):
         self._rnn_carries = {}
+
+    # ------------------------------------------------------------ memory
+    def memory_report(self, batch_size: int = 32, with_compiled: bool = True):
+        """Per-layer analytic memory estimate + exact XLA compiled-step HBM
+        (DL4J LayerMemoryReport/NetworkMemoryReport analog, exceeded via
+        jit(...).compile().memory_analysis())."""
+        from deeplearning4j_tpu.util.memory import build_memory_report
+        return build_memory_report(self, batch_size, with_compiled)
 
     # ------------------------------------------------------------ params
     def num_params(self) -> int:

@@ -1,0 +1,5 @@
+"""`python -m deeplearning4j_tpu.train` — CLI training entry
+(ParallelWrapperMain.java analog; see train/cli.py)."""
+from deeplearning4j_tpu.train.cli import main
+
+raise SystemExit(main())

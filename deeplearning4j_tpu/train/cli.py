@@ -1,0 +1,136 @@
+"""CLI training entry point.
+
+Parity: DL4J `deeplearning4j-scaleout-parallelwrapper/.../main/
+ParallelWrapperMain.java` (143 LoC): args-driven launcher — model zip in,
+worker/averaging knobs, fit over a data source, save the trained model.
+
+Usage:
+    python -m deeplearning4j_tpu.train \
+        --model model.zip --output trained.zip \
+        --dataset mnist --epochs 2 --batch-size 64 \
+        --mode sync --averaging-frequency 5 --ui-port 9001
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+import numpy as np
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m deeplearning4j_tpu.train",
+        description="Train a serialized model with the ParallelWrapper "
+                    "data-parallel trainer (ParallelWrapperMain analog)")
+    p.add_argument("--model", required=True,
+                   help="input model zip (save_model format)")
+    p.add_argument("--output", required=True,
+                   help="where to write the trained model zip")
+    p.add_argument("--dataset", required=True,
+                   help="mnist | emnist | cifar10 | iris | path to .npz "
+                        "with 'features' and 'labels' arrays")
+    p.add_argument("--epochs", type=int, default=1)
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--mode", choices=["sync", "averaging", "single"],
+                   default="sync",
+                   help="sync = compiled all-reduce DP; averaging = DL4J "
+                        "AVERAGING semantics; single = plain net.fit")
+    p.add_argument("--averaging-frequency", type=int, default=5)
+    p.add_argument("--no-average-updaters", action="store_true",
+                   help="skip averaging optimizer state (saveUpdater=false)")
+    p.add_argument("--ui-port", type=int, default=None,
+                   help="serve the training dashboard on this port")
+    p.add_argument("--score-every", type=int, default=10,
+                   help="ScoreIterationListener frequency")
+    p.add_argument("--synthetic-data", action="store_true",
+                   help="substitute deterministic synthetic data when the "
+                        "dataset cache is missing (pipeline testing only); "
+                        "without this flag a missing cache is an error")
+    return p
+
+
+def _load_data(name: str, batch_size: int, allow_synthetic: bool = False):
+    from deeplearning4j_tpu.data.fetchers import (
+        Cifar10DataSetIterator, EmnistDataSetIterator, IrisDataSetIterator,
+        MnistDataSetIterator,
+    )
+    from deeplearning4j_tpu.data.iterator import ArrayDataSetIterator
+    # a real training CLI must not silently train on synthetic noise: the
+    # fetchers' lenient default is overridden to fail loudly unless the
+    # user opted in with --synthetic-data
+    syn = None if allow_synthetic else False
+    builtin = {
+        "mnist": lambda: MnistDataSetIterator(batch_size=batch_size,
+                                              synthetic=syn),
+        "emnist": lambda: EmnistDataSetIterator(batch_size=batch_size,
+                                                synthetic=syn),
+        "cifar10": lambda: Cifar10DataSetIterator(batch_size=batch_size,
+                                                  synthetic=syn),
+        "iris": lambda: IrisDataSetIterator(batch_size=batch_size),
+    }
+    if name.lower() in builtin:
+        return builtin[name.lower()]()
+    data = np.load(name)
+    if "features" not in data or "labels" not in data:
+        raise SystemExit(f"{name}: npz must contain 'features' and 'labels'")
+    return ArrayDataSetIterator(data["features"], data["labels"],
+                                batch_size=batch_size)
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    import os
+
+    import jax
+    if os.environ.get("JAX_PLATFORMS"):
+        # the axon TPU plugin force-appends itself to jax_platforms at
+        # import, overriding the env var — pin the user's choice back
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    from deeplearning4j_tpu.parallel import ParallelWrapper, TrainingMode
+    from deeplearning4j_tpu.train.listeners import (
+        PerformanceListener, ScoreIterationListener,
+    )
+    from deeplearning4j_tpu.util.serialization import load_model, save_model
+
+    net = load_model(args.model)
+    iterator = _load_data(args.dataset, args.batch_size,
+                          allow_synthetic=args.synthetic_data)
+    listeners = [ScoreIterationListener(args.score_every),
+                 PerformanceListener(args.score_every)]
+    ui_server = None
+    if args.ui_port is not None:
+        from deeplearning4j_tpu.ui import (
+            InMemoryStatsStorage, StatsListener, UIServer,
+        )
+        storage = InMemoryStatsStorage()
+        listeners.append(StatsListener(storage, frequency=args.score_every))
+        ui_server = UIServer(port=args.ui_port)   # serves once constructed
+        ui_server.attach(storage)
+        print(f"dashboard: {ui_server.url}", file=sys.stderr)
+    net.set_listeners(*listeners)
+
+    if args.mode == "single":
+        net.fit(iterator, epochs=args.epochs)
+    else:
+        wrapper = ParallelWrapper(
+            net,
+            mode=(TrainingMode.SYNC_GRADIENTS if args.mode == "sync"
+                  else TrainingMode.AVERAGING),
+            averaging_frequency=args.averaging_frequency,
+            average_updaters=not args.no_average_updaters)
+        wrapper.fit(iterator, epochs=args.epochs)
+
+    save_model(net, args.output)
+    print(json.dumps({"output": args.output,
+                      "final_score": net.score(),
+                      "iterations": net.iteration_count,
+                      "epochs": net.epoch_count}))
+    if ui_server is not None:
+        ui_server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -119,3 +119,45 @@ def test_deepwalk_embeds_components_apart():
     assert intra > inter, (intra, inter)
     near = dw.verts_nearest(0, 5)
     assert set(near) <= set(range(1, 6)), near
+
+
+def test_barnes_hut_tsne_scales_with_tiled_memory():
+    """The scalable t-SNE (BarnesHutTsne role): tiled repulsion + sparse
+    kNN attraction. Checks (a) cluster separation like the exact version,
+    (b) KL decreases over optimization, (c) per-iteration HBM stays
+    O(N*tile), NOT O(N^2) (round-2 VERDICT item 5)."""
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.manifold import BarnesHutTsne
+    from deeplearning4j_tpu.manifold.bhtsne import _tiled_forces
+    X, y = _three_blobs(n_per=40)
+    bh = BarnesHutTsne(perplexity=10, max_iter=300, tile_rows=32, seed=0)
+    Y = bh.fit_transform(X)
+    assert Y.shape == (120, 2)
+    assert np.isfinite(bh.kl_divergence_)
+    cents = np.stack([Y[y == c].mean(0) for c in range(3)])
+    intra = np.mean([np.linalg.norm(Y[y == c] - cents[c], axis=1).mean()
+                     for c in range(3)])
+    inter = np.mean([np.linalg.norm(cents[a] - cents[b])
+                     for a in range(3) for b in range(a + 1, 3)])
+    assert inter > 2 * intra, (inter, intra)
+    # KL after the early-exaggeration phase must improve monotonically-ish:
+    # every sampled KL after the first post-lying sample is below the first
+    post = [k for k in bh.kl_history_[2:]]
+    assert post and all(k <= bh.kl_history_[1] + 1e-6 for k in post), \
+        bh.kl_history_
+
+    # memory assertion: compiled gradient evaluation at tile=64 on N=1024
+    # must keep temporaries well under the N^2 matrix it replaces
+    n, k, tile = 1024, 8, 64
+    rs = np.random.RandomState(0)
+    Yb = jnp.asarray(rs.randn(n, 2).astype("float32"))
+    src = jnp.asarray(np.repeat(np.arange(n), k))
+    dst = jnp.asarray(rs.randint(0, n, n * k))
+    p = jnp.asarray(rs.rand(n * k).astype("float32") / (n * k))
+    lowered = _tiled_forces.lower(Yb, src, dst, n // tile, p,
+                                  jnp.int32(n))
+    ma = lowered.compile().memory_analysis()
+    if ma is not None:
+        n2_bytes = n * n * 4
+        assert int(ma.temp_size_in_bytes) < n2_bytes // 2, \
+            (ma.temp_size_in_bytes, n2_bytes)

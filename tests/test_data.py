@@ -187,3 +187,84 @@ def test_multi_dataset_iterator_partial_final_batch():
              .add_output_one_hot("r", 2, 3))
     sizes = [b.features[0].shape[0] for b in small]
     assert sizes == [3]
+
+
+def test_svhn_tinyimagenet_lfw_synthetic_shapes():
+    from deeplearning4j_tpu.data.fetchers import (
+        LfwDataSetIterator, SvhnDataSetIterator, TinyImageNetDataSetIterator,
+    )
+    ds = next(iter(SvhnDataSetIterator(batch_size=16, n_synthetic=64)))
+    assert ds.features.shape == (16, 32, 32, 3)
+    assert ds.labels.shape == (16, 10)
+    ds = next(iter(TinyImageNetDataSetIterator(batch_size=8, n_synthetic=32)))
+    assert ds.features.shape == (8, 64, 64, 3)
+    assert ds.labels.shape == (8, 200)
+    it = LfwDataSetIterator(batch_size=8, n_synthetic=32, image_size=48)
+    ds = next(iter(it))
+    assert ds.features.shape == (8, 48, 48, 3)
+    assert ds.labels.shape == (8, 8)
+    assert len(it.label_names) == 8
+
+
+def test_svhn_real_mat_parsing(tmp_path, monkeypatch):
+    """SVHN .mat layout: X (32,32,3,N) HWCN + y 1..10 with 10 == digit 0
+    (SvhnDataFetcher.java parity)."""
+    from scipy.io import savemat
+    from deeplearning4j_tpu.data.fetchers import SvhnDataSetIterator
+    monkeypatch.setenv("DL4J_TPU_DATA_DIR", str(tmp_path))
+    d = tmp_path / "svhn"
+    d.mkdir()
+    rs = np.random.RandomState(0)
+    X = (rs.rand(32, 32, 3, 12) * 255).astype("uint8")
+    y = np.array([[1], [2], [10], [4], [5], [6], [7], [8], [9], [10],
+                  [1], [3]], dtype="uint8")
+    savemat(str(d / "train_32x32.mat"), {"X": X, "y": y})
+    ds = next(iter(SvhnDataSetIterator(batch_size=12)))
+    assert ds.features.shape == (12, 32, 32, 3)
+    assert float(ds.features.max()) <= 1.0
+    labels = np.argmax(np.asarray(ds.labels), 1)
+    assert labels[2] == 0 and labels[9] == 0      # '10' -> class 0
+    assert labels[0] == 1 and labels[1] == 2
+    np.testing.assert_allclose(np.asarray(ds.features)[3],
+                               X[:, :, :, 3] / 255.0, atol=1e-6)
+
+
+def test_lfw_real_directory_parsing(tmp_path, monkeypatch):
+    from PIL import Image
+    from deeplearning4j_tpu.data.fetchers import LfwDataSetIterator
+    monkeypatch.setenv("DL4J_TPU_DATA_DIR", str(tmp_path))
+    root = tmp_path / "lfw"
+    rs = np.random.RandomState(1)
+    for person, n in (("Ada_Lovelace", 3), ("Alan_Turing", 2),
+                      ("One_Shot", 1)):
+        pdir = root / person
+        pdir.mkdir(parents=True)
+        for i in range(n):
+            arr = (rs.rand(250, 250, 3) * 255).astype("uint8")
+            Image.fromarray(arr).save(str(pdir / f"{person}_{i:04d}.jpg"))
+    it = LfwDataSetIterator(batch_size=4, image_size=32,
+                            min_faces_per_person=2)
+    ds = next(iter(it))
+    assert it.label_names == ["Ada_Lovelace", "Alan_Turing"]   # One_Shot filtered
+    assert ds.features.shape == (4, 32, 32, 3)
+    assert ds.labels.shape == (4, 2)
+
+
+def test_tiny_imagenet_real_directory_parsing(tmp_path, monkeypatch):
+    from PIL import Image
+    from deeplearning4j_tpu.data.fetchers import TinyImageNetDataSetIterator
+    monkeypatch.setenv("DL4J_TPU_DATA_DIR", str(tmp_path))
+    root = tmp_path / "tiny-imagenet-200"
+    rs = np.random.RandomState(2)
+    wnids = ["n001", "n002"]
+    for w in wnids:
+        img_dir = root / "train" / w / "images"
+        img_dir.mkdir(parents=True)
+        for i in range(3):
+            arr = (rs.rand(64, 64, 3) * 255).astype("uint8")
+            Image.fromarray(arr).save(str(img_dir / f"{w}_{i}.JPEG"))
+    it = TinyImageNetDataSetIterator(batch_size=6)
+    ds = next(iter(it))
+    assert ds.features.shape == (6, 64, 64, 3)
+    # labels one-hot over the discovered wnids (2 classes present)
+    assert set(np.argmax(np.asarray(ds.labels), 1)) == {0, 1}

@@ -471,3 +471,14 @@ def test_gc_attention_dropout_fixed_rng():
         assert _fd_sweep(loss, params, analytic, per_leaf=3) >= 20
     finally:
         jc.update("jax_enable_x64", False)
+
+
+@pytest.mark.parametrize("reset_after", [True, False],
+                         ids=["reset_after", "classic"])
+def test_gc_gru(reset_after):
+    from deeplearning4j_tpu.nn.layers import GRU
+    X, Y, mask = _rnn_data()
+    net = _net([GRU(n_out=5, reset_after=reset_after),
+                RnnOutputLayer(n_out=2, activation="softmax", loss="mcxent")],
+               InputType.recurrent(4, 5))
+    _check(net, X, Y, fmask=mask)

@@ -144,10 +144,92 @@ def test_imported_model_transfer_learning_finetune(tmp_path):
 def test_unsupported_layer_raises(tmp_path):
     m = keras.Sequential([
         keras.layers.Input((4, 4, 1)),
-        keras.layers.Conv2DTranspose(2, 3),
-        keras.layers.Flatten(),
-        keras.layers.Dense(2),
+        keras.layers.ConvLSTM1D(2, 3),    # no mapper for ConvLSTM family
     ])
     p = _save(m, tmp_path)
     with pytest.raises(ValueError, match="Unsupported Keras layer"):
         KerasModelImport.import_keras_model_and_weights(p)
+
+
+# -------------------------------------------- round-3 mapper breadth parity
+def test_conv2d_transpose_parity(tmp_path):
+    m = keras.Sequential([
+        keras.layers.Input((7, 7, 3)),
+        keras.layers.Conv2DTranspose(5, 3, strides=2, padding="same",
+                                     activation="relu"),
+        keras.layers.Conv2DTranspose(2, 3, strides=1, padding="valid"),
+    ])
+    p = _save(m, tmp_path)
+    net = KerasModelImport.import_keras_sequential_model_and_weights(p)
+    x = np.random.RandomState(3).rand(2, 7, 7, 3).astype("float32")
+    np.testing.assert_allclose(np.asarray(net.output(x)),
+                               np.asarray(m(x)), atol=1e-4)
+
+
+def test_separable_and_depthwise_conv_parity(tmp_path):
+    m = keras.Sequential([
+        keras.layers.Input((10, 10, 4)),
+        keras.layers.SeparableConv2D(6, 3, padding="same",
+                                     depth_multiplier=2,
+                                     activation="relu"),
+        keras.layers.DepthwiseConv2D(3, padding="valid",
+                                     depth_multiplier=1),
+    ])
+    p = _save(m, tmp_path)
+    net = KerasModelImport.import_keras_sequential_model_and_weights(p)
+    x = np.random.RandomState(4).rand(2, 10, 10, 4).astype("float32")
+    np.testing.assert_allclose(np.asarray(net.output(x)),
+                               np.asarray(m(x)), atol=1e-4)
+
+
+def test_gru_parity(tmp_path):
+    m = keras.Sequential([
+        keras.layers.Input((6, 4)),
+        keras.layers.GRU(5, return_sequences=True),
+        keras.layers.Dense(3, activation="softmax"),
+    ])
+    p = _save(m, tmp_path)
+    net = KerasModelImport.import_keras_sequential_model_and_weights(p)
+    x = np.random.RandomState(5).randn(2, 6, 4).astype("float32")
+    np.testing.assert_allclose(np.asarray(net.output(x)),
+                               np.asarray(m(x)), atol=1e-4)
+
+
+def test_gru_reset_after_false_parity(tmp_path):
+    m = keras.Sequential([
+        keras.layers.Input((5, 3)),
+        keras.layers.GRU(4, return_sequences=True, reset_after=False),
+    ])
+    p = _save(m, tmp_path)
+    net = KerasModelImport.import_keras_sequential_model_and_weights(p)
+    x = np.random.RandomState(6).randn(2, 5, 3).astype("float32")
+    np.testing.assert_allclose(np.asarray(net.output(x)),
+                               np.asarray(m(x)), atol=1e-4)
+
+
+def test_time_distributed_dense_parity(tmp_path):
+    m = keras.Sequential([
+        keras.layers.Input((6, 4)),
+        keras.layers.LSTM(5, return_sequences=True),
+        keras.layers.TimeDistributed(keras.layers.Dense(3,
+                                                        activation="tanh")),
+    ])
+    p = _save(m, tmp_path)
+    net = KerasModelImport.import_keras_sequential_model_and_weights(p)
+    x = np.random.RandomState(7).randn(2, 6, 4).astype("float32")
+    np.testing.assert_allclose(np.asarray(net.output(x)),
+                               np.asarray(m(x)), atol=1e-4)
+
+
+def test_cropping_and_zeropadding_parity(tmp_path):
+    m = keras.Sequential([
+        keras.layers.Input((9, 9, 2)),
+        keras.layers.ZeroPadding2D(((1, 2), (0, 3))),
+        keras.layers.Cropping2D(((2, 1), (1, 0))),
+        keras.layers.Conv2D(3, 3),
+    ])
+    p = _save(m, tmp_path)
+    net = KerasModelImport.import_keras_sequential_model_and_weights(p)
+    x = np.random.RandomState(8).rand(2, 9, 9, 2).astype("float32")
+    np.testing.assert_allclose(np.asarray(net.output(x)),
+                               np.asarray(m(x)), atol=1e-4)

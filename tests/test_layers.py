@@ -232,3 +232,29 @@ class TestVAE:
         grads = jax.grad(lambda p: layer.pretrain_score(p, x, jax.random.PRNGKey(10)))(params)
         flat = jax.tree_util.tree_leaves(grads)
         assert all(jnp.all(jnp.isfinite(g)) for g in flat)
+
+
+def test_gru_accepts_cnn_input_via_preprocessor():
+    """GRU registered in _KIND_BY_CLASS: a CNN input ahead of a GRU gets
+    the automatic CNN->RNN preprocessor exactly like LSTM does."""
+    import numpy as np
+    from deeplearning4j_tpu.data.iterator import ArrayDataSetIterator
+    from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import GRU, RnnOutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.updaters import Adam
+    conf = (NeuralNetConfiguration.Builder().seed(0).updater(Adam(1e-2))
+            .list()
+            .layer(GRU(n_out=6))
+            .layer(RnnOutputLayer(n_out=2))
+            .set_input_type(InputType.convolutional(5, 3, 2))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    X = np.random.RandomState(0).rand(4, 5, 3, 2).astype("float32")
+    out = np.asarray(net.output(X))
+    # CNN->RNN preprocessor: (B, 5, 3, 2) -> (B, 5*3=15 steps, 2 features)
+    assert out.shape == (4, 15, 2)
+    Y = np.eye(2, dtype="float32")[np.random.RandomState(1)
+                                   .randint(0, 2, (4, 15))]
+    net.fit(ArrayDataSetIterator(X, Y, batch_size=4), epochs=1)
+    assert np.isfinite(net.score())

@@ -1,0 +1,77 @@
+"""Memory reports (DL4J nn/conf/memory/LayerMemoryReport.java:22 parity,
+exceeded with exact XLA compiled-step numbers)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.conf.base import InputType
+from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import (
+    ConvolutionLayer, DenseLayer, OutputLayer, SubsamplingLayer,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updaters import Adam, Sgd
+
+
+def _lenet(updater=None):
+    return (NeuralNetConfiguration.Builder()
+            .seed(0).updater(updater or Adam(1e-3)).list()
+            .layer(ConvolutionLayer(n_out=20, kernel=(5, 5),
+                                    activation="relu"))
+            .layer(SubsamplingLayer(kernel=(2, 2), stride=(2, 2)))
+            .layer(ConvolutionLayer(n_out=50, kernel=(5, 5),
+                                    activation="relu"))
+            .layer(SubsamplingLayer(kernel=(2, 2), stride=(2, 2)))
+            .layer(DenseLayer(n_out=120, activation="relu"))
+            .layer(OutputLayer(n_out=10, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.convolutional(28, 28, 1))
+            .build())
+
+
+def test_memory_report_analytic_structure():
+    net = MultiLayerNetwork(_lenet()).init()
+    rep = net.memory_report(batch_size=16, with_compiled=False)
+    assert len(rep.layers) == 6
+    # conv1: 20 params of 5*5*1 + bias = 520 floats
+    conv1 = rep.layers[0]
+    assert conv1.params_bytes == 520 * 4
+    # Adam: 2 state arrays per param leaf
+    assert conv1.updater_state_bytes == 2 * conv1.params_bytes
+    # conv1 output 24x24x20 per sample
+    assert conv1.activation_bytes == 16 * 24 * 24 * 20 * 4
+    # params total matches the network
+    assert rep.total_params_bytes == net.num_params() * 4
+    assert "analytic train total" in rep.summary()
+
+
+def test_memory_report_sgd_has_no_updater_state():
+    net = MultiLayerNetwork(_lenet(updater=Sgd(0.1))).init()
+    rep = net.memory_report(batch_size=8, with_compiled=False)
+    assert rep.total_updater_bytes == 0
+
+
+def test_memory_report_compiled_within_2x_of_analytic():
+    """The analytic estimate must be within 2x of XLA's own accounting for
+    the compiled training step (the review contract from round-2 VERDICT
+    item 7)."""
+    net = MultiLayerNetwork(_lenet()).init()
+    rep = net.memory_report(batch_size=16)
+    if rep.compiled is None:
+        pytest.skip("backend exposes no memory_analysis")
+    truth = rep.compiled_total_bytes
+    est = rep.total_train_bytes
+    assert truth > 0
+    ratio = est / truth
+    assert 0.5 <= ratio <= 2.0, (est, truth, ratio)
+
+
+def test_memory_report_graph():
+    from deeplearning4j_tpu.models import ResNet50
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    net = ComputationGraph(ResNet50(num_classes=10,
+                                    input_shape=(32, 32, 3)).conf()).init()
+    rep = net.memory_report(batch_size=4, with_compiled=False)
+    assert rep.total_params_bytes == net.num_params() * 4
+    names = [r.name for r in rep.layers]
+    assert "stem_conv" in names and "output" in names
+    assert rep.total_train_bytes > rep.total_inference_bytes

@@ -1,0 +1,108 @@
+"""Nearest-neighbors REST server round-trip + CLI training entry smoke test
+(DL4J NearestNeighborsServer.java:42, ParallelWrapperMain.java parity)."""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.clustering import (
+    NearestNeighborsClient, NearestNeighborsServer,
+)
+
+
+def test_nn_server_round_trip():
+    rs = np.random.RandomState(0)
+    pts = rs.randn(64, 8).astype("float32")
+    with NearestNeighborsServer(pts, port=0) as server:
+        client = NearestNeighborsClient(port=server.port)
+        h = client.health()
+        assert h == {"status": "ok", "points": 64, "dim": 8}
+        # knn of an indexed point: nearest is itself at distance 0
+        res = client.knn(index=5, k=3)
+        assert res[0]["index"] == 5
+        assert res[0]["distance"] == pytest.approx(0.0, abs=1e-6)
+        # knn of a new vector matches brute force
+        q = rs.randn(8).astype("float32")
+        res = client.knn_new(q, k=5)
+        brute = np.argsort(np.linalg.norm(pts - q, axis=1))[:5]
+        assert [r["index"] for r in res] == list(brute)
+        # insert + query finds the inserted point
+        new_idx = client.insert(q)
+        assert new_idx == 64
+        res = client.knn_new(q, k=1)
+        assert res[0]["index"] == 64
+        assert res[0]["distance"] == pytest.approx(0.0, abs=1e-6)
+
+
+def test_nn_server_rejects_bad_requests():
+    pts = np.eye(4, dtype="float32")
+    with NearestNeighborsServer(pts, port=0) as server:
+        import urllib.error
+        import urllib.request
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/knn",
+            data=json.dumps({"index": 99, "k": 1}).encode(),
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(req, timeout=10)
+        assert exc.value.code == 400
+
+
+def test_cli_trains_and_saves(tmp_path):
+    """ParallelWrapperMain flow: model zip in -> fit with wrapper knobs ->
+    trained zip out, exercised through `python -m deeplearning4j_tpu.train`
+    in a subprocess (real CLI surface)."""
+    from deeplearning4j_tpu.nn.conf.base import InputType
+    from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.updaters import Adam
+    from deeplearning4j_tpu.util.serialization import load_model, save_model
+
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(7).updater(Adam(5e-2)).list()
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    model_in = str(tmp_path / "model.zip")
+    model_out = str(tmp_path / "trained.zip")
+    save_model(net, model_in)
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "-m", "deeplearning4j_tpu.train",
+         "--model", model_in, "--output", model_out,
+         "--dataset", "iris", "--epochs", "30", "--batch-size", "32",
+         "--mode", "sync"],
+        capture_output=True, cwd=os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), env=env, timeout=600)
+    assert proc.returncode == 0, proc.stderr.decode()[-2000:]
+    result = json.loads(proc.stdout.decode().strip().splitlines()[-1])
+    assert result["output"] == model_out
+    assert np.isfinite(result["final_score"])
+    trained = load_model(model_out)
+    from deeplearning4j_tpu.data.fetchers import iris_dataset
+    ds = iris_dataset()
+    acc = trained.evaluate((ds.features, ds.labels)).accuracy()
+    assert acc > 0.9, acc
+
+
+def test_cli_npz_dataset_and_bad_npz(tmp_path):
+    from deeplearning4j_tpu.train.cli import _load_data
+    rs = np.random.RandomState(0)
+    p = str(tmp_path / "data.npz")
+    np.savez(p, features=rs.rand(20, 4).astype("float32"),
+             labels=np.eye(2, dtype="float32")[rs.randint(0, 2, 20)])
+    it = _load_data(p, batch_size=8)
+    ds = next(iter(it))
+    assert ds.features.shape == (8, 4)
+    bad = str(tmp_path / "bad.npz")
+    np.savez(bad, foo=np.zeros(3))
+    with pytest.raises(SystemExit):
+        _load_data(bad, batch_size=8)

@@ -39,10 +39,15 @@ from deeplearning4j_tpu.parallel.mesh import (
 
 log = logging.getLogger("deeplearning4j_tpu")
 
-# layers whose state/computation crosses sequence-shard boundaries
-_SEQ_CROSSING = {"LSTM", "GravesLSTM", "SimpleRnn", "Bidirectional",
+# layers/vertices whose state/computation crosses sequence-shard boundaries
+_SEQ_CROSSING = {"LSTM", "GravesLSTM", "SimpleRnn", "GRU", "Bidirectional",
                  "GravesBidirectionalLSTM", "Convolution1DLayer",
-                 "Subsampling1DLayer", "LastTimeStep"}
+                 "Subsampling1DLayer", "LastTimeStep",
+                 # graph vertices that read/reorder the global time axis:
+                 # per-shard last-step / flip / length-broadcast are all
+                 # silently wrong on a local sequence chunk
+                 "LastTimeStepVertex", "ReverseTimeSeriesVertex",
+                 "DuplicateToTimeSeriesVertex"}
 
 
 class ContextParallelTrainer:
@@ -59,10 +64,18 @@ class ContextParallelTrainer:
         if model.params is None:
             model.init()
         from deeplearning4j_tpu.nn.graph import ComputationGraph
-        if isinstance(model, ComputationGraph):
-            raise NotImplementedError(
-                "context parallelism currently supports MultiLayerNetwork")
-        for layer in model.layers:
+        self._is_graph = isinstance(model, ComputationGraph)
+        if self._is_graph:
+            if len(model.conf.network_inputs) != 1 or \
+                    len(model.conf.network_outputs) != 1:
+                raise ValueError(
+                    "context parallelism supports single-input/"
+                    "single-output ComputationGraphs (one sequence axis "
+                    "to shard)")
+            units = [vd.vertex for vd in model.conf.vertices.values()]
+        else:
+            units = list(model.layers)
+        for layer in units:
             # check every level of the wrapper chain: both a crossing
             # wrapper (LastTimeStep, Bidirectional) and a crossing wrapped
             # layer (FrozenLayerWrapper(LSTM)) are rejected
@@ -87,12 +100,23 @@ class ContextParallelTrainer:
         self._step = None
 
     # ---------------------------------------------------------------- build
-    def _build_step(self, with_mask):
+    def _build_step(self, with_fmask, with_lmask):
+        from deeplearning4j_tpu.nn.conf.base import LayerConf
+        from deeplearning4j_tpu.nn.regularization import (
+            apply_constraints, has_constraints,
+        )
         net = self.model
         tx = net._tx
         mesh = self.mesh
+        if self._is_graph:
+            layer_map = {name: vd.vertex
+                         for name, vd in net.conf.vertices.items()
+                         if isinstance(vd.vertex, LayerConf)}
+        else:
+            layer_map = {str(i): l for i, l in enumerate(net.layers)}
+        constrained = has_constraints(layer_map.values())
 
-        def local_step(params, opt_state, state, x, y, fmask, rng):
+        def local_step(params, opt_state, state, x, y, fmask, lmask, rng):
             """Runs on one (data, seq) shard; params replicated."""
             # decorrelate dropout across shards
             rng = jax.random.fold_in(
@@ -101,16 +125,25 @@ class ContextParallelTrainer:
 
             def loss_fn(p):
                 with context_parallel(SEQ_AXIS):
-                    loss, (new_state, _) = net._score_fn(
-                        p, state, x, y, fmask, fmask, True, rng)
-                if fmask is not None:
+                    if self._is_graph:
+                        loss, (new_state, _) = net._score_fn(
+                            p, state, (x,), (y,),
+                            None if fmask is None else (fmask,),
+                            None if lmask is None else (lmask,), True, rng)
+                    else:
+                        loss, (new_state, _) = net._score_fn(
+                            p, state, x, y, fmask, lmask, True, rng)
+                # the loss-weighting mask is the one the output layer used:
+                # an explicit label mask wins, else the feature mask
+                wmask = lmask if lmask is not None else fmask
+                if wmask is not None:
                     # shards hold different numbers of VALID tokens: the
                     # global masked mean is psum(local_sum)/psum(count),
                     # where local_sum = local_masked_mean * local_count
                     # (fully-masked shards have loss 0, count 0). The
                     # replicated l1/l2 term passes through unchanged:
                     # psum(reg*cnt)/psum(cnt) == reg.
-                    cnt = jnp.sum(fmask)
+                    cnt = jnp.sum(wmask)
                     num = jax.lax.psum(loss * cnt, (DATA_AXIS, SEQ_AXIS))
                     den = jax.lax.psum(cnt, (DATA_AXIS, SEQ_AXIS))
                     loss = num / jnp.maximum(den, 1.0)
@@ -128,30 +161,81 @@ class ContextParallelTrainer:
             grads = jax.lax.pmean(grads, SEQ_AXIS)
             updates, new_opt = tx.update(grads, opt_state, params)
             new_params = optax.apply_updates(params, updates)
+            if constrained:    # same post-update projection as net.fit
+                new_params = apply_constraints(layer_map, new_params)
             return new_params, new_opt, new_state, loss
 
         repl = P()
         xspec = P(DATA_AXIS, SEQ_AXIS)          # (B, T, ...) batch+seq sharded
         out_specs = (repl, repl, repl, repl)
-        if with_mask:
-            in_specs = (repl, repl, repl, xspec, xspec, xspec, repl)
-            sm = compat_shard_map(local_step, mesh, in_specs, out_specs)
+        # shard_map can't take None specs for None args uniformly across
+        # jax versions; close over the absent masks instead
+        if with_fmask and with_lmask:
+            sm = compat_shard_map(local_step, mesh,
+                                  (repl, repl, repl, xspec, xspec, xspec,
+                                   xspec, repl), out_specs)
+        elif with_fmask:
+            def fm_step(params, opt_state, state, x, y, fmask, rng):
+                return local_step(params, opt_state, state, x, y, fmask,
+                                  None, rng)
+            inner = compat_shard_map(
+                fm_step, mesh,
+                (repl, repl, repl, xspec, xspec, xspec, repl), out_specs)
+
+            def sm(params, opt_state, state, x, y, fmask, lmask, rng):
+                return inner(params, opt_state, state, x, y, fmask, rng)
+        elif with_lmask:
+            def lm_step(params, opt_state, state, x, y, lmask, rng):
+                return local_step(params, opt_state, state, x, y, None,
+                                  lmask, rng)
+            inner = compat_shard_map(
+                lm_step, mesh,
+                (repl, repl, repl, xspec, xspec, xspec, repl), out_specs)
+
+            def sm(params, opt_state, state, x, y, fmask, lmask, rng):
+                return inner(params, opt_state, state, x, y, lmask, rng)
         else:
-            def no_mask_step(params, opt_state, state, x, y, rng):
-                return local_step(params, opt_state, state, x, y, None, rng)
+            def bare_step(params, opt_state, state, x, y, rng):
+                return local_step(params, opt_state, state, x, y, None,
+                                  None, rng)
+            inner = compat_shard_map(
+                bare_step, mesh,
+                (repl, repl, repl, xspec, xspec, repl), out_specs)
 
-            in_specs = (repl, repl, repl, xspec, xspec, repl)
-            inner = compat_shard_map(no_mask_step, mesh, in_specs, out_specs)
-
-            def sm(params, opt_state, state, x, y, fmask, rng):
+            def sm(params, opt_state, state, x, y, fmask, lmask, rng):
                 return inner(params, opt_state, state, x, y, rng)
 
         return jax.jit(sm, donate_argnums=(0, 1, 2))
 
     # ------------------------------------------------------------------ fit
+    def _iter_batches(self, data, batch_size):
+        """Yield (x, y, fmask, lmask) for either container type."""
+        net = self.model
+        if self._is_graph:
+            for mds in net._iter_data(data):
+                fm = lm = None
+                if mds.features_masks is not None and \
+                        mds.features_masks[0] is not None:
+                    fm = jnp.asarray(mds.features_masks[0])
+                if mds.labels_masks is not None and \
+                        mds.labels_masks[0] is not None:
+                    lm = jnp.asarray(mds.labels_masks[0])
+                yield (jnp.asarray(mds.features[0]),
+                       jnp.asarray(mds.labels[0]), fm, lm)
+            if hasattr(data, "reset"):
+                data.reset()
+        else:
+            source = net._as_iterator(data, batch_size)
+            for ds in source:
+                yield (jnp.asarray(ds.features), jnp.asarray(ds.labels),
+                       None if ds.features_mask is None
+                       else jnp.asarray(ds.features_mask),
+                       None if ds.labels_mask is None
+                       else jnp.asarray(ds.labels_mask))
+            source.reset()
+
     def fit(self, data, epochs: int = 1, batch_size: int = 32):
         net = self.model
-        source = net._as_iterator(data, batch_size)
         # vary by epoch_count so repeated fit() calls draw fresh dropout
         # masks (matches MultiLayerNetwork._fit_epoch keying)
         rng = jax.random.fold_in(
@@ -159,21 +243,17 @@ class ContextParallelTrainer:
         for _ in range(epochs):
             for lst in net.listeners:
                 lst.on_epoch_start(net, net.epoch_count)
-            for ds in source:
-                x = jnp.asarray(ds.features)
-                y = jnp.asarray(ds.labels)
-                fm = None if ds.features_mask is None \
-                    else jnp.asarray(ds.features_mask)
+            for x, y, fm, lm in self._iter_batches(data, batch_size):
                 self._check_divisible(x)
-                with_mask = fm is not None
+                sig = (fm is not None, lm is not None)
                 if self._step is None:
                     self._step = {}
-                if with_mask not in self._step:
-                    self._step[with_mask] = self._build_step(with_mask)
+                if sig not in self._step:
+                    self._step[sig] = self._build_step(*sig)
                 rng, sub = jax.random.split(rng)
                 net.params, net.opt_state, net.state, loss = \
-                    self._step[with_mask](
-                        net.params, net.opt_state, net.state, x, y, fm, sub)
+                    self._step[sig](net.params, net.opt_state, net.state,
+                                    x, y, fm, lm, sub)
                 net._score = float(loss)
                 for lst in net.listeners:
                     lst.iteration_done(net, net.iteration_count,
@@ -183,7 +263,6 @@ class ContextParallelTrainer:
             for lst in net.listeners:
                 lst.on_epoch_end(net, net.epoch_count)
             net.epoch_count += 1
-            source.reset()
         net._train_step = None
         net._output_fn = None
         return net

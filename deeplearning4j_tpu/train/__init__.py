@@ -3,9 +3,14 @@ from deeplearning4j_tpu.train.listeners import (
     CollectScoresIterationListener, TimeIterationListener,
     EvaluativeListener, CheckpointListener,
 )
+from deeplearning4j_tpu.train.solvers import (
+    BackTrackLineSearch, ConjugateGradient, LBFGS, LineGradientDescent,
+)
 
 __all__ = [
     "TrainingListener", "ScoreIterationListener", "PerformanceListener",
     "CollectScoresIterationListener", "TimeIterationListener",
     "EvaluativeListener", "CheckpointListener",
+    "BackTrackLineSearch", "LineGradientDescent", "ConjugateGradient",
+    "LBFGS",
 ]

@@ -1,0 +1,79 @@
+"""Worker for the REAL 2-process multi-host test (jax.distributed over
+localhost — the TPU-native analog of the reference exercising its
+distributed paths in-process with Spark local[N], SURVEY.md §4, and of
+`SharedTrainingWrapper.java:206-244` forming the worker mesh).
+
+Each OS process contributes 4 virtual CPU devices; the 2-process cluster
+forms a global 8-device mesh and runs ParallelWrapper sync-DP.
+
+Usage: python tests/_distributed_worker.py RANK NPROC COORD_PORT OUT.npz
+"""
+import os
+import sys
+
+rank, nproc, port = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+out_path = sys.argv[4]
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+os.environ["XLA_FLAGS"] = (
+    flags + " --xla_force_host_platform_device_count=4").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+from deeplearning4j_tpu.parallel import (  # noqa: E402
+    DistributedConfig, initialize_distributed,
+)
+
+multi = initialize_distributed(DistributedConfig(
+    coordinator_address=f"127.0.0.1:{port}",
+    num_processes=nproc, process_id=rank))
+assert multi, "distributed runtime did not form"
+assert jax.process_count() == nproc
+assert jax.device_count() == 4 * nproc
+assert jax.local_device_count() == 4
+
+from deeplearning4j_tpu.data.iterator import ArrayDataSetIterator  # noqa: E402
+from deeplearning4j_tpu.nn.conf.base import InputType  # noqa: E402
+from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration  # noqa: E402
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer  # noqa: E402
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork  # noqa: E402
+from deeplearning4j_tpu.nn.updaters import Adam  # noqa: E402
+from deeplearning4j_tpu.parallel import ParallelWrapper, TrainingMode  # noqa: E402
+
+
+def blob_data(n=256, d=8, k=4, seed=0):
+    rs = np.random.RandomState(seed)
+    centers = rs.randn(k, d) * 3
+    X = np.concatenate([centers[i] + rs.randn(n // k, d)
+                        for i in range(k)]).astype("float32")
+    Y = np.eye(k, dtype="float32")[np.repeat(np.arange(k), n // k)]
+    perm = rs.permutation(n)
+    return X[perm], Y[perm]
+
+
+X, Y = blob_data()             # identical on every process (global batch)
+conf = (NeuralNetConfiguration.Builder()
+        .seed(11).updater(Adam(5e-2)).list()
+        .layer(DenseLayer(n_out=16, activation="relu"))
+        .layer(OutputLayer(n_out=4, activation="softmax", loss="mcxent"))
+        .set_input_type(InputType.feed_forward(8)).build())
+net = MultiLayerNetwork(conf).init()
+
+wrapper = ParallelWrapper(net, mode=TrainingMode.SYNC_GRADIENTS)
+assert wrapper.n_workers == 4 * nproc      # global mesh, not local
+wrapper.fit(ArrayDataSetIterator(X, Y, batch_size=64), epochs=8)
+
+acc = net.evaluate((X, Y)).accuracy()
+np.savez(out_path,
+         params=np.asarray(net.params_flat()),
+         accuracy=acc,
+         final_score=net.score(),
+         process_count=jax.process_count(),
+         device_count=jax.device_count())
+print(f"rank {rank}: acc={acc:.3f} score={net.score():.4f}", flush=True)

@@ -1,0 +1,110 @@
+"""Full-batch solver family (DL4J optimize/solvers/ parity:
+BackTrackLineSearch.java:64, ConjugateGradient.java:40, LBFGS.java:39)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.conf.base import InputType
+from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updaters import Sgd
+from deeplearning4j_tpu.train import (
+    BackTrackLineSearch, ConjugateGradient, LBFGS, LineGradientDescent,
+)
+
+
+def _blob_data(n=200, d=6, k=3, seed=0):
+    rs = np.random.RandomState(seed)
+    centers = rs.randn(k, d) * 3
+    X = np.concatenate([centers[i] + rs.randn(n // k, d)
+                        for i in range(k)]).astype("float32")
+    Y = np.eye(k, dtype="float32")[np.repeat(np.arange(k), n // k)]
+    return X, Y
+
+
+def _logreg(seed=0):
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(seed).updater(Sgd(1e-2)).list()
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(6))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _mlp(seed=0):
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(seed).updater(Sgd(1e-2)).list()
+            .layer(DenseLayer(n_out=12, activation="tanh"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(6))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def test_backtrack_line_search_sufficient_decrease():
+    """On f(x) = ||x||^2 the Armijo condition must hold for the accepted
+    step, starting from a point where step=1 along -g overshoots."""
+    import jax
+
+    @jax.jit
+    def f(x):
+        return jnp.sum(x * x)
+
+    vg = jax.jit(jax.value_and_grad(f))
+    x0 = jnp.full((5,), 3.0)
+    f0, g0 = vg(x0)
+    ls = BackTrackLineSearch(vg, max_iterations=10)
+    step, x1, f1 = ls.optimize(x0, f0, g0, -g0)
+    assert step > 0
+    slope = float(jnp.vdot(g0, -g0))
+    assert f1 <= float(f0) + ls.ALF * step * slope
+    assert f1 < float(f0)
+
+
+@pytest.mark.parametrize("solver_cls",
+                         [LineGradientDescent, ConjugateGradient, LBFGS])
+def test_solvers_converge_logreg(solver_cls):
+    X, Y = _blob_data()
+    net = _logreg()
+    before = net.score((__import__(
+        "deeplearning4j_tpu.data.dataset", fromlist=["DataSet"])
+        .DataSet(X, Y)))
+    res = solver_cls(max_iterations=60).optimize(net, (X, Y))
+    assert res.final_score < 0.3 * before, res.scores[:5] + res.scores[-3:]
+    acc = net.evaluate((X, Y)).accuracy()
+    assert acc > 0.93, acc
+    # monotone non-increasing scores (line search guarantees descent)
+    diffs = np.diff(res.scores)
+    assert np.all(diffs <= 1e-6), res.scores
+
+
+def test_lbfgs_beats_gradient_descent_iterations():
+    """Curvature exploitation: on the same budget L-BFGS must reach a
+    lower loss than steepest descent (the reason the family exists)."""
+    X, Y = _blob_data(seed=3)
+    net_gd = _mlp(seed=5)
+    net_lb = _mlp(seed=5)
+    r_gd = LineGradientDescent(max_iterations=25).optimize(net_gd, (X, Y))
+    r_lb = LBFGS(max_iterations=25).optimize(net_lb, (X, Y))
+    assert r_lb.final_score < r_gd.final_score, \
+        (r_lb.final_score, r_gd.final_score)
+
+
+def test_cg_works_on_graph():
+    from deeplearning4j_tpu.nn.conf.network import GraphBuilder
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    X, Y = _blob_data()
+    g = (GraphBuilder(NeuralNetConfiguration.Builder().seed(1)
+                      .updater(Sgd(1e-2)))
+         .add_inputs("in").set_input_types(InputType.feed_forward(6)))
+    g.add_layer("d", DenseLayer(n_out=8, activation="tanh"), "in")
+    g.add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                   loss="mcxent"), "d")
+    g.set_outputs("out")
+    net = ComputationGraph(g.build()).init()
+    res = ConjugateGradient(max_iterations=40).optimize(net, (X, Y))
+    assert res.final_score < res.scores[0] * 0.5
+    assert net.evaluate(__import__(
+        "deeplearning4j_tpu.data.dataset", fromlist=["DataSet"])
+        .DataSet(X, Y)).accuracy() > 0.9

@@ -4,6 +4,11 @@ from deeplearning4j_tpu.data.iterator import (
     BenchmarkDataSetIterator,
 )
 from deeplearning4j_tpu.data.async_iterator import AsyncDataSetIterator
+from deeplearning4j_tpu.data.utility_iterators import (
+    AsyncMultiDataSetIterator, DataSetIteratorSplitter,
+    EarlyTerminationDataSetIterator, IteratorDataSetIterator,
+    MultipleEpochsIterator, SamplingDataSetIterator,
+)
 from deeplearning4j_tpu.data.fetchers import (
     Cifar10DataSetIterator, EmnistDataSetIterator, IrisDataSetIterator,
     LfwDataSetIterator, MnistDataSetIterator, SvhnDataSetIterator,
@@ -14,6 +19,9 @@ __all__ = [
     "DataSet", "MultiDataSet", "DataSetIterator", "ArrayDataSetIterator",
     "ExistingDataSetIterator", "BenchmarkDataSetIterator",
     "AsyncDataSetIterator",
+    "EarlyTerminationDataSetIterator", "MultipleEpochsIterator",
+    "DataSetIteratorSplitter", "SamplingDataSetIterator",
+    "IteratorDataSetIterator", "AsyncMultiDataSetIterator",
     "MnistDataSetIterator", "EmnistDataSetIterator", "Cifar10DataSetIterator",
     "IrisDataSetIterator", "UciSequenceDataSetIterator",
     "SvhnDataSetIterator", "TinyImageNetDataSetIterator",

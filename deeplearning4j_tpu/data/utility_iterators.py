@@ -1,0 +1,179 @@
+"""Utility DataSet iterators.
+
+Parity: DL4J `deeplearning4j-utility-iterators/` (~30 classes; the
+load-bearing ones): `EarlyTerminationDataSetIterator`,
+`MultipleEpochsIterator`, `DataSetIteratorSplitter` (train/test views over
+one source), `SamplingDataSetIterator`, `IteratorDataSetIterator` (wrap a
+plain iterable), and the async MULTI-dataset shield
+(`AsyncMultiDataSetIterator`).
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterable, Iterator, List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
+from deeplearning4j_tpu.data.iterator import DataSetIterator
+
+
+class EarlyTerminationDataSetIterator(DataSetIterator):
+    """Caps the number of minibatches per epoch
+    (EarlyTerminationDataSetIterator)."""
+
+    def __init__(self, source: DataSetIterator, max_batches: int):
+        if max_batches <= 0:
+            raise ValueError("max_batches must be positive")
+        self.source = source
+        self.max_batches = max_batches
+
+    def __iter__(self) -> Iterator[DataSet]:
+        for i, ds in enumerate(self.source):
+            if i >= self.max_batches:
+                break
+            yield ds
+
+    def reset(self):
+        self.source.reset()
+
+
+class MultipleEpochsIterator(DataSetIterator):
+    """Replays the source n_epochs times as ONE epoch
+    (MultipleEpochsIterator — DL4J's pre-`fit(iter, epochs)` idiom)."""
+
+    def __init__(self, source: DataSetIterator, n_epochs: int):
+        self.source = source
+        self.n_epochs = max(1, n_epochs)
+
+    def __iter__(self) -> Iterator[DataSet]:
+        for _ in range(self.n_epochs):
+            yield from self.source
+            self.source.reset()
+
+    def reset(self):
+        self.source.reset()
+
+
+class _SplitView(DataSetIterator):
+    def __init__(self, parent: "DataSetIteratorSplitter", train: bool):
+        self.parent = parent
+        self.train = train
+
+    def __iter__(self) -> Iterator[DataSet]:
+        boundary = self.parent.n_train
+        for i, ds in enumerate(self.parent.source):
+            if self.train and i < boundary:
+                yield ds
+            elif not self.train and i >= boundary:
+                yield ds
+        self.parent.source.reset()
+
+    def reset(self):
+        self.parent.source.reset()
+
+
+class DataSetIteratorSplitter:
+    """Splits one iterator's epoch into train/test partitions by batch
+    count (DataSetIteratorSplitter: totalBatches * ratio go to train)."""
+
+    def __init__(self, source: DataSetIterator, total_batches: int,
+                 ratio: float):
+        if not 0.0 < ratio < 1.0:
+            raise ValueError("ratio must be in (0, 1)")
+        self.source = source
+        self.total_batches = total_batches
+        self.n_train = int(total_batches * ratio)
+
+    @property
+    def train_iterator(self) -> DataSetIterator:
+        return _SplitView(self, True)
+
+    @property
+    def test_iterator(self) -> DataSetIterator:
+        return _SplitView(self, False)
+
+
+class SamplingDataSetIterator(DataSetIterator):
+    """Random-with-replacement minibatches from one DataSet
+    (SamplingDataSetIterator)."""
+
+    def __init__(self, dataset: DataSet, batch_size: int,
+                 total_batches: int, seed: int = 123):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.total_batches = total_batches
+        self.seed = seed
+        self._epoch = 0
+
+    def __iter__(self) -> Iterator[DataSet]:
+        rs = np.random.RandomState(self.seed + self._epoch)
+        n = len(self.dataset.features)
+        for _ in range(self.total_batches):
+            sel = rs.randint(0, n, self.batch_size)
+            yield DataSet(
+                np.asarray(self.dataset.features)[sel],
+                np.asarray(self.dataset.labels)[sel],
+                None if self.dataset.features_mask is None
+                else np.asarray(self.dataset.features_mask)[sel],
+                None if self.dataset.labels_mask is None
+                else np.asarray(self.dataset.labels_mask)[sel])
+        self._epoch += 1
+
+    def reset(self):
+        pass
+
+
+class IteratorDataSetIterator(DataSetIterator):
+    """Wraps any (re-iterable) python iterable of DataSets
+    (IteratorDataSetIterator)."""
+
+    def __init__(self, iterable: Iterable[DataSet]):
+        self._items: List[DataSet] = list(iterable)
+
+    def __iter__(self) -> Iterator[DataSet]:
+        return iter(self._items)
+
+    def reset(self):
+        pass
+
+
+class AsyncMultiDataSetIterator:
+    """Background-thread prefetch over MultiDataSets — the multi-input twin
+    of AsyncDataSetIterator (AsyncMultiDataSetIterator)."""
+
+    _END = object()
+
+    def __init__(self, source, queue_size: int = 4):
+        self.source = source
+        self.queue_size = max(1, queue_size)
+
+    def __iter__(self):
+        q: "queue.Queue" = queue.Queue(self.queue_size)
+        err: List[BaseException] = []
+
+        def worker():
+            try:
+                for item in self.source:
+                    q.put(item)
+            except BaseException as e:      # surface in the consumer
+                err.append(e)
+            finally:
+                q.put(self._END)
+
+        t = threading.Thread(target=worker, daemon=True,
+                             name="AsyncMultiDataSetIterator")
+        t.start()
+        while True:
+            item = q.get()
+            if item is self._END:
+                break
+            yield item
+        t.join()
+        if err:
+            raise err[0]
+
+    def reset(self):
+        if hasattr(self.source, "reset"):
+            self.source.reset()

@@ -152,6 +152,7 @@ class SequenceVectors(WordVectors):
                  min_learning_rate: float = 1e-4,
                  epochs: int = 1, batch_size: int = 512,
                  elements_learning_algorithm: str = "skipgram",
+                 backend: str = "device", n_threads: int = 0,
                  seed: int = 42):
         super().__init__(VocabCache(), np.zeros((0, layer_size), np.float32))
         self.layer_size = layer_size
@@ -165,6 +166,11 @@ class SequenceVectors(WordVectors):
         self.epochs = epochs
         self.batch_size = batch_size
         self.algorithm = elements_learning_algorithm
+        # "device": batched jit steps on TPU/CPU; "native": the C++ HogWild
+        # trainer (deeplearning4j_tpu.native) — the architecture DL4J's
+        # AggregateSkipGram path uses, for host-bound corpora
+        self.backend = backend
+        self.n_threads = n_threads
         self.seed = seed
         self._rs = np.random.RandomState(seed)
         self.syn1 = None            # HS inner-node table
@@ -187,6 +193,8 @@ class SequenceVectors(WordVectors):
     def fit(self, source):
         if len(self.vocab) == 0:
             self.build_vocab(source)
+        if self.backend == "native":
+            return self._fit_native(source)
         V, D = len(self.vocab), self.layer_size
         rs = self._rs
         w_in = jnp.asarray(
@@ -256,6 +264,66 @@ class SequenceVectors(WordVectors):
         self.vectors = np.asarray(w_in)
         self.w_out = np.asarray(w_out)
         self.syn1 = np.asarray(syn1)
+        return self
+
+    # ------------------------------------------------------------- native
+    def _fit_native(self, source):
+        """C++ HogWild skip-gram/negative-sampling epochs (the reference's
+        AggregateSkipGram architecture — lock-free threads over shared
+        tables; SkipGram.java:224-272). Requires skipgram + negative
+        sampling; raises when the toolchain/library is unavailable."""
+        from deeplearning4j_tpu import native
+        if self.algorithm != "skipgram" or self.negative <= 0 or self.use_hs:
+            raise ValueError("backend='native' supports skip-gram with "
+                             "negative sampling (the AggregateSkipGram "
+                             "path); use backend='device' otherwise")
+        if not native.available():
+            raise RuntimeError("native backend unavailable: g++ build "
+                               "failed or no toolchain (see logs)")
+        V, D = len(self.vocab), self.layer_size
+        rs = self._rs
+        syn0 = ((rs.rand(V, D) - 0.5) / D).astype(np.float32)
+        syn1neg = np.zeros((V, D), np.float32)
+        p = self.vocab.unigram_table()
+        cum = np.cumsum(np.asarray(p, np.float64))
+        cum /= cum[-1]
+        # float rounding can still push the last probe past cum[-1] and
+        # searchsorted would emit the out-of-range id V — clamp (the C++
+        # kernel indexes the table unchecked, as HogWild kernels do)
+        table = np.minimum(
+            np.searchsorted(cum, (np.arange(1_000_000) + 0.5) / 1_000_000),
+            V - 1).astype(np.int32)
+        # the device backend takes batch-MEAN steps (lr divided by ~batch
+        # size inside the jit step); HogWild applies every pair
+        # individually, so the same knob maps into the per-pair regime by
+        # 0.05: the 0.5 default becomes 0.025 — word2vec.c's canonical
+        # skip-gram rate. Without this, per-pair lr 0.5 diverges to NaN.
+        pair_lr = self.learning_rate * 0.05
+        pair_lr_min = self.min_learning_rate * 0.05
+        self.last_loss = 0.0
+        for epoch in range(self.epochs):
+            ids, offsets = [], [0]
+            for seq in self._sequences(source):
+                enc = self._encode(seq, rs)
+                if len(enc) < 2:
+                    continue
+                ids.append(enc)
+                offsets.append(offsets[-1] + len(enc))
+            if not ids:
+                break
+            corpus = np.concatenate(ids).astype(np.int32)
+            offs = np.asarray(offsets, np.int64)
+            frac0 = epoch / self.epochs
+            lr_start = max(pair_lr_min, pair_lr * (1.0 - frac0))
+            # within-call decay slope matches the global schedule when the
+            # counter horizon spans all remaining epochs
+            horizon = len(corpus) * max(self.epochs - epoch, 1)
+            self.last_loss = native.sg_ns_train(
+                syn0, syn1neg, corpus, offs, self.window, self.negative,
+                table, lr_start, pair_lr_min, horizon,
+                seed=self.seed + epoch, n_threads=self.n_threads)
+        self.vectors = syn0
+        self.w_out = syn1neg
         return self
 
     # ------------------------------------------------------------- sampling

@@ -1,0 +1,161 @@
+"""Native host kernels: build-on-demand C++ via g++ + ctypes.
+
+The reference's host hot paths are out-of-tree C++ consumed over JNI
+(SURVEY.md §2.1: libnd4j compression ops, AggregateSkipGram HogWild
+aggregates). This package is the analog: `src/dl4jtpu_native.cpp` compiles
+once into a cached shared library; if no toolchain is present everything
+degrades to the pure JAX/numpy implementations (the callers check
+`available()`), so the framework never hard-requires a compiler.
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import logging
+import os
+import subprocess
+import tempfile
+from typing import Optional, Tuple
+
+import numpy as np
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+_SRC = os.path.join(os.path.dirname(__file__), "src", "dl4jtpu_native.cpp")
+_lib: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+
+def _cache_dir() -> str:
+    d = os.environ.get(
+        "DL4J_TPU_NATIVE_CACHE",
+        os.path.join(os.path.expanduser("~"), ".cache",
+                     "deeplearning4j_tpu"))
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _build() -> Optional[ctypes.CDLL]:
+    with open(_SRC, "rb") as f:
+        src = f.read()
+    tag = hashlib.sha256(src).hexdigest()[:16]
+    so_path = os.path.join(_cache_dir(), f"dl4jtpu_native-{tag}.so")
+    if not os.path.exists(so_path):
+        base = ["g++", "-std=c++17", "-O3", "-shared", "-fPIC",
+                "-march=native", _SRC, "-o"]
+        tmp = so_path + f".tmp{os.getpid()}"
+        for extra in (["-fopenmp"], []):   # OpenMP if present, else serial
+            cmd = base[:-1] + extra + ["-o", tmp]
+            try:
+                r = subprocess.run(cmd, capture_output=True, timeout=120)
+            except (OSError, subprocess.TimeoutExpired) as e:
+                log.warning("native build failed to run g++: %s", e)
+                return None
+            if r.returncode == 0:
+                os.replace(tmp, so_path)
+                break
+        else:
+            log.warning("native build failed:\n%s",
+                        r.stderr.decode()[-1000:])
+            return None
+    lib = ctypes.CDLL(so_path)
+    lib.threshold_encode_f32.restype = ctypes.c_int64
+    lib.threshold_encode_f32.argtypes = [
+        ctypes.POINTER(ctypes.c_float), ctypes.c_int64, ctypes.c_float,
+        ctypes.c_int64, ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float)]
+    lib.decode_accumulate_f32.restype = None
+    lib.decode_accumulate_f32.argtypes = [
+        ctypes.POINTER(ctypes.c_float), ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_float),
+        ctypes.c_int64]
+    lib.sg_ns_train.restype = ctypes.c_double
+    lib.sg_ns_train.argtypes = [
+        ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+        ctypes.c_int64, ctypes.c_int64, ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int64, ctypes.c_int32,
+        ctypes.c_int32, ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
+        ctypes.c_float, ctypes.c_float, ctypes.c_int64, ctypes.c_uint64,
+        ctypes.c_int32]
+    lib.native_abi_version.restype = ctypes.c_int32
+    if lib.native_abi_version() != 1:
+        log.warning("native ABI mismatch")
+        return None
+    return lib
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    global _lib, _build_failed
+    if _lib is None and not _build_failed:
+        _lib = _build()
+        if _lib is None:
+            _build_failed = True
+    return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+def _fptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def _i32ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+def threshold_encode(grad: np.ndarray, threshold: float, cap: int
+                     ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Host codec: exact-magnitude sparse encode. Returns (idx int32[m],
+    vals f32[m], residual f32 like grad) with m <= cap — the native twin of
+    encoding.threshold_encode_values (no -1 padding: host buffers are
+    dynamic)."""
+    lib = get_lib()
+    g = np.ascontiguousarray(np.asarray(grad, np.float32).reshape(-1))
+    n = g.size
+    cap = int(min(max(cap, 0), n))
+    idx = np.empty(cap, np.int32)
+    vals = np.empty(cap, np.float32)
+    residual = np.empty(n, np.float32)
+    m = lib.threshold_encode_f32(_fptr(g), n, ctypes.c_float(threshold),
+                                 cap, _i32ptr(idx), _fptr(vals),
+                                 _fptr(residual))
+    return idx[:m].copy(), vals[:m].copy(), residual.reshape(grad.shape)
+
+
+def decode_accumulate(dense: np.ndarray, idx: np.ndarray,
+                      vals: np.ndarray) -> np.ndarray:
+    lib = get_lib()
+    d = np.ascontiguousarray(np.asarray(dense, np.float32))
+    lib.decode_accumulate_f32(
+        _fptr(d), d.size, _i32ptr(np.ascontiguousarray(idx, np.int32)),
+        _fptr(np.ascontiguousarray(vals, np.float32)), int(len(idx)))
+    return d
+
+
+def sg_ns_train(syn0: np.ndarray, syn1neg: np.ndarray, corpus: np.ndarray,
+                offsets: np.ndarray, window: int, negative: int,
+                table: np.ndarray, lr_start: float, lr_min: float,
+                total_words: int, seed: int = 0,
+                n_threads: int = 0) -> float:
+    """HogWild skip-gram/negative-sampling epoch IN PLACE on syn0/syn1neg.
+    Returns mean pair loss (AggregateSkipGram analog)."""
+    lib = get_lib()
+    for name, a in (("syn0", syn0), ("syn1neg", syn1neg)):
+        if not (isinstance(a, np.ndarray) and a.dtype == np.float32
+                and a.flags["C_CONTIGUOUS"]):
+            # a silent ascontiguousarray copy would discard the in-place
+            # updates — demand the right layout instead
+            raise ValueError(f"{name} must be C-contiguous float32")
+    corpus = np.ascontiguousarray(corpus, np.int32)
+    offsets = np.ascontiguousarray(offsets, np.int64)
+    table = np.ascontiguousarray(table, np.int32)
+    loss = lib.sg_ns_train(
+        _fptr(syn0), _fptr(syn1neg), syn0.shape[0], syn0.shape[1],
+        _i32ptr(corpus),
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        len(offsets) - 1, window, negative, _i32ptr(table), table.size,
+        ctypes.c_float(lr_start), ctypes.c_float(lr_min),
+        int(total_words), ctypes.c_uint64(seed), int(n_threads))
+    return float(loss)

@@ -193,6 +193,11 @@ class EncodingHandler:
     # hard cap on transmitted density (fraction of elements); defaults to
     # 4x the target band
     max_density: Optional[float] = None
+    # "jax": encode as a compiled XLA op (device-resident gradients);
+    # "native": the C++ host codec (deeplearning4j_tpu.native — the twin of
+    # ND4J's native thresholdEncode), right when the gradient is already
+    # host-bound for a DCN transport. values mode only.
+    backend: str = "jax"
 
     def __post_init__(self):
         self._residual = None
@@ -219,16 +224,24 @@ class EncodingHandler:
         density_cap = (self.boundary * 4 if self.max_density is None
                        else self.max_density)
         cap = max(16, int(g.size * min(1.0, density_cap)))
-        if self.mode == "values":
+        if self.mode == "values" and self.backend == "native":
+            from deeplearning4j_tpu import native
+            idx, payload, residual = native.threshold_encode(
+                np.asarray(g), used_threshold, cap)
+            residual = jnp.asarray(residual)
+            scale = used_threshold
+            sent = float(len(idx))
+        elif self.mode == "values":
             idx, payload, residual = threshold_encode_values(
                 g, used_threshold, cap)
             scale = used_threshold
+            sent = float(jnp.sum(idx >= 0))
         else:
             idx, payload, scale, residual = threshold_encode_scaled(
                 g, used_threshold, cap)
+            sent = float(jnp.sum(idx >= 0))
         self._residual = residual
         self.iterations += 1
-        sent = float(jnp.sum(idx >= 0))
         self.last_sparsity = sent / g.size
         # adaptive threshold. The reference creeps +-2%/iteration
         # (EncodingHandler.java adaptive branch); that is far too slow when

@@ -97,10 +97,18 @@ class SharedGradientsTrainer:
                                  "(e.g. SocketTransport)")
             self.transport = LoopbackTransport(self.n_workers)
         # per-pod encoder: residuals are pod-local state (EncodingHandler
-        # "left-overs" buffer)
+        # "left-overs" buffer). On the rank/DCN path the gradient crosses
+        # to the host anyway, so the C++ codec encodes it there (the
+        # reference's native thresholdEncode); in-process simulation stays
+        # on the compiled XLA path.
+        backend = "jax"
+        if self.rank is not None:
+            from deeplearning4j_tpu import native
+            if native.available():
+                backend = "native"
         self.handlers = [EncodingHandler(threshold=self.threshold,
                                          boundary=self.boundary,
-                                         max_density=0.2)
+                                         max_density=0.2, backend=backend)
                          for _ in range(self.n_workers)]
         self._grad_fn = None
         self._apply_fn = None

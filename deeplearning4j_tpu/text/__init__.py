@@ -19,6 +19,10 @@ from deeplearning4j_tpu.text.documentiterator import (
     LabelsSource, SimpleLabelAwareIterator,
 )
 from deeplearning4j_tpu.text.invertedindex import InMemoryInvertedIndex
+from deeplearning4j_tpu.text.cjk import (
+    ChineseTokenizerFactory, JapaneseTokenizerFactory,
+    KoreanTokenizerFactory,
+)
 from deeplearning4j_tpu.text.vectorizers import (
     BagOfWordsVectorizer, BaseTextVectorizer, TfidfVectorizer,
 )
@@ -32,5 +36,7 @@ __all__ = [
     "SimpleLabelAwareIterator", "BasicLabelAwareIterator",
     "FileLabelAwareIterator", "FilenamesLabelAwareIterator",
     "InMemoryInvertedIndex",
+    "ChineseTokenizerFactory", "JapaneseTokenizerFactory",
+    "KoreanTokenizerFactory",
     "BaseTextVectorizer", "BagOfWordsVectorizer", "TfidfVectorizer",
 ]

@@ -1,0 +1,146 @@
+"""CJK tokenizer packs + utility iterators (DL4J
+deeplearning4j-nlp-{chinese,japanese,korean} and
+deeplearning4j-utility-iterators parity)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data import (
+    ArrayDataSetIterator, AsyncMultiDataSetIterator, DataSet,
+    DataSetIteratorSplitter, EarlyTerminationDataSetIterator,
+    IteratorDataSetIterator, MultiDataSet, MultipleEpochsIterator,
+    SamplingDataSetIterator,
+)
+from deeplearning4j_tpu.text import (
+    ChineseTokenizerFactory, JapaneseTokenizerFactory,
+    KoreanTokenizerFactory, TfidfVectorizer,
+)
+
+
+# ------------------------------------------------------------------- CJK
+def test_chinese_tokenizer_lexicon_longest_match():
+    tf = ChineseTokenizerFactory(lexicon=["北京", "大学", "北京大学"])
+    toks = tf.tokenize("我在北京大学学习 machine learning 2024")
+    assert "北京大学" in toks           # longest match wins over 北京+大学
+    assert "machine" in toks and "learning" in toks
+    assert "2024" in toks
+    assert "我" in toks                 # OOV han chars fall back to unigrams
+
+
+def test_chinese_tokenizer_bigrams_without_lexicon():
+    tf = ChineseTokenizerFactory()
+    toks = tf.tokenize("中文分词")
+    assert {"中", "文", "分", "词"}.issubset(toks)
+    assert "中文" in toks and "分词" in toks     # bigram emission
+
+
+def test_japanese_tokenizer_script_boundaries():
+    tf = JapaneseTokenizerFactory()
+    toks = tf.tokenize("私はカタカナとKanjiをtokenizeします")
+    assert "カタカナ" in toks           # katakana run kept whole
+    assert "tokenize" in toks
+    assert "は" in toks or "私" in toks
+
+
+def test_korean_tokenizer_strips_particles():
+    tf = KoreanTokenizerFactory()
+    # 고양이(cat)+가(subject particle), 집(house)+에서(locative)
+    toks = tf.tokenize("고양이가 집에서 잔다")
+    assert "고양이" in toks
+    assert "집" in toks
+    tf2 = KoreanTokenizerFactory(strip_particles=False)
+    assert "고양이가" in tf2.tokenize("고양이가 집에서 잔다")
+
+
+def test_cjk_feeds_vectorizer_pipeline():
+    """The factory contract matches the vectorizers (the nlp-chinese
+    module's purpose: tokenization feeding the same pipelines)."""
+    docs = [("北京 大学 研究", "edu"), ("上海 市场 金融", "fin"),
+            ("大学 教育 研究", "edu"), ("金融 市场 投资", "fin")]
+    tv = TfidfVectorizer(docs, tokenizer_factory=ChineseTokenizerFactory(
+        lexicon=["北京", "大学", "研究", "上海", "市场", "金融", "教育",
+                 "投资"]))
+    tv.fit()
+    assert "金融" in tv.vocab and "大学" in tv.vocab
+    ds = tv.vectorize()
+    assert ds.features.shape[0] == 4
+
+
+# -------------------------------------------------------- utility iterators
+def _source(n=10, bs=4):
+    rs = np.random.RandomState(0)
+    X = rs.rand(n * bs, 3).astype("float32")
+    Y = np.eye(2, dtype="float32")[rs.randint(0, 2, n * bs)]
+    return ArrayDataSetIterator(X, Y, batch_size=bs)
+
+
+def test_early_termination_iterator():
+    it = EarlyTerminationDataSetIterator(_source(n=10), max_batches=3)
+    assert len(list(it)) == 3
+    it.reset()
+    assert len(list(it)) == 3
+    with pytest.raises(ValueError):
+        EarlyTerminationDataSetIterator(_source(), 0)
+
+
+def test_multiple_epochs_iterator():
+    it = MultipleEpochsIterator(_source(n=4), n_epochs=3)
+    assert len(list(it)) == 12
+
+
+def test_splitter_partitions_batches():
+    sp = DataSetIteratorSplitter(_source(n=10), total_batches=10, ratio=0.7)
+    train = list(sp.train_iterator)
+    test = list(sp.test_iterator)
+    assert len(train) == 7 and len(test) == 3
+    # the partitions are disjoint: first train batch != first test batch
+    assert not np.allclose(np.asarray(train[0].features),
+                           np.asarray(test[0].features))
+
+
+def test_sampling_iterator_shapes_and_reseed():
+    ds = DataSet(np.arange(20, dtype="float32").reshape(10, 2),
+                 np.eye(2, dtype="float32")[np.arange(10) % 2])
+    it = SamplingDataSetIterator(ds, batch_size=4, total_batches=5)
+    batches = list(it)
+    assert len(batches) == 5
+    assert batches[0].features.shape == (4, 2)
+    batches2 = list(it)          # different epoch -> different draw
+    assert not all(np.array_equal(a.features, b.features)
+                   for a, b in zip(batches, batches2))
+
+
+def test_iterator_dataset_iterator_wraps_iterable():
+    items = [DataSet(np.zeros((2, 3), "float32"),
+                     np.zeros((2, 2), "float32")) for _ in range(4)]
+    it = IteratorDataSetIterator(items)
+    assert len(list(it)) == 4
+    assert len(list(it)) == 4    # re-iterable
+
+
+def test_async_multi_iterator_prefetches_and_propagates_errors():
+    mds = [MultiDataSet((np.zeros((2, 3), "float32"),),
+                        (np.zeros((2, 2), "float32"),)) for _ in range(6)]
+    it = AsyncMultiDataSetIterator(mds, queue_size=2)
+    assert len(list(it)) == 6
+
+    def boom():
+        yield mds[0]
+        raise RuntimeError("source failed")
+
+    with pytest.raises(RuntimeError, match="source failed"):
+        list(AsyncMultiDataSetIterator(boom()))
+
+
+def test_utility_iterators_compose_with_fit():
+    """Early-termination wrapping feeds net.fit like any iterator."""
+    from deeplearning4j_tpu.nn.conf.base import InputType
+    from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.updaters import Sgd
+    conf = (NeuralNetConfiguration.Builder().seed(0).updater(Sgd(1e-1))
+            .list().layer(OutputLayer(n_out=2))
+            .set_input_type(InputType.feed_forward(3)).build())
+    net = MultiLayerNetwork(conf).init()
+    net.fit(EarlyTerminationDataSetIterator(_source(n=8), 2), epochs=2)
+    assert net.iteration_count == 4

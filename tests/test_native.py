@@ -1,0 +1,140 @@
+"""Native C++ host kernels (the analog of ND4J's out-of-tree native ops:
+thresholdEncode compression — EncodingHandler.java:136-178 — and the
+AggregateSkipGram HogWild aggregates — SkipGram.java:224-272)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import native
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="no native toolchain")
+
+
+def test_codec_round_trip_and_top_k_selection():
+    rs = np.random.RandomState(0)
+    g = rs.randn(2000).astype("float32") * 0.01
+    big_idx = rs.choice(2000, 40, replace=False)
+    g[big_idx] = np.sign(g[big_idx]) * (0.5 + rs.rand(40))
+    idx, vals, residual = native.threshold_encode(g, 0.1, cap=100)
+    assert len(idx) == 40
+    assert set(idx.tolist()) == set(big_idx.tolist())
+    np.testing.assert_allclose(vals, g[idx], atol=0)
+    dense = native.decode_accumulate(np.zeros(2000, "float32"), idx, vals)
+    np.testing.assert_allclose(dense + residual, g, atol=1e-7)
+    # cap enforcement keeps the LARGEST magnitudes
+    idx2, vals2, _ = native.threshold_encode(g, 0.0, cap=10)
+    assert len(idx2) == 10
+    kept = np.sort(np.abs(vals2))
+    top10 = np.sort(np.abs(g))[-10:]
+    np.testing.assert_allclose(kept, top10, atol=0)
+
+
+def test_codec_matches_jax_path():
+    """Host codec and the compiled XLA encoder agree on selection, values,
+    and residual (backend equivalence — the cuDNN-vs-builtin test pattern,
+    SURVEY.md §4)."""
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.parallel.encoding import threshold_encode_values
+    rs = np.random.RandomState(1)
+    g = rs.randn(512).astype("float32")
+    j_idx, j_vals, j_res = threshold_encode_values(jnp.asarray(g), 0.8, 64)
+    n_idx, n_vals, n_res = native.threshold_encode(g, 0.8, 64)
+    j_valid = np.asarray(j_idx) >= 0
+    assert set(np.asarray(j_idx)[j_valid].tolist()) == set(n_idx.tolist())
+    np.testing.assert_allclose(np.asarray(j_res), n_res, atol=1e-7)
+
+
+def test_encoding_handler_native_backend():
+    from deeplearning4j_tpu.parallel.encoding import EncodingHandler
+    h = EncodingHandler(threshold=0.1, boundary=0.5, backend="native")
+    g = np.full(100, 0.06, "float32")
+    idx, vals, thr = h.encode(g)          # below threshold: nothing sent
+    assert len(idx) == 0
+    idx, vals, thr = h.encode(g)          # residual pushes over
+    assert len(idx) == 100
+    np.testing.assert_allclose(vals, 0.12, atol=1e-6)
+
+
+def test_hogwild_skipgram_learns_topic_structure():
+    """The C++ HogWild trainer must learn the same co-occurrence structure
+    as the device backend (Word2Vec backend='native')."""
+    from deeplearning4j_tpu.embeddings import Word2Vec
+    from deeplearning4j_tpu.text import CollectionSentenceIterator
+    rs = np.random.RandomState(3)
+    animals = ["cat", "dog", "pet", "fur", "tail"]
+    vehicles = ["car", "bus", "road", "wheel", "engine"]
+    sents = []
+    for _ in range(400):
+        pool = animals if rs.rand() < 0.5 else vehicles
+        sents.append(" ".join(rs.choice(pool, 6)))
+    w2v = Word2Vec(layer_size=32, window=3, min_count=2, negative=5,
+                   epochs=25, backend="native", n_threads=2, seed=1)
+    w2v.fit(CollectionSentenceIterator(sents))
+    assert len(w2v.vocab) == 10
+    assert np.isfinite(w2v.last_loss) and w2v.last_loss > 0
+    same = w2v.similarity("cat", "dog")
+    cross = w2v.similarity("cat", "car")
+    assert same > cross, (same, cross)
+    near = w2v.words_nearest("bus", 4)
+    assert set(near).issubset(set(vehicles)), near
+
+
+def test_native_backend_rejects_unsupported_modes():
+    from deeplearning4j_tpu.embeddings import Word2Vec
+    from deeplearning4j_tpu.text import CollectionSentenceIterator
+    w2v = Word2Vec(layer_size=8, min_count=1, negative=0,
+                   use_hierarchic_softmax=True, backend="native")
+    with pytest.raises(ValueError, match="native"):
+        w2v.fit(CollectionSentenceIterator(["a b c d"]))
+
+
+def test_shared_gradients_two_process_uses_native_codec():
+    """The rank/DCN trainer advertises the native codec when available."""
+    from deeplearning4j_tpu.parallel.shared import SharedGradientsTrainer
+    from deeplearning4j_tpu.nn.conf.base import InputType
+    from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.updaters import Sgd
+    from deeplearning4j_tpu.parallel.transport import SocketTransport
+    import socket
+    conf = (NeuralNetConfiguration.Builder().seed(0).updater(Sgd(1e-2))
+            .list().layer(OutputLayer(n_out=2))
+            .set_input_type(InputType.feed_forward(4)).build())
+    net = MultiLayerNetwork(conf).init()
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    with SocketTransport(rank=0, n_workers=1, base_port=port) as tr:
+        t = SharedGradientsTrainer(net, n_workers=1, rank=0, transport=tr)
+        assert t.handlers[0].backend == "native"
+
+
+def test_ns_table_never_contains_out_of_vocab_ids():
+    """Regression: float32 cumsum rounding used to leak id==V into the
+    negative-sampling table, which the unchecked C++ kernel would index
+    out of bounds (heap corruption)."""
+    from deeplearning4j_tpu.embeddings import Word2Vec
+    from deeplearning4j_tpu.text import CollectionSentenceIterator
+    rs = np.random.RandomState(0)
+    # Zipf-ish vocabulary large enough to trigger the rounding
+    words = [f"w{i}" for i in range(1000)]
+    freqs = (1.0 / (np.arange(1000) + 1)) ** 0.9
+    sents = []
+    for _ in range(300):
+        ids = rs.choice(1000, 8, p=freqs / freqs.sum())
+        sents.append(" ".join(words[i] for i in ids))
+    w2v = Word2Vec(layer_size=8, min_count=1, negative=5, epochs=1,
+                   backend="native", seed=0)
+    w2v.build_vocab(CollectionSentenceIterator(sents))
+    V = len(w2v.vocab)
+    p = w2v.vocab.unigram_table()
+    cum = np.cumsum(np.asarray(p, np.float64))
+    cum /= cum[-1]
+    table = np.minimum(
+        np.searchsorted(cum, (np.arange(1_000_000) + 0.5) / 1_000_000),
+        V - 1)
+    assert table.max() < V and table.min() >= 0
+    # and the full native fit survives (would corrupt/segfault before)
+    w2v.fit(CollectionSentenceIterator(sents))
+    assert np.all(np.isfinite(w2v.vectors))

@@ -63,12 +63,19 @@ class _SplitView(DataSetIterator):
 
     def __iter__(self) -> Iterator[DataSet]:
         boundary = self.parent.n_train
-        for i, ds in enumerate(self.parent.source):
-            if self.train and i < boundary:
-                yield ds
-            elif not self.train and i >= boundary:
-                yield ds
-        self.parent.source.reset()
+        # always leave the shared source rewound, even on early break or
+        # an exception mid-epoch — otherwise the sibling view would start
+        # mid-stream and the partitions would shift
+        try:
+            for i, ds in enumerate(self.parent.source):
+                if self.train:
+                    if i >= boundary:
+                        break          # train view never drains the tail
+                    yield ds
+                elif i >= boundary:
+                    yield ds
+        finally:
+            self.parent.source.reset()
 
     def reset(self):
         self.parent.source.reset()
@@ -151,26 +158,46 @@ class AsyncMultiDataSetIterator:
 
     def __iter__(self):
         q: "queue.Queue" = queue.Queue(self.queue_size)
+        stop = threading.Event()
         err: List[BaseException] = []
 
         def worker():
             try:
                 for item in self.source:
-                    q.put(item)
+                    # bounded put so an abandoned consumer (early break)
+                    # can't park this thread forever on a full queue
+                    while not stop.is_set():
+                        try:
+                            q.put(item, timeout=0.2)
+                            break
+                        except queue.Full:
+                            continue
+                    if stop.is_set():
+                        return
             except BaseException as e:      # surface in the consumer
                 err.append(e)
             finally:
-                q.put(self._END)
-
+                # the END sentinel must not be dropped on a momentarily
+                # full queue (the consumer would then block forever on
+                # q.get) — retry until delivered or the consumer is gone
+                while not stop.is_set():
+                    try:
+                        q.put(self._END, timeout=0.2)
+                        break
+                    except queue.Full:
+                        continue
         t = threading.Thread(target=worker, daemon=True,
                              name="AsyncMultiDataSetIterator")
         t.start()
-        while True:
-            item = q.get()
-            if item is self._END:
-                break
-            yield item
-        t.join()
+        try:
+            while True:
+                item = q.get()
+                if item is self._END:
+                    break
+                yield item
+        finally:                            # also runs on abandonment
+            stop.set()
+            t.join(timeout=5)
         if err:
             raise err[0]
 

@@ -16,8 +16,9 @@ from deeplearning4j_tpu.embeddings.vocab import VocabCache, VocabWord
 from deeplearning4j_tpu.embeddings.wordvectors import WordVectors
 from deeplearning4j_tpu.embeddings.sequencevectors import SequenceVectors
 from deeplearning4j_tpu.embeddings.word2vec import Word2Vec
+from deeplearning4j_tpu.embeddings.distributed import SparkWord2Vec
 from deeplearning4j_tpu.embeddings.paragraphvectors import ParagraphVectors
 from deeplearning4j_tpu.embeddings.glove import Glove
 
 __all__ = ["VocabCache", "VocabWord", "WordVectors", "SequenceVectors",
-           "Word2Vec", "ParagraphVectors", "Glove"]
+           "Word2Vec", "SparkWord2Vec", "ParagraphVectors", "Glove"]

@@ -190,16 +190,22 @@ class SequenceVectors(WordVectors):
             self.vocab.build_huffman()
         return self
 
-    def fit(self, source):
+    def fit(self, source, *, initial_syn0=None, initial_syn1neg=None):
+        """Train. `initial_syn0`/`initial_syn1neg` warm-start the tables —
+        the hook the partition-parallel trainer (embeddings/distributed.py,
+        the Spark word2vec analog) uses to continue from broadcast
+        parameters."""
         if len(self.vocab) == 0:
             self.build_vocab(source)
         if self.backend == "native":
-            return self._fit_native(source)
+            return self._fit_native(source, initial_syn0, initial_syn1neg)
         V, D = len(self.vocab), self.layer_size
         rs = self._rs
-        w_in = jnp.asarray(
-            (rs.rand(V, D).astype(np.float32) - 0.5) / D)
-        w_out = jnp.zeros((V, D), jnp.float32)
+        w_in = jnp.asarray(initial_syn0) if initial_syn0 is not None \
+            else jnp.asarray((rs.rand(V, D).astype(np.float32) - 0.5) / D)
+        w_out = jnp.asarray(initial_syn1neg) \
+            if initial_syn1neg is not None \
+            else jnp.zeros((V, D), jnp.float32)
         syn1 = jnp.zeros((max(V - 1, 1), D), jnp.float32)
         table = self.vocab.unigram_table()
         total_words = max(self.vocab.total_count(), 1)
@@ -267,7 +273,7 @@ class SequenceVectors(WordVectors):
         return self
 
     # ------------------------------------------------------------- native
-    def _fit_native(self, source):
+    def _fit_native(self, source, initial_syn0=None, initial_syn1neg=None):
         """C++ HogWild skip-gram/negative-sampling epochs (the reference's
         AggregateSkipGram architecture — lock-free threads over shared
         tables; SkipGram.java:224-272). Requires skipgram + negative
@@ -282,8 +288,12 @@ class SequenceVectors(WordVectors):
                                "failed or no toolchain (see logs)")
         V, D = len(self.vocab), self.layer_size
         rs = self._rs
-        syn0 = ((rs.rand(V, D) - 0.5) / D).astype(np.float32)
-        syn1neg = np.zeros((V, D), np.float32)
+        syn0 = (np.ascontiguousarray(initial_syn0, np.float32)
+                if initial_syn0 is not None
+                else ((rs.rand(V, D) - 0.5) / D).astype(np.float32))
+        syn1neg = (np.ascontiguousarray(initial_syn1neg, np.float32)
+                   if initial_syn1neg is not None
+                   else np.zeros((V, D), np.float32))
         p = self.vocab.unigram_table()
         cum = np.cumsum(np.asarray(p, np.float64))
         cum /= cum[-1]

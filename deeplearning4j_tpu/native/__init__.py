@@ -97,6 +97,16 @@ def available() -> bool:
     return get_lib() is not None
 
 
+def _require_lib() -> ctypes.CDLL:
+    lib = get_lib()
+    if lib is None:
+        raise RuntimeError(
+            "native backend unavailable: the g++ build failed or no "
+            "toolchain is present (check the 'native build failed' log); "
+            "use the jax/device backends instead")
+    return lib
+
+
 def _fptr(a: np.ndarray):
     return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
 
@@ -111,7 +121,7 @@ def threshold_encode(grad: np.ndarray, threshold: float, cap: int
     vals f32[m], residual f32 like grad) with m <= cap — the native twin of
     encoding.threshold_encode_values (no -1 padding: host buffers are
     dynamic)."""
-    lib = get_lib()
+    lib = _require_lib()
     g = np.ascontiguousarray(np.asarray(grad, np.float32).reshape(-1))
     n = g.size
     cap = int(min(max(cap, 0), n))
@@ -126,7 +136,7 @@ def threshold_encode(grad: np.ndarray, threshold: float, cap: int
 
 def decode_accumulate(dense: np.ndarray, idx: np.ndarray,
                       vals: np.ndarray) -> np.ndarray:
-    lib = get_lib()
+    lib = _require_lib()
     d = np.ascontiguousarray(np.asarray(dense, np.float32))
     lib.decode_accumulate_f32(
         _fptr(d), d.size, _i32ptr(np.ascontiguousarray(idx, np.int32)),
@@ -141,7 +151,7 @@ def sg_ns_train(syn0: np.ndarray, syn1neg: np.ndarray, corpus: np.ndarray,
                 n_threads: int = 0) -> float:
     """HogWild skip-gram/negative-sampling epoch IN PLACE on syn0/syn1neg.
     Returns mean pair loss (AggregateSkipGram analog)."""
-    lib = get_lib()
+    lib = _require_lib()
     for name, a in (("syn0", syn0), ("syn1neg", syn1neg)):
         if not (isinstance(a, np.ndarray) and a.dtype == np.float32
                 and a.flags["C_CONTIGUOUS"]):

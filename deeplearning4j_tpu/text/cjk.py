@@ -151,21 +151,39 @@ _KO_PARTICLES = ("은", "는", "이", "가", "을", "를", "의", "에", "에서
 
 
 class KoreanTokenizerFactory:
+    """Whitespace/script tokenization with particle handling. Without a
+    morphological dictionary a bare noun ending in a particle syllable is
+    indistinguishable from noun+particle (고양이 'cat' ends in the
+    subject-particle syllable 이), so stripping single-syllable particles
+    emits BOTH surface and stripped forms — 고양이 and 고양이가 then share
+    the token 고양이, which is the form merging the feature exists for.
+    Multi-syllable particles (에서, 으로...) are unambiguous enough to
+    strip outright."""
+
     def __init__(self, strip_particles: bool = True, preprocessor=None):
         self.strip_particles = strip_particles
         self.preprocessor = preprocessor
+
+    def _hangul_tokens(self, run: str) -> List[str]:
+        if not self.strip_particles or len(run) < 2:
+            return [run]
+        for p in sorted(_KO_PARTICLES, key=len, reverse=True):
+            if run.endswith(p) and len(run) > len(p):
+                stem = run[:-len(p)]
+                if len(p) >= 2:
+                    return [stem]
+                return [run, stem]      # ambiguous: keep both forms
+        return [run]
 
     def tokenize(self, text: str) -> List[str]:
         toks: List[str] = []
         for script, run in _runs(text):
             if script in ("space", "other"):
                 continue
-            if script == "hangul" and self.strip_particles and len(run) > 1:
-                for p in sorted(_KO_PARTICLES, key=len, reverse=True):
-                    if run.endswith(p) and len(run) > len(p):
-                        run = run[:-len(p)]
-                        break
-            toks.append(run)
+            if script == "hangul":
+                toks.extend(self._hangul_tokens(run))
+            else:
+                toks.append(run)
         if self.preprocessor is not None:
             toks = [self.preprocessor.pre_process(t) for t in toks]
         return [t for t in toks if t]

@@ -1,7 +1,7 @@
 from deeplearning4j_tpu.train.listeners import (
     TrainingListener, ScoreIterationListener, PerformanceListener,
     CollectScoresIterationListener, TimeIterationListener,
-    EvaluativeListener, CheckpointListener,
+    EvaluativeListener, CheckpointListener, ProfilerListener,
 )
 from deeplearning4j_tpu.train.solvers import (
     BackTrackLineSearch, ConjugateGradient, LBFGS, LineGradientDescent,
@@ -10,7 +10,7 @@ from deeplearning4j_tpu.train.solvers import (
 __all__ = [
     "TrainingListener", "ScoreIterationListener", "PerformanceListener",
     "CollectScoresIterationListener", "TimeIterationListener",
-    "EvaluativeListener", "CheckpointListener",
+    "EvaluativeListener", "CheckpointListener", "ProfilerListener",
     "BackTrackLineSearch", "LineGradientDescent", "ConjugateGradient",
     "LBFGS",
 ]

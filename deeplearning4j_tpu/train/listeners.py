@@ -162,3 +162,44 @@ class CheckpointListener(TrainingListener):
     def on_epoch_end(self, model, epoch):
         if self.every_epoch and (epoch + 1) % self.every_epoch == 0:
             self._save(model, f"epoch_{epoch}")
+
+
+class ProfilerListener(TrainingListener):
+    """Captures an XLA device trace with jax.profiler for a window of
+    iterations (SURVEY.md §5.1: the reference's op-level profiling lives in
+    external ND4J; the TPU equivalent is the XLA profiler, exposed here as
+    an ordinary listener).
+
+    Usage:
+        net.set_listeners(ProfilerListener("/tmp/trace", start_iteration=5,
+                                           num_iterations=3))
+        net.fit(...)          # iterations [5, 8) are traced
+        # inspect with tensorboard or xprof on the written trace dir
+    """
+
+    def __init__(self, log_dir: str, start_iteration: int = 5,
+                 num_iterations: int = 3):
+        self.log_dir = log_dir
+        self.start_iteration = start_iteration
+        self.stop_iteration = start_iteration + num_iterations
+        self._active = False
+        self.trace_dir: Optional[str] = None
+
+    def iteration_done(self, model, iteration, epoch, score, etl_ms,
+                       batch_size):
+        import jax
+        if iteration + 1 == self.start_iteration and not self._active:
+            jax.profiler.start_trace(self.log_dir)
+            self._active = True
+        elif iteration + 1 >= self.stop_iteration and self._active:
+            jax.profiler.stop_trace()
+            self._active = False
+            self.trace_dir = self.log_dir
+            log.info("profiler trace written to %s", self.log_dir)
+
+    def on_epoch_end(self, model, epoch):
+        if self._active:        # epoch ended inside the window: close out
+            import jax
+            jax.profiler.stop_trace()
+            self._active = False
+            self.trace_dir = self.log_dir

@@ -289,3 +289,22 @@ def test_tfidf_transform_consistent_with_corpus_path():
     tv.fit()                                   # refit does not corrupt
     assert tv.index.num_documents() == 2
     np.testing.assert_allclose(tv.transform("the cat")[0], row, atol=1e-7)
+
+
+def test_spark_word2vec_partition_parallel():
+    """Partition-parallel word2vec with per-epoch table averaging (the
+    dl4j-spark-nlp Word2Vec flow: broadcast vocab, per-partition training,
+    fold results)."""
+    from deeplearning4j_tpu.embeddings import SparkWord2Vec
+    w2v = SparkWord2Vec(n_workers=4, layer_size=32, window=3, min_count=2,
+                        negative=5, epochs=30, seed=7)
+    w2v.fit(CollectionSentenceIterator(_toy_corpus(500, seed=7)))
+    assert len(w2v.vocab) == 10
+    # averaged tables must carry the topic structure: same-topic pairs
+    # beat cross-topic pairs across the board
+    vehicles = {"bus", "road", "wheel", "engine"}
+    for a, b, c in (("cat", "dog", "car"), ("bus", "road", "pet"),
+                    ("car", "wheel", "fur")):
+        assert w2v.similarity(a, b) > w2v.similarity(a, c), (a, b, c)
+    near = w2v.words_nearest("car", 3)
+    assert len(vehicles.intersection(near)) >= 2, near

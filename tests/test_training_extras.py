@@ -213,3 +213,29 @@ def test_gradient_check_graph_residual():
     net = ComputationGraph(g.build()).init()
     res = check_gradients(net, X, Y, max_per_param=16)
     assert res.passed, res.failures[:3]
+
+
+def test_profiler_listener_writes_trace(tmp_path):
+    """ProfilerListener captures an XLA trace window during fit
+    (SURVEY.md §5.1 tracing hook)."""
+    import os
+    from deeplearning4j_tpu.data.iterator import ArrayDataSetIterator
+    from deeplearning4j_tpu.train import ProfilerListener
+    rs = np.random.RandomState(0)
+    X = rs.rand(64, 4).astype("float32")
+    Y = np.eye(2, dtype="float32")[rs.randint(0, 2, 64)]
+    conf = (NeuralNetConfiguration.Builder().seed(0).updater(Sgd(1e-2))
+            .list().layer(OutputLayer(n_out=2))
+            .set_input_type(InputType.feed_forward(4)).build())
+    net = MultiLayerNetwork(conf).init()
+    prof = ProfilerListener(str(tmp_path), start_iteration=2,
+                            num_iterations=2)
+    net.set_listeners(prof)
+    net.fit(ArrayDataSetIterator(X, Y, batch_size=16), epochs=2)
+    assert prof.trace_dir == str(tmp_path)
+    assert not prof._active
+    # jax writes plugins/profile/<run>/ under the log dir
+    found = []
+    for root, dirs, files in os.walk(str(tmp_path)):
+        found.extend(files)
+    assert found, "no trace files written"

@@ -30,6 +30,7 @@ from deeplearning4j_tpu.parallel.ring import (
     blockwise_attention, make_ring_attention, ring_self_attention,
 )
 from deeplearning4j_tpu.parallel.context import ContextParallelTrainer
+from deeplearning4j_tpu.parallel.pipeline import PipelineParallelTrainer
 from deeplearning4j_tpu.parallel.shared import (
     LoopbackTransport, SharedGradientsTrainer,
 )
@@ -44,6 +45,6 @@ __all__ = [
     "ShardingRules", "shard_params", "logical_to_mesh",
     "DistributedConfig", "initialize_distributed",
     "ring_self_attention", "make_ring_attention", "blockwise_attention",
-    "ContextParallelTrainer",
+    "ContextParallelTrainer", "PipelineParallelTrainer",
     "SharedGradientsTrainer", "LoopbackTransport",
 ]

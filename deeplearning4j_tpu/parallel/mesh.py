@@ -10,6 +10,7 @@ Axis conventions used throughout:
   "data"  — data parallelism (batch dim; DL4J worker index)
   "model" — tensor parallelism (feature/head dims; absent in DL4J)
   "seq"   — sequence/context parallelism (time dim; absent in DL4J)
+  "stage" — pipeline parallelism (layer-stack dim; absent in DL4J)
 """
 from __future__ import annotations
 
@@ -23,6 +24,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
 SEQ_AXIS = "seq"
+STAGE_AXIS = "stage"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -31,37 +33,41 @@ class MeshConfig:
 
     `data=-1` means "all remaining devices". Mirrors the role of
     ParallelWrapper's `workers(n)` builder knob (ParallelWrapper.java:59-74)
-    plus the model/seq axes DL4J has no equivalent for.
+    plus the model/seq/stage axes DL4J has no equivalent for.
     """
     data: int = -1
     model: int = 1
     seq: int = 1
+    stage: int = 1
 
-    def resolve(self, n_devices: int) -> Tuple[int, int, int]:
-        d, m, s = self.data, self.model, self.seq
+    def resolve(self, n_devices: int) -> Tuple[int, int, int, int]:
+        d, m, s, p = self.data, self.model, self.seq, self.stage
         if d == -1:
-            if n_devices % (m * s):
+            if n_devices % (m * s * p):
                 raise ValueError(
-                    f"{n_devices} devices not divisible by model*seq={m * s}")
-            d = n_devices // (m * s)
-        if d * m * s != n_devices:
+                    f"{n_devices} devices not divisible by "
+                    f"model*seq*stage={m * s * p}")
+            d = n_devices // (m * s * p)
+        if d * m * s * p != n_devices:
             raise ValueError(
-                f"mesh {d}x{m}x{s} != available devices {n_devices}")
-        return d, m, s
+                f"mesh {d}x{p}x{s}x{m} != available devices {n_devices}")
+        return d, m, s, p
 
 
 def build_mesh(config: Optional[MeshConfig] = None,
                devices: Optional[Sequence] = None) -> Mesh:
-    """Build a (data, model, seq) mesh over the given (default: all) devices.
+    """Build a (data, stage, seq, model) mesh over the given (default: all)
+    devices.
 
-    Axis order puts "model" and "seq" innermost so tensor/sequence collectives
-    ride the fastest ICI links (scaling-book recipe: closest chips get the
-    highest-traffic axis)."""
+    Axis order puts "model" and "seq" innermost so tensor/sequence
+    collectives ride the fastest ICI links; "stage" sits next to "data"
+    because its traffic is point-to-point ring permutes (scaling-book
+    recipe: closest chips get the highest-traffic axis)."""
     config = config or MeshConfig()
     devices = list(devices if devices is not None else jax.devices())
-    d, m, s = config.resolve(len(devices))
-    arr = np.asarray(devices).reshape(d, s, m)
-    return Mesh(arr, (DATA_AXIS, SEQ_AXIS, MODEL_AXIS))
+    d, m, s, p = config.resolve(len(devices))
+    arr = np.asarray(devices).reshape(d, p, s, m)
+    return Mesh(arr, (DATA_AXIS, STAGE_AXIS, SEQ_AXIS, MODEL_AXIS))
 
 
 def data_sharding(mesh: Mesh) -> NamedSharding:

@@ -63,11 +63,32 @@ conf = (NeuralNetConfiguration.Builder()
         .layer(DenseLayer(n_out=16, activation="relu"))
         .layer(OutputLayer(n_out=4, activation="softmax", loss="mcxent"))
         .set_input_type(InputType.feed_forward(8)).build())
-net = MultiLayerNetwork(conf).init()
+
+# optional phase for the elastic-recovery exercise (SURVEY.md §5.3:
+# checkpoint + restart IS the multi-host failure story):
+#   phase=first  : train 4 epochs, coordinator checkpoints, exit (the
+#                  "crash" — the whole cluster goes down)
+#   phase=resume : a NEW cluster restores the checkpoint and trains the
+#                  remaining 4 epochs
+#   (unset)      : uninterrupted 8 epochs — must end bit-identical
+phase = os.environ.get("DL4J_TPU_WORKER_PHASE", "")
+ckpt = os.environ.get("DL4J_TPU_WORKER_CKPT", "")
+
+if phase == "resume":
+    from deeplearning4j_tpu.util.serialization import load_model
+    net = load_model(ckpt)
+else:
+    net = MultiLayerNetwork(conf).init()
 
 wrapper = ParallelWrapper(net, mode=TrainingMode.SYNC_GRADIENTS)
 assert wrapper.n_workers == 4 * nproc      # global mesh, not local
-wrapper.fit(ArrayDataSetIterator(X, Y, batch_size=64), epochs=8)
+epochs = 4 if phase in ("first", "resume") else 8
+wrapper.fit(ArrayDataSetIterator(X, Y, batch_size=64), epochs=epochs)
+
+if phase == "first":
+    if rank == 0:              # coordinator saves (TrainingMaster role)
+        from deeplearning4j_tpu.util.serialization import save_model
+        save_model(net, ckpt)
 
 acc = net.evaluate((X, Y)).accuracy()
 np.savez(out_path,

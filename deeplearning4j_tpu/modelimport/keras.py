@@ -407,8 +407,11 @@ def _sequence_after(k_cls: str, cur_seq: bool) -> bool:
     if k_cls in ("GlobalAveragePooling1D", "GlobalMaxPooling1D",
                  "Flatten"):
         return False
+    if k_cls in ("Conv1D", "MaxPooling1D", "AveragePooling1D"):
+        return cur_seq          # 1D conv/pool keep (B, T, C) sequences
     if k_cls in ("Dropout", "Activation", "BatchNormalization",
-                 "LayerNormalization", "Dense", "TimeDistributed"):
+                 "LayerNormalization", "Dense", "TimeDistributed",
+                 "LeakyReLU", "ELU", "ReLU", "Softmax"):
         return cur_seq          # Keras Dense on 3D is time-distributed
     return False
 
@@ -623,6 +626,76 @@ def _map_layer(k_cls: str, k_cfg: dict, is_output: bool,
         inner_cls = inner.get("class_name")
         inner_cfg = inner.get("config", {})
         return _map_layer(inner_cls, inner_cfg, is_output, sequence=True)
+
+    def _one(v) -> int:
+        """Scalar from a Keras 1D size field (stored scalar or 1-tuple)."""
+        return int(v[0] if isinstance(v, (list, tuple)) else v)
+
+    if k_cls == "Conv1D":
+        from deeplearning4j_tpu.nn.layers import Convolution1DLayer
+
+        def load_c1(params, state, w):
+            params["W"] = jnp.asarray(w[0])     # (k, in, out) both sides
+            if len(w) > 1 and "b" in params:
+                params["b"] = jnp.asarray(w[1])
+        return Convolution1DLayer(
+            n_out=int(k_cfg["filters"]),
+            kernel=_one(k_cfg.get("kernel_size", 3)),
+            stride=_one(k_cfg.get("strides", 1)),
+            dilation=_one(k_cfg.get("dilation_rate", 1)),
+            convolution_mode=_padding(k_cfg.get("padding", "valid")),
+            activation=_act(k_cfg.get("activation", "linear")),
+            has_bias=k_cfg.get("use_bias", True)), load_c1
+
+    if k_cls in ("MaxPooling1D", "AveragePooling1D"):
+        from deeplearning4j_tpu.nn.layers import Subsampling1DLayer
+        ps = k_cfg.get("pool_size", 2)
+        return Subsampling1DLayer(
+            kernel=_one(ps),
+            stride=_one(k_cfg.get("strides") or ps),
+            pooling_type="max" if k_cls.startswith("Max") else "avg",
+            convolution_mode=_padding(k_cfg.get("padding", "valid"))), None
+
+    if k_cls in ("GlobalAveragePooling1D", "GlobalMaxPooling1D"):
+        return GlobalPoolingLayer(
+            pooling_type="avg" if "Average" in k_cls else "max"), None
+
+    if k_cls == "UpSampling2D":
+        from deeplearning4j_tpu.nn.layers import Upsampling2D
+        if k_cfg.get("interpolation", "nearest") != "nearest":
+            raise ValueError("UpSampling2D: only nearest interpolation "
+                             "is mapped")
+        sz = k_cfg.get("size", 2)
+        if isinstance(sz, (list, tuple)):
+            sz = tuple(int(x) for x in sz)   # asymmetric (h, w) supported
+        else:
+            sz = int(sz)
+        return Upsampling2D(size=sz), None
+
+    if k_cls in ("LeakyReLU", "ELU", "ReLU", "Softmax"):
+        if k_cls == "Softmax" and k_cfg.get("axis", -1) != -1:
+            raise ValueError("Softmax: only axis=-1 is mapped")
+        name = {"LeakyReLU": "leakyrelu", "ELU": "elu", "ReLU": "relu",
+                "Softmax": "softmax"}[k_cls]
+        alpha = None
+        if k_cls == "LeakyReLU":       # Keras 3: negative_slope; 2: alpha
+            alpha = float(k_cfg.get("negative_slope",
+                                    k_cfg.get("alpha", 0.3)))
+        elif k_cls == "ELU":
+            alpha = float(k_cfg.get("alpha", 1.0))
+        elif k_cls == "ReLU":
+            mv = k_cfg.get("max_value")
+            ns = float(k_cfg.get("negative_slope", 0.0) or 0.0)
+            thr = float(k_cfg.get("threshold", 0.0) or 0.0)
+            if ns or thr:
+                raise ValueError("ReLU: negative_slope/threshold variants "
+                                 "are not mapped")
+            if mv is not None:
+                if float(mv) != 6.0:
+                    raise ValueError("ReLU: only max_value in (None, 6.0) "
+                                     "is mapped")
+                name = "relu6"        # MobileNet-family clipped relu
+        return ActivationLayer(activation=name, alpha=alpha), None
 
     raise ValueError(f"Unsupported Keras layer '{k_cls}' "
                      "(KerasModelImport layer mappers)")

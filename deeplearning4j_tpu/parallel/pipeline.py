@@ -98,6 +98,20 @@ class PipelineParallelTrainer:
         if not self.post_idx or \
                 not hasattr(model.layers[self.post_idx[-1]], "score"):
             raise ValueError("last layer must be an output layer")
+        if model._compute_dtype != model._param_dtype:
+            raise ValueError(
+                "pipeline path runs layers on uncast parameters; "
+                "compute_dtype must equal the param dtype here (mixed "
+                "precision pp is not implemented)")
+        # layer state updates are discarded by the pipelined step — reject
+        # stateful layers (e.g. BatchNorm running stats) rather than let
+        # their statistics silently stay at init values
+        for i, layer in enumerate(model.layers):
+            if model.state.get(str(i)):
+                raise ValueError(
+                    f"layer {i} ({type(layer).__name__}) carries state; "
+                    "the pp step does not thread state updates — use "
+                    "stateless stacks (LN-based transformers)")
         # dropout inside the pipelined torso is not implemented (blocks
         # run with rng=None) — reject rather than silently train without
         dcfg = self.block_conf

@@ -452,3 +452,15 @@ def test_pipeline_parallel_honors_masks():
         ExistingDataSetIterator([ds]), epochs=1)
     np.testing.assert_allclose(np.asarray(net_a.params_flat()),
                                np.asarray(net_b.params_flat()), atol=5e-4)
+
+
+def test_pipeline_parallel_rejects_mixed_precision_and_stateful():
+    import dataclasses
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.parallel import PipelineParallelTrainer
+    mesh = build_mesh(MeshConfig(data=2, stage=4))
+    conf = dataclasses.replace(
+        TransformerLM(vocab_size=8, seq_length=8, n_layers=4, n_embd=16,
+                      n_heads=2).conf(), compute_dtype="bfloat16")
+    with pytest.raises(ValueError, match="compute_dtype"):
+        PipelineParallelTrainer(MultiLayerNetwork(conf).init(), mesh)

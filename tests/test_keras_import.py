@@ -233,3 +233,37 @@ def test_cropping_and_zeropadding_parity(tmp_path):
     x = np.random.RandomState(8).rand(2, 9, 9, 2).astype("float32")
     np.testing.assert_allclose(np.asarray(net.output(x)),
                                np.asarray(m(x)), atol=1e-4)
+
+
+def test_text_cnn_1d_parity(tmp_path):
+    """Conv1D / MaxPooling1D / GlobalAveragePooling1D — the Keras text-CNN
+    family."""
+    m = keras.Sequential([
+        keras.layers.Input((20, 8)),
+        keras.layers.Conv1D(12, 3, padding="same", activation="relu"),
+        keras.layers.MaxPooling1D(2),
+        keras.layers.Conv1D(6, 3, padding="valid"),
+        keras.layers.GlobalAveragePooling1D(),
+        keras.layers.Dense(3, activation="softmax"),
+    ])
+    p = _save(m, tmp_path)
+    net = KerasModelImport.import_keras_sequential_model_and_weights(p)
+    x = np.random.RandomState(9).randn(4, 20, 8).astype("float32")
+    np.testing.assert_allclose(np.asarray(net.output(x)),
+                               np.asarray(m(x)), atol=1e-4)
+
+
+def test_upsampling_and_advanced_activations_parity(tmp_path):
+    m = keras.Sequential([
+        keras.layers.Input((6, 6, 2)),
+        keras.layers.Conv2D(4, 3, padding="same"),
+        keras.layers.LeakyReLU(negative_slope=0.2),
+        keras.layers.UpSampling2D(2),
+        keras.layers.Conv2D(2, 3, padding="same"),
+        keras.layers.ELU(alpha=0.7),
+    ])
+    p = _save(m, tmp_path)
+    net = KerasModelImport.import_keras_sequential_model_and_weights(p)
+    x = np.random.RandomState(10).rand(2, 6, 6, 2).astype("float32")
+    np.testing.assert_allclose(np.asarray(net.output(x)),
+                               np.asarray(m(x)), atol=1e-4)

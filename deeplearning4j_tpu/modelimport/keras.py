@@ -633,6 +633,9 @@ def _map_layer(k_cls: str, k_cfg: dict, is_output: bool,
 
     if k_cls == "Conv1D":
         from deeplearning4j_tpu.nn.layers import Convolution1DLayer
+        if k_cfg.get("padding") == "causal":
+            raise ValueError("Conv1D: padding='causal' is not mapped "
+                             "(pad the input explicitly or use 'same')")
 
         def load_c1(params, state, w):
             params["W"] = jnp.asarray(w[0])     # (k, in, out) both sides

@@ -89,6 +89,8 @@ class SharedGradientsTrainer:
     rank: Optional[int] = None
 
     def __post_init__(self):
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        self._is_graph = isinstance(self.model, ComputationGraph)
         if self.model.params is None:
             raise ValueError("model must be init()ed first")
         if self.transport is None:
@@ -119,12 +121,17 @@ class SharedGradientsTrainer:
     def _build(self):
         net = self.model
         n = self.n_workers
+        is_graph = self._is_graph
 
         @jax.jit
         def grad_fn(params, state, x, y, rng):
             def lf(p):
-                loss, (new_state, _) = net._score_fn(
-                    p, state, x, y, None, None, True, rng)
+                if is_graph:
+                    loss, (new_state, _) = net._score_fn(
+                        p, state, (x,), (y,), None, None, True, rng)
+                else:
+                    loss, (new_state, _) = net._score_fn(
+                        p, state, x, y, None, None, True, rng)
                 return loss, new_state
             (loss, new_state), grads = jax.value_and_grad(
                 lf, has_aux=True)(params)
@@ -142,25 +149,51 @@ class SharedGradientsTrainer:
         self._grad_fn, self._apply_fn = grad_fn, apply_fn
 
     # ------------------------------------------------------------------ fit
+    def _iter_source(self, data, batch_size):
+        """Yield (features, labels) minibatches for either container type
+        (graphs speak MultiDataSet; single-input/single-output, no masks —
+        one batch axis to shard across pods)."""
+        if self._is_graph:
+            for mds in self.model._iter_data(data):
+                if len(mds.features) != 1 or len(mds.labels) != 1:
+                    raise ValueError("encoded-gradient training supports "
+                                     "single-input/single-output graphs")
+                if mds.features_masks is not None or \
+                        mds.labels_masks is not None:
+                    raise ValueError("encoded-gradient training does not "
+                                     "thread masks; strip them or use "
+                                     "ParallelWrapper")
+                x = np.asarray(mds.features[0])
+                y = np.asarray(mds.labels[0])
+                # graphs' _iter_data yields whole datasets — minibatch
+                # here so batch_size means the same as on the MLN path
+                for lo in range(0, len(x), batch_size):
+                    yield x[lo:lo + batch_size], y[lo:lo + batch_size]
+            if hasattr(data, "reset"):
+                data.reset()
+        else:
+            source = self.model._as_iterator(data, batch_size)
+            for ds in source:
+                yield ds.features, ds.labels
+            source.reset()
+
     def fit(self, data, epochs: int = 1, batch_size: int = 32):
         net = self.model
         if self._grad_fn is None:
             self._build()
-        source = net._as_iterator(data, batch_size)
         rng = jax.random.PRNGKey(net.conf.seed + 86243)
         for _ in range(epochs):
-            for ds in source:
+            for x, y in self._iter_source(data, batch_size):
                 rng, sub = jax.random.split(rng)
-                self._iteration(ds, sub)
-            source.reset()
+                self._iteration(x, y, sub)
             net.epoch_count += 1
         return net
 
-    def _iteration(self, ds, rng):
+    def _iteration(self, x, y, rng):
         if self.rank is not None:
-            return self._iteration_distributed(ds, rng)
+            return self._iteration_distributed(x, y, rng)
         net = self.model
-        shards = self._split(ds.features, ds.labels)
+        shards = self._split(x, y)
         n_params = int(param_util.params_to_flat(net.params).shape[0])
         # 1. every pod: local gradients on its shard (same start params)
         encoded = []
@@ -199,18 +232,18 @@ class SharedGradientsTrainer:
         net._score = float(loss)
         for lst in net.listeners:
             lst.iteration_done(net, self.iteration_count, net.epoch_count,
-                               net._score, 0.0, int(ds.features.shape[0]))
+                               net._score, 0.0, int(np.shape(x)[0]))
         self.iteration_count += 1
         net.iteration_count += 1
 
-    def _iteration_distributed(self, ds, rng):
+    def _iteration_distributed(self, x, y, rng):
         """One lockstep iteration of THIS pod: local gradients on the
         rank-th shard, broadcast the encoded message, block for the peers'
         messages, apply the identical decoded sum (SilentTrainingDriver
         semantics: remote updates land in the local accumulator and every
         replica applies the same total)."""
         net = self.model
-        shards = self._split(ds.features, ds.labels)
+        shards = self._split(x, y)
         xw, yw = shards[self.rank]
         n_params = int(param_util.params_to_flat(net.params).shape[0])
         flat, loss, new_state = self._grad_fn(

@@ -2,6 +2,7 @@ from deeplearning4j_tpu.train.listeners import (
     TrainingListener, ScoreIterationListener, PerformanceListener,
     CollectScoresIterationListener, TimeIterationListener,
     EvaluativeListener, CheckpointListener, ProfilerListener,
+    DivergenceListener, TrainingDivergedError,
 )
 from deeplearning4j_tpu.train.solvers import (
     BackTrackLineSearch, ConjugateGradient, LBFGS, LineGradientDescent,
@@ -11,6 +12,7 @@ __all__ = [
     "TrainingListener", "ScoreIterationListener", "PerformanceListener",
     "CollectScoresIterationListener", "TimeIterationListener",
     "EvaluativeListener", "CheckpointListener", "ProfilerListener",
+    "DivergenceListener", "TrainingDivergedError",
     "BackTrackLineSearch", "LineGradientDescent", "ConjugateGradient",
     "LBFGS",
 ]

@@ -203,3 +203,49 @@ class ProfilerListener(TrainingListener):
             jax.profiler.stop_trace()
             self._active = False
             self.trace_dir = self.log_dir
+
+
+class DivergenceListener(TrainingListener):
+    """Training failure detection (SURVEY.md §5.2/5.3: the reference has no
+    in-tree sanitizer; its closest analog is cuDNN helpers counting
+    failures). Watches the score stream for NaN/Inf or a sustained
+    explosion and either raises TrainingDivergedError (default — fail the
+    job before it burns more TPU hours) or invokes a callback (alerting /
+    checkpoint-and-restart policies).
+
+    Usage:
+        net.set_listeners(DivergenceListener())                  # raise
+        net.set_listeners(DivergenceListener(on_divergence=cb))  # custom
+    """
+
+    def __init__(self, explosion_factor: float = 1e4,
+                 window: int = 20, on_divergence: Optional[Callable] = None):
+        self.explosion_factor = explosion_factor
+        self.window = window
+        self.on_divergence = on_divergence
+        self._recent: List[float] = []
+
+    def iteration_done(self, model, iteration, epoch, score, etl_ms,
+                       batch_size):
+        import math
+        bad = None
+        if not math.isfinite(score):
+            bad = f"non-finite score {score} at iteration {iteration}"
+        else:
+            self._recent.append(score)
+            if len(self._recent) > self.window:
+                self._recent.pop(0)
+            baseline = min(self._recent)
+            if baseline > 0 and score > baseline * self.explosion_factor:
+                bad = (f"score exploded: {score:.4g} > "
+                       f"{self.explosion_factor:g} x recent best "
+                       f"{baseline:.4g} at iteration {iteration}")
+        if bad:
+            if self.on_divergence is not None:
+                self.on_divergence(model, iteration, bad)
+            else:
+                raise TrainingDivergedError(bad)
+
+
+class TrainingDivergedError(RuntimeError):
+    """Raised by DivergenceListener when the loss goes NaN/Inf/explodes."""

@@ -464,3 +464,23 @@ def test_pipeline_parallel_rejects_mixed_precision_and_stateful():
                       n_heads=2).conf(), compute_dtype="bfloat16")
     with pytest.raises(ValueError, match="compute_dtype"):
         PipelineParallelTrainer(MultiLayerNetwork(conf).init(), mesh)
+    # stateful layers (BatchNorm running stats) are rejected too: the pp
+    # step drops state updates
+    from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.base import InputType
+    from deeplearning4j_tpu.nn.layers import (
+        BatchNormalization, EmbeddingSequenceLayer, RnnOutputLayer,
+        TransformerBlock,
+    )
+    b = (NeuralNetConfiguration.Builder().seed(0).updater(Adam(1e-3))
+         .list()
+         .layer(EmbeddingSequenceLayer(n_in=8, n_out=16))
+         .layer(TransformerBlock(n_out=16, n_heads=2))
+         .layer(TransformerBlock(n_out=16, n_heads=2))
+         .layer(TransformerBlock(n_out=16, n_heads=2))
+         .layer(TransformerBlock(n_out=16, n_heads=2))
+         .layer(BatchNormalization())
+         .layer(RnnOutputLayer(n_out=8))
+         .set_input_type(InputType.recurrent(1, 8)).build())
+    with pytest.raises(ValueError, match="carries state"):
+        PipelineParallelTrainer(MultiLayerNetwork(b).init(), mesh)

@@ -424,3 +424,27 @@ def test_two_process_checkpoint_crash_resume_matches_uninterrupted():
         straight = np.load(outs_c[0])["params"]
         np.testing.assert_allclose(resumed, straight, atol=1e-6)
         assert np.load(outs_b[0])["accuracy"] > 0.95
+
+
+def test_shared_gradients_trainer_works_on_graphs():
+    """Encoded-gradient training accepts ComputationGraphs (single-in/out),
+    completing the DCN story for DAG models."""
+    from deeplearning4j_tpu.nn.conf.network import GraphBuilder
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.parallel import SharedGradientsTrainer
+    X, Y = _blob_data(n=128)
+    g = (GraphBuilder(NeuralNetConfiguration.Builder().seed(3)
+                      .updater(Sgd(5e-2)))
+         .add_inputs("in").set_input_types(InputType.feed_forward(8)))
+    g.add_layer("d", DenseLayer(n_out=16, activation="relu"), "in")
+    g.add_layer("out", OutputLayer(n_out=4, activation="softmax",
+                                   loss="mcxent"), "d")
+    g.set_outputs("out")
+    net = ComputationGraph(g.build()).init()
+    trainer = SharedGradientsTrainer(net, n_workers=2, threshold=5e-4)
+    from deeplearning4j_tpu.data.dataset import DataSet
+    for _ in range(12):
+        trainer.fit(DataSet(X, Y), epochs=1)
+    acc = net.evaluate(DataSet(X, Y)).accuracy()
+    assert acc > 0.9, acc
+    assert trainer.compression_ratio() < 0.5

@@ -239,3 +239,45 @@ def test_profiler_listener_writes_trace(tmp_path):
     for root, dirs, files in os.walk(str(tmp_path)):
         found.extend(files)
     assert found, "no trace files written"
+
+
+def test_divergence_listener_raises_on_nan_and_explosion():
+    """Failure detection (SURVEY.md §5.3): NaN scores and loss explosions
+    abort training instead of burning device hours."""
+    from deeplearning4j_tpu.train import (
+        DivergenceListener, TrainingDivergedError,
+    )
+
+    class FakeModel:
+        pass
+
+    lst = DivergenceListener()
+    lst.iteration_done(FakeModel(), 0, 0, 1.0, 0.0, 8)
+    with pytest.raises(TrainingDivergedError, match="non-finite"):
+        lst.iteration_done(FakeModel(), 1, 0, float("nan"), 0.0, 8)
+
+    lst2 = DivergenceListener(explosion_factor=100.0)
+    for i in range(5):
+        lst2.iteration_done(FakeModel(), i, 0, 1.0, 0.0, 8)
+    with pytest.raises(TrainingDivergedError, match="exploded"):
+        lst2.iteration_done(FakeModel(), 5, 0, 500.0, 0.0, 8)
+
+    seen = []
+    lst3 = DivergenceListener(
+        on_divergence=lambda m, it, msg: seen.append((it, msg)))
+    lst3.iteration_done(FakeModel(), 7, 0, float("inf"), 0.0, 8)
+    assert seen and seen[0][0] == 7
+
+    # integrates with a real fit: a huge lr makes the MLP explode
+    from deeplearning4j_tpu.data.iterator import ArrayDataSetIterator
+    rs = np.random.RandomState(0)
+    X = (rs.rand(64, 6) * 50).astype("float32")
+    Y = np.eye(2, dtype="float32")[rs.randint(0, 2, 64)]
+    conf = (NeuralNetConfiguration.Builder().seed(0).updater(Sgd(500.0))
+            .list().layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=2))
+            .set_input_type(InputType.feed_forward(6)).build())
+    net = MultiLayerNetwork(conf).init()
+    net.set_listeners(DivergenceListener(explosion_factor=10.0, window=3))
+    with pytest.raises(TrainingDivergedError):
+        net.fit(ArrayDataSetIterator(X, Y, batch_size=16), epochs=50)

@@ -518,6 +518,25 @@ class ComputationGraph:
     def rnn_clear_previous_state(self):
         self._rnn_carries = {}
 
+    # -------------------------------------------------------------- summary
+    def summary(self) -> str:
+        """Vertex table in topological order: name, type, inputs, output
+        shape, parameter count (ComputationGraph summary analog)."""
+        if self.params is None:
+            raise RuntimeError("init() the network before summary()")
+        types = self._vertex_types or self._resolve_types()
+        self._vertex_types = types
+        rows = [("vertex", "type", "inputs", "out", "params")]
+        total = 0
+        for name in self._topo:
+            vd = self.conf.vertices[name]
+            n = param_util.num_params(self.params.get(name, {}))
+            total += n
+            rows.append((name, type(vd.vertex).__name__,
+                         ",".join(vd.inputs),
+                         "x".join(map(str, types[name].shape)), f"{n:,}"))
+        return param_util.format_param_table(rows, total)
+
     # --------------------------------------------------------------- memory
     def memory_report(self, batch_size: int = 32, with_compiled: bool = True):
         """Per-vertex analytic memory estimate + exact XLA compiled-step HBM

@@ -547,6 +547,25 @@ class MultiLayerNetwork:
     def rnn_clear_previous_state(self):
         self._rnn_carries = {}
 
+    # ------------------------------------------------------------ summary
+    def summary(self) -> str:
+        """Layer table: name, type, shapes, parameter count
+        (MultiLayerNetwork.summary(), MultiLayerNetwork.java:3230)."""
+        if self.params is None:
+            raise RuntimeError("init() the network before summary()")
+        types = self._input_types or self._resolve_types()
+        rows = [("idx", "type", "in", "out", "params")]
+        total = 0
+        for i, layer in enumerate(self.layers):
+            in_t = types[i]
+            out_t = layer.output_type(in_t)
+            n = param_util.num_params(self.params[str(i)])
+            total += n
+            rows.append((str(i), type(layer).__name__,
+                         "x".join(map(str, in_t.shape)),
+                         "x".join(map(str, out_t.shape)), f"{n:,}"))
+        return param_util.format_param_table(rows, total)
+
     # ------------------------------------------------------------ memory
     def memory_report(self, batch_size: int = 32, with_compiled: bool = True):
         """Per-layer analytic memory estimate + exact XLA compiled-step HBM

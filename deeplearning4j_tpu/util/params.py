@@ -78,3 +78,14 @@ def _clone_structure(tree):
     if isinstance(tree, (list, tuple)):
         return [_clone_structure(v) for v in tree]
     return None
+
+
+def format_param_table(rows, total: int) -> str:
+    """Fixed-width table for summary() (shared by both containers).
+    rows[0] is the header; appends a total-parameters footer."""
+    widths = [max(len(r[c]) for r in rows) for c in range(len(rows[0]))]
+    gap = 2 * (len(widths) - 1)
+    lines = ["  ".join(v.ljust(w) for v, w in zip(r, widths)) for r in rows]
+    lines.insert(1, "-" * (sum(widths) + gap))
+    lines.append(f"total parameters: {total:,}")
+    return "\n".join(lines)

@@ -289,3 +289,27 @@ class TestSerde:
         np.testing.assert_allclose(np.asarray(net.params["0"]["W"]), w_before)
         assert not np.allclose(np.asarray(net.params["1"]["W"]),
                                np.asarray(MultiLayerNetwork(conf).init().params["1"]["W"]))
+
+
+def test_summary_tables():
+    """summary() prints the layer/vertex table (MultiLayerNetwork.java:3230)."""
+    from deeplearning4j_tpu.models import LeNet
+    from deeplearning4j_tpu.nn.conf.network import GraphBuilder
+    from deeplearning4j_tpu.nn.conf.graph_vertices import ElementWiseVertex
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    net = MultiLayerNetwork(LeNet(num_classes=10).conf()).init()
+    s = net.summary()
+    assert "ConvolutionLayer" in s and "total parameters" in s
+    assert f"{net.num_params():,}" in s
+    g = (GraphBuilder(NeuralNetConfiguration.Builder().seed(0)
+                      .updater(Adam(1e-3)))
+         .add_inputs("in")
+         .set_input_types(InputType.feed_forward(6)))
+    g.add_layer("d", DenseLayer(n_out=6, activation="tanh"), "in")
+    g.add_vertex("res", ElementWiseVertex(op="add"), "d", "in")
+    g.add_layer("out", OutputLayer(n_out=2), "res")
+    g.set_outputs("out")
+    gn = ComputationGraph(g.build()).init()
+    sg = gn.summary()
+    assert "res" in sg and "ElementWiseVertex" in sg
+    assert f"{gn.num_params():,}" in sg

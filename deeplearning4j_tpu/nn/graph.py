@@ -544,6 +544,22 @@ class ComputationGraph:
         from deeplearning4j_tpu.util.memory import build_memory_report
         return build_memory_report(self, batch_size, with_compiled)
 
+    def copy(self) -> "ComputationGraph":
+        """Clone with copied parameter/state pytrees (MultiLayerNetwork.copy
+        analog for graphs)."""
+        clone = ComputationGraph(self.conf)
+        if self.params is not None:
+            clone._vertex_types = self._vertex_types or self._resolve_types()
+            clone._pre_kind = self._pre_kind
+            # materialize NEW buffers: the original's arrays are donated by
+            # its train step and would be deleted out from under the clone
+            clone.params = jax.tree_util.tree_map(
+                lambda a: jnp.array(a, copy=True), self.params)
+            clone.state = jax.tree_util.tree_map(
+                lambda a: jnp.array(a, copy=True), self.state)
+            clone._build_optimizer()
+        return clone
+
     # --------------------------------------------------------------- params
     def num_params(self) -> int:
         return param_util.num_params(self.params)

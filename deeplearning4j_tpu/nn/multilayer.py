@@ -589,8 +589,12 @@ class MultiLayerNetwork:
         clone = MultiLayerNetwork(self.conf)
         if self.params is not None:
             clone._input_types = self._resolve_types()
-            clone.params = jax.tree_util.tree_map(lambda a: a, self.params)
-            clone.state = jax.tree_util.tree_map(lambda a: a, self.state)
+            # materialize NEW buffers: the original's arrays are donated by
+            # its train step and would be deleted out from under the clone
+            clone.params = jax.tree_util.tree_map(
+                lambda a: jnp.array(a, copy=True), self.params)
+            clone.state = jax.tree_util.tree_map(
+                lambda a: jnp.array(a, copy=True), self.state)
             clone._build_optimizer()
         return clone
 

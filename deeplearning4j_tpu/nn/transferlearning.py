@@ -148,11 +148,17 @@ class TransferLearningHelper:
         head_conf = dc.replace(network.conf, layers=tuple(head_layers),
                                input_type=body_out)
         self.head = MultiLayerNetwork(head_conf).init()
+        import jax.numpy as jnp
         for i, _ in enumerate(head_layers):
+            # materialized copies: the head's train step donates its
+            # buffers, and aliasing would delete the source network's
+            # parameters out from under it
             self.head.params[str(i)] = jax.tree_util.tree_map(
-                lambda a: a, network.params[str(self._split + i)])
+                lambda a: jnp.array(a, copy=True),
+                network.params[str(self._split + i)])
             self.head.state[str(i)] = jax.tree_util.tree_map(
-                lambda a: a, network.state[str(self._split + i)])
+                lambda a: jnp.array(a, copy=True),
+                network.state[str(self._split + i)])
         self.head._build_optimizer()
 
     def featurize(self, features):
@@ -171,8 +177,12 @@ class TransferLearningHelper:
 
     def unfrozen_network(self) -> MultiLayerNetwork:
         """Write the trained head back into a full network copy."""
+        import jax.numpy as jnp
         net = self.src.copy()
         for i in range(self._split, len(net.layers)):
+            # copies, not aliases: training the returned network donates
+            # its buffers, which must not delete the head's parameters
             net.params[str(i)] = jax.tree_util.tree_map(
-                lambda a: a, self.head.params[str(i - self._split)])
+                lambda a: jnp.array(a, copy=True),
+                self.head.params[str(i - self._split)])
         return net

@@ -133,26 +133,96 @@ class EvaluativeListener(TrainingListener):
 class CheckpointListener(TrainingListener):
     """Periodic checkpoint saver with retention policy
     (DL4J checkpoint/CheckpointListener.java:46-144: saveEveryNIterations /
-    saveEveryNEpochs + keepLast)."""
+    saveEveryNEpochs + keepLast).
+
+    `async_save=True` moves the zip serialization off the training thread
+    (the device array snapshot is taken synchronously — params are copied
+    to host before the step loop continues mutating them — but compression
+    and file IO happen in a background worker, so checkpointing does not
+    stall the accelerator). Call `flush()` (or let the listener be used as
+    a context manager) to wait for pending saves; errors from background
+    saves surface on the next save or flush."""
 
     def __init__(self, directory: str, save_every_n_iterations: Optional[int] = None,
-                 save_every_n_epochs: Optional[int] = None, keep_last: int = 3):
+                 save_every_n_epochs: Optional[int] = None, keep_last: int = 3,
+                 async_save: bool = False):
         self.dir = directory
         self.every_iter = save_every_n_iterations
         self.every_epoch = save_every_n_epochs
         self.keep_last = keep_last
+        self.async_save = async_save
         self._saved: List[str] = []
+        self._executor = None
+        self._pending: List = []
         os.makedirs(directory, exist_ok=True)
 
     def _save(self, model, tag: str):
         from deeplearning4j_tpu.util.serialization import save_model
         path = os.path.join(self.dir, f"checkpoint_{tag}.zip")
-        save_model(model, path)
-        self._saved.append(path)
-        while len(self._saved) > self.keep_last:
-            old = self._saved.pop(0)
-            if os.path.exists(old):
-                os.remove(old)
+        if self.async_save:
+            import concurrent.futures
+
+            import numpy as np
+
+            import jax
+            if self._executor is None:
+                self._executor = concurrent.futures.ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="ckpt")
+            self._raise_pending_errors(block=False)
+            # host snapshot NOW: copy() materializes independent device
+            # buffers (the live ones are donated by the next step), the
+            # counters ride along, and the optimizer state gets its own
+            # forced host copies (np.asarray could alias the soon-donated
+            # originals on CPU backends)
+            snap = model.copy()
+            snap.iteration_count = model.iteration_count
+            snap.epoch_count = model.epoch_count
+            snap.params = jax.tree_util.tree_map(np.asarray, snap.params)
+            snap.state = jax.tree_util.tree_map(np.asarray, snap.state)
+            snap.opt_state = jax.tree_util.tree_map(
+                lambda a: np.array(a, copy=True), model.opt_state)
+
+            def job():
+                save_model(snap, path)
+                # retention runs AFTER the file lands; the single-worker
+                # executor serializes these mutations
+                self._saved.append(path)
+                while len(self._saved) > self.keep_last:
+                    old = self._saved.pop(0)
+                    if os.path.exists(old):
+                        os.remove(old)
+
+            self._pending.append(self._executor.submit(job))
+        else:
+            save_model(model, path)
+            self._saved.append(path)
+            while len(self._saved) > self.keep_last:
+                old = self._saved.pop(0)
+                if os.path.exists(old):
+                    os.remove(old)
+
+    def _raise_pending_errors(self, block: bool):
+        still = []
+        for f in self._pending:
+            if f.done() or block:
+                f.result()          # re-raises background failures
+            else:
+                still.append(f)
+        self._pending = still
+
+    def flush(self):
+        """Block until all background saves land (async_save mode)."""
+        self._raise_pending_errors(block=True)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        try:
+            self.flush()
+        finally:                    # never leak the worker thread
+            if self._executor is not None:
+                self._executor.shutdown(wait=True)
 
     def iteration_done(self, model, iteration, epoch, score, etl_ms=0.0,
                        batch_size=0):

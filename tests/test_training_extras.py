@@ -281,3 +281,91 @@ def test_divergence_listener_raises_on_nan_and_explosion():
     net.set_listeners(DivergenceListener(explosion_factor=10.0, window=3))
     with pytest.raises(TrainingDivergedError):
         net.fit(ArrayDataSetIterator(X, Y, batch_size=16), epochs=50)
+
+
+def test_async_checkpoint_listener(tmp_path):
+    """async_save moves serialization off the training thread; the saved
+    zips restore bit-identically to the synchronous path."""
+    from deeplearning4j_tpu.data.iterator import ArrayDataSetIterator
+    from deeplearning4j_tpu.train import CheckpointListener
+    from deeplearning4j_tpu.util.serialization import load_model
+    rs = np.random.RandomState(0)
+    X = rs.rand(64, 6).astype("float32")
+    Y = np.eye(2, dtype="float32")[rs.randint(0, 2, 64)]
+    conf = (NeuralNetConfiguration.Builder().seed(4).updater(Sgd(1e-2))
+            .list().layer(DenseLayer(n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_out=2))
+            .set_input_type(InputType.feed_forward(6)).build())
+    net = MultiLayerNetwork(conf).init()
+    with CheckpointListener(str(tmp_path), save_every_n_iterations=2,
+                            keep_last=2, async_save=True) as ckpt:
+        net.set_listeners(ckpt)
+        net.fit(ArrayDataSetIterator(X, Y, batch_size=8), epochs=1)
+    # retention: at most keep_last files remain
+    import os
+    files = sorted(f for f in os.listdir(str(tmp_path)) if f.endswith(".zip"))
+    assert 1 <= len(files) <= 2, files
+    restored = load_model(os.path.join(str(tmp_path), files[-1]))
+    assert np.isfinite(float(np.asarray(restored.params_flat()).sum()))
+    # the last checkpoint captured the params at its save iteration, not
+    # the final ones (snapshot semantics) — restoring + refitting works
+    restored.fit(ArrayDataSetIterator(X, Y, batch_size=8), epochs=1)
+    assert np.isfinite(restored.score())
+
+
+def test_computation_graph_copy_independent():
+    from deeplearning4j_tpu.nn.conf.network import GraphBuilder
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    g = (GraphBuilder(NeuralNetConfiguration.Builder().seed(0)
+                      .updater(Sgd(1e-2)))
+         .add_inputs("in").set_input_types(InputType.feed_forward(4)))
+    g.add_layer("d", DenseLayer(n_out=6), "in")
+    g.add_layer("out", OutputLayer(n_out=2), "d")
+    g.set_outputs("out")
+    net = ComputationGraph(g.build()).init()
+    clone = net.copy()
+    rs = np.random.RandomState(0)
+    X = rs.rand(16, 4).astype("float32")
+    np.testing.assert_allclose(np.asarray(clone.output(X)),
+                               np.asarray(net.output(X)), atol=1e-6)
+    from deeplearning4j_tpu.data.dataset import DataSet
+    Y = np.eye(2, dtype="float32")[rs.randint(0, 2, 16)]
+    net.fit(DataSet(X, Y), epochs=3)
+    # clone unaffected by training the original
+    assert not np.allclose(np.asarray(clone.params_flat()),
+                           np.asarray(net.params_flat()))
+
+
+def test_async_checkpoint_preserves_counters_and_head_survives_unfreeze():
+    """Async checkpoints carry iteration/epoch counters (snapshot parity
+    with sync saves), and the transfer-learning head survives training the
+    unfrozen network (no donated-buffer aliasing)."""
+    import os as _os
+    from deeplearning4j_tpu.data.iterator import ArrayDataSetIterator
+    from deeplearning4j_tpu.train import CheckpointListener
+    from deeplearning4j_tpu.util.serialization import load_model
+    rs = np.random.RandomState(1)
+    X = rs.rand(64, 6).astype("float32")
+    Y = np.eye(3, dtype="float32")[rs.randint(0, 3, 64)]
+    conf = _mlp()
+    net = MultiLayerNetwork(conf).init()
+    import tempfile
+    with tempfile.TemporaryDirectory() as td:
+        with CheckpointListener(td, save_every_n_iterations=4,
+                                keep_last=1, async_save=True) as ckpt:
+            net.set_listeners(ckpt)
+            net.fit(ArrayDataSetIterator(X, Y, batch_size=8), epochs=1)
+        files = [f for f in _os.listdir(td) if f.endswith(".zip")]
+        assert len(files) == 1, files
+        restored = load_model(_os.path.join(td, files[0]))
+        assert restored.iteration_count == 4, restored.iteration_count
+
+    src = MultiLayerNetwork(_mlp()).init()
+    helper = TransferLearningHelper(src, frozen_until=1)
+    feats = np.asarray(helper.featurize(X))
+    helper.fit_featurized(feats, Y, epochs=2, batch_size=16)
+    full = helper.unfrozen_network()
+    full.fit((X, Y), epochs=2, batch_size=16)      # donates full's buffers
+    # the head is still alive and usable afterwards
+    out = np.asarray(helper.head.output(feats[:4]))
+    assert np.all(np.isfinite(out))

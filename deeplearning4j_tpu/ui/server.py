@@ -146,7 +146,10 @@ function render(){
     ['model_class','num_params','num_layers','devices'].map(k=>
       `<tr><td>${k}</td><td>${JSON.stringify(si[k])}</td></tr>`).join('')+
     `<tr><td>score (last)</td><td>${last.score.toPrecision(5)}</td></tr>`+
-    `<tr><td>iteration</td><td>${last.iteration}</td></tr></table>`;
+    `<tr><td>iteration</td><td>${last.iteration}</td></tr></table>`+
+    (si.summary?`<pre style="font-size:11px">${String(si.summary)
+      .replace(/&/g,'&amp;').replace(/</g,'&lt;')
+      .replace(/>/g,'&gt;')}</pre>`:'');
 }
 poll();
 </script></body></html>

@@ -180,6 +180,10 @@ class StatsListener(TrainingListener):
             info["config_json"] = model.conf.to_json()
         except Exception:
             info["config_json"] = json.dumps({"error": "unserializable"})
+        try:                      # layer table for the dashboard info card
+            info["summary"] = model.summary()
+        except Exception:
+            pass
         self.router.put_static_info(StatsRecord(
             session_id=self.session_id, type_id=TYPE_ID,
             worker_id=self.worker_id, timestamp=time.time(), data=info))

@@ -38,13 +38,15 @@ class KMeansClustering:
         self.iterations_done = 0
 
     def _init_centers(self, X, rs):
-        """k-means++ seeding."""
+        """k-means++ seeding (pairwise distances via the native host
+        kernel when built — O(N·K) memory instead of numpy's N×K×D
+        broadcast temporary)."""
+        from ..native.ndarray import pairwise_sqdist
         n = len(X)
         centers = [X[rs.randint(n)]]
         for _ in range(1, self.k):
-            d2 = np.min(
-                ((X[:, None, :] - np.asarray(centers)[None]) ** 2).sum(-1),
-                axis=1)
+            d2 = pairwise_sqdist(X, np.asarray(centers)).min(axis=1)
+            d2 = d2.astype(np.float64)   # rs.choice needs probs Σ=1 to 1e-8
             probs = d2 / max(d2.sum(), 1e-12)
             centers.append(X[rs.choice(n, p=probs)])
         return np.asarray(centers, np.float32)

@@ -30,6 +30,15 @@ from deeplearning4j_tpu.data.dataset import DataSet
 from deeplearning4j_tpu.data.iterator import ArrayDataSetIterator
 
 
+def _u8_to_unit(a: np.ndarray) -> np.ndarray:
+    """u8 image bytes -> f32 in [0,1] via the native ETL kernel when
+    built (ndarray_ops.cpp scale_u8_f32), else numpy."""
+    if a.dtype == np.uint8:
+        from deeplearning4j_tpu.native.ndarray import scale_u8
+        return scale_u8(a, 1.0 / 255.0)
+    return a.astype("float32") / 255.0
+
+
 def data_dir() -> str:
     return os.environ.get(
         "DL4J_TPU_DATA_DIR",
@@ -102,7 +111,7 @@ class MnistDataSetIterator(ArrayDataSetIterator):
                     f"no egress; download {cls.URL} files there, or pass "
                     "synthetic=True")
             return _synthetic_images(n_synthetic, 28, 28, 1, 10, seed)
-        images = read_idx(img).astype("float32")[..., None] / 255.0
+        images = _u8_to_unit(read_idx(img))[..., None]
         labels = np.eye(10, dtype="float32")[read_idx(_find(d, lab_name))]
         return images, labels
 
@@ -128,7 +137,7 @@ class EmnistDataSetIterator(ArrayDataSetIterator):
                 raise FileNotFoundError(f"EMNIST not cached under {d}")
             X, Y = _synthetic_images(n_synthetic, 28, 28, 1, k, seed)
         else:
-            X = read_idx(img).astype("float32")[..., None] / 255.0
+            X = _u8_to_unit(read_idx(img))[..., None]
             lab = _find(d, f"emnist-{split}-{t}-labels-idx1-ubyte")
             raw = read_idx(lab).astype(int)
             raw = raw - raw.min()          # letters split is 1-indexed
@@ -161,7 +170,7 @@ class Cifar10DataSetIterator(ArrayDataSetIterator):
                 # stored CHW planar -> NHWC
                 xs.append(raw[:, 1:].reshape(-1, 3, 32, 32)
                           .transpose(0, 2, 3, 1))
-            X = np.concatenate(xs).astype("float32") / 255.0
+            X = _u8_to_unit(np.ascontiguousarray(np.concatenate(xs)))
             Y = np.eye(10, dtype="float32")[np.concatenate(ys)]
         super().__init__(X, Y, batch_size=batch_size)
 

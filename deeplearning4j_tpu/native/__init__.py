@@ -21,7 +21,9 @@ import numpy as np
 
 log = logging.getLogger("deeplearning4j_tpu")
 
-_SRC = os.path.join(os.path.dirname(__file__), "src", "dl4jtpu_native.cpp")
+_SRC_DIR = os.path.join(os.path.dirname(__file__), "src")
+_SRCS = [os.path.join(_SRC_DIR, "dl4jtpu_native.cpp"),
+         os.path.join(_SRC_DIR, "ndarray_ops.cpp")]
 _lib: Optional[ctypes.CDLL] = None
 _build_failed = False
 
@@ -36,13 +38,15 @@ def _cache_dir() -> str:
 
 
 def _build() -> Optional[ctypes.CDLL]:
-    with open(_SRC, "rb") as f:
-        src = f.read()
-    tag = hashlib.sha256(src).hexdigest()[:16]
+    h = hashlib.sha256()
+    for path in _SRCS:
+        with open(path, "rb") as f:
+            h.update(f.read())
+    tag = h.hexdigest()[:16]
     so_path = os.path.join(_cache_dir(), f"dl4jtpu_native-{tag}.so")
     if not os.path.exists(so_path):
         base = ["g++", "-std=c++17", "-O3", "-shared", "-fPIC",
-                "-march=native", _SRC, "-o"]
+                "-march=native", *_SRCS, "-o"]
         tmp = so_path + f".tmp{os.getpid()}"
         for extra in (["-fopenmp"], []):   # OpenMP if present, else serial
             cmd = base[:-1] + extra + ["-o", tmp]
@@ -81,7 +85,46 @@ def _build() -> Optional[ctypes.CDLL]:
     if lib.native_abi_version() != 1:
         log.warning("native ABI mismatch")
         return None
+    _declare_ndarray_ops(lib)
     return lib
+
+
+def _declare_ndarray_ops(lib: ctypes.CDLL) -> None:
+    """ctypes prototypes for the INDArray-contract host kernels
+    (src/ndarray_ops.cpp)."""
+    f32p = ctypes.POINTER(ctypes.c_float)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    i32, i64 = ctypes.c_int32, ctypes.c_int64
+    f32, u64 = ctypes.c_float, ctypes.c_uint64
+    lib.dot_f32.restype = f32
+    lib.dot_f32.argtypes = [f32p, f32p, i64]
+    lib.axpy_f32.restype = None
+    lib.axpy_f32.argtypes = [f32, f32p, f32p, i64]
+    lib.nrm2_f32.restype = f32
+    lib.nrm2_f32.argtypes = [f32p, i64]
+    lib.gemm_f32.restype = None
+    lib.gemm_f32.argtypes = [i32, i32, i64, i64, i64, f32, f32p, f32p,
+                             f32, f32p]
+    lib.transform_f32.restype = None
+    lib.transform_f32.argtypes = [i32, f32p, i64, f32, f32p]
+    lib.binary_f32.restype = None
+    lib.binary_f32.argtypes = [i32, f32p, f32p, i64, f32p]
+    lib.broadcast_row_f32.restype = None
+    lib.broadcast_row_f32.argtypes = [i32, f32p, i64, i64, f32p, f32p]
+    lib.reduce_f32.restype = None
+    lib.reduce_f32.argtypes = [i32, f32p, i64, i64, i32, f32p]
+    lib.im2col_f32.restype = None
+    lib.im2col_f32.argtypes = [f32p] + [i64] * 9 + [f32p]
+    lib.col2im_f32.restype = None
+    lib.col2im_f32.argtypes = [f32p] + [i64] * 9 + [f32p]
+    lib.random_uniform_f32.restype = None
+    lib.random_uniform_f32.argtypes = [u64, i64, f32, f32, f32p]
+    lib.random_gaussian_f32.restype = None
+    lib.random_gaussian_f32.argtypes = [u64, i64, f32, f32, f32p]
+    lib.pairwise_sqdist_f32.restype = None
+    lib.pairwise_sqdist_f32.argtypes = [f32p, i64, f32p, i64, i64, f32p]
+    lib.scale_u8_f32.restype = None
+    lib.scale_u8_f32.argtypes = [u8p, i64, f32, f32, f32p]
 
 
 def get_lib() -> Optional[ctypes.CDLL]:

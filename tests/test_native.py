@@ -138,3 +138,95 @@ def test_ns_table_never_contains_out_of_vocab_ids():
     # and the full native fit survives (would corrupt/segfault before)
     w2v.fit(CollectionSentenceIterator(sents))
     assert np.all(np.isfinite(w2v.vectors))
+
+
+# --------------------------------------------------------------------------
+# INDArray op contract (src/ndarray_ops.cpp + native/ndarray.py): the host
+# half of the surface the reference consumes from libnd4j (SURVEY.md §2.1 —
+# gemm LSTMHelpers.java:212, im2col ConvolutionLayer.java:215, Transforms,
+# reductions, broadcasts, random). Each test is a backend-equivalence
+# check against the numpy oracle.
+
+def test_ndarray_gemm_matches_numpy_all_transposes():
+    from deeplearning4j_tpu.native.ndarray import HostNDArray
+    rs = np.random.RandomState(0)
+    A = rs.randn(37, 23).astype("float32")
+    B = rs.randn(23, 41).astype("float32")
+    ref = A @ B
+    np.testing.assert_allclose(
+        HostNDArray(A).mmul(HostNDArray(B)).numpy(), ref, atol=1e-4)
+    np.testing.assert_allclose(
+        HostNDArray(A.T.copy()).mmul(HostNDArray(B),
+                                     transpose_a=True).numpy(),
+        ref, atol=1e-4)
+    np.testing.assert_allclose(
+        HostNDArray(A).mmul(HostNDArray(B.T.copy()),
+                            transpose_b=True).numpy(),
+        ref, atol=1e-4)
+    np.testing.assert_allclose(
+        HostNDArray(A).mmul(HostNDArray(B), alpha=0.5).numpy(),
+        0.5 * ref, atol=1e-4)
+
+
+def test_ndarray_transforms_reductions_broadcasts():
+    from deeplearning4j_tpu.native.ndarray import HostNDArray
+    rs = np.random.RandomState(1)
+    A = rs.randn(19, 31).astype("float32")
+    a = HostNDArray(A)
+    np.testing.assert_allclose(a.tanh().numpy(), np.tanh(A), atol=1e-6)
+    np.testing.assert_allclose(a.sigmoid().numpy(),
+                               1 / (1 + np.exp(-A)), atol=1e-6)
+    np.testing.assert_allclose(a.relu().numpy(), np.maximum(A, 0),
+                               atol=0)
+    np.testing.assert_allclose((a + 1.5).numpy(), A + 1.5, atol=1e-6)
+    np.testing.assert_allclose((a * a).numpy(), A * A, atol=1e-6)
+    np.testing.assert_allclose(a.sum(axis=1).numpy(), A.sum(1), atol=1e-3)
+    np.testing.assert_allclose(a.mean(axis=0).numpy(), A.mean(0),
+                               atol=1e-4)
+    np.testing.assert_allclose(a.max(axis=1).numpy(), A.max(1), atol=0)
+    assert (a.argmax(axis=1) == A.argmax(1)).all()
+    assert abs(a.norm2() - np.linalg.norm(A)) < 1e-2
+    v = rs.randn(31).astype("float32")
+    np.testing.assert_allclose((a + v).numpy(), A + v, atol=1e-6)
+    np.testing.assert_allclose(a.broadcast_row("div", v).numpy(), A / v,
+                               atol=1e-4)
+    assert abs(float(a.sum()) - float(A.sum())) < 1e-2
+
+
+def test_ndarray_im2col_col2im_adjoint_and_equivalence():
+    from deeplearning4j_tpu.native import ndarray as nd
+    rs = np.random.RandomState(2)
+    img = rs.randn(3, 11, 9).astype("float32")
+    cols = nd.im2col(img, 3, 3, 2, 2, 1, 1)
+    # backend equivalence vs the numpy fallback
+    lib, native._lib = native._lib, None
+    native._build_failed = True
+    try:
+        cols_np = nd.im2col(img, 3, 3, 2, 2, 1, 1)
+    finally:
+        native._lib, native._build_failed = lib, False
+    np.testing.assert_allclose(cols, cols_np, atol=0)
+    # adjoint identity: <im2col(x), y> == <x, col2im(y)>
+    y = rs.randn(*cols.shape).astype("float32")
+    lhs = float((cols * y).sum())
+    rhs = float((img * nd.col2im(y, 3, 11, 9, 3, 3, 2, 2, 1, 1)).sum())
+    assert abs(lhs - rhs) < 1e-2
+
+
+def test_ndarray_random_and_distance_kernels():
+    from deeplearning4j_tpu.native import ndarray as nd
+    r = nd.HostNDArray.randn(20000, seed=7)
+    assert abs(float(r.mean())) < 0.05
+    assert abs(float(np.std(r.numpy())) - 1.0) < 0.05
+    u = nd.HostNDArray.rand(20000, seed=7, lo=-2.0, hi=2.0).numpy()
+    assert u.min() >= -2.0 and u.max() <= 2.0
+    assert abs(u.mean()) < 0.1
+    rs = np.random.RandomState(3)
+    X = rs.randn(64, 17).astype("float32")
+    Q = rs.randn(9, 17).astype("float32")
+    np.testing.assert_allclose(
+        nd.pairwise_sqdist(X, Q),
+        ((X[:, None, :] - Q[None]) ** 2).sum(-1), atol=1e-3)
+    b = rs.randint(0, 256, (13, 28, 28)).astype(np.uint8)
+    np.testing.assert_allclose(nd.scale_u8(b, 1 / 255.0),
+                               b.astype("float32") / 255.0, atol=1e-6)

@@ -117,6 +117,7 @@ class MultiLayerNetwork:
         self._input_types: Optional[List[InputType]] = None
         self._tx = None
         self._train_step = None
+        self._scan_step: Dict[Any, Any] = {}
         self._output_fn = None
 
     # ------------------------------------------------------------ plumbing
@@ -193,6 +194,7 @@ class MultiLayerNetwork:
             self._tx = transforms["__global__"]
         self.opt_state = self._tx.init(self.params)
         self._train_step = None     # force re-trace
+        self._scan_step = {}
 
     # ------------------------------------------------------------- forward
     def _cast_params(self, params):

@@ -192,7 +192,9 @@ class HostNDArray:
             if name == "sub":
                 return self._transform("add_scalar", -float(other))
             if name == "div":
-                return self._transform("mul_scalar", 1.0 / float(other))
+                with np.errstate(divide="ignore"):  # 0 → ±inf, as elementwise
+                    inv = float(np.float64(1.0) / np.float64(other))
+                return self._transform("mul_scalar", inv)
             other = np.full_like(self.data, other)
         b = _as_np(other)
         if b.shape == self.shape:
@@ -227,9 +229,13 @@ class HostNDArray:
     def __add__(self, o): return self._binary("add", o)
     def __radd__(self, o): return self._binary("add", o)
     def __sub__(self, o): return self._binary("sub", o)
+    def __rsub__(self, o): return self.__neg__()._binary("add", o)
     def __mul__(self, o): return self._binary("mul", o)
     def __rmul__(self, o): return self._binary("mul", o)
     def __truediv__(self, o): return self._binary("div", o)
+    def __rtruediv__(self, o):
+        num = np.full_like(self.data, o) if np.isscalar(o) else _as_np(o)
+        return HostNDArray(num)._binary("div", self)
     def __neg__(self): return self._transform("neg")
 
     def maximum(self, o): return self._binary("max", o)
@@ -239,6 +245,8 @@ class HostNDArray:
     def _reduce(self, name: str, axis: Optional[int]) \
             -> Union[float, "HostNDArray"]:
         if axis is None:
+            if name == "argmax" and self.data.size == 0:
+                raise ValueError("argmax of an empty array")
             flat = self.data.reshape(1, -1)
             out = np.empty(1, np.float32)
             if available():
@@ -249,7 +257,13 @@ class HostNDArray:
             return float(out[0])
         if self.data.ndim != 2:
             raise ValueError("axis reductions expect rank 2 (reshape first)")
+        if axis == -1:
+            axis = 1
+        if axis not in (0, 1):
+            raise ValueError(f"axis must be 0, 1 or -1, got {axis}")
         rows, cols = self.shape
+        if name == "argmax" and (cols if axis == 1 else rows) == 0:
+            raise ValueError("argmax of an empty array")
         out = np.empty(rows if axis == 1 else cols, np.float32)
         if available():
             get_lib().reduce_f32(_REDUCE[name], _ptr(self.data), rows,
@@ -294,6 +308,11 @@ def _np_binary(name: str, a, b) -> np.ndarray:
 
 
 def _np_reduce(name: str, x: np.ndarray, axis=None):
+    reduced = x.shape[axis] if axis is not None and x.ndim > 1 else x.size
+    if reduced == 0:    # match native: sum of empty = 0, rest = NaN
+        shape = () if axis is None or x.ndim <= 1 else \
+            (x.shape[1 - axis],)
+        return np.full(shape, 0.0 if name == "sum" else np.nan, np.float32)
     f = {"sum": np.sum, "mean": np.mean, "max": np.max, "min": np.min,
          "argmax": np.argmax, "norm2": lambda v, axis=None:
          np.sqrt(np.sum(np.square(v), axis=axis))}[name]

@@ -17,6 +17,7 @@
 #include <cmath>
 #include <cstdint>
 #include <cstring>
+#include <limits>
 
 #ifdef _OPENMP
 #include <omp.h>
@@ -133,7 +134,7 @@ void transform_f32(int32_t op, const float* x, int64_t n, float arg,
             case 1: v = std::log(v); break;
             case 2: v = std::tanh(v); break;
             case 3: v = 1.0f / (1.0f + std::exp(-v)); break;
-            case 4: v = v > 0.0f ? v : 0.0f; break;
+            case 4: v = std::max(v, 0.0f); break;  // NaN propagates, as numpy
             case 5: v = std::sqrt(v); break;
             case 6: v = std::fabs(v); break;
             case 7: v = -v; break;
@@ -199,6 +200,13 @@ void broadcast_row_f32(int32_t op, const float* x, int64_t rows,
 // axis=1 (per row, out[rows]) or axis=0 (per col, out[cols]).
 void reduce_f32(int32_t op, const float* x, int64_t rows, int64_t cols,
                 int32_t axis, float* out) {
+    if (rows == 0 || cols == 0) {   // empty reduced dim: sum→0, else NaN
+        int64_t n = (axis == 1) ? rows : cols;
+        float fill = (op == 0) ? 0.0f
+                               : std::numeric_limits<float>::quiet_NaN();
+        for (int64_t i = 0; i < n; ++i) out[i] = fill;
+        return;
+    }
     if (axis == 1) {
 #ifdef _OPENMP
 #pragma omp parallel for if (rows * cols > 32768)

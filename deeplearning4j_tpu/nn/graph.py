@@ -13,6 +13,7 @@ across vertices (DL4J walks GraphVertex objects at runtime instead).
 from __future__ import annotations
 
 import logging
+import os
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -27,7 +28,10 @@ from deeplearning4j_tpu.nn.conf.base import (
 )
 from deeplearning4j_tpu.nn.conf.graph_vertices import GraphVertexConf
 from deeplearning4j_tpu.nn.conf.network import ComputationGraphConfiguration
-from deeplearning4j_tpu.nn.multilayer import _as_jnp, _required_kind
+from deeplearning4j_tpu.nn.multilayer import (
+    _as_jnp, _required_kind, _run_scan_pipeline,
+    _scan_incompatible_listeners,
+)
 from deeplearning4j_tpu.nn.updaters import NoOp, build_optimizer
 from deeplearning4j_tpu.util import params as param_util
 
@@ -50,6 +54,7 @@ class ComputationGraph:
         self._vertex_types: Optional[Dict[str, InputType]] = None
         self._tx = None
         self._train_step = None
+        self._scan_step: Dict[Any, Any] = {}
         self._output_fn = None
 
     def set_listeners(self, *listeners):
@@ -141,6 +146,7 @@ class ComputationGraph:
             self._tx = transforms["__global__"]
         self.opt_state = self._tx.init(self.params)
         self._train_step = None
+        self._scan_step = {}
 
     # -------------------------------------------------------------- forward
     def _cast_params(self, params):
@@ -269,6 +275,9 @@ class ComputationGraph:
     # --------------------------------------------------------------- output
     def output(self, *inputs, train: bool = False):
         """Multi-output inference (ComputationGraph.output, :1759-1810)."""
+        if self.params is None:
+            raise RuntimeError(
+                "Network is not initialized — call init() first")
         if self._output_fn is None:
             @jax.jit
             def _out(params, state, inputs):
@@ -338,50 +347,164 @@ class ComputationGraph:
 
         return jax.jit(step, donate_argnums=(0, 1, 2))
 
-    def fit(self, data, epochs: int = 1):
+    def fit(self, data, epochs: int = 1, scan_steps: Optional[int] = None):
         """Train on a MultiDataSet / DataSet / iterator of either
-        (ComputationGraph.fit, :1015)."""
+        (ComputationGraph.fit, :1015).
+
+        scan_steps > 1 fuses that many optimizer steps into one jit via
+        lax.scan with a one-chunk-deferred loss fetch (input-pipelined fit;
+        see MultiLayerNetwork.fit) — bit-identical math/RNG to the per-call
+        path. Default from $DL4J_TPU_SCAN_STEPS or 1."""
         if self.params is None:
             self.init()
         if self._train_step is None:
             self._train_step = self._make_train_step()
+        if scan_steps is None:
+            scan_steps = int(os.environ.get("DL4J_TPU_SCAN_STEPS", "1"))
         rng = jax.random.PRNGKey(self.conf.seed + 331 * (self.epoch_count + 1))
         tbptt = self.conf.backprop_type == "tbptt"
         for _ in range(epochs):
             for lst in self.listeners:
                 lst.on_epoch_start(self, self.epoch_count)
-            etl_start = time.perf_counter()
-            for mds in self._iter_data(data):
-                etl_ms = (time.perf_counter() - etl_start) * 1e3
-                inputs = tuple(_as_jnp(f, self._compute_dtype) for f in mds.features)
-                labels = tuple(_as_jnp(l, self._compute_dtype) for l in mds.labels)
-                fmasks = None if mds.features_masks is None else tuple(
-                    _as_jnp(m) for m in mds.features_masks)
-                lmasks = None if mds.labels_masks is None else tuple(
-                    _as_jnp(m) for m in mds.labels_masks)
-                bs = int(np.shape(mds.features[0])[0])
-                if tbptt:
-                    rng = self._fit_tbptt_batch(inputs, labels, fmasks,
-                                                lmasks, rng, etl_ms, bs)
-                else:
-                    rng, sub = jax.random.split(rng)
-                    (self.params, self.opt_state, self.state, loss,
-                     _) = self._train_step(
-                        self.params, self.opt_state, self.state, inputs,
-                        labels, fmasks, lmasks, sub, None)
-                    self._score = float(loss)
-                    for lst in self.listeners:
-                        lst.iteration_done(self, self.iteration_count,
-                                           self.epoch_count, self._score,
-                                           etl_ms, bs)
-                    self.iteration_count += 1
-                etl_start = time.perf_counter()
+            if not tbptt and scan_steps > 1:
+                rng = self._fit_epoch_scan(data, rng, scan_steps)
+            else:
+                rng = self._fit_epoch_per_call(data, rng, tbptt)
             for lst in self.listeners:
                 lst.on_epoch_end(self, self.epoch_count)
             self.epoch_count += 1
             if hasattr(data, "reset"):
                 data.reset()
         return self
+
+    def _fit_epoch_per_call(self, data, rng, tbptt):
+        etl_start = time.perf_counter()
+        for mds in self._iter_data(data):
+            etl_ms = (time.perf_counter() - etl_start) * 1e3
+            inputs = tuple(_as_jnp(f, self._compute_dtype) for f in mds.features)
+            labels = tuple(_as_jnp(l, self._compute_dtype) for l in mds.labels)
+            fmasks = None if mds.features_masks is None else tuple(
+                _as_jnp(m) for m in mds.features_masks)
+            lmasks = None if mds.labels_masks is None else tuple(
+                _as_jnp(m) for m in mds.labels_masks)
+            bs = int(np.shape(mds.features[0])[0])
+            if tbptt:
+                rng = self._fit_tbptt_batch(inputs, labels, fmasks,
+                                            lmasks, rng, etl_ms, bs)
+            else:
+                rng, sub = jax.random.split(rng)
+                (self.params, self.opt_state, self.state, loss,
+                 _) = self._train_step(
+                    self.params, self.opt_state, self.state, inputs,
+                    labels, fmasks, lmasks, sub, None)
+                self._score = float(loss)
+                for lst in self.listeners:
+                    lst.iteration_done(self, self.iteration_count,
+                                       self.epoch_count, self._score,
+                                       etl_ms, bs)
+                self.iteration_count += 1
+            etl_start = time.perf_counter()
+        return rng
+
+    def _make_scan_step(self):
+        from deeplearning4j_tpu.nn.regularization import (
+            apply_constraints, has_constraints,
+        )
+        tx = self._tx
+        layer_map = {name: vd.vertex for name, vd in self.conf.vertices.items()
+                     if isinstance(vd.vertex, LayerConf)}
+        constrained = has_constraints(layer_map.values())
+
+        def kstep(params, opt_state, state, inputs, labels, fmasks, lmasks,
+                  subs):
+            def body(carry, batch):
+                params, opt_state, state = carry
+                cin, clab, cfm, clm, sub = batch
+                def loss_fn(p):
+                    return self._score_fn(p, state, cin, clab, cfm, clm,
+                                          True, sub, carries=None)
+                (loss, (new_state, _)), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(params)
+                updates, new_opt = tx.update(grads, opt_state, params)
+                new_params = optax.apply_updates(params, updates)
+                if constrained:
+                    new_params = apply_constraints(layer_map, new_params)
+                return (new_params, new_opt, new_state), loss
+
+            (params, opt_state, state), losses = jax.lax.scan(
+                body, (params, opt_state, state),
+                (inputs, labels, fmasks, lmasks, subs))
+            return params, opt_state, state, losses
+
+        return jax.jit(kstep, donate_argnums=(0, 1, 2))
+
+    def _fit_epoch_scan(self, data, rng, K):
+        """Input-pipelined epoch over MultiDataSets: consecutive same-shape
+        batches are stacked and run as one scan-of-K jit; the loss fetch is
+        deferred one chunk so host stacking overlaps device compute. Ragged
+        tails fall back to the per-call step."""
+        if _scan_incompatible_listeners(self.listeners):
+            return self._fit_epoch_per_call(data, rng, False)
+
+        def process(p):
+            losses, bs, etl_ms = p
+            for loss in np.asarray(losses):
+                self._score = float(loss)
+                for lst in self.listeners:
+                    lst.iteration_done(self, self.iteration_count,
+                                       self.epoch_count, self._score,
+                                       etl_ms, bs)
+                self.iteration_count += 1
+                etl_ms = 0.0
+
+        def to_dev(mds):
+            return (tuple(_as_jnp(f, self._compute_dtype) for f in mds.features),
+                    tuple(_as_jnp(l, self._compute_dtype) for l in mds.labels),
+                    None if mds.features_masks is None else tuple(
+                        _as_jnp(m) for m in mds.features_masks),
+                    None if mds.labels_masks is None else tuple(
+                        _as_jnp(m) for m in mds.labels_masks))
+
+        def dispatch(group, etl_ms):
+            nonlocal rng
+            subs = []
+            for _ in group:
+                rng, sub = jax.random.split(rng)
+                subs.append(sub)
+            bs = int(np.shape(group[0].features[0])[0])
+            if len(group) < K:
+                # ragged tail / shape-change remainder: reuse the compiled
+                # per-call step instead of a one-off scan-of-len(group)
+                losses = []
+                for mds, sub in zip(group, subs):
+                    inputs, labels, fmasks, lmasks = to_dev(mds)
+                    (self.params, self.opt_state, self.state, loss,
+                     _) = self._train_step(
+                        self.params, self.opt_state, self.state, inputs,
+                        labels, fmasks, lmasks, sub, None)
+                    losses.append(loss)
+                return (jnp.stack(losses), bs, etl_ms)
+            items = [to_dev(m) for m in group]
+            inputs, labels, fmasks, lmasks = jax.tree_util.tree_map(
+                lambda *xs: jnp.stack(xs), *items)
+            sig = (len(group), fmasks is not None, lmasks is not None)
+            if sig not in self._scan_step:
+                self._scan_step[sig] = self._make_scan_step()
+            (self.params, self.opt_state, self.state,
+             losses) = self._scan_step[sig](
+                self.params, self.opt_state, self.state, inputs, labels,
+                fmasks, lmasks, jnp.stack(subs))
+            return (losses, bs, etl_ms)
+
+        def sig_of(mds):
+            shapes = lambda t: None if t is None else tuple(
+                np.shape(a) for a in t)
+            return (shapes(mds.features), shapes(mds.labels),
+                    shapes(mds.features_masks), shapes(mds.labels_masks))
+
+        _run_scan_pipeline(self._iter_data(data), sig_of, dispatch, process,
+                           K)
+        return rng
 
     def _fit_tbptt_batch(self, inputs, labels, fmasks, lmasks, rng, etl_ms,
                          bs):

@@ -20,6 +20,7 @@ DL4J's flattenedParams single buffer (:114,603-627).
 from __future__ import annotations
 
 import logging
+import os
 import time
 from typing import Any, Dict, List, Optional
 
@@ -64,6 +65,52 @@ _KIND_BY_CLASS = {
 }
 
 _RECURRENT_CLASSES = {"LSTM", "GravesLSTM", "SimpleRnn", "GRU"}
+
+
+def _scan_incompatible_listeners(listeners) -> bool:
+    """Listeners that inspect the model (params/opt state) or capture
+    gradients need iteration_done in lockstep with the params — the
+    pipelined scan fit delivers it up to 2K-1 steps late, so their
+    presence forces the per-call path."""
+    return any(getattr(lst, "wants_gradients", False)
+               or getattr(lst, "reads_model", False)
+               for lst in listeners)
+
+
+def _run_scan_pipeline(batches, sig_of, dispatch, process, K):
+    """Shared chunking/deferral loop of the input-pipelined fit paths
+    (MultiLayerNetwork._fit_epoch_scan, ComputationGraph._fit_epoch_scan).
+
+    Groups consecutive batches with identical shape signature `sig_of(b)`
+    into chunks of at most K, calls `dispatch(group, etl_ms)` for each
+    chunk (returning an opaque pending record whose device values are still
+    futures), and calls `process(pending)` for chunk i only AFTER chunk
+    i+1 has been dispatched — so the host-side stacking and dispatch of the
+    next chunk overlaps the device compute of the current one, and the one
+    blocking loss fetch per chunk happens while the device is busy."""
+    pending = None
+    group, gsig = [], None
+    etl_start = time.perf_counter()
+
+    def flush():
+        nonlocal pending, group, etl_start
+        etl_ms = (time.perf_counter() - etl_start) * 1e3
+        fresh = dispatch(group, etl_ms)
+        if pending is not None:
+            process(pending)
+        pending = fresh
+        group, etl_start = [], time.perf_counter()
+
+    for b in batches:
+        s = sig_of(b)
+        if group and (s != gsig or len(group) == K):
+            flush()
+        group.append(b)
+        gsig = s
+    if group:
+        flush()
+    if pending is not None:
+        process(pending)
 
 
 def _required_kind(layer: LayerConf) -> Optional[Kind]:
@@ -353,17 +400,30 @@ class MultiLayerNetwork:
             self._train_step[sig] = self._make_train_step(*sig)
         return self._train_step[sig]
 
-    def fit(self, data, epochs: int = 1, batch_size: int = 32):
+    def fit(self, data, epochs: int = 1, batch_size: int = 32,
+            scan_steps: Optional[int] = None):
         """Train (DL4J fit(DataSetIterator), :1268). Accepts a DataSetIterator,
-        a DataSet, or (features, labels) arrays."""
+        a DataSet, or (features, labels) arrays.
+
+        scan_steps > 1 fuses that many optimizer steps into ONE jit call via
+        lax.scan (input-pipelined fit): batches are stacked host-side while
+        the previous chunk computes on device, and the per-step loss fetch is
+        deferred one chunk, so the dispatch pipeline never blocks on a
+        device→host sync. The RNG stream, update math and listener calls are
+        identical to the per-call path (bit-for-bit, tested) — only the
+        host/device overlap changes. Default from $DL4J_TPU_SCAN_STEPS or 1."""
         if self.params is None:
             self.init()
+        if scan_steps is None:
+            scan_steps = int(os.environ.get("DL4J_TPU_SCAN_STEPS", "1"))
         iterator = self._as_iterator(data, batch_size)
         for _ in range(epochs):
             for lst in self.listeners:
                 lst.on_epoch_start(self, self.epoch_count)
             if self.conf.backprop_type == "tbptt":
                 self._fit_epoch_tbptt(iterator)
+            elif scan_steps > 1:
+                self._fit_epoch_scan(iterator, scan_steps)
             else:
                 self._fit_epoch(iterator)
             for lst in self.listeners:
@@ -467,6 +527,112 @@ class MultiLayerNetwork:
                                    self.epoch_count, self._score, etl_ms, bs)
             self.iteration_count += 1
             etl_start = time.perf_counter()
+
+    def _make_scan_step(self, with_fmask, with_lmask, K):
+        """K optimizer steps fused into one jit via lax.scan. Same math as
+        _make_train_step applied K times; returns the K per-step losses as a
+        device array so the host never syncs inside the chunk."""
+        from deeplearning4j_tpu.nn.regularization import (
+            apply_constraints, has_constraints,
+        )
+        tx = self._tx
+        constrained = has_constraints(self.layers)
+        layer_map = {str(i): l for i, l in enumerate(self.layers)}
+
+        def kstep(params, opt_state, state, xs, ys, fms, lms, subs):
+            def body(carry, batch):
+                params, opt_state, state = carry
+                x, y, fm, lm, sub = batch
+                def loss_fn(p):
+                    return self._score_fn(p, state, x, y, fm, lm, True, sub,
+                                          carries=None)
+                (loss, (new_state, _)), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(params)
+                updates, new_opt = tx.update(grads, opt_state, params)
+                new_params = optax.apply_updates(params, updates)
+                if constrained:
+                    new_params = apply_constraints(layer_map, new_params)
+                return (new_params, new_opt, new_state), loss
+
+            (params, opt_state, state), losses = jax.lax.scan(
+                body, (params, opt_state, state), (xs, ys, fms, lms, subs))
+            return params, opt_state, state, losses
+
+        return jax.jit(kstep, donate_argnums=(0, 1, 2))
+
+    def _get_scan_step(self, fmask, lmask, K):
+        sig = (fmask is not None, lmask is not None, K)
+        if sig not in self._scan_step:
+            self._scan_step[sig] = self._make_scan_step(*sig)
+        return self._scan_step[sig]
+
+    def _fit_epoch_scan(self, iterator, K):
+        """Input-pipelined epoch: group consecutive same-shape batches into
+        chunks of K, stack host-side, run one scan-of-K jit per chunk, and
+        defer the loss fetch by one chunk so stacking/dispatch of chunk i+1
+        overlaps chunk i's device compute. Ragged tails (or a shape change
+        mid-epoch) fall back to per-call steps for those batches."""
+        if _scan_incompatible_listeners(self.listeners):
+            return self._fit_epoch(iterator)
+        rng = jax.random.PRNGKey(self.conf.seed + 7919 * (self.epoch_count + 1))
+
+        def process(p):
+            losses, bs, etl_ms = p
+            for loss in np.asarray(losses):     # single blocking fetch/chunk
+                self._score = float(loss)
+                for lst in self.listeners:
+                    lst.iteration_done(self, self.iteration_count,
+                                       self.epoch_count, self._score,
+                                       etl_ms, bs)
+                self.iteration_count += 1
+                etl_ms = 0.0
+
+        def dispatch(group, etl_ms):
+            nonlocal rng
+            subs = []
+            for _ in group:
+                rng, sub = jax.random.split(rng)
+                subs.append(sub)
+            ds0 = group[0]
+            if len(group) < K:
+                # ragged tail / shape-change remainder: reuse the already
+                # compiled per-call step rather than compiling a one-off
+                # scan-of-len(group) program
+                step = self._get_train_step(ds0.features_mask,
+                                            ds0.labels_mask, None)
+                losses = []
+                for ds, sub in zip(group, subs):
+                    out = step(self.params, self.opt_state, self.state,
+                               _as_jnp(ds.features, self._compute_dtype),
+                               _as_jnp(ds.labels, self._compute_dtype),
+                               _as_jnp(ds.features_mask),
+                               _as_jnp(ds.labels_mask), sub, None)
+                    self.params, self.opt_state, self.state, loss, _ = out
+                    losses.append(loss)
+                losses = jnp.stack(losses)
+            else:
+                stack = lambda get, dt=None: (
+                    None if get(ds0) is None else
+                    _as_jnp(np.stack([np.asarray(get(d)) for d in group]),
+                            dt))
+                xs = stack(lambda d: d.features, self._compute_dtype)
+                ys = stack(lambda d: d.labels, self._compute_dtype)
+                fms = stack(lambda d: d.features_mask)
+                lms = stack(lambda d: d.labels_mask)
+                kstep = self._get_scan_step(fms, lms, len(group))
+                (self.params, self.opt_state, self.state,
+                 losses) = kstep(self.params, self.opt_state, self.state,
+                                 xs, ys, fms, lms, jnp.stack(subs))
+            return losses, int(np.shape(ds0.features)[0]), etl_ms
+
+        def sig_of(ds):
+            return (np.shape(ds.features), np.shape(ds.labels),
+                    None if ds.features_mask is None
+                    else np.shape(ds.features_mask),
+                    None if ds.labels_mask is None
+                    else np.shape(ds.labels_mask))
+
+        _run_scan_pipeline(iterator, sig_of, dispatch, process, K)
 
     def _fit_epoch_tbptt(self, iterator):
         """Truncated BPTT: chunk the time axis, carry RNN state across chunks,

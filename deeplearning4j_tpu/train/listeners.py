@@ -22,6 +22,14 @@ log = logging.getLogger("deeplearning4j_tpu")
 
 
 class TrainingListener:
+    #: True for listeners whose iteration_done inspects the MODEL (params,
+    #: opt state) rather than just the scalar score stream. The
+    #: input-pipelined fit path (fit(scan_steps=K)) delivers iteration_done
+    #: up to 2K-1 steps after the params have advanced, so such listeners
+    #: force a fallback to the per-call path where model state and
+    #: iteration number are always in sync.
+    reads_model = False
+
     def on_epoch_start(self, model, epoch: int):
         pass
 
@@ -109,6 +117,8 @@ class TimeIterationListener(TrainingListener):
 class EvaluativeListener(TrainingListener):
     """Periodic evaluation on a held-out iterator (DL4J EvaluativeListener)."""
 
+    reads_model = True
+
     def __init__(self, iterator, frequency: int = 1, unit: str = "epoch"):
         self.iterator = iterator
         self.frequency = max(int(frequency), 1)
@@ -142,6 +152,8 @@ class CheckpointListener(TrainingListener):
     stall the accelerator). Call `flush()` (or let the listener be used as
     a context manager) to wait for pending saves; errors from background
     saves surface on the next save or flush."""
+
+    reads_model = True      # snapshots params: scan-mode fit falls back
 
     def __init__(self, directory: str, save_every_n_iterations: Optional[int] = None,
                  save_every_n_epochs: Optional[int] = None, keep_last: int = 3,
@@ -247,6 +259,8 @@ class ProfilerListener(TrainingListener):
         # inspect with tensorboard or xprof on the written trace dir
     """
 
+    reads_model = True      # brackets live device work: needs per-call fit
+
     def __init__(self, log_dir: str, start_iteration: int = 5,
                  num_iterations: int = 3):
         self.log_dir = log_dir
@@ -293,6 +307,9 @@ class DivergenceListener(TrainingListener):
         self.explosion_factor = explosion_factor
         self.window = window
         self.on_divergence = on_divergence
+        # a custom callback receives the model; the default raise path only
+        # reads the score stream and stays scan-compatible
+        self.reads_model = on_divergence is not None
         self._recent: List[float] = []
 
     def iteration_done(self, model, iteration, epoch, score, etl_ms,

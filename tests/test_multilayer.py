@@ -313,3 +313,58 @@ def test_summary_tables():
     sg = gn.summary()
     assert "res" in sg and "ElementWiseVertex" in sg
     assert f"{gn.num_params():,}" in sg
+
+
+class TestScanFit:
+    """Input-pipelined fit (scan_steps>1) must be bit-identical to the
+    per-call path: same RNG stream, same update math, same listener calls."""
+
+    def test_scan_fit_matches_per_call_bitwise(self):
+        X, Y = make_blobs(n=250)        # 250/64 -> ragged tail batch of 58
+        a = MultiLayerNetwork(mlp_conf()).init()
+        b = MultiLayerNetwork(mlp_conf()).init()
+        sa, sb = CollectScoresIterationListener(), CollectScoresIterationListener()
+        a.set_listeners(sa)
+        b.set_listeners(sb)
+        a.fit(ArrayDataSetIterator(X, Y, batch_size=64), epochs=3)
+        b.fit(ArrayDataSetIterator(X, Y, batch_size=64), epochs=3,
+              scan_steps=3)
+        assert a.iteration_count == b.iteration_count
+        np.testing.assert_array_equal(
+            np.array([s for _, s in sa.scores]),
+            np.array([s for _, s in sb.scores]))
+        for k in a.params:
+            for pk in a.params[k]:
+                np.testing.assert_array_equal(
+                    np.asarray(a.params[k][pk]), np.asarray(b.params[k][pk]),
+                    err_msg=f"{k}/{pk}")
+
+    def test_scan_fit_with_dropout_and_env_default(self, monkeypatch):
+        X, Y = make_blobs(n=128)
+        conf = (NeuralNetConfiguration.Builder().seed(7).updater(Sgd(1e-2))
+                .list()
+                .layer(DenseLayer(n_out=16, activation="relu", dropout=0.5))
+                .layer(OutputLayer(n_out=3, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(4)).build())
+        a = MultiLayerNetwork(conf).init()
+        b = MultiLayerNetwork(conf).init()
+        a.fit(ArrayDataSetIterator(X, Y, batch_size=32), epochs=2)
+        monkeypatch.setenv("DL4J_TPU_SCAN_STEPS", "4")
+        b.fit(ArrayDataSetIterator(X, Y, batch_size=32), epochs=2)
+        for k in a.params:
+            for pk in a.params[k]:
+                np.testing.assert_array_equal(
+                    np.asarray(a.params[k][pk]), np.asarray(b.params[k][pk]))
+
+    def test_scan_fit_falls_back_for_model_reading_listeners(self, tmp_path):
+        from deeplearning4j_tpu.train.listeners import CheckpointListener
+        X, Y = make_blobs(n=128)
+        net = MultiLayerNetwork(mlp_conf()).init()
+        ckpt = CheckpointListener(str(tmp_path), save_every_n_iterations=2)
+        net.set_listeners(ckpt)
+        net.fit(ArrayDataSetIterator(X, Y, batch_size=32), epochs=1,
+                scan_steps=4)
+        # per-call fallback: checkpoints reflect the exact iteration params
+        assert len(ckpt._saved) >= 1
+        assert net.iteration_count == 3   # 126 samples, drop_last batching

@@ -230,3 +230,60 @@ def test_ndarray_random_and_distance_kernels():
     b = rs.randint(0, 256, (13, 28, 28)).astype(np.uint8)
     np.testing.assert_allclose(nd.scale_u8(b, 1 / 255.0),
                                b.astype("float32") / 255.0, atol=1e-6)
+
+
+def test_ndarray_edge_semantics_match_across_backends():
+    """Backend-divergence regressions (advisor r3): NaN relu, empty
+    reductions, reflected scalar ops, axis validation, div-by-zero."""
+    from deeplearning4j_tpu.native import ndarray as nd
+
+    def both(fn):
+        out_native = fn()
+        lib, failed = native._lib, native._build_failed
+        native._lib, native._build_failed = None, True
+        try:
+            out_numpy = fn()
+        finally:
+            native._lib, native._build_failed = lib, failed
+        return out_native, out_numpy
+
+    # relu(NaN) propagates NaN on both backends
+    x = nd.HostNDArray(np.array([1.0, -2.0, np.nan], np.float32))
+    a, b = both(lambda: nd.HostNDArray(
+        np.array([1.0, -2.0, np.nan], np.float32)).relu().numpy())
+    np.testing.assert_array_equal(np.isnan(a), np.isnan(b))
+    np.testing.assert_allclose(a[:2], [1.0, 0.0])
+    assert np.isnan(a[2])
+
+    # empty reductions: sum -> 0, mean/max -> NaN, both backends
+    empty = lambda: nd.HostNDArray(np.empty((0,), np.float32))
+    for name, want_nan in [("sum", False), ("mean", True), ("max", True)]:
+        a, b = both(lambda n=name: getattr(empty(), n)())
+        if want_nan:
+            assert np.isnan(a) and np.isnan(b)
+        else:
+            assert a == 0.0 and b == 0.0
+
+    # axis normalization and validation
+    m = nd.HostNDArray(np.arange(6, dtype=np.float32).reshape(2, 3))
+    np.testing.assert_allclose(m.sum(axis=-1).numpy(),
+                               m.sum(axis=1).numpy())
+    with pytest.raises(ValueError):
+        m.sum(axis=2)
+
+    # reflected scalar ops
+    np.testing.assert_allclose((10.0 - m).numpy(), 10.0 - m.numpy())
+    np.testing.assert_allclose((6.0 / (m + 1.0)).numpy(),
+                               6.0 / (m.numpy() + 1.0), rtol=1e-6)
+    # scalar division by zero -> inf, not an exception
+    assert np.isposinf((m + 1.0).__truediv__(0.0).numpy()).all()
+
+
+def test_ndarray_argmax_empty_raises_and_rdiv_exact():
+    from deeplearning4j_tpu.native import ndarray as nd
+    with pytest.raises(ValueError):
+        nd.HostNDArray(np.empty((0, 5), np.float32)).argmax(axis=0)
+    # reflected division is exact elementwise division, not reciprocal*mul
+    x = nd.HostNDArray(np.array([1e-40, 2.0], np.float32))
+    out = (1e-5 / x).numpy()
+    assert np.isfinite(out[0]) and out[0] == np.float32(1e-5) / np.float32(1e-40)

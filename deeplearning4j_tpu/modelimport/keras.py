@@ -113,14 +113,23 @@ class KerasModelImport:
         return net
 
 
-def _layer_weights(root, layer_name: str) -> List[np.ndarray]:
+class _WeightList(list):
+    """Weights plus their h5 paths — wrapper mappers (Bidirectional) need
+    the names to tell the forward/backward halves apart, since Keras 2
+    lists forward first while h5 alphabetical iteration yields backward
+    first."""
+    names: List[str]
+
+
+def _layer_weights(root, layer_name: str) -> "_WeightList":
     """Datasets for one layer, in weight_names order (Keras 2) or h5
     iteration order of the nested group (Keras 3)."""
+    out = _WeightList()
+    out.names = []
     if layer_name not in root:
-        return []
+        return out
     g = root[layer_name]
     names = g.attrs.get("weight_names")
-    out = []
     if names is not None:
         for n in names:
             if isinstance(n, bytes):
@@ -137,6 +146,7 @@ def _layer_weights(root, layer_name: str) -> List[np.ndarray]:
             if node is None:
                 node = _find_dataset(g, n.split("/")[-1])
             out.append(np.asarray(node))
+            out.names.append(n)
         return out
     _collect_datasets(g, out)
     return out
@@ -155,13 +165,15 @@ def _find_dataset(g, name):
     return found[0]
 
 
-def _collect_datasets(g, out):
+def _collect_datasets(g, out, prefix=""):
     for k in g:
         obj = g[k]
         if getattr(obj, "shape", None) is not None:
             out.append(np.asarray(obj))
+            if hasattr(out, "names"):
+                out.names.append(prefix + k)
         else:
-            _collect_datasets(obj, out)
+            _collect_datasets(obj, out, prefix + k + "/")
 
 
 # --------------------------------------------------------------- conf build
@@ -243,9 +255,10 @@ def _build_sequential(cfg: dict, updater=None):
     importers: List[Tuple[Optional[str], Any]] = []
     n_real = sum(1 for lc in layers_cfg
                  if lc["class_name"] not in ("InputLayer", "Flatten",
-                                             "Dropout"))
+                                             "Dropout", "Masking"))
     seen_real = 0
     cur_seq = False        # is the running activation a (B, T, F) sequence?
+    pending_mask = None    # Keras Masking wraps the NEXT RNN layer
     for lc in layers_cfg:
         k_cls = lc["class_name"]
         k_cfg = lc.get("config", {})
@@ -264,15 +277,37 @@ def _build_sequential(cfg: dict, updater=None):
         if k_cls == "Flatten":
             cur_seq = False     # auto preprocessor handles CNN/RNN->FF
             continue
+        if k_cls == "Masking":
+            # Keras Masking emits a mask that propagates to EVERY
+            # downstream RNN until the sequence collapses. Mapping: wrap
+            # each subsequent recurrent layer in MaskZeroLayer — the first
+            # with the configured mask_value, later ones with 0.0 (masked
+            # steps emit exact zeros, so the mask re-derives).
+            pending_mask = float(k_cfg.get("mask_value", 0.0))
+            continue
         is_last_real = False
         if k_cls not in ("Dropout",):
             seen_real += 1
             is_last_real = seen_real == n_real
         layer, loader = _map_layer(k_cls, k_cfg, is_last_real,
                                    sequence=cur_seq)
-        cur_seq = _sequence_after(k_cls, cur_seq)
+        cur_seq = _sequence_after(k_cls, cur_seq, k_cfg)
         if layer is None:
             continue
+        if pending_mask is not None and _recurrent_capable(layer):
+            layer = _wrap_mask_zero(layer, pending_mask, k_cls)
+            pending_mask = 0.0      # downstream masked steps are zeroed
+        elif pending_mask is not None and k_cls not in _MASK_TRANSPARENT:
+            # layer transforms values (e.g. Dense bias), so masked steps
+            # are no longer re-derivable from zeros — silent divergence
+            # from Keras; refuse loudly (pass features_mask at fit/output
+            # time instead of relying on an in-graph Masking layer)
+            raise ValueError(
+                f"Keras Masking cannot propagate through '{k_cls}': masked "
+                "steps would stop being exact zeros. Remove the Masking "
+                "layer and supply features_mask explicitly instead.")
+        if not cur_seq:
+            pending_mask = None     # mask consumed / sequence collapsed
         b.layer(layer)
         importers.append((name if loader else None, loader))
     if input_type is None:
@@ -288,6 +323,31 @@ def _build_sequential(cfg: dict, updater=None):
             continue
         bound.append((name, _bind_mln_loader(loader, i)))
     return net, bound
+
+
+# Keras classes whose mapped layer is purely multiplicative on values
+# (identity at inference), so exact-zero masked steps stay exact zeros and
+# a chained MaskZeroLayer re-derives the same mask
+_MASK_TRANSPARENT = frozenset({
+    "Dropout", "SpatialDropout1D", "SpatialDropout2D", "GaussianDropout",
+})
+
+
+def _recurrent_capable(layer) -> bool:
+    from deeplearning4j_tpu.nn.layers import Bidirectional, LastTimeStep
+    return (hasattr(layer, "apply_seq")
+            or isinstance(layer, (Bidirectional, LastTimeStep)))
+
+
+def _wrap_mask_zero(layer, mask_value: float, k_cls: str):
+    """Wrap a recurrent layer downstream of a Keras Masking in
+    MaskZeroLayer (the KerasMasking -> MaskZeroLayer mapping)."""
+    from deeplearning4j_tpu.nn.layers import MaskZeroLayer
+    if not _recurrent_capable(layer):
+        raise ValueError(
+            f"Keras Masking must be followed by a recurrent layer; got "
+            f"'{k_cls}'")
+    return MaskZeroLayer(layer=layer, mask_value=mask_value)
 
 
 def _bind_mln_loader(loader, index):
@@ -313,13 +373,14 @@ def _build_functional(cfg: dict, updater=None):
     importers = []
     out_names = _io_names(cfg.get("output_layers", []))
     flatten_alias: Dict[str, str] = {}
+    mask_pending: Dict[str, float] = {}   # Masking node -> mask_value
     seq_of: Dict[str, bool] = {}
     for lc in cfg["layers"]:
         k_cls = lc["class_name"]
         k_cfg = lc.get("config", {})
         name = k_cfg.get("name", lc.get("name"))
-        inbound = _inbound_names(lc)
-        inbound = [flatten_alias.get(n, n) for n in inbound]
+        raw_inbound = _inbound_names(lc)
+        inbound = [flatten_alias.get(n, n) for n in raw_inbound]
         if k_cls == "InputLayer":
             shape = k_cfg.get("batch_shape") or k_cfg.get(
                 "batch_input_shape")
@@ -333,8 +394,25 @@ def _build_functional(cfg: dict, updater=None):
             flatten_alias[name] = inbound[0]   # auto preprocessor
             seq_of[name] = False
             continue
+        if k_cls == "Masking":
+            # alias through; consumers get wrapped in MaskZeroLayer
+            flatten_alias[name] = inbound[0]
+            mask_pending[name] = float(k_cfg.get("mask_value", 0.0))
+            seq_of[name] = in_seq
+            continue
+        if k_cls in ("NotEqual", "Any"):
+            # Keras 3 materializes Masking's mask as NotEqual -> Any op
+            # nodes feeding downstream `mask` kwargs (which _inbound_names
+            # ignores); the Masking node itself carries the semantics
+            continue
+        carried = next((mask_pending[n] for n in raw_inbound
+                        if n in mask_pending), None)
         if k_cls in ("Add", "Concatenate", "Average", "Maximum",
                      "Subtract", "Multiply"):
+            if carried is not None:
+                raise ValueError(
+                    f"Keras Masking cannot propagate through a '{k_cls}' "
+                    "merge; supply features_mask explicitly instead.")
             vertex = MergeVertex() if k_cls == "Concatenate" else \
                 ElementWiseVertex(op={"Add": "add", "Subtract": "subtract",
                                       "Multiply": "product",
@@ -345,10 +423,24 @@ def _build_functional(cfg: dict, updater=None):
             continue
         layer, loader = _map_layer(k_cls, k_cfg, name in out_names,
                                    sequence=in_seq)
-        seq_of[name] = _sequence_after(k_cls, in_seq)
+        seq_of[name] = _sequence_after(k_cls, in_seq, k_cfg)
         if layer is None:
             flatten_alias[name] = inbound[0]
+            if carried is not None:
+                mask_pending[name] = carried
             continue
+        if carried is not None:
+            if _recurrent_capable(layer):
+                layer = _wrap_mask_zero(layer, carried, k_cls)
+                if seq_of[name]:    # masked steps now exact zeros
+                    mask_pending[name] = 0.0
+            elif k_cls in _MASK_TRANSPARENT:
+                mask_pending[name] = carried    # zero-preserving passthrough
+            else:
+                raise ValueError(
+                    f"Keras Masking cannot propagate through '{k_cls}': "
+                    "masked steps would stop being exact zeros. Supply "
+                    "features_mask explicitly instead.")
         g.add_layer(name, layer, *inbound)
         if loader:
             importers.append((name, _bind_graph_loader(loader, name)))
@@ -398,20 +490,30 @@ def _inbound_names(lc) -> List[str]:
     return out
 
 
-def _sequence_after(k_cls: str, cur_seq: bool) -> bool:
+def _sequence_after(k_cls: str, cur_seq: bool, k_cfg: dict = None) -> bool:
     """Does the activation remain/become a (B, T, F) sequence after this
     layer? LSTM/GRU/Embedding emit sequences; pooling/Dense/conv leave
-    them."""
-    if k_cls in ("LSTM", "GRU", "Embedding"):
+    them. RNN layers with return_sequences=False collapse to (B, F)."""
+    k_cfg = k_cfg or {}
+    if k_cls in ("LSTM", "GRU", "SimpleRNN"):
+        return bool(k_cfg.get("return_sequences", False))
+    if k_cls == "Bidirectional":
+        inner = k_cfg.get("layer", {}).get("config", {})
+        return bool(inner.get("return_sequences", False))
+    if k_cls in ("Embedding", "RepeatVector"):
         return True
     if k_cls in ("GlobalAveragePooling1D", "GlobalMaxPooling1D",
                  "Flatten"):
         return False
-    if k_cls in ("Conv1D", "MaxPooling1D", "AveragePooling1D"):
-        return cur_seq          # 1D conv/pool keep (B, T, C) sequences
+    if k_cls in ("Conv1D", "MaxPooling1D", "AveragePooling1D",
+                 "Cropping1D", "UpSampling1D", "ZeroPadding1D",
+                 "LocallyConnected1D", "Masking"):
+        return cur_seq          # 1D conv/pool/pad keep (B, T, C) sequences
     if k_cls in ("Dropout", "Activation", "BatchNormalization",
                  "LayerNormalization", "Dense", "TimeDistributed",
-                 "LeakyReLU", "ELU", "ReLU", "Softmax"):
+                 "LeakyReLU", "ELU", "ReLU", "Softmax", "Permute",
+                 "SpatialDropout1D", "SpatialDropout2D", "GaussianNoise",
+                 "GaussianDropout", "AlphaDropout"):
         return cur_seq          # Keras Dense on 3D is time-distributed
     return False
 
@@ -422,11 +524,14 @@ def _map_layer(k_cls: str, k_cfg: dict, is_output: bool,
     """Returns (LayerConf | None, loader | None). loader(params, state,
     weights) copies Keras weights into our pytrees."""
     from deeplearning4j_tpu.nn.layers import (
-        GRU, ActivationLayer, BatchNormalization, ConvolutionLayer,
-        Cropping2D, Deconvolution2D, DenseLayer, DepthwiseConvolution2D,
-        DropoutLayer, EmbeddingSequenceLayer, GlobalPoolingLayer,
-        LayerNormLayer, LSTM, OutputLayer, RnnOutputLayer,
-        SeparableConvolution2D, SubsamplingLayer, ZeroPaddingLayer,
+        GRU, ActivationLayer, BatchNormalization, Bidirectional,
+        ConvolutionLayer, Cropping1D, Cropping2D, Deconvolution2D,
+        DenseLayer, DepthwiseConvolution2D, DropoutLayer,
+        EmbeddingSequenceLayer, GlobalPoolingLayer, LastTimeStep,
+        LayerNormLayer, LocallyConnected1D, LocallyConnected2D, LSTM,
+        OutputLayer, PermuteLayer, RepeatVector, RnnOutputLayer,
+        SeparableConvolution2D, SimpleRnn, SubsamplingLayer, Upsampling1D,
+        ZeroPadding1DLayer, ZeroPaddingLayer,
     )
     import jax.numpy as jnp
 
@@ -515,11 +620,6 @@ def _map_layer(k_cls: str, k_cfg: dict, is_output: bool,
             n_in=int(k_cfg["input_dim"])), load_emb
 
     if k_cls == "LSTM":
-        if not k_cfg.get("return_sequences", False):
-            raise ValueError(
-                "LSTM with return_sequences=False is unsupported; add it "
-                "as LSTM(return_sequences=True) + LastTimeStep semantics")
-
         def load_lstm(params, state, w):
             # Keras: kernel (in, 4H), recurrent_kernel (H, 4H), bias (4H)
             # gate order i,f,c,o == ours i,f,g,o — verbatim copy
@@ -527,16 +627,59 @@ def _map_layer(k_cls: str, k_cfg: dict, is_output: bool,
             params["R"] = jnp.asarray(w[1])
             if len(w) > 2:
                 params["b"] = jnp.asarray(w[2])
-        return LSTM(
+        layer = LSTM(
             n_out=int(k_cfg["units"]),
             activation=_act(k_cfg.get("activation", "tanh")),
             gate_activation=_act(
-                k_cfg.get("recurrent_activation", "sigmoid"))), load_lstm
+                k_cfg.get("recurrent_activation", "sigmoid")))
+        if not k_cfg.get("return_sequences", False):
+            # KerasLstm.java:212 — return_sequences=False == LastTimeStep
+            layer = LastTimeStep(layer=layer)
+        return layer, load_lstm
+
+    if k_cls == "SimpleRNN":
+        def load_rnn(params, state, w):
+            # Keras: kernel (in, H), recurrent_kernel (H, H), bias (H)
+            params["W"] = jnp.asarray(w[0])
+            params["R"] = jnp.asarray(w[1])
+            if len(w) > 2:
+                params["b"] = jnp.asarray(w[2])
+        layer = SimpleRnn(n_out=int(k_cfg["units"]),
+                          activation=_act(k_cfg.get("activation", "tanh")))
+        if not k_cfg.get("return_sequences", False):
+            layer = LastTimeStep(layer=layer)
+        return layer, load_rnn
+
+    if k_cls == "Bidirectional":
+        inner = k_cfg.get("layer") or {}
+        inner_cls = inner.get("class_name")
+        inner_cfg = dict(inner.get("config", {}))
+        if inner_cls not in ("LSTM", "GRU", "SimpleRNN"):
+            raise ValueError(f"Bidirectional: unsupported inner layer "
+                             f"'{inner_cls}'")
+        merge = k_cfg.get("merge_mode", "concat")
+        mode = {"concat": "concat", "sum": "add", "mul": "mul",
+                "ave": "ave"}.get(merge)
+        if mode is None:
+            raise ValueError(f"Bidirectional: merge_mode '{merge}' is not "
+                             "mapped (concat/sum/mul/ave)")
+        # map the inner layer (LastTimeStep-wrapped when
+        # return_sequences=False — KerasBidirectional.java:126-137 builds
+        # Bidirectional(mode, LastTimeStep(rnn)) in that case)
+        inner_layer, inner_loader = _map_layer(inner_cls, inner_cfg, False,
+                                               sequence=True)
+
+        def load_bi(params, state, w):
+            half = len(w) // 2
+            fw, bw = w[:half], w[half:]
+            names = getattr(w, "names", None)
+            if names and any("backward" in str(n) for n in names[:half]):
+                fw, bw = bw, fw     # h5 alphabetical order: backward first
+            inner_loader(params["fwd"], {}, fw)
+            inner_loader(params["bwd"], {}, bw)
+        return Bidirectional(layer=inner_layer, mode=mode), load_bi
 
     if k_cls == "GRU":
-        if not k_cfg.get("return_sequences", False):
-            raise ValueError("GRU with return_sequences=False is "
-                             "unsupported; use return_sequences=True")
         reset_after = bool(k_cfg.get("reset_after", True))
 
         def load_gru(params, state, w):
@@ -548,12 +691,15 @@ def _map_layer(k_cls: str, k_cfg: dict, is_output: bool,
             if len(w) > 2:
                 b = jnp.asarray(w[2])
                 params["b"] = b.reshape(params["b"].shape)
-        return GRU(
+        layer = GRU(
             n_out=int(k_cfg["units"]),
             activation=_act(k_cfg.get("activation", "tanh")),
             gate_activation=_act(
                 k_cfg.get("recurrent_activation", "sigmoid")),
-            reset_after=reset_after), load_gru
+            reset_after=reset_after)
+        if not k_cfg.get("return_sequences", False):
+            layer = LastTimeStep(layer=layer)
+        return layer, load_gru
 
     if k_cls == "Conv2DTranspose":
         def load_deconv(params, state, w):
@@ -699,6 +845,80 @@ def _map_layer(k_cls: str, k_cfg: dict, is_output: bool,
                                      "is mapped")
                 name = "relu6"        # MobileNet-family clipped relu
         return ActivationLayer(activation=name, alpha=alpha), None
+
+    if k_cls == "Permute":
+        dims = k_cfg.get("dims", (1,))
+        return PermuteLayer(dims=tuple(int(d) for d in dims)), None
+
+    if k_cls == "RepeatVector":
+        return RepeatVector(n=int(k_cfg["n"])), None
+
+    if k_cls in ("SpatialDropout1D", "SpatialDropout2D"):
+        from deeplearning4j_tpu.nn.regularization import SpatialDropout
+        return DropoutLayer(
+            dropout=SpatialDropout(p=float(k_cfg.get("rate", 0.5)))), None
+
+    if k_cls == "GaussianNoise":
+        from deeplearning4j_tpu.nn.regularization import GaussianNoise
+        return DropoutLayer(dropout=GaussianNoise(
+            stddev=float(k_cfg.get("stddev", 0.1)))), None
+
+    if k_cls == "GaussianDropout":
+        from deeplearning4j_tpu.nn.regularization import GaussianDropout
+        return DropoutLayer(dropout=GaussianDropout(
+            rate=float(k_cfg.get("rate", 0.1)))), None
+
+    if k_cls == "AlphaDropout":
+        from deeplearning4j_tpu.nn.regularization import AlphaDropout
+        return DropoutLayer(dropout=AlphaDropout(
+            p=float(k_cfg.get("rate", 0.05)))), None
+
+    if k_cls == "Cropping1D":
+        crop = k_cfg.get("cropping", (1, 1))
+        if isinstance(crop, int):
+            crop = (crop, crop)
+        return Cropping1D(cropping=tuple(int(x) for x in crop)), None
+
+    if k_cls == "UpSampling1D":
+        return Upsampling1D(size=_one(k_cfg.get("size", 2))), None
+
+    if k_cls == "ZeroPadding1D":
+        pad = k_cfg.get("padding", 1)
+        if isinstance(pad, int):
+            pad = (pad, pad)
+        return ZeroPadding1DLayer(padding=tuple(int(x) for x in pad)), None
+
+    if k_cls == "LocallyConnected1D":
+        # Keras 2 layer (dropped in Keras 3); implementation 1 storage:
+        # kernel (ot, k*c_in, filters), bias (ot, filters) — our layout
+        if k_cfg.get("padding", "valid") != "valid":
+            raise ValueError("LocallyConnected1D: only padding='valid'")
+
+        def load_lc1(params, state, w):
+            params["W"] = jnp.asarray(w[0])
+            if len(w) > 1 and "b" in params:
+                params["b"] = jnp.asarray(w[1]).reshape(params["b"].shape)
+        return LocallyConnected1D(
+            n_out=int(k_cfg["filters"]),
+            kernel=_one(k_cfg.get("kernel_size", 3)),
+            stride=_one(k_cfg.get("strides", 1)),
+            activation=_act(k_cfg.get("activation", "linear")),
+            has_bias=k_cfg.get("use_bias", True)), load_lc1
+
+    if k_cls == "LocallyConnected2D":
+        if k_cfg.get("padding", "valid") != "valid":
+            raise ValueError("LocallyConnected2D: only padding='valid'")
+
+        def load_lc2(params, state, w):
+            params["W"] = jnp.asarray(w[0])
+            if len(w) > 1 and "b" in params:
+                params["b"] = jnp.asarray(w[1]).reshape(params["b"].shape)
+        return LocallyConnected2D(
+            n_out=int(k_cfg["filters"]),
+            kernel=_pair(k_cfg.get("kernel_size", 3)),
+            stride=_pair(k_cfg.get("strides", 1)),
+            activation=_act(k_cfg.get("activation", "linear")),
+            has_bias=k_cfg.get("use_bias", True)), load_lc2
 
     raise ValueError(f"Unsupported Keras layer '{k_cls}' "
                      "(KerasModelImport layer mappers)")

@@ -1,12 +1,15 @@
 from deeplearning4j_tpu.nn.layers.feedforward import (
     DenseLayer, EmbeddingLayer, ActivationLayer, DropoutLayer,
     OutputLayer, CenterLossOutputLayer, LossLayer, AutoEncoder,
+    RepeatVector, PermuteLayer,
 )
 from deeplearning4j_tpu.nn.layers.convolution import (
     ConvolutionLayer, Convolution1DLayer, SubsamplingLayer,
     Subsampling1DLayer, Upsampling2D, ZeroPaddingLayer, GlobalPoolingLayer,
     Deconvolution2D, SeparableConvolution2D, DepthwiseConvolution2D,
     SpaceToDepthLayer, SpaceToBatchLayer, Cropping2D, CnnLossLayer,
+    Cropping1D, Upsampling1D, ZeroPadding1DLayer,
+    LocallyConnected1D, LocallyConnected2D,
 )
 from deeplearning4j_tpu.nn.layers.normalization import (
     BatchNormalization, LocalResponseNormalization,
@@ -26,11 +29,14 @@ from deeplearning4j_tpu.nn.layers.attention import (
 __all__ = [
     "DenseLayer", "EmbeddingLayer", "ActivationLayer", "DropoutLayer",
     "OutputLayer", "CenterLossOutputLayer", "LossLayer", "AutoEncoder",
+    "RepeatVector", "PermuteLayer",
     "ConvolutionLayer", "Convolution1DLayer", "SubsamplingLayer",
     "Subsampling1DLayer", "Upsampling2D", "ZeroPaddingLayer",
     "GlobalPoolingLayer", "Deconvolution2D", "SeparableConvolution2D",
     "DepthwiseConvolution2D", "SpaceToDepthLayer", "SpaceToBatchLayer",
     "Cropping2D", "CnnLossLayer",
+    "Cropping1D", "Upsampling1D", "ZeroPadding1DLayer",
+    "LocallyConnected1D", "LocallyConnected2D",
     "BatchNormalization", "LocalResponseNormalization",
     "GRU", "LSTM", "GravesLSTM", "GravesBidirectionalLSTM", "SimpleRnn",
     "Bidirectional", "RnnOutputLayer", "RnnLossLayer", "LastTimeStep",

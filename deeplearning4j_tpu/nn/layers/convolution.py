@@ -534,3 +534,172 @@ class CnnLossLayer(LayerConf):
         if mask is not None:
             per_pix_mask = mask.reshape(b, -1)
         return loss_fn(lab, z, self.activation, mask=per_pix_mask)
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class Cropping1D(LayerConf):
+    """Crop timesteps off a (B, T, C) sequence (DL4J
+    nn/conf/layers/convolutional/Cropping1D.java)."""
+    cropping: Tuple[int, int] = (0, 0)      # (head, tail)
+
+    def output_type(self, input_type: InputType) -> InputType:
+        t, c = input_type.shape
+        a, b = self.cropping
+        return InputType(Kind.RNN, (t - a - b, c))
+
+    def has_params(self):
+        return False
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        a, b = self.cropping
+        T = x.shape[1]
+        return x[:, a:T - b if b else T, :], state
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class Upsampling1D(LayerConf):
+    """Repeat each timestep `size` times (DL4J Upsampling1D.java)."""
+    size: int = 2
+
+    def output_type(self, input_type: InputType) -> InputType:
+        t, c = input_type.shape
+        return InputType(Kind.RNN, (t * int(self.size), c))
+
+    def has_params(self):
+        return False
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        return jnp.repeat(x, int(self.size), axis=1), state
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class ZeroPadding1DLayer(LayerConf):
+    """Zero-pad the time axis of a (B, T, C) sequence (DL4J
+    ZeroPadding1DLayer.java)."""
+    padding: Tuple[int, int] = (0, 0)       # (head, tail)
+
+    def output_type(self, input_type: InputType) -> InputType:
+        t, c = input_type.shape
+        a, b = self.padding
+        return InputType(Kind.RNN, (t + a + b, c))
+
+    def has_params(self):
+        return False
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        a, b = self.padding
+        return jnp.pad(x, ((0, 0), (a, b), (0, 0))), state
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class LocallyConnected1D(LayerConf):
+    """1D convolution with UNTIED weights — a distinct kernel per output
+    position (DL4J nn/conf/layers/LocallyConnected1D.java, a SameDiff
+    layer in the reference; here one einsum over extracted patches, which
+    XLA maps onto the MXU as a batched matmul).
+
+    W: (ot, k*c_in, n_out); b: (ot, n_out) — matching Keras
+    LocallyConnected1D's storage so import is a verbatim copy."""
+    n_out: int = 0
+    kernel: int = 3
+    stride: int = 1
+    convolution_mode: str = "truncate"
+    activation: str = "identity"
+    weight_init: str = "xavier"
+    has_bias: bool = True
+
+    def _out_len(self, t: int) -> int:
+        return _conv_out_dim(t, self.kernel, self.stride, 1,
+                             "truncate" if self.convolution_mode != "strict"
+                             else "strict")
+
+    def output_type(self, input_type: InputType) -> InputType:
+        t, c = input_type.shape
+        return InputType(Kind.RNN, (self._out_len(t), self.n_out))
+
+    def init(self, key, input_type: InputType, dtype=jnp.float32):
+        t, c = input_type.shape
+        ot = self._out_len(t)
+        fan_in = self.kernel * c
+        w_init = get_initializer(self.weight_init)
+        params = {"W": w_init(key, (ot, self.kernel * c, self.n_out),
+                              fan_in, self.n_out, dtype)}
+        if self.has_bias:
+            params["b"] = jnp.zeros((ot, self.n_out), dtype)
+        return params, {}
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        x = self.maybe_dropout_input(x, train, rng)
+        ot = params["W"].shape[0]
+        # patches[b, o, k*c] for output position o
+        idx = (jnp.arange(ot)[:, None] * self.stride
+               + jnp.arange(self.kernel)[None, :])        # (ot, k)
+        patches = x[:, idx, :]                            # (B, ot, k, C)
+        patches = patches.reshape(x.shape[0], ot, -1)     # (B, ot, k*C)
+        y = jnp.einsum("bok,okn->bon", patches, params["W"])
+        if self.has_bias:
+            y = y + params["b"]
+        return get_activation(self.activation)(y), state
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class LocallyConnected2D(LayerConf):
+    """2D convolution with untied weights (DL4J LocallyConnected2D.java).
+    W: (oh*ow, kh*kw*c_in, n_out); b: (oh, ow, n_out) — Keras
+    LocallyConnected2D storage, verbatim import."""
+    n_out: int = 0
+    kernel: Tuple[int, int] = (3, 3)
+    stride: Tuple[int, int] = (1, 1)
+    convolution_mode: str = "truncate"
+    activation: str = "identity"
+    weight_init: str = "xavier"
+    has_bias: bool = True
+
+    def _out_hw(self, h: int, w: int) -> Tuple[int, int]:
+        kh, kw = _pair(self.kernel)
+        sh, sw = _pair(self.stride)
+        mode = "strict" if self.convolution_mode == "strict" else "truncate"
+        return (_conv_out_dim(h, kh, sh, 1, mode),
+                _conv_out_dim(w, kw, sw, 1, mode))
+
+    def output_type(self, input_type: InputType) -> InputType:
+        h, w, c = input_type.shape
+        oh, ow = self._out_hw(h, w)
+        return InputType.convolutional(oh, ow, self.n_out)
+
+    def init(self, key, input_type: InputType, dtype=jnp.float32):
+        h, w, c = input_type.shape
+        oh, ow = self._out_hw(h, w)
+        kh, kw = _pair(self.kernel)
+        fan_in = kh * kw * c
+        w_init = get_initializer(self.weight_init)
+        params = {"W": w_init(key, (oh * ow, fan_in, self.n_out),
+                              fan_in, self.n_out, dtype)}
+        if self.has_bias:
+            params["b"] = jnp.zeros((oh, ow, self.n_out), dtype)
+        return params, {}
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        x = self.maybe_dropout_input(x, train, rng)
+        B, H, W, C = x.shape
+        kh, kw = _pair(self.kernel)
+        sh, sw = _pair(self.stride)
+        oh, ow = self._out_hw(H, W)
+        iy = (jnp.arange(oh)[:, None] * sh
+              + jnp.arange(kh)[None, :])                  # (oh, kh)
+        ix = (jnp.arange(ow)[:, None] * sw
+              + jnp.arange(kw)[None, :])                  # (ow, kw)
+        # (B, oh, kh, ow, kw, C) -> (B, oh, ow, kh, kw, C)
+        patches = x[:, iy[:, :, None, None], ix[None, None, :, :], :]
+        patches = patches.transpose(0, 1, 3, 2, 4, 5).reshape(
+            B, oh * ow, kh * kw * C)
+        y = jnp.einsum("bok,okn->bon", patches, params["W"])
+        y = y.reshape(B, oh, ow, self.n_out)
+        if self.has_bias:
+            y = y + params["b"]
+        return get_activation(self.activation)(y), state

@@ -290,3 +290,43 @@ class AutoEncoder(LayerConf):
         h = act(x_in @ params["W"] + params["b"])
         recon_pre = h @ params["W"].T + params["vb"]
         return get_loss(self.loss)(x, recon_pre, self.activation)
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class RepeatVector(LayerConf):
+    """Repeat a (B, C) vector n times into a (B, n, C) sequence (DL4J
+    nn/conf/layers/misc/RepeatVector.java; Keras RepeatVector)."""
+    n: int = 1
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType(Kind.RNN, (int(self.n), input_type.shape[0]))
+
+    def has_params(self):
+        return False
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        return jnp.repeat(x[:, None, :], int(self.n), axis=1), state
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class PermuteLayer(LayerConf):
+    """Permute the non-batch axes (the layer form of DL4J's
+    keras/preprocessors/PermutePreprocessor.java; Keras Permute). `dims`
+    uses Keras' 1-indexed convention: Permute((2, 1)) swaps the first two
+    non-batch axes."""
+    dims: Tuple[int, ...] = (1,)
+
+    def output_type(self, input_type: InputType) -> InputType:
+        shape = tuple(input_type.shape[d - 1] for d in self.dims)
+        if len(shape) == len(input_type.shape):
+            return InputType(input_type.kind, shape)
+        return input_type
+
+    def has_params(self):
+        return False
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        perm = (0,) + tuple(int(d) for d in self.dims)
+        return jnp.transpose(x, perm), state

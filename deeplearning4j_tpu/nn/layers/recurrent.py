@@ -338,6 +338,8 @@ class Bidirectional(LayerConf):
     def output_type(self, input_type: InputType) -> InputType:
         inner = self.layer.output_type(input_type)
         if self.mode == "concat":
+            if inner.kind == Kind.FF:   # e.g. Bidirectional(LastTimeStep(..))
+                return InputType.feed_forward(2 * inner.shape[0])
             t, f = inner.shape
             return InputType(Kind.RNN, (t, 2 * f))
         return inner
@@ -356,7 +358,8 @@ class Bidirectional(LayerConf):
         xr = jnp.flip(x, axis=1)
         mr = jnp.flip(mask, axis=1) if mask is not None else None
         yb, _ = self.layer.apply(params["bwd"], {}, xr, train=train, rng=r2, mask=mr)
-        yb = jnp.flip(yb, axis=1)
+        if yb.ndim == 3:    # rank-2 when the inner layer is LastTimeStep
+            yb = jnp.flip(yb, axis=1)
         if self.mode == "concat":
             y = jnp.concatenate([yf, yb], axis=-1)
         elif self.mode == "add":
@@ -461,8 +464,12 @@ class LastTimeStep(LayerConf):
                                         mask=mask)
         if mask is None:
             return y[:, -1, :], new_state
-        # index of last unmasked step per example
-        idx = jnp.maximum(jnp.sum(mask, axis=1).astype(jnp.int32) - 1, 0)
+        # index of last unmasked step per example; (mask * (t+1)).argmax
+        # handles any mask layout (valid-prefix AND the valid-suffix masks
+        # produced by Bidirectional's time flip), not just ALIGN_START
+        T = y.shape[1]
+        pos = jnp.where(mask > 0, jnp.arange(1, T + 1, dtype=jnp.int32), 0)
+        idx = jnp.argmax(pos, axis=1).astype(jnp.int32)
         out = jnp.take_along_axis(y, idx[:, None, None], axis=1)[:, 0, :]
         return out, new_state
 

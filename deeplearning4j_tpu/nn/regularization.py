@@ -91,6 +91,22 @@ class GaussianNoise(IDropout):
         return x + self.stddev * jax.random.normal(rng, x.shape, x.dtype)
 
 
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class SpatialDropout(IDropout):
+    """Channel-wise dropout (nn/conf/dropout/SpatialDropout.java; Keras
+    SpatialDropout1D/2D): drops entire feature maps — one Bernoulli draw
+    per (example, channel), broadcast over the spatial/time axes. The
+    channel axis is last (NHWC / (B, T, C) layouts)."""
+    p: float = 0.5
+
+    def apply(self, x, rng):
+        keep = 1.0 - self.p
+        shape = (x.shape[0],) + (1,) * (x.ndim - 2) + (x.shape[-1],)
+        mask = jax.random.bernoulli(rng, keep, shape)
+        return jnp.where(mask, x / keep, 0.0)
+
+
 def apply_input_dropout(dropout, x, train, rng):
     """Dispatch for LayerConf.dropout: float (DL4J drop-prob semantics) or
     IDropout object. Called from LayerConf.maybe_dropout_input."""

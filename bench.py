@@ -255,7 +255,14 @@ def main():
                                                 net2.opt_state, net2.state)
 
     best = max((r for r in results if "imgs_sec" in r),
-               key=lambda r: r["imgs_sec"])
+               key=lambda r: r["imgs_sec"], default=None)
+    if best is None:            # every config errored — still emit JSON
+        print(json.dumps({
+            "metric": "resnet50_train_imgs_per_sec_per_chip",
+            "value": None, "unit": "imgs/sec", "vs_baseline": None,
+            "tpu_unavailable": not on_tpu, "sweep": results,
+        }))
+        return
     mfu = None
     if peak and flops_per_img:
         mfu = round(best["imgs_sec"] * flops_per_img / peak * 100, 1)

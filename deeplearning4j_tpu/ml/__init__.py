@@ -1,0 +1,5 @@
+from deeplearning4j_tpu.ml.estimators import (
+    AutoEncoderTransformer, DL4JClassifier, DL4JRegressor,
+)
+
+__all__ = ["DL4JClassifier", "DL4JRegressor", "AutoEncoderTransformer"]

@@ -218,8 +218,9 @@ class ParallelWrapper:
                 lst.on_epoch_end(net, net.epoch_count)
             net.epoch_count += 1
             self._reset(source)
-        net._train_step = None     # wrapped net re-traces its own step lazily
-        net._output_fn = None
+        # note: the wrapped net's own compiled-step caches are kept — jit
+        # re-lowers automatically if the params' sharding changed, so
+        # dropping them only forced needless recompiles on later fits
 
     # --- AVERAGING --------------------------------------------------------
     def _fit_averaging(self, source, epochs):
@@ -247,6 +248,7 @@ class ParallelWrapper:
         # buffers — the finally block below re-saves whatever is live
         self._stacked = None
         rng = jax.random.PRNGKey(net.conf.seed + 131071)
+        losses = None
         try:
             for _ in range(epochs):
                 for lst in net.listeners:
@@ -259,11 +261,17 @@ class ParallelWrapper:
                     sp, so, ss, losses = self._step_fn(sp, so, ss, x, y, fm,
                                                        lm, subs)
                     self._local_steps += 1
-                    if self._local_steps % self.averaging_frequency == 0:
+                    at_avg = self._local_steps % self.averaging_frequency == 0
+                    if at_avg:
                         sp, so, ss = self._avg_fn(sp, so, ss)
-                        if self.report_score_after_averaging:
+                    # the blocking device->host loss fetch serializes the
+                    # dispatch pipeline — only pay it when someone reads
+                    # the value: listeners each iteration, otherwise at
+                    # averaging barriers only
+                    if self.report_score_after_averaging:
+                        if at_avg:
                             net._score = float(jnp.mean(losses))
-                    if not self.report_score_after_averaging:
+                    elif bool(net.listeners) or at_avg:
                         net._score = float(jnp.mean(losses))
                     for lst in net.listeners:
                         lst.iteration_done(net, net.iteration_count,
@@ -274,6 +282,11 @@ class ParallelWrapper:
                     lst.on_epoch_end(net, net.epoch_count)
                 net.epoch_count += 1
                 self._reset(source)
+                # one catch-up fetch per epoch so score() is never stale
+                # when no listeners forced per-iteration fetches
+                if losses is not None and not net.listeners and \
+                        not self.report_score_after_averaging:
+                    net._score = float(jnp.mean(losses))
         finally:
             # final average + write back to the wrapped network; preserves
             # progress even when fit is interrupted between steps
@@ -288,8 +301,6 @@ class ParallelWrapper:
                 # nothing recoverable — leave the network at its last state
                 log.warning("AVERAGING fit interrupted mid-step; stacked "
                             "replica state lost")
-            net._train_step = None
-            net._output_fn = None
 
     # ------------------------------------------------------------- batching
     def _map_entry(self, v, fn):
@@ -299,29 +310,69 @@ class ParallelWrapper:
             return tuple(None if a is None else fn(a) for a in v)
         return fn(v)
 
-    def _pad_to_workers(self, a):
-        """Ragged final batches wrap-pad with leading examples so every
-        worker gets an even shard (DL4J round-robins leftovers to a subset
-        of workers; XLA needs uniform shards — the duplicated examples get
-        double weight in that one step, which is the closest SPMD analog)."""
+    def _pad_to_workers(self, a, zero: bool = False):
+        """Pad a ragged batch up to a multiple of n_workers: wrap-pad with
+        leading examples (zero=False) or zero rows (zero=True, used for the
+        labels mask so padded examples are EXCLUDED from the loss)."""
+        a = np.asarray(a)
         n = self.n_workers
         b = a.shape[0]
         if b % n == 0:
             return a
         pad = n - b % n
+        if zero:
+            extra = np.zeros((pad,) + a.shape[1:], a.dtype)
+        else:
+            reps = int(np.ceil(pad / b))
+            extra = np.concatenate([a] * reps)[:pad]
+        return np.concatenate([a, extra])
+
+    def _pad_batch(self, x, y, fm, lm):
+        """Make the batch evenly shardable, EXACTLY (no double-weighting):
+        wrap-pad features/labels, then zero-pad a (synthesized if absent)
+        labels mask so the loss's masked mean renormalizes by the true
+        example count — the padded rows contribute nothing to loss or
+        gradient. (DL4J round-robins leftovers to a worker subset; XLA
+        needs uniform shards, so exclusion-by-mask is the exact SPMD
+        analog. BatchNorm batch statistics still see the padded rows —
+        the same caveat DL4J's per-worker stats have.)"""
+        b = self._batch_count(x)
+        if b % self.n_workers == 0:
+            return x, y, fm, lm
         if not self._warned_ragged:
-            log.warning(
-                "batch of %d not divisible by %d workers; wrap-padding "
-                "(last partial batch of each epoch)", b, n)
+            log.info(
+                "batch of %d not divisible by %d workers; padding with "
+                "mask-excluded rows (exact loss renormalization)", b,
+                self.n_workers)
             self._warned_ragged = True
-        reps = int(np.ceil(pad / b))
-        extra = np.concatenate([np.asarray(a)] * reps)[:pad]
-        return np.concatenate([np.asarray(a), extra])
+
+        def synth(yy, mm):
+            if mm is not None:
+                return np.asarray(mm)
+            yy = np.asarray(yy)
+            # validity per example (FF, rank-2 labels), per step (RNN,
+            # rank-3) or per pixel (CNN loss, rank-4 -> (B, H, W))
+            shape = ((yy.shape[0],) if yy.ndim < 3
+                     else yy.shape[:2] if yy.ndim == 3
+                     else yy.shape[:-1])
+            return np.ones(shape, np.float32)
+
+        if isinstance(y, (list, tuple)):
+            lm = tuple(synth(yy, None if lm is None else lm[i])
+                       for i, yy in enumerate(y))
+        else:
+            lm = synth(y, lm)
+        wrap = lambda a: self._pad_to_workers(a)
+        zero = lambda a: self._pad_to_workers(a, zero=True)
+        return (self._map_entry(x, wrap), self._map_entry(y, wrap),
+                self._map_entry(fm, wrap), self._map_entry(lm, zero))
 
     def _device_batch(self, x, y, fm, lm, shard):
         """Global-view batch, placed sharded over the data axis."""
+        x, y, fm, lm = self._pad_batch(x, y, fm, lm)
+
         def put(a):
-            return jax.device_put(jnp.asarray(self._pad_to_workers(a)), shard)
+            return jax.device_put(jnp.asarray(a), shard)
 
         return (self._map_entry(x, put), self._map_entry(y, put),
                 self._map_entry(fm, put), self._map_entry(lm, put))
@@ -329,11 +380,12 @@ class ParallelWrapper:
     def _split_batch(self, x, y, fm, lm):
         """(n_workers, local_b, ...) stacked batch for the vmapped step,
         shard i on device i (worker-axis sharding)."""
+        x, y, fm, lm = self._pad_batch(x, y, fm, lm)
         n = self.n_workers
         stacked = stacked_sharding(self.mesh)
 
         def split(a):
-            a = np.asarray(self._pad_to_workers(np.asarray(a)))
+            a = np.asarray(a)
             return jax.device_put(
                 jnp.asarray(a.reshape(n, a.shape[0] // n, *a.shape[1:])),
                 stacked)

@@ -1,0 +1,67 @@
+"""sklearn-contract estimator tests (dl4j-spark-ml analog:
+SparkDl4jNetworkTest.java / AutoEncoderNetworkTest.java)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.ml import (
+    AutoEncoderTransformer, DL4JClassifier, DL4JRegressor,
+)
+
+
+def _cls_data(n=240, seed=0):
+    rs = np.random.RandomState(seed)
+    centers = rs.randn(3, 6) * 3
+    X = np.concatenate([centers[i] + rs.randn(n // 3, 6)
+                        for i in range(3)]).astype("float32")
+    y = np.repeat(["a", "b", "c"], n // 3)      # string labels
+    perm = rs.permutation(n)
+    return X[perm], y[perm]
+
+
+def test_classifier_fit_predict_score():
+    X, y = _cls_data()
+    clf = DL4JClassifier(hidden=(24,), epochs=30, batch_size=48, seed=3)
+    clf.fit(X, y)
+    assert set(clf.predict(X[:10])) <= {"a", "b", "c"}
+    proba = clf.predict_proba(X)
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, atol=1e-5)
+    assert clf.score(X, y) > 0.9            # ClassifierMixin accuracy
+    with pytest.raises(RuntimeError):
+        DL4JClassifier().predict(X)
+
+
+def test_regressor_learns_linear_map():
+    rs = np.random.RandomState(1)
+    X = rs.randn(256, 5).astype("float32")
+    w = rs.randn(5)
+    y = X @ w + 0.01 * rs.randn(256)
+    reg = DL4JRegressor(hidden=(32,), epochs=60, batch_size=64, seed=2)
+    reg.fit(X, y)
+    assert reg.score(X, y) > 0.95           # RegressorMixin R^2
+    assert reg.predict(X).shape == (256,)
+
+
+def test_sklearn_pipeline_and_grid_search_integration():
+    sklearn = pytest.importorskip("sklearn")
+    from sklearn.model_selection import GridSearchCV
+    from sklearn.pipeline import Pipeline
+    from sklearn.preprocessing import StandardScaler
+    X, y = _cls_data(n=120)
+    pipe = Pipeline([
+        ("scale", StandardScaler()),
+        ("net", DL4JClassifier(hidden=(16,), epochs=15, batch_size=40)),
+    ])
+    pipe.fit(X, y)
+    assert pipe.score(X, y) > 0.8
+    gs = GridSearchCV(DL4JClassifier(epochs=10, batch_size=40),
+                      {"hidden": [(8,), (16,)]}, cv=2, n_jobs=1)
+    gs.fit(X, y)
+    assert set(gs.best_params_) == {"hidden"}
+
+
+def test_autoencoder_transformer_reduces_dim():
+    X, _ = _cls_data(n=150)
+    tf = AutoEncoderTransformer(n_components=4, epochs=20, batch_size=50)
+    Z = tf.fit_transform(X)
+    assert Z.shape == (150, 4)
+    assert np.isfinite(Z).all()

@@ -23,7 +23,8 @@ log = logging.getLogger("deeplearning4j_tpu")
 
 _SRC_DIR = os.path.join(os.path.dirname(__file__), "src")
 _SRCS = [os.path.join(_SRC_DIR, "dl4jtpu_native.cpp"),
-         os.path.join(_SRC_DIR, "ndarray_ops.cpp")]
+         os.path.join(_SRC_DIR, "ndarray_ops.cpp"),
+         os.path.join(_SRC_DIR, "sptree.cpp")]
 _lib: Optional[ctypes.CDLL] = None
 _build_failed = False
 
@@ -123,6 +124,9 @@ def _declare_ndarray_ops(lib: ctypes.CDLL) -> None:
     lib.random_gaussian_f32.argtypes = [u64, i64, f32, f32, f32p]
     lib.pairwise_sqdist_f32.restype = None
     lib.pairwise_sqdist_f32.argtypes = [f32p, i64, f32p, i64, i64, f32p]
+    lib.bh_repulsion_f32.restype = ctypes.c_double
+    lib.bh_repulsion_f32.argtypes = [f32p, i64, i32, f32, f32p,
+                                     ctypes.POINTER(i64)]
     lib.scale_u8_f32.restype = None
     lib.scale_u8_f32.argtypes = [u8p, i64, f32, f32, f32p]
 

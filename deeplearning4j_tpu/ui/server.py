@@ -4,11 +4,16 @@ Parity: DL4J `deeplearning4j-play/.../play/PlayUIServer.java` +
 `module/train/TrainModule.java` (overview / model / system tabs fed by an
 attached StatsStorage, live-updating browser charts).
 
-TPU-native redesign: stdlib ThreadingHTTPServer serving ONE self-contained
-HTML page (inline JS+SVG, no external assets — zero egress) that polls JSON
-endpoints. Endpoints mirror TrainModule's routes:
+TPU-native redesign: stdlib ThreadingHTTPServer serving self-contained
+HTML pages (SVG charts drawn by the shared /assets/charts.js component
+module — the `deeplearning4j-ui-components` analog, see ui/components.py —
+no external assets, zero egress) that poll JSON endpoints. Endpoints
+mirror TrainModule's routes:
+    /train            (overview tab: score, throughput, memory, ratios)
+    /train/model      (model tab: per-layer drill-down)
     /train/sessions            -> session ids
     /train/data?sid=&after=    -> static info + updates since a timestamp
+    /assets/charts.js          -> reusable chart components
 """
 from __future__ import annotations
 
@@ -18,28 +23,53 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional
 from urllib.parse import parse_qs, urlparse
 
+from deeplearning4j_tpu.ui.components import CHARTS_JS, STYLE_CSS
 from deeplearning4j_tpu.ui.storage import StatsStorage
 
-_PAGE = """<!DOCTYPE html>
+_HEAD = f"""<!DOCTYPE html>
 <html><head><meta charset="utf-8"><title>DL4J-TPU Training UI</title>
-<style>
- body{font-family:sans-serif;margin:0;background:#f4f6f8;color:#222}
- header{background:#223;color:#fff;padding:10px 16px;font-size:18px}
- .row{display:flex;flex-wrap:wrap;gap:12px;padding:12px}
- .card{background:#fff;border-radius:6px;padding:10px 14px;
-       box-shadow:0 1px 3px rgba(0,0,0,.15)}
- .card h3{margin:2px 0 8px 0;font-size:14px;color:#445}
- svg{background:#fafbfc;border:1px solid #e0e4e8}
- select{margin-left:12px}
- table{border-collapse:collapse;font-size:12px}
- td,th{border:1px solid #dde;padding:3px 8px;text-align:right}
- th{background:#eef}
- td:first-child,th:first-child{text-align:left}
-</style></head><body>
+<style>{STYLE_CSS}</style>
+<script src="/assets/charts.js"></script>
+</head><body>
 <header>DL4J-TPU Training Dashboard
+ <a href="/train">overview</a><a href="/train/model">model</a>
  <select id="sess"></select>
  <span id="status" style="font-size:12px;margin-left:12px"></span>
 </header>
+<script>
+let updates=[], statics={{}}, after=0, sid=null;
+async function refreshSessions(){{
+  const r=await fetch('/train/sessions'); const j=await r.json();
+  const sel=document.getElementById('sess');
+  const cur=sel.value;
+  sel.innerHTML=j.sessions.map(s=>`<option>${{s}}</option>`).join('');
+  if(j.sessions.includes(cur))sel.value=cur;
+  if(!sid&&j.sessions.length){{sid=sel.value;}}
+}}
+async function poll(){{
+  try{{
+    await refreshSessions();
+    const sel=document.getElementById('sess');
+    if(sel.value&&sel.value!==sid){{sid=sel.value;updates=[];after=0;}}
+    if(!sid){{setTimeout(poll,2000);return;}}
+    const r=await fetch(`/train/data?sid=${{encodeURIComponent(sid)}}&after=${{after}}`);
+    const j=await r.json();
+    statics=j.static||{{}};
+    if(j.updates.length){{
+      updates=updates.concat(j.updates);
+      after=j.updates[j.updates.length-1].timestamp;
+      if(updates.length>2000)updates=updates.slice(-2000);
+    }}
+    render();
+    document.getElementById('status').textContent=
+      `${{updates.length}} records | live`;
+  }}catch(e){{document.getElementById('status').textContent='disconnected';}}
+  setTimeout(poll,2000);
+}}
+</script>
+"""
+
+_OVERVIEW_PAGE = _HEAD + """
 <div class="row">
  <div class="card"><h3>Score vs iteration</h3><svg id="score" width="460" height="220"></svg></div>
  <div class="card"><h3>Samples/sec</h3><svg id="perf" width="460" height="220"></svg></div>
@@ -55,101 +85,108 @@ _PAGE = """<!DOCTYPE html>
   <svg id="hist" width="460" height="220"></svg></div>
 </div>
 <script>
-let updates=[], statics={}, after=0, sid=null, histKey=null;
-const colors=["#3366cc","#dc3912","#ff9900","#109618","#990099","#0099c6",
-  "#dd4477","#66aa00","#b82e2e","#316395","#994499","#22aa99"];
-function line(svgId, series, names){
-  const svg=document.getElementById(svgId); svg.innerHTML="";
-  const W=svg.width.baseVal.value,H=svg.height.baseVal.value,P=36;
-  let xs=[],ys=[];
-  series.forEach(s=>s.forEach(p=>{xs.push(p[0]);ys.push(p[1]);}));
-  if(!xs.length)return;
-  const x0=Math.min(...xs),x1=Math.max(...xs),y0=Math.min(...ys),y1=Math.max(...ys);
-  const fx=v=>P+(W-2*P)*(x1>x0?(v-x0)/(x1-x0):0.5);
-  const fy=v=>H-P-(H-2*P)*(y1>y0?(v-y0)/(y1-y0):0.5);
-  let g='';
-  for(let i=0;i<=4;i++){const y=y0+(y1-y0)*i/4, py=fy(y);
-    g+=`<line x1="${P}" y1="${py}" x2="${W-P}" y2="${py}" stroke="#eee"/>`+
-       `<text x="2" y="${py+4}" font-size="9">${y.toPrecision(3)}</text>`;}
-  g+=`<text x="${W/2}" y="${H-4}" font-size="9">${x0.toFixed(0)} .. ${x1.toFixed(0)}</text>`;
-  series.forEach((s,i)=>{
-    if(!s.length)return;
-    const d=s.map((p,j)=>(j?'L':'M')+fx(p[0]).toFixed(1)+','+fy(p[1]).toFixed(1)).join(' ');
-    g+=`<path d="${d}" fill="none" stroke="${colors[i%colors.length]}" stroke-width="1.5"/>`;
-    if(names&&names[i])g+=`<text x="${W-P+2}" y="${16+12*i}" font-size="9" fill="${colors[i%colors.length]}">${names[i]}</text>`;
-  });
-  svg.innerHTML=g;
-}
-function bars(svgId, counts, lo, hi){
-  const svg=document.getElementById(svgId); svg.innerHTML="";
-  if(!counts||!counts.length)return;
-  const W=svg.width.baseVal.value,H=svg.height.baseVal.value,P=26;
-  const m=Math.max(...counts,1),bw=(W-2*P)/counts.length;
-  let g='';
-  counts.forEach((c,i)=>{const h=(H-2*P)*c/m;
-    g+=`<rect x="${P+i*bw}" y="${H-P-h}" width="${Math.max(bw-1,1)}" height="${h}" fill="#3366cc"/>`;});
-  g+=`<text x="${P}" y="${H-6}" font-size="9">${lo!==undefined?lo.toPrecision(3):''}</text>`;
-  g+=`<text x="${W-P-40}" y="${H-6}" font-size="9">${hi!==undefined?hi.toPrecision(3):''}</text>`;
-  svg.innerHTML=g;
-}
-async function refreshSessions(){
-  const r=await fetch('train/sessions'); const j=await r.json();
-  const sel=document.getElementById('sess');
-  const cur=sel.value;
-  sel.innerHTML=j.sessions.map(s=>`<option>${s}</option>`).join('');
-  if(j.sessions.includes(cur))sel.value=cur;
-  if(!sid&&j.sessions.length){sid=sel.value;}
-}
-async function poll(){
-  try{
-    await refreshSessions();
-    const sel=document.getElementById('sess');
-    if(sel.value&&sel.value!==sid){sid=sel.value;updates=[];after=0;}
-    if(!sid){setTimeout(poll,2000);return;}
-    const r=await fetch(`train/data?sid=${encodeURIComponent(sid)}&after=${after}`);
-    const j=await r.json();
-    statics=j.static||{};
-    if(j.updates.length){
-      updates=updates.concat(j.updates);
-      after=j.updates[j.updates.length-1].timestamp;
-      if(updates.length>2000)updates=updates.slice(-2000);
-    }
-    render();
-    document.getElementById('status').textContent=
-      `${updates.length} records | live`;
-  }catch(e){document.getElementById('status').textContent='disconnected';}
-  setTimeout(poll,2000);
-}
 function render(){
   const d=updates.map(u=>u.data);
-  line('score',[d.map(u=>[u.iteration,u.score])]);
-  line('perf',[d.filter(u=>u.samples_sec>0).map(u=>[u.iteration,u.samples_sec])]);
-  line('mem',[d.filter(u=>u.memory&&u.memory.device_bytes_in_use)
+  dl4j.line('score',[d.map(u=>[u.iteration,u.score])]);
+  dl4j.line('perf',[d.filter(u=>u.samples_sec>0).map(u=>[u.iteration,u.samples_sec])]);
+  dl4j.line('mem',[d.filter(u=>u.memory&&u.memory.device_bytes_in_use)
      .map(u=>[u.iteration,u.memory.device_bytes_in_use/1048576])]);
   const last=d[d.length-1]; if(!last)return;
   const keys=Object.keys(last.params||{});
-  line('pmag',keys.map(k=>d.filter(u=>u.params&&u.params[k])
-     .map(u=>[u.iteration,Math.log10(u.params[k].mean_mag+1e-12)])),keys);
-  line('ratio',keys.map(k=>d.filter(u=>u.updates&&u.updates[k]&&u.params[k])
-     .map(u=>[u.iteration,Math.log10((u.updates[k].mean_mag+1e-12)/(u.params[k].mean_mag+1e-12))])),keys);
+  dl4j.line('pmag',keys.map(k=>d.filter(u=>u.params&&u.params[k])
+     .map(u=>[u.iteration,Math.log10(u.params[k].mean_mag+1e-12)])),{names:keys});
+  dl4j.line('ratio',keys.map(k=>d.filter(u=>u.updates&&u.updates[k]&&u.params[k])
+     .map(u=>[u.iteration,Math.log10((u.updates[k].mean_mag+1e-12)/(u.params[k].mean_mag+1e-12))])),{names:keys});
   const hsel=document.getElementById('hsel');
   const gkeys=Object.keys(last.gradients||{});
   if(hsel.options.length!==gkeys.length){
     hsel.innerHTML=gkeys.map(k=>`<option>${k}</option>`).join('');}
-  histKey=hsel.value||gkeys[0];
+  const histKey=hsel.value||gkeys[0];
   if(histKey&&last.gradients&&last.gradients[histKey]){
     const h=last.gradients[histKey];
-    bars('hist',h.hist,h.lo,h.hi);}
+    dl4j.bars('hist',h.hist,h.lo,h.hi);}
   const si=statics.data||{};
-  document.getElementById('info').innerHTML=
-    `<table><tr><th>field</th><th>value</th></tr>`+
+  dl4j.kvTable('info',
     ['model_class','num_params','num_layers','devices'].map(k=>
-      `<tr><td>${k}</td><td>${JSON.stringify(si[k])}</td></tr>`).join('')+
-    `<tr><td>score (last)</td><td>${last.score.toPrecision(5)}</td></tr>`+
-    `<tr><td>iteration</td><td>${last.iteration}</td></tr></table>`+
-    (si.summary?`<pre style="font-size:11px">${String(si.summary)
-      .replace(/&/g,'&amp;').replace(/</g,'&lt;')
-      .replace(/>/g,'&gt;')}</pre>`:'');
+      [k,JSON.stringify(si[k])])
+    .concat([['score (last)',last.score.toPrecision(5)],
+             ['iteration',last.iteration]]));
+  if(si.summary)document.getElementById('info').innerHTML+=
+    `<pre style="font-size:11px">${dl4j.esc(si.summary)}</pre>`;
+}
+poll();
+</script></body></html>
+"""
+
+_MODEL_PAGE = _HEAD + """
+<div class="row">
+ <div class="card" style="min-width:280px"><h3>Layers (click to select)</h3>
+  <div id="ltable" style="font-size:12px"></div></div>
+ <div class="card"><h3 id="ltitle">Layer</h3><div id="ldetail" style="font-size:12px"></div></div>
+</div>
+<div class="row">
+ <div class="card"><h3>Mean magnitude: parameters (log10)</h3><svg id="lpmag" width="460" height="220"></svg></div>
+ <div class="card"><h3>Mean magnitude: gradients (log10)</h3><svg id="lgmag" width="460" height="220"></svg></div>
+ <div class="card"><h3>Update:param ratio (log10)</h3><svg id="lratio" width="460" height="220"></svg></div>
+</div>
+<div class="row">
+ <div class="card"><h3>Parameter histogram <select id="lpsel"></select></h3>
+  <svg id="lphist" width="460" height="220"></svg></div>
+ <div class="card"><h3>Gradient histogram</h3><svg id="lghist" width="460" height="220"></svg></div>
+ <div class="card"><h3>Update histogram</h3><svg id="luhist" width="460" height="220"></svg></div>
+</div>
+<script>
+let layer=null;
+function layerKeys(rec, group, name){
+  return Object.keys(rec[group]||{}).filter(k=>k.split('/')[0]===name);
+}
+function selectLayer(name){ layer=name; render(); }
+function render(){
+  const si=statics.data||{};
+  const layers=si.layers||[];
+  if(layer===null&&layers.length)layer=layers[0].name;
+  dl4j.grid('ltable',['layer','type','n_params'],
+    layers.map(l=>[l.name,l.type,l.n_params]));
+  // row click-through: rebuild with onclick handles
+  const rows=document.querySelectorAll('#ltable tr');
+  layers.forEach((l,i)=>{
+    const tr=rows[i+1]; if(!tr)return;
+    tr.style.cursor='pointer';
+    if(l.name===layer)tr.style.background='#dde8ff';
+    tr.onclick=()=>selectLayer(l.name);
+  });
+  const d=updates.map(u=>u.data);
+  const last=d[d.length-1];
+  if(!last||layer===null)return;
+  const info=layers.find(l=>l.name===layer)||{};
+  document.getElementById('ltitle').textContent=
+    `Layer ${layer} (${info.type||'?'})`;
+  dl4j.kvTable('ldetail',
+    [['type',info.type],['n_params',info.n_params]].concat(
+      Object.entries(info.shapes||{}).map(([k,v])=>
+        ['shape '+k,JSON.stringify(v)])));
+  const pkeys=layerKeys(last,'params',layer);
+  dl4j.line('lpmag',pkeys.map(k=>d.filter(u=>u.params&&u.params[k])
+    .map(u=>[u.iteration,Math.log10(u.params[k].mean_mag+1e-12)])),{names:pkeys});
+  const gkeys=layerKeys(last,'gradients',layer);
+  dl4j.line('lgmag',gkeys.map(k=>d.filter(u=>u.gradients&&u.gradients[k])
+    .map(u=>[u.iteration,Math.log10(u.gradients[k].mean_mag+1e-12)])),{names:gkeys});
+  dl4j.line('lratio',pkeys.map(k=>d.filter(u=>u.updates&&u.updates[k]&&u.params[k])
+    .map(u=>[u.iteration,Math.log10((u.updates[k].mean_mag+1e-12)/(u.params[k].mean_mag+1e-12))])),{names:pkeys});
+  const sel=document.getElementById('lpsel');
+  if(sel.dataset.keys!==pkeys.join()){   // layer switch: rebuild options
+    sel.innerHTML=pkeys.map(k=>`<option>${k}</option>`).join('');
+    sel.dataset.keys=pkeys.join();
+  }
+  const pk=sel.value||pkeys[0];
+  if(pk&&last.params[pk]&&last.params[pk].hist)
+    dl4j.bars('lphist',last.params[pk].hist,last.params[pk].lo,last.params[pk].hi);
+  const gk=(layerKeys(last,'gradients',layer))[Math.max(0,sel.selectedIndex)];
+  if(gk&&last.gradients[gk]&&last.gradients[gk].hist)
+    dl4j.bars('lghist',last.gradients[gk].hist,last.gradients[gk].lo,last.gradients[gk].hi);
+  const uk=(layerKeys(last,'updates',layer))[Math.max(0,sel.selectedIndex)];
+  if(uk&&last.updates[uk]&&last.updates[uk].hist)
+    dl4j.bars('luhist',last.updates[uk].hist,last.updates[uk].lo,last.updates[uk].hi);
 }
 poll();
 </script></body></html>
@@ -170,16 +207,25 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _raw(self, body: bytes, ctype: str):
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
     def do_GET(self):
         ui: "UIServer" = self.server.ui           # type: ignore[attr-defined]
         url = urlparse(self.path)
         if url.path in ("/", "/train", "/train/overview"):
-            body = _PAGE.encode()
-            self.send_response(200)
-            self.send_header("Content-Type", "text/html; charset=utf-8")
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
+            self._raw(_OVERVIEW_PAGE.encode(), "text/html; charset=utf-8")
+            return
+        if url.path == "/train/model":
+            self._raw(_MODEL_PAGE.encode(), "text/html; charset=utf-8")
+            return
+        if url.path == "/assets/charts.js":
+            self._raw(CHARTS_JS.encode(),
+                      "application/javascript; charset=utf-8")
             return
         if url.path == "/train/sessions":
             self._json({"sessions": ui.session_ids()})

@@ -168,12 +168,36 @@ class StatsListener(TrainingListener):
             devices = []
         layers: List[str] = [type(l).__name__
                              for l in getattr(model, "layers", [])]
+        # per-layer drill-down table (TrainModule model tab): name, type,
+        # param count and shapes, keyed the same way the update records
+        # key their params ("0/W", "conv1/b", ...)
+        params = getattr(model, "params", None) or {}
+        detail = []
+        if layers:
+            named = [(str(i), type(l).__name__)
+                     for i, l in enumerate(getattr(model, "layers", []))]
+        else:       # ComputationGraph: vertices in topological order
+            conf = getattr(model, "conf", None)
+            vertices = getattr(conf, "vertices", {}) or {}
+            named = [(name, type(vd.vertex).__name__)
+                     for name, vd in vertices.items()]
+        for key, ltype in named:
+            # _leaf_paths handles nested trees (Bidirectional fwd/bwd etc.)
+            # with the same path keys the update records use
+            leaves = _leaf_paths(params.get(key, {}) or {})
+            detail.append({
+                "name": key,
+                "type": ltype,
+                "n_params": int(sum(a.size for a in leaves.values())),
+                "shapes": {k: list(a.shape) for k, a in leaves.items()},
+            })
         info = {
             "start_time": time.time(),
             "model_class": type(model).__name__,
             "num_params": int(model.num_params()),
-            "num_layers": len(layers),
+            "num_layers": len(detail) if detail else len(layers),
             "layer_names": layers,
+            "layers": detail,
             "devices": devices,
         }
         try:

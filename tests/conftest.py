@@ -4,8 +4,25 @@ This mirrors the reference's strategy of testing distributed code without a
 real cluster (SURVEY.md §4: Spark local[N] masters) — multi-chip sharding
 logic runs on 8 virtual CPU devices; the driver separately dry-runs the
 multi-chip path, and bench.py runs on real TPU.
+
+Markers (README "Running the tests"):
+- `slow`: tests that individually take >=7s on an 8-vCPU box (big jit
+  compiles: pipeline/context parallel, f64 gradcheck matrices, zoo
+  forwards, multi-OS-process runs). `pytest -m "not slow"` is the quick
+  gate; the full suite is the merge gate.
+- `distributed`: tests that spawn real extra OS processes.
+
+A persistent XLA compilation cache (JAX_TEST_CACHE_DIR, default
+/tmp/dl4jtpu-jax-test-cache) makes repeat runs compile-free: the first run
+pays the jit cost, later runs reload compiled programs from disk.
 """
 import os
+import sys
+
+# allow invoking pytest from inside tests/ (package not pip-installed)
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
 
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
@@ -13,8 +30,89 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax  # noqa: E402
+import pytest  # noqa: E402
 
 # The TPU plugin ("axon") force-appends itself to jax_platforms at import,
 # overriding the env var — pin the config back to CPU-only for tests.
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", False)
+
+# Persistent compile cache: repeat suite runs skip XLA compilation.
+jax.config.update("jax_compilation_cache_dir",
+                  os.environ.get("JAX_TEST_CACHE_DIR",
+                                 "/tmp/dl4jtpu-jax-test-cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+
+# tests that individually take >=7s on the 8-vCPU reference box (measured
+# via --durations: big pipeline/ring-attention compiles, f64 gradchecks,
+# zoo forwards, multi-process distributed runs) — names without any
+# parametrize suffix, so every variant of a listed test is marked
+_SLOW = {
+    "tests/test_attention.py::test_context_parallel_dp_sp_mesh_trains",
+    "tests/test_attention.py::test_context_parallel_graph_matches_single_device",
+    "tests/test_attention.py::test_context_parallel_honors_label_mask",
+    "tests/test_attention.py::test_context_parallel_masked_matches_single_device",
+    "tests/test_attention.py::test_context_parallel_step_matches_single_device",
+    "tests/test_attention.py::test_pipeline_parallel_honors_masks",
+    "tests/test_attention.py::test_pipeline_parallel_step_matches_single_device",
+    "tests/test_attention.py::test_pipeline_parallel_trains",
+    "tests/test_attention.py::test_ring_attention_masked_matches_dense",
+    "tests/test_attention.py::test_ring_attention_matches_dense",
+    "tests/test_attention.py::test_transformer_block_and_moe_shapes",
+    "tests/test_attention.py::test_transformer_lm_trains",
+    "tests/test_attention.py::test_transformer_tp_sharded_step",
+    "tests/test_gradientcheck.py::test_gc_attention_dropout_fixed_rng",
+    "tests/test_gradientcheck.py::test_gc_graves_bidirectional_lstm",
+    "tests/test_gradientcheck.py::test_gc_graves_lstm",
+    "tests/test_gradientcheck.py::test_gc_lstm_last_time_step_global_pool",
+    "tests/test_gradientcheck.py::test_gc_ring_attention_fd",
+    "tests/test_gradientcheck.py::test_gc_separable_conv",
+    "tests/test_gradientcheck.py::test_gc_transformer_block_blockwise",
+    "tests/test_gradientcheck.py::test_gc_vae_pretrain_elbo",
+    "tests/test_gradientcheck.py::test_gc_vae_supervised",
+    "tests/test_gradientcheck.py::test_gc_yolo_loss",
+    "tests/test_keras_import.py::test_separable_and_depthwise_conv_parity",
+    "tests/test_keras_import.py::test_sequential_cnn_parity",
+    "tests/test_memory.py::test_memory_report_graph",
+    "tests/test_nlp.py::test_paragraph_vectors_labels",
+    "tests/test_nlp.py::test_spark_word2vec_partition_parallel",
+    "tests/test_nlp.py::test_word2vec_cbow_and_hs",
+    "tests/test_nlp.py::test_word2vec_separates_topics",
+    "tests/test_parallel.py::test_shared_gradients_two_os_processes_over_socket_transport",
+    "tests/test_parallel.py::test_two_process_checkpoint_crash_resume_matches_uninterrupted",
+    "tests/test_parallel.py::test_two_process_jax_distributed_parallel_wrapper",
+    "tests/test_pretraining.py::test_vae_pretrain_via_driver",
+    "tests/test_regularization.py::test_dropout_variants_train_only_and_nets_train",
+    "tests/test_server_cli.py::test_cli_trains_and_saves",
+    "tests/test_solvers.py::test_lbfgs_beats_gradient_descent_iterations",
+    "tests/test_zoo.py::test_darknet19_small_input_forward",
+    "tests/test_zoo.py::test_simplecnn_forward",
+    "tests/test_zoo.py::test_tinyyolo_small_forward_and_loss",
+}
+
+_DISTRIBUTED = {
+    "tests/test_parallel.py::test_shared_gradients_two_os_processes_over_socket_transport",
+    "tests/test_parallel.py::test_two_process_checkpoint_crash_resume_matches_uninterrupted",
+    "tests/test_parallel.py::test_two_process_jax_distributed_parallel_wrapper",
+}
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: >=7s on the 8-vCPU box; excluded by -m 'not slow'")
+    config.addinivalue_line(
+        "markers", "distributed: spawns extra OS processes")
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        # normalize to the repo-relative "tests/file.py::name" form so the
+        # match is independent of the invocation directory/rootdir
+        base = "tests/" + item.path.name + "::" + \
+            item.nodeid.split("::", 1)[-1].split("[")[0]
+        if base in _SLOW:
+            item.add_marker(pytest.mark.slow)
+        if base in _DISTRIBUTED:
+            item.add_marker(pytest.mark.distributed)

@@ -125,3 +125,42 @@ def test_ui_server_serves_dashboard_and_data():
         assert data2["updates"] == []
     finally:
         server.stop()
+
+
+def test_ui_server_model_tab_and_chart_components():
+    """/train/model endpoint + per-layer static detail + the shared
+    /assets/charts.js module (TrainModule model-tab parity)."""
+    storage = InMemoryStatsStorage()
+    listener = StatsListener(storage, frequency=1)
+    _train_net(listener)
+    server = UIServer(port=0)
+    try:
+        server.attach(storage)
+        base = server.url.rstrip("/")
+        model_html = urllib.request.urlopen(
+            base + "/train/model", timeout=5).read().decode()
+        assert "ltable" in model_html and "charts.js" in model_html
+        js = urllib.request.urlopen(
+            base + "/assets/charts.js", timeout=5).read().decode()
+        for component in ("line", "bars", "kvTable", "grid", "palette"):
+            assert component in js
+        # overview page uses the SAME shared module (no inline chart code)
+        over = urllib.request.urlopen(
+            base + "/train", timeout=5).read().decode()
+        assert "charts.js" in over and "dl4j.line" in over
+        sid = json.loads(urllib.request.urlopen(
+            base + "/train/sessions", timeout=5).read())["sessions"][0]
+        data = json.loads(urllib.request.urlopen(
+            f"{base}/train/data?sid={sid}&after=0", timeout=5).read())
+        layers = data["static"]["data"]["layers"]
+        assert [l["type"] for l in layers] == ["DenseLayer", "OutputLayer"]
+        assert layers[0]["n_params"] == 5 * 8 + 8
+        assert layers[0]["shapes"]["W"] == [5, 8]
+        # per-layer histograms flow for params, gradients AND updates
+        last = data["updates"][-1]["data"]
+        for group in ("params", "gradients", "updates"):
+            keys = [k for k in last[group] if k.startswith("0/")]
+            assert keys, group
+            assert "hist" in last[group][keys[0]]
+    finally:
+        server.stop()

@@ -1,6 +1,8 @@
 """Model zoo tests (DL4J deeplearning4j-zoo/src/test TestModels analog):
 every zoo architecture builds, serializes its config round-trip, and the
 small ones run a forward pass + one training step."""
+import os
+
 import numpy as np
 import pytest
 
@@ -111,3 +113,40 @@ def test_yolo_loss_prefers_accurate_boxes():
     l_good = float(layer.score(None, jnp.asarray(good), jnp.asarray(labels)))
     l_bad = float(layer.score(None, jnp.asarray(bad), jnp.asarray(labels)))
     assert l_good < l_bad, (l_good, l_bad)
+
+
+class TestPretrainedFixtures:
+    """ZooModel.init_pretrained drive (ZooModel.java initPretrained): the
+    committed golden checkpoints under tests/fixtures/pretrained stand in
+    for the reference's downloaded weight archives (no egress)."""
+
+    FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures",
+                            "pretrained")
+
+    def test_lenet_pretrained_accuracy_regression(self):
+        from sklearn.datasets import load_digits
+        from deeplearning4j_tpu.models.zoo import LeNet
+        net = LeNet().init_pretrained(cache_dir=self.FIXTURES)
+        d = load_digits()
+        X8 = d.images.astype("float32") / 16.0
+        X24 = np.repeat(np.repeat(X8, 3, axis=1), 3, axis=2)
+        X = np.pad(X24, ((0, 0), (2, 2), (2, 2)))[..., None]
+        Y = np.eye(10, dtype="float32")[d.target]
+        ev = net.evaluate((X[1500:], Y[1500:]), batch_size=99)
+        assert ev.accuracy() > 0.9      # golden fixture trained to 0.926
+
+    def test_textgeneration_lstm_pretrained_regression(self):
+        from deeplearning4j_tpu.models.zoo import TextGenerationLSTM
+        net = TextGenerationLSTM(
+            total_unique_characters=12, max_length=20,
+            units=32).init_pretrained(cache_dir=self.FIXTURES)
+        seqs = np.array([(s + np.arange(21)) % 12 for s in range(12)])
+        X = np.eye(12, dtype="float32")[seqs[:, :-1]]
+        out = np.asarray(net.output(X))
+        acc = (out.argmax(-1) == seqs[:, 1:]).mean()
+        assert acc > 0.95               # golden fixture trained to 0.997
+
+    def test_missing_cache_raises_clear_error(self, tmp_path):
+        from deeplearning4j_tpu.models.zoo import LeNet
+        with pytest.raises(FileNotFoundError, match="pretrained"):
+            LeNet().init_pretrained(cache_dir=str(tmp_path))

@@ -194,7 +194,9 @@ class BarnesHutTsne:
         src, dst, vals = self._build_sparse_p(X, perplexity)
         if self.theta > 0:
             from deeplearning4j_tpu import native
-            if native.available():
+            # mirror bh_repulsion's native gate (dim <= 3) — the pure-
+            # Python tree would be orders of magnitude slower per iteration
+            if native.available() and self.n_components <= 3:
                 return self._fit_barnes_hut(X, src, dst, vals)
             # pure-Python tree traversal is orders of magnitude slower
             # than the XLA tiled kernel — fall back to exact repulsion

@@ -31,8 +31,10 @@ class PySpTree:
         n, self.dim = Y.shape
         self.fanout = 1 << self.dim
         lo, hi = Y.min(0), Y.max(0)
-        c = 0.5 * (lo + hi)
-        h = float(max(0.5 * (hi - lo).max(), 1e-5)) * 1.0001
+        c = np.float32(0.5) * (lo + hi)
+        # keep formula bitwise in sync with sptree.cpp bh_repulsion_f32
+        h = float(np.float32(max(np.float32(0.5) * (hi - lo).max(),
+                                 np.float32(1e-5))) * np.float32(1.0001))
         self.center = [c.astype(np.float32)]
         self.hw = [h]
         self.com = [np.zeros(self.dim, np.float32)]

@@ -135,7 +135,7 @@ double bh_repulsion_f32(const float* Y, int64_t n, int32_t dim,
         c[k] = 0.5f * (lo[k] + hi[k]);
         h = std::max(h, 0.5f * (hi[k] - lo[k]));
     }
-    h = h * 1.0001f + 1e-5f;
+    h = std::max(h, 1e-5f) * 1.0001f;   // keep formula in sync: PySpTree
 
     Arena tree(dim);
     tree.y_all = Y;

@@ -166,9 +166,11 @@ class MultiHeadAttention(LayerConf):
     attention_dropout: float = 0.0
     weight_init: str = "xavier"
     has_bias: bool = False
-    # "dense" | "blockwise" (O(T*block) memory, single device); under a
-    # ContextParallelTrainer the layer automatically switches to ring
-    # attention regardless of this setting
+    # "dense" | "blockwise" (O(T*block) memory, single device) | "flash"
+    # (fused Pallas kernel, ops/flash_attention.py — same memory shape as
+    # blockwise but one kernel; attention dropout falls back to blockwise
+    # since the kernel has no RNG plumbing); under a ContextParallelTrainer
+    # the layer automatically switches to ring attention regardless
     attention_impl: str = "dense"
     block_size: int = 512
 
@@ -228,6 +230,32 @@ class MultiHeadAttention(LayerConf):
                                       axis_name=_CONTEXT_PARALLEL_AXIS,
                                       causal=self.causal, mask=mask,
                                       dropout=drop, rng=attn_rng)
+        elif self.attention_impl == "flash" and drop == 0.0:
+            from deeplearning4j_tpu.ops import flash_attention
+            out = flash_attention(q, k, v, mask=mask, causal=self.causal,
+                                  block_q=self.block_size,
+                                  block_k=self.block_size)
+        elif self.attention_impl == "flash":
+            # dropout path: blockwise recomputation, padded to the block
+            # size the same way the flash wrapper pads internally
+            from deeplearning4j_tpu.parallel.ring import blockwise_attention
+            t = q.shape[1]
+            bs = min(self.block_size, t)
+            pad = (-t) % bs
+            if pad:
+                qp, kp, vp = (jnp.pad(a, ((0, 0), (0, pad), (0, 0), (0, 0)))
+                              for a in (q, k, v))
+                mp = jnp.ones((q.shape[0], t), q.dtype) if mask is None \
+                    else mask
+                mp = jnp.pad(mp, ((0, 0), (0, pad)))
+                out = blockwise_attention(qp, kp, vp, block_size=bs,
+                                          causal=self.causal, mask=mp,
+                                          dropout=drop,
+                                          rng=attn_rng)[:, :t]
+            else:
+                out = blockwise_attention(q, k, v, block_size=bs,
+                                          causal=self.causal, mask=mask,
+                                          dropout=drop, rng=attn_rng)
         elif self.attention_impl == "blockwise":
             from deeplearning4j_tpu.parallel.ring import blockwise_attention
             out = blockwise_attention(q, k, v, block_size=self.block_size,

@@ -1,0 +1,169 @@
+"""Pallas flash attention — fused online-softmax attention for TPU.
+
+The hot-op counterpart of `nn/layers/attention.py:dot_product_attention`
+(reference anchor: the cuDNN fused-attention seam the reference reaches
+through its helper classes). One Pallas kernel computes a q-block's output
+while streaming K/V blocks through VMEM with the running-max/denominator
+recurrence, so the (Tq, Tk) score matrix never materializes in HBM — the
+same memory shape as `parallel/ring.py:blockwise_attention`, but fused
+into a single kernel (no per-block XLA op dispatch, scores stay in
+registers/VMEM, MXU does the two matmuls back to back).
+
+Semantics match dot_product_attention exactly (tested):
+- (B, T, H, D) layout, f32 accumulation, 1/sqrt(D) scaling;
+- optional causal masking;
+- optional (B, Tk) 0/1 key-validity mask, fully-masked query rows emit 0;
+- backward pass: custom VJP that recomputes through the O(T*block)
+  blockwise path (flash-style recomputation — no stored score matrix).
+
+On CPU the kernel runs under `interpret=True` (numerically identical,
+slow) — callers gate on backend; tests run interpret mode.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG = -1e30
+
+
+def _attn_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, *, causal: bool,
+                 block_q: int, block_k: int, t_k: int, scale: float):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale            # (bq, D)
+    d = q.shape[-1]
+    m0 = jnp.full((block_q,), NEG, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    a0 = jnp.zeros((block_q, d), jnp.float32)
+
+    def body(j, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.dslice(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.dslice(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        kmask = mask_ref[0, pl.dslice(j * block_k, block_k)]
+        s = jnp.where(kmask[None, :] > 0, s, NEG)
+        if causal:
+            qpos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(qpos >= kpos, s, NEG)
+        m_new = jnp.maximum(m, s.max(-1))
+        # exp(NEG - NEG) == 1 for all-masked rows: zero those terms
+        p = jnp.exp(s - m_new[:, None])
+        p = jnp.where(s > NEG / 2, p, 0.0)
+        alpha = jnp.exp(m - m_new)
+        alpha = jnp.where(m > NEG / 2, alpha, 0.0)
+        l = l * alpha + p.sum(-1)
+        acc = acc * alpha[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l, acc
+
+    m, l, acc = jax.lax.fori_loop(0, t_k // block_k, body, (m0, l0, a0))
+    out = acc / jnp.maximum(l, 1e-30)[:, None]
+    out = jnp.where((m <= NEG / 2)[:, None], 0.0, out)
+    o_ref[0] = out.astype(o_ref.dtype)
+
+
+def _flash_call(q, k, v, mask, causal: bool, block_q: int, block_k: int,
+                interpret: bool):
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    scale = 1.0 / float(d) ** 0.5
+    # (B, T, H, D) -> (B*H, T, D): one grid row per (batch, head)
+    qh = q.transpose(0, 2, 1, 3).reshape(b * h, tq, d)
+    kh = k.transpose(0, 2, 1, 3).reshape(b * h, tk, d)
+    vh = v.transpose(0, 2, 1, 3).reshape(b * h, tk, d)
+    if mask is None:
+        mask = jnp.ones((b, tk), jnp.float32)
+    mask = mask.astype(jnp.float32)
+
+    kernel = functools.partial(_attn_kernel, causal=causal,
+                               block_q=block_q, block_k=block_k, t_k=tk,
+                               scale=scale)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, tq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, tk, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, tk, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, tk), lambda bh, qi, _h=h: (bh // _h, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
+        interpret=interpret,
+    )(qh, kh, vh, mask)
+    return out.reshape(b, h, tq, d).transpose(0, 2, 1, 3)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash(q, k, v, mask, causal, block_q, block_k, interpret):
+    return _flash_call(q, k, v, mask, causal, block_q, block_k, interpret)
+
+
+def _flash_fwd(q, k, v, mask, causal, block_q, block_k, interpret):
+    out = _flash_call(q, k, v, mask, causal, block_q, block_k, interpret)
+    return out, (q, k, v, mask)
+
+
+def _flash_bwd(causal, block_q, block_k, interpret, res, g):
+    # flash-style recomputation: the O(T*block) blockwise path computes the
+    # same function, so its VJP is the true gradient — and never holds the
+    # full score matrix either. blockwise assumes square self-attention
+    # (tq == tk); cross-attention gradients recompute densely instead.
+    q, k, v, mask = res
+    if q.shape[1] == k.shape[1]:
+        from deeplearning4j_tpu.parallel.ring import blockwise_attention
+
+        def f(q, k, v):
+            return blockwise_attention(q, k, v, block_size=block_k,
+                                       causal=causal, mask=mask)
+    else:
+        from deeplearning4j_tpu.nn.layers.attention import (
+            dot_product_attention,
+        )
+
+        def f(q, k, v):
+            return dot_product_attention(q, k, v, mask=mask, causal=causal)
+    _, vjp = jax.vjp(f, q, k, v)
+    dq, dk, dv = vjp(g.astype(q.dtype))
+    return dq, dk, dv, None
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, *, mask=None, causal: bool = False,
+                    block_q: int = 128, block_k: int = 128,
+                    interpret: Optional[bool] = None):
+    """Fused flash attention on (B, T, H, D); see module docstring.
+
+    Sequence lengths are padded to the block size internally (padded keys
+    are mask-excluded; padded query rows are sliced off)."""
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    # one block size for q and k so the recomputing backward (blockwise,
+    # which assumes tq == tk == multiple of its block) lines up
+    block_q = block_k = min(block_q, block_k, max(tq, 1), max(tk, 1))
+    pq = (-tq) % block_q
+    pk = (-tk) % block_k
+    if mask is None and pk:
+        mask = jnp.ones((b, tk), q.dtype)
+    if pq or pk:
+        q = jnp.pad(q, ((0, 0), (0, pq), (0, 0), (0, 0)))
+        k = jnp.pad(k, ((0, 0), (0, pk), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pk), (0, 0), (0, 0)))
+        if mask is not None:
+            mask = jnp.pad(mask, ((0, 0), (0, pk)))
+    out = _flash(q, k, v, mask, causal, block_q, block_k, interpret)
+    return out[:, :tq]

@@ -200,15 +200,10 @@ class _Handler(BaseHTTPRequestHandler):
         pass
 
     def _json(self, obj, code=200):
-        body = json.dumps(obj).encode()
-        self.send_response(code)
-        self.send_header("Content-Type", "application/json")
-        self.send_header("Content-Length", str(len(body)))
-        self.end_headers()
-        self.wfile.write(body)
+        self._raw(json.dumps(obj).encode(), "application/json", code)
 
-    def _raw(self, body: bytes, ctype: str):
-        self.send_response(200)
+    def _raw(self, body: bytes, ctype: str, code: int = 200):
+        self.send_response(code)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()

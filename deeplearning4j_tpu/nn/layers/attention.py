@@ -230,14 +230,16 @@ class MultiHeadAttention(LayerConf):
                                       axis_name=_CONTEXT_PARALLEL_AXIS,
                                       causal=self.causal, mask=mask,
                                       dropout=drop, rng=attn_rng)
-        elif self.attention_impl == "flash" and drop == 0.0:
+        elif self.attention_impl == "flash" and drop == 0.0 \
+                and jax.default_backend() == "tpu":
             from deeplearning4j_tpu.ops import flash_attention
             out = flash_attention(q, k, v, mask=mask, causal=self.causal,
                                   block_q=self.block_size,
                                   block_k=self.block_size)
         elif self.attention_impl == "flash":
-            # dropout path: blockwise recomputation, padded to the block
-            # size the same way the flash wrapper pads internally
+            # off-TPU (the Pallas interpreter would be orders of magnitude
+            # slower than XLA) or dropout on: blockwise recomputation,
+            # padded to the block size like the flash wrapper pads
             from deeplearning4j_tpu.parallel.ring import blockwise_attention
             t = q.shape[1]
             bs = min(self.block_size, t)

@@ -27,49 +27,66 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 NEG = -1e30
 
 
-def _attn_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, *, causal: bool,
-                 block_q: int, block_k: int, t_k: int, scale: float):
+def _attn_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, m_scr, l_scr,
+                 acc_scr, *, causal: bool, block_q: int, block_k: int,
+                 scale: float):
+    """Grid (B*H, q_blocks, k_blocks), k innermost: each step folds ONE
+    (block_k, D) K/V tile into the running (m, l, acc) scratch — only one
+    K and one V tile are VMEM-resident at a time, so sequence length is
+    not bounded by VMEM."""
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale            # (bq, D)
-    d = q.shape[-1]
-    m0 = jnp.full((block_q,), NEG, jnp.float32)
-    l0 = jnp.zeros((block_q,), jnp.float32)
-    a0 = jnp.zeros((block_q, d), jnp.float32)
+    kj = pl.program_id(2)
+    nkb = pl.num_programs(2)
 
-    def body(j, carry):
-        m, l, acc = carry
-        k = k_ref[0, pl.dslice(j * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.dslice(j * block_k, block_k), :].astype(jnp.float32)
+    @pl.when(kj == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    # causal: skip key blocks entirely above the diagonal (their whole
+    # tile is masked) — no MXU work for ~half the grid
+    live = (kj * block_k <= (qi + 1) * block_q - 1) if causal else True
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[0].astype(jnp.float32) * scale        # (bq, D)
+        k = k_ref[0].astype(jnp.float32)                # (bk, D)
+        v = v_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
-        kmask = mask_ref[0, pl.dslice(j * block_k, block_k)]
+        kmask = mask_ref[0]
         s = jnp.where(kmask[None, :] > 0, s, NEG)
         if causal:
             qpos = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
-            kpos = j * block_k + jax.lax.broadcasted_iota(
+            kpos = kj * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(qpos >= kpos, s, NEG)
+        m = m_scr[...]
         m_new = jnp.maximum(m, s.max(-1))
         # exp(NEG - NEG) == 1 for all-masked rows: zero those terms
         p = jnp.exp(s - m_new[:, None])
         p = jnp.where(s > NEG / 2, p, 0.0)
         alpha = jnp.exp(m - m_new)
         alpha = jnp.where(m > NEG / 2, alpha, 0.0)
-        l = l * alpha + p.sum(-1)
-        acc = acc * alpha[:, None] + jax.lax.dot_general(
+        m_scr[...] = m_new
+        l_scr[...] = l_scr[...] * alpha + p.sum(-1)
+        acc_scr[...] = acc_scr[...] * alpha[:, None] + jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        return m_new, l, acc
 
-    m, l, acc = jax.lax.fori_loop(0, t_k // block_k, body, (m0, l0, a0))
-    out = acc / jnp.maximum(l, 1e-30)[:, None]
-    out = jnp.where((m <= NEG / 2)[:, None], 0.0, out)
-    o_ref[0] = out.astype(o_ref.dtype)
+    @pl.when(kj == nkb - 1)
+    def _finish():
+        m = m_scr[...]
+        out = acc_scr[...] / jnp.maximum(l_scr[...], 1e-30)[:, None]
+        out = jnp.where((m <= NEG / 2)[:, None], 0.0, out)
+        o_ref[0] = out.astype(o_ref.dtype)
 
 
 def _flash_call(q, k, v, mask, causal: bool, block_q: int, block_k: int,
@@ -86,19 +103,26 @@ def _flash_call(q, k, v, mask, causal: bool, block_q: int, block_k: int,
     mask = mask.astype(jnp.float32)
 
     kernel = functools.partial(_attn_kernel, causal=causal,
-                               block_q=block_q, block_k=block_k, t_k=tk,
+                               block_q=block_q, block_k=block_k,
                                scale=scale)
     out = pl.pallas_call(
         kernel,
-        grid=(b * h, tq // block_q),
+        grid=(b * h, tq // block_q, tk // block_k),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, tk, d), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((1, tk, d), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((1, tk), lambda bh, qi, _h=h: (bh // _h, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, kj: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, kj: (bh, kj, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, kj: (bh, kj, 0)),
+            pl.BlockSpec((1, block_k),
+                         lambda bh, qi, kj, _h=h: (bh // _h, kj)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+        out_specs=pl.BlockSpec((1, block_q, d),
+                               lambda bh, qi, kj: (bh, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q,), jnp.float32),
+            pltpu.VMEM((block_q,), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
         interpret=interpret,
     )(qh, kh, vh, mask)
     return out.reshape(b, h, tq, d).transpose(0, 2, 1, 3)

@@ -70,6 +70,45 @@ const dl4j = (() => {
     svg.innerHTML = g;
   }
 
+  function scatter(target, points, opts) {
+    // points: [[x, y, label?], ...]; one color per distinct label
+    const svg = el(target); svg.innerHTML = "";
+    if (!points || !points.length) return;
+    const W = svg.width.baseVal.value, H = svg.height.baseVal.value, P = 30;
+    const xs = points.map(p => p[0]), ys = points.map(p => p[1]);
+    const x0 = Math.min(...xs), x1 = Math.max(...xs);
+    const y0 = Math.min(...ys), y1 = Math.max(...ys);
+    const fx = v => P + (W-2*P) * (x1 > x0 ? (v-x0)/(x1-x0) : 0.5);
+    const fy = v => H - P - (H-2*P) * (y1 > y0 ? (v-y0)/(y1-y0) : 0.5);
+    const labels = [...new Set(points.map(p => p[2]))];
+    let g = '';
+    points.forEach(p => {
+      const c = palette[Math.max(labels.indexOf(p[2]), 0) % palette.length];
+      g += `<circle cx="${fx(p[0]).toFixed(1)}" cy="${fy(p[1]).toFixed(1)}"`
+         + ` r="2.5" fill="${c}" fill-opacity="0.7">`
+         + `<title>${esc(p[2] !== undefined ? p[2] : '')}</title></circle>`;
+    });
+    labels.forEach((lb, i) => {
+      if (lb === undefined) return;
+      g += `<text x="${W-P+2}" y="${16+12*i}" font-size="9"`
+         + ` fill="${palette[i%palette.length]}">${esc(lb)}</text>`;
+    });
+    svg.innerHTML = g;
+  }
+
+  async function applyI18n(lang) {
+    const r = await fetch(`/i18n?lang=${encodeURIComponent(lang)}`);
+    const cat = await r.json();
+    document.querySelectorAll('[data-i18n]').forEach(n => {
+      const t = cat[n.dataset.i18n];
+      if (t) n.textContent = t;
+    });
+    document.querySelectorAll('[data-i18n-placeholder]').forEach(n => {
+      const t = cat[n.dataset.i18nPlaceholder];
+      if (t) n.placeholder = t;
+    });
+  }
+
   function kvTable(target, rows) {
     el(target).innerHTML = `<table><tr><th>field</th><th>value</th></tr>`
       + rows.map(([k, v]) =>
@@ -85,7 +124,7 @@ const dl4j = (() => {
       + `</table>`;
   }
 
-  return { palette, line, bars, kvTable, grid, esc };
+  return { palette, line, bars, scatter, kvTable, grid, esc, applyI18n };
 })();
 """
 

@@ -21,7 +21,7 @@ import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional
-from urllib.parse import parse_qs, urlparse
+from urllib.parse import parse_qs, unquote, urlparse
 
 from deeplearning4j_tpu.ui.components import CHARTS_JS, STYLE_CSS
 from deeplearning4j_tpu.ui.storage import StatsStorage
@@ -32,8 +32,14 @@ _HEAD = f"""<!DOCTYPE html>
 <script src="/assets/charts.js"></script>
 </head><body>
 <header>DL4J-TPU Training Dashboard
- <a href="/train">overview</a><a href="/train/model">model</a>
+ <a href="/train" data-i18n="train.nav.overview">overview</a><a
+  href="/train/model" data-i18n="train.nav.model">model</a><a
+  href="/tsne" data-i18n="train.nav.tsne">t-SNE</a><a
+  href="/word2vec" data-i18n="train.nav.word2vec">word2vec</a>
  <select id="sess"></select>
+ <select id="lang" onchange="dl4j.applyI18n(this.value)">
+  <option>en</option><option>de</option><option>ja</option>
+  <option>ko</option><option>ru</option><option>zh</option></select>
  <span id="status" style="font-size:12px;margin-left:12px"></span>
 </header>
 <script>
@@ -71,17 +77,17 @@ async function poll(){{
 
 _OVERVIEW_PAGE = _HEAD + """
 <div class="row">
- <div class="card"><h3>Score vs iteration</h3><svg id="score" width="460" height="220"></svg></div>
- <div class="card"><h3>Samples/sec</h3><svg id="perf" width="460" height="220"></svg></div>
- <div class="card"><h3>Device memory (MB in use)</h3><svg id="mem" width="460" height="220"></svg></div>
+ <div class="card"><h3 data-i18n="train.overview.chart.score">Score vs iteration</h3><svg id="score" width="460" height="220"></svg></div>
+ <div class="card"><h3 data-i18n="train.overview.chart.throughput">Samples/sec</h3><svg id="perf" width="460" height="220"></svg></div>
+ <div class="card"><h3 data-i18n="train.overview.chart.memory">Device memory (MB in use)</h3><svg id="mem" width="460" height="220"></svg></div>
 </div>
 <div class="row">
- <div class="card"><h3>Parameter mean magnitudes (log10)</h3><svg id="pmag" width="700" height="240"></svg></div>
- <div class="card"><h3>Update:param ratio (log10, healthy ~ -3)</h3><svg id="ratio" width="700" height="240"></svg></div>
+ <div class="card"><h3 data-i18n="train.overview.chart.paramMag">Parameter mean magnitudes (log10)</h3><svg id="pmag" width="700" height="240"></svg></div>
+ <div class="card"><h3 data-i18n="train.overview.chart.ratio">Update:param ratio (log10, healthy ~ -3)</h3><svg id="ratio" width="700" height="240"></svg></div>
 </div>
 <div class="row">
- <div class="card"><h3>Model / session info</h3><div id="info" style="font-size:12px"></div></div>
- <div class="card"><h3>Last gradient histogram <select id="hsel"></select></h3>
+ <div class="card"><h3 data-i18n="train.overview.info">Model / session info</h3><div id="info" style="font-size:12px"></div></div>
+ <div class="card"><h3><span data-i18n="train.overview.chart.gradHist">Last gradient histogram</span> <select id="hsel"></select></h3>
   <svg id="hist" width="460" height="220"></svg></div>
 </div>
 <script>
@@ -120,13 +126,13 @@ poll();
 
 _MODEL_PAGE = _HEAD + """
 <div class="row">
- <div class="card" style="min-width:280px"><h3>Layers (click to select)</h3>
+ <div class="card" style="min-width:280px"><h3 data-i18n="train.model.layers">Layers (click to select)</h3>
   <div id="ltable" style="font-size:12px"></div></div>
  <div class="card"><h3 id="ltitle">Layer</h3><div id="ldetail" style="font-size:12px"></div></div>
 </div>
 <div class="row">
- <div class="card"><h3>Mean magnitude: parameters (log10)</h3><svg id="lpmag" width="460" height="220"></svg></div>
- <div class="card"><h3>Mean magnitude: gradients (log10)</h3><svg id="lgmag" width="460" height="220"></svg></div>
+ <div class="card"><h3 data-i18n="train.model.paramMag">Mean magnitude: parameters (log10)</h3><svg id="lpmag" width="460" height="220"></svg></div>
+ <div class="card"><h3 data-i18n="train.model.gradMag">Mean magnitude: gradients (log10)</h3><svg id="lgmag" width="460" height="220"></svg></div>
  <div class="card"><h3>Update:param ratio (log10)</h3><svg id="lratio" width="460" height="220"></svg></div>
 </div>
 <div class="row">
@@ -193,6 +199,60 @@ poll();
 """
 
 
+_TSNE_PAGE = _HEAD + """
+<div class="row">
+ <div class="card"><h3 data-i18n="tsne.title">t-SNE embedding</h3>
+  <select id="tsess"></select>
+  <svg id="plot" width="760" height="560"></svg></div>
+</div>
+<script>
+function render(){}
+async function tsnePoll(){
+  try{
+    const r=await fetch('/tsne/sessions'); const j=await r.json();
+    const sel=document.getElementById('tsess');
+    const cur=sel.value;
+    sel.innerHTML=j.sessions.map(s=>`<option>${s}</option>`).join('');
+    if(j.sessions.includes(cur))sel.value=cur;
+    if(sel.value){
+      const c=await fetch(`/tsne/coords/${encodeURIComponent(sel.value)}`);
+      const d=await c.json();
+      dl4j.scatter('plot', d.points);
+      document.getElementById('status').textContent=
+        `${d.points.length} points`;
+    }
+  }catch(e){document.getElementById('status').textContent='disconnected';}
+  setTimeout(tsnePoll,3000);
+}
+tsnePoll();
+</script></body></html>
+"""
+
+_W2V_PAGE = _HEAD + """
+<div class="row">
+ <div class="card"><h3 data-i18n="word2vec.title">Nearest words</h3>
+  <input id="word" data-i18n-placeholder="word2vec.prompt" placeholder="word">
+  <input id="topn" type="number" value="10" style="width:52px">
+  <button onclick="query()">&rarr;</button>
+  <div id="result" style="font-size:13px;margin-top:10px"></div></div>
+</div>
+<script>
+function render(){}
+async function query(){
+  const w=document.getElementById('word').value;
+  const n=document.getElementById('topn').value;
+  const r=await fetch(`/word2vec/nearest?word=${encodeURIComponent(w)}&n=${n}`);
+  const j=await r.json();
+  if(j.error){document.getElementById('result').textContent=j.error;return;}
+  dl4j.grid('result',['word','similarity'],
+    j.nearest.map(e=>[e.word,e.similarity.toFixed(4)]));
+}
+document.getElementById('word').addEventListener('keydown',
+  e=>{if(e.key==='Enter')query();});
+</script></body></html>
+"""
+
+
 class _Handler(BaseHTTPRequestHandler):
     server_version = "DL4JTPU-UI/1.0"
 
@@ -222,6 +282,38 @@ class _Handler(BaseHTTPRequestHandler):
             self._raw(CHARTS_JS.encode(),
                       "application/javascript; charset=utf-8")
             return
+        if url.path == "/tsne":
+            self._raw(_TSNE_PAGE.encode(), "text/html; charset=utf-8")
+            return
+        if url.path == "/word2vec":
+            self._raw(_W2V_PAGE.encode(), "text/html; charset=utf-8")
+            return
+        if url.path == "/i18n":
+            from deeplearning4j_tpu.ui.i18n import catalog
+            lang = parse_qs(url.query).get("lang", ["en"])[0]
+            self._json(catalog(lang))
+            return
+        if url.path == "/tsne/sessions":
+            self._json({"sessions": sorted(ui._tsne_sessions)})
+            return
+        if url.path.startswith("/tsne/coords/"):
+            sid = unquote(url.path.rsplit("/", 1)[-1])
+            pts = ui._tsne_sessions.get(sid)
+            if pts is None:
+                self._json({"error": f"unknown t-SNE session '{sid}'"},
+                           code=404)
+            else:
+                self._json({"points": pts})
+            return
+        if url.path == "/word2vec/nearest":
+            q = parse_qs(url.query)
+            word = q.get("word", [""])[0]
+            try:
+                n = max(1, int(q.get("n", ["10"])[0]))
+            except ValueError:
+                n = 10
+            self._json(ui.nearest_words(word, n))
+            return
         if url.path == "/train/sessions":
             self._json({"sessions": ui.session_ids()})
             return
@@ -230,6 +322,25 @@ class _Handler(BaseHTTPRequestHandler):
             sid = q.get("sid", [""])[0]
             after = float(q.get("after", ["0"])[0])
             self._json(ui.session_data(sid, after))
+            return
+        self._json({"error": "not found"}, code=404)
+
+    def do_POST(self):
+        # TsneModule.java route parity: POST /tsne/post/<sid> with a JSON
+        # body {"points": [[x, y, label?], ...]}
+        ui: "UIServer" = self.server.ui           # type: ignore[attr-defined]
+        url = urlparse(self.path)
+        if url.path.startswith("/tsne/post/"):
+            sid = unquote(url.path.rsplit("/", 1)[-1])
+            length = int(self.headers.get("Content-Length", "0"))
+            try:
+                body = json.loads(self.rfile.read(length) or b"{}")
+                pts = body["points"]
+                ui.post_tsne(sid, pts)
+            except (ValueError, KeyError, TypeError, IndexError) as e:
+                self._json({"error": f"bad body: {e}"}, code=400)
+                return
+            self._json({"ok": True, "n": len(pts)})
             return
         self._json({"error": "not found"}, code=404)
 
@@ -247,6 +358,8 @@ class UIServer:
 
     def __init__(self, port: int = 0):
         self._storages: list = []
+        self._tsne_sessions: Dict[str, list] = {}
+        self._word_vectors = None
         self._httpd = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
         self._httpd.ui = self                    # type: ignore[attr-defined]
         self.port = self._httpd.server_address[1]
@@ -272,6 +385,41 @@ class UIServer:
     def detach(self, storage: StatsStorage):
         if storage in self._storages:
             self._storages.remove(storage)
+
+    # --------------------------------------------- t-SNE / word2vec views
+    def post_tsne(self, session_id: str, points, labels=None):
+        """Publish a 2D embedding to the /tsne view (TsneModule.java
+        uploadFile/postFile parity). points: (N, 2) array-like or
+        [[x, y, label?], ...]; labels: optional per-point labels."""
+        out = []
+        for i, p in enumerate(points):
+            p = list(p)
+            if labels is not None:
+                out.append([float(p[0]), float(p[1]), str(labels[i])])
+            elif len(p) > 2:
+                out.append([float(p[0]), float(p[1]), str(p[2])])
+            else:
+                out.append([float(p[0]), float(p[1])])
+        self._tsne_sessions[str(session_id)] = out
+
+    def attach_word_vectors(self, word_vectors):
+        """Attach a WordVectors/lookup table for the /word2vec nearest-
+        neighbor view (NearestNeighborsQuery.java parity)."""
+        self._word_vectors = word_vectors
+
+    def nearest_words(self, word: str, n: int = 10) -> Dict:
+        wv = self._word_vectors
+        if wv is None:
+            return {"error": "no word vectors attached "
+                             "(UIServer.attach_word_vectors)"}
+        if not word:
+            return {"error": "empty query"}
+        if hasattr(wv, "has_word") and not wv.has_word(word):
+            return {"error": f"'{word}' not in vocabulary"}
+        near = wv.words_nearest(word, top_n=n)
+        return {"word": word, "nearest": [
+            {"word": w, "similarity": float(wv.similarity(word, w))}
+            for w in near]}
 
     # ----------------------------------------------------------- queries
     def session_ids(self):

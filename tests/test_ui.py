@@ -164,3 +164,91 @@ def test_ui_server_model_tab_and_chart_components():
             assert "hist" in last[group][keys[0]]
     finally:
         server.stop()
+
+
+def test_tsne_word2vec_views_and_i18n():
+    """Legacy-visualizer parity: /tsne (TsneModule routes), /word2vec
+    (NearestNeighborsQuery) and the /i18n catalog."""
+    import urllib.error
+    from deeplearning4j_tpu.embeddings.vocab import VocabCache
+    from deeplearning4j_tpu.embeddings.wordvectors import WordVectors
+
+    server = UIServer(port=0)
+    try:
+        base = server.url.rstrip("/")
+        # --- t-SNE: POST coords (module route) then render data
+        pts = [[0.0, 1.0, "a"], [2.0, 3.0, "b"], [4.0, 5.0, "a"]]
+        req = urllib.request.Request(
+            base + "/tsne/post/run1",
+            data=json.dumps({"points": pts}).encode(),
+            headers={"Content-Type": "application/json"})
+        r = json.loads(urllib.request.urlopen(req, timeout=5).read())
+        assert r == {"ok": True, "n": 3}
+        sessions = json.loads(urllib.request.urlopen(
+            base + "/tsne/sessions", timeout=5).read())["sessions"]
+        assert sessions == ["run1"]
+        coords = json.loads(urllib.request.urlopen(
+            base + "/tsne/coords/run1", timeout=5).read())["points"]
+        assert coords == pts
+        page = urllib.request.urlopen(base + "/tsne", timeout=5).read()
+        assert b"dl4j.scatter" in page
+        # python-side publisher too
+        server.post_tsne("run2", np.array([[1.0, 2.0], [3.0, 4.0]]),
+                         labels=["x", "y"])
+        coords2 = json.loads(urllib.request.urlopen(
+            base + "/tsne/coords/run2", timeout=5).read())["points"]
+        assert coords2[0] == [1.0, 2.0, "x"]
+
+        # --- word2vec nearest view
+        vocab = VocabCache()
+        for w, c in (("king", 3), ("queen", 2), ("apple", 1)):
+            vocab.add_token(w, count=c)
+        vocab.build()
+        vecs = np.array([[1.0, 0.0], [0.9, 0.1], [0.0, 1.0]], np.float32)
+        server.attach_word_vectors(WordVectors(vocab, vecs))
+        res = json.loads(urllib.request.urlopen(
+            base + "/word2vec/nearest?word=king&n=2", timeout=5).read())
+        assert res["nearest"][0]["word"] == "queen"
+        assert res["nearest"][0]["similarity"] > 0.9
+        oov = json.loads(urllib.request.urlopen(
+            base + "/word2vec/nearest?word=zzz&n=2", timeout=5).read())
+        assert "not in vocabulary" in oov["error"]
+
+        # --- i18n catalog in all six reference languages
+        for lang, expect in [("en", "overview"), ("de", "Übersicht"),
+                             ("ja", "概要"), ("ko", "개요"),
+                             ("ru", "обзор"), ("zh", "概览")]:
+            cat = json.loads(urllib.request.urlopen(
+                base + f"/i18n?lang={lang}", timeout=5).read())
+            assert cat["train.nav.overview"] == expect
+        # unknown language falls back to english
+        cat = json.loads(urllib.request.urlopen(
+            base + "/i18n?lang=xx", timeout=5).read())
+        assert cat["train.nav.overview"] == "overview"
+    finally:
+        server.stop()
+
+
+def test_tsne_routes_handle_encoded_ids_and_bad_bodies():
+    import urllib.error
+    server = UIServer(port=0)
+    try:
+        base = server.url.rstrip("/")
+        server.post_tsne("run 1", [[0.0, 1.0]])
+        got = json.loads(urllib.request.urlopen(
+            base + "/tsne/coords/run%201", timeout=5).read())
+        assert got["points"] == [[0.0, 1.0]]
+        req = urllib.request.Request(
+            base + "/tsne/post/x", data=b'{"points": [[1]]}',
+            headers={"Content-Type": "application/json"})
+        try:
+            urllib.request.urlopen(req, timeout=5)
+            assert False, "expected 400"
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+        # non-numeric n falls back instead of crashing the handler
+        r = json.loads(urllib.request.urlopen(
+            base + "/word2vec/nearest?word=x&n=", timeout=5).read())
+        assert "error" in r
+    finally:
+        server.stop()

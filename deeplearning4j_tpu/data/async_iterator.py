@@ -26,6 +26,11 @@ _SENTINEL = object()
 class AsyncDataSetIterator(DataSetIterator):
     def __init__(self, source: DataSetIterator, queue_size: int = 4,
                  device_put: bool = True, device=None):
+        if getattr(source, "async_supported", True) is False:
+            # AsyncShieldDataSetIterator semantics: pass through unwrapped
+            self._passthrough = source
+        else:
+            self._passthrough = None
         self._source = source
         self._queue_size = int(queue_size)
         self._device_put = device_put
@@ -68,6 +73,11 @@ class AsyncDataSetIterator(DataSetIterator):
         self._put(q, stop, _SENTINEL)
 
     def __iter__(self):
+        if self._passthrough is not None:
+            return iter(self._passthrough)
+        return self._iter_async()
+
+    def _iter_async(self):
         q: "queue.Queue" = queue.Queue(maxsize=self._queue_size)
         stop = threading.Event()
         t = threading.Thread(target=self._worker, args=(q, stop), daemon=True)

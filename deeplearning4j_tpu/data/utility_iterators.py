@@ -4,8 +4,14 @@ Parity: DL4J `deeplearning4j-utility-iterators/` (~30 classes; the
 load-bearing ones): `EarlyTerminationDataSetIterator`,
 `MultipleEpochsIterator`, `DataSetIteratorSplitter` (train/test views over
 one source), `SamplingDataSetIterator`, `IteratorDataSetIterator` (wrap a
-plain iterable), and the async MULTI-dataset shield
-(`AsyncMultiDataSetIterator`).
+plain iterable), the async MULTI-dataset shield
+(`AsyncMultiDataSetIterator`), plus (round 4)
+`ReconstructionDataSetIterator`, `AsyncShieldDataSetIterator`,
+`BenchmarkDataSetIterator`, `SingletonMultiDataSetIterator`,
+`IteratorMultiDataSetIterator`, `EarlyTerminationMultiDataSetIterator`,
+`MultiDataSetWrapperIterator` and `MultiDataSetIteratorSplitter`.
+`Floats/Doubles/INDArrayDataSetIterator` collapse into
+`ArrayDataSetIterator` (numpy is the only array currency here).
 """
 from __future__ import annotations
 
@@ -204,3 +210,132 @@ class AsyncMultiDataSetIterator:
     def reset(self):
         if hasattr(self.source, "reset"):
             self.source.reset()
+
+
+class ReconstructionDataSetIterator(DataSetIterator):
+    """Features become the labels (DL4J ReconstructionDataSetIterator):
+    the autoencoder-training adapter."""
+
+    def __init__(self, source: DataSetIterator):
+        self.source = source
+
+    def reset(self):
+        self.source.reset()
+
+    def batch_size(self):
+        return self.source.batch_size()
+
+    def __iter__(self):
+        for ds in self.source:
+            yield DataSet(ds.features, ds.features, ds.features_mask,
+                          ds.features_mask)
+
+
+class AsyncShieldDataSetIterator(DataSetIterator):
+    """Marks a source as must-NOT-be-async-prefetched (DL4J
+    AsyncShieldDataSetIterator): AsyncDataSetIterator passes it through
+    untouched via `async_supported`. Use for sources whose batches alias
+    shared mutable buffers."""
+
+    async_supported = False
+
+    def __init__(self, source: DataSetIterator):
+        self.source = source
+
+    def reset(self):
+        self.source.reset()
+
+    def batch_size(self):
+        return self.source.batch_size()
+
+    def __iter__(self):
+        return iter(self.source)
+
+
+class BenchmarkDataSetIterator(DataSetIterator):
+    """Fixed synthetic batches for throughput measurement (DL4J
+    impl/BenchmarkDataSetIterator.java): one batch is materialized once
+    and yielded `n_batches` times per epoch — iteration cost is pure
+    framework/device time, no data generation in the loop."""
+
+    def __init__(self, feature_shape, n_labels: int, n_batches: int = 100,
+                 seed: int = 0):
+        rs = np.random.RandomState(seed)
+        feats = rs.rand(*feature_shape).astype("float32")
+        labels = np.eye(n_labels, dtype="float32")[
+            rs.randint(0, n_labels, feature_shape[0])]
+        self._ds = DataSet(feats, labels)
+        self.n_batches = int(n_batches)
+
+    def batch_size(self):
+        return int(self._ds.features.shape[0])
+
+    def __iter__(self):
+        for _ in range(self.n_batches):
+            yield self._ds
+
+
+class SingletonMultiDataSetIterator:
+    """Yields one MultiDataSet per epoch (DL4J
+    impl/SingletonMultiDataSetIterator.java)."""
+
+    def __init__(self, mds: MultiDataSet):
+        self.mds = mds
+
+    def reset(self):
+        pass
+
+    def __iter__(self):
+        yield self.mds
+
+
+class IteratorMultiDataSetIterator:
+    """Wrap a plain iterable of MultiDataSet (DL4J
+    IteratorMultiDataSetIterator); resettable only when constructed from
+    a re-iterable collection."""
+
+    def __init__(self, source: Iterable):
+        self.source = source
+
+    def reset(self):
+        pass
+
+    def __iter__(self):
+        return iter(self.source)
+
+
+class EarlyTerminationMultiDataSetIterator(EarlyTerminationDataSetIterator):
+    """Cap the number of MultiDataSet batches per epoch (DL4J
+    EarlyTerminationMultiDataSetIterator). The capping logic is
+    source-type agnostic — this is the MultiDataSet-typed name for it."""
+
+
+class MultiDataSetWrapperIterator(DataSetIterator):
+    """Adapt a single-input/single-output MultiDataSet iterator to the
+    DataSetIterator contract (DL4J MultiDataSetWrapperIterator)."""
+
+    def __init__(self, source):
+        self.source = source
+
+    def reset(self):
+        if hasattr(self.source, "reset"):
+            self.source.reset()
+
+    def __iter__(self):
+        for mds in self.source:
+            if len(mds.features) != 1 or len(mds.labels) != 1:
+                raise ValueError(
+                    "MultiDataSetWrapperIterator requires single-input/"
+                    f"single-output data, got {len(mds.features)} inputs / "
+                    f"{len(mds.labels)} outputs")
+            fm = mds.features_masks[0] if mds.features_masks else None
+            lm = mds.labels_masks[0] if mds.labels_masks else None
+            yield DataSet(mds.features[0], mds.labels[0], fm, lm)
+
+
+class MultiDataSetIteratorSplitter(DataSetIteratorSplitter):
+    """Train/test views over one MultiDataSet source (DL4J
+    MultiDataSetIteratorSplitter). _SplitView never inspects the yielded
+    items, so the whole split/rewind machinery (including the
+    rewind-on-early-break invariant) is shared with the DataSet
+    variant."""

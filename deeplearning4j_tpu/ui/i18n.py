@@ -20,6 +20,9 @@ _CATALOG: Dict[str, Dict[str, str]] = {
     "train.nav.model": {
         "en": "model", "de": "Modell", "ja": "モデル", "ko": "모델",
         "ru": "модель", "zh": "模型"},
+    "train.nav.system": {
+        "en": "system", "de": "System", "ja": "システム", "ko": "시스템",
+        "ru": "система", "zh": "系统"},
     "train.nav.tsne": {
         "en": "t-SNE", "de": "t-SNE", "ja": "t-SNE", "ko": "t-SNE",
         "ru": "t-SNE", "zh": "t-SNE"},

@@ -34,6 +34,7 @@ _HEAD = f"""<!DOCTYPE html>
 <header>DL4J-TPU Training Dashboard
  <a href="/train" data-i18n="train.nav.overview">overview</a><a
   href="/train/model" data-i18n="train.nav.model">model</a><a
+  href="/train/system" data-i18n="train.nav.system">system</a><a
   href="/tsne" data-i18n="train.nav.tsne">t-SNE</a><a
   href="/word2vec" data-i18n="train.nav.word2vec">word2vec</a>
  <select id="sess"></select>
@@ -48,7 +49,7 @@ async function refreshSessions(){{
   const r=await fetch('/train/sessions'); const j=await r.json();
   const sel=document.getElementById('sess');
   const cur=sel.value;
-  sel.innerHTML=j.sessions.map(s=>`<option>${{s}}</option>`).join('');
+  sel.innerHTML=j.sessions.map(s=>`<option>${{dl4j.esc(s)}}</option>`).join('');
   if(j.sessions.includes(cur))sel.value=cur;
   if(!sid&&j.sessions.length){{sid=sel.value;}}
 }}
@@ -199,6 +200,33 @@ poll();
 """
 
 
+_SYSTEM_PAGE = _HEAD + """
+<div class="row">
+ <div class="card"><h3>Devices</h3><div id="devs" style="font-size:12px"></div></div>
+ <div class="card"><h3>Host memory (max RSS, MB)</h3><svg id="rss" width="460" height="220"></svg></div>
+ <div class="card"><h3>Device memory (MB in use)</h3><svg id="dmem" width="460" height="220"></svg></div>
+</div>
+<div class="row">
+ <div class="card"><h3>Iteration time (ms)</h3><svg id="itms" width="460" height="220"></svg></div>
+ <div class="card"><h3>ETL time (ms)</h3><svg id="etl" width="460" height="220"></svg></div>
+</div>
+<script>
+function render(){
+  const d=updates.map(u=>u.data);
+  const si=statics.data||{};
+  dl4j.kvTable('devs',[['devices',JSON.stringify(si.devices)],
+    ['model_class',si.model_class],['num_params',si.num_params]]);
+  dl4j.line('rss',[d.filter(u=>u.memory&&u.memory.host_max_rss_kb)
+    .map(u=>[u.iteration,u.memory.host_max_rss_kb/1024])]);
+  dl4j.line('dmem',[d.filter(u=>u.memory&&u.memory.device_bytes_in_use)
+    .map(u=>[u.iteration,u.memory.device_bytes_in_use/1048576])]);
+  dl4j.line('itms',[d.filter(u=>u.iter_ms>0).map(u=>[u.iteration,u.iter_ms])]);
+  dl4j.line('etl',[d.map(u=>[u.iteration,u.etl_ms])]);
+}
+poll();
+</script></body></html>
+"""
+
 _TSNE_PAGE = _HEAD + """
 <div class="row">
  <div class="card"><h3 data-i18n="tsne.title">t-SNE embedding</h3>
@@ -212,7 +240,7 @@ async function tsnePoll(){
     const r=await fetch('/tsne/sessions'); const j=await r.json();
     const sel=document.getElementById('tsess');
     const cur=sel.value;
-    sel.innerHTML=j.sessions.map(s=>`<option>${s}</option>`).join('');
+    sel.innerHTML=j.sessions.map(s=>`<option>${dl4j.esc(s)}</option>`).join('');
     if(j.sessions.includes(cur))sel.value=cur;
     if(sel.value){
       const c=await fetch(`/tsne/coords/${encodeURIComponent(sel.value)}`);
@@ -278,6 +306,9 @@ class _Handler(BaseHTTPRequestHandler):
         if url.path == "/train/model":
             self._raw(_MODEL_PAGE.encode(), "text/html; charset=utf-8")
             return
+        if url.path == "/train/system":
+            self._raw(_SYSTEM_PAGE.encode(), "text/html; charset=utf-8")
+            return
         if url.path == "/assets/charts.js":
             self._raw(CHARTS_JS.encode(),
                       "application/javascript; charset=utf-8")
@@ -294,11 +325,14 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(catalog(lang))
             return
         if url.path == "/tsne/sessions":
-            self._json({"sessions": sorted(ui._tsne_sessions)})
+            with ui._tsne_lock:
+                sessions = sorted(ui._tsne_sessions)
+            self._json({"sessions": sessions})
             return
         if url.path.startswith("/tsne/coords/"):
             sid = unquote(url.path.rsplit("/", 1)[-1])
-            pts = ui._tsne_sessions.get(sid)
+            with ui._tsne_lock:
+                pts = ui._tsne_sessions.get(sid)
             if pts is None:
                 self._json({"error": f"unknown t-SNE session '{sid}'"},
                            code=404)
@@ -359,6 +393,7 @@ class UIServer:
     def __init__(self, port: int = 0):
         self._storages: list = []
         self._tsne_sessions: Dict[str, list] = {}
+        self._tsne_lock = threading.Lock()
         self._word_vectors = None
         self._httpd = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
         self._httpd.ui = self                    # type: ignore[attr-defined]
@@ -400,7 +435,8 @@ class UIServer:
                 out.append([float(p[0]), float(p[1]), str(p[2])])
             else:
                 out.append([float(p[0]), float(p[1])])
-        self._tsne_sessions[str(session_id)] = out
+        with self._tsne_lock:
+            self._tsne_sessions[str(session_id)] = out
 
     def attach_word_vectors(self, word_vectors):
         """Attach a WordVectors/lookup table for the /word2vec nearest-

@@ -268,3 +268,72 @@ def test_tiny_imagenet_real_directory_parsing(tmp_path, monkeypatch):
     assert ds.features.shape == (6, 64, 64, 3)
     # labels one-hot over the discovered wnids (2 classes present)
     assert set(np.argmax(np.asarray(ds.labels), 1)) == {0, 1}
+
+
+class TestRound4UtilityIterators:
+    """The remaining load-bearing utility-iterator surface (DL4J
+    deeplearning4j-utility-iterators round-4 additions)."""
+
+    def _mds_batches(self, n=6):
+        from deeplearning4j_tpu.data.dataset import MultiDataSet
+        return [MultiDataSet((np.full((2, 3), i, np.float32),),
+                             (np.full((2, 1), i, np.float32),), None, None)
+                for i in range(n)]
+
+    def test_reconstruction_iterator_mirrors_features(self):
+        from deeplearning4j_tpu.data.iterator import ArrayDataSetIterator
+        from deeplearning4j_tpu.data.utility_iterators import (
+            ReconstructionDataSetIterator,
+        )
+        X = np.arange(12, dtype=np.float32).reshape(4, 3)
+        it = ReconstructionDataSetIterator(
+            ArrayDataSetIterator(X, np.zeros((4, 1), np.float32),
+                                 batch_size=2))
+        for ds in it:
+            np.testing.assert_array_equal(ds.features, ds.labels)
+
+    def test_async_shield_passes_through_unwrapped(self):
+        from deeplearning4j_tpu.data.async_iterator import AsyncDataSetIterator
+        from deeplearning4j_tpu.data.iterator import ArrayDataSetIterator
+        from deeplearning4j_tpu.data.utility_iterators import (
+            AsyncShieldDataSetIterator,
+        )
+        X = np.zeros((4, 3), np.float32)
+        Y = np.zeros((4, 2), np.float32)
+        shielded = AsyncShieldDataSetIterator(
+            ArrayDataSetIterator(X, Y, batch_size=2))
+        wrapped = AsyncDataSetIterator(shielded)
+        assert wrapped._passthrough is shielded
+        assert len(list(wrapped)) == 2
+
+    def test_benchmark_iterator_reuses_one_batch(self):
+        from deeplearning4j_tpu.data.utility_iterators import (
+            BenchmarkDataSetIterator,
+        )
+        it = BenchmarkDataSetIterator((8, 4), n_labels=3, n_batches=5)
+        batches = list(it)
+        assert len(batches) == 5
+        assert all(b is batches[0] for b in batches)
+        assert batches[0].labels.shape == (8, 3)
+
+    def test_mds_wrapper_splitter_and_early_termination(self):
+        from deeplearning4j_tpu.data.utility_iterators import (
+            EarlyTerminationMultiDataSetIterator,
+            IteratorMultiDataSetIterator, MultiDataSetIteratorSplitter,
+            MultiDataSetWrapperIterator, SingletonMultiDataSetIterator,
+        )
+        batches = self._mds_batches(6)
+        src = IteratorMultiDataSetIterator(batches)
+        assert len(list(src)) == 6
+        early = EarlyTerminationMultiDataSetIterator(src, 2)
+        assert len(list(early)) == 2
+        split = MultiDataSetIteratorSplitter(src, total_batches=6,
+                                             ratio=0.5)
+        assert [float(m.features[0][0, 0])
+                for m in split.train_iterator] == [0.0, 1.0, 2.0]
+        assert [float(m.features[0][0, 0])
+                for m in split.test_iterator] == [3.0, 4.0, 5.0]
+        ds = list(MultiDataSetWrapperIterator(src))
+        assert ds[0].features.shape == (2, 3)
+        single = SingletonMultiDataSetIterator(batches[0])
+        assert len(list(single)) == 1

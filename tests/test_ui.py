@@ -192,6 +192,9 @@ def test_tsne_word2vec_views_and_i18n():
         assert coords == pts
         page = urllib.request.urlopen(base + "/tsne", timeout=5).read()
         assert b"dl4j.scatter" in page
+        sysp = urllib.request.urlopen(base + "/train/system",
+                                      timeout=5).read()
+        assert b"Iteration time" in sysp and b"charts.js" in sysp
         # python-side publisher too
         server.post_tsne("run2", np.array([[1.0, 2.0], [3.0, 4.0]]),
                          labels=["x", "y"])

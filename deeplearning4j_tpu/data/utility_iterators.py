@@ -291,11 +291,12 @@ class SingletonMultiDataSetIterator:
 
 class IteratorMultiDataSetIterator:
     """Wrap a plain iterable of MultiDataSet (DL4J
-    IteratorMultiDataSetIterator); resettable only when constructed from
-    a re-iterable collection."""
+    IteratorMultiDataSetIterator). Materialized at construction (like
+    IteratorDataSetIterator above) so a one-shot generator source still
+    supports multi-epoch reset instead of silently yielding nothing."""
 
     def __init__(self, source: Iterable):
-        self.source = source
+        self.source = list(source)
 
     def reset(self):
         pass

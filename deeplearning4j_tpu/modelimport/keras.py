@@ -509,6 +509,8 @@ def _sequence_after(k_cls: str, cur_seq: bool, k_cfg: dict = None) -> bool:
                  "Cropping1D", "UpSampling1D", "ZeroPadding1D",
                  "LocallyConnected1D", "Masking"):
         return cur_seq          # 1D conv/pool/pad keep (B, T, C) sequences
+    if k_cls == "Reshape":
+        return len(k_cfg.get("target_shape", ())) == 2   # (T, C) -> seq
     if k_cls in ("Dropout", "Activation", "BatchNormalization",
                  "LayerNormalization", "Dense", "TimeDistributed",
                  "LeakyReLU", "ELU", "ReLU", "Softmax", "Permute",
@@ -849,6 +851,20 @@ def _map_layer(k_cls: str, k_cfg: dict, is_output: bool,
     if k_cls == "Permute":
         dims = k_cfg.get("dims", (1,))
         return PermuteLayer(dims=tuple(int(d) for d in dims)), None
+
+    if k_cls == "Reshape":
+        # KerasReshape.java -> ReshapePreprocessor; layer form here
+        from deeplearning4j_tpu.nn.layers import ReshapeLayer
+        target = tuple(int(d) for d in k_cfg["target_shape"])
+        return ReshapeLayer(target=target), None
+
+    if k_cls in ("LRN", "LocalResponseNormalization"):
+        # KerasLRN.java (custom/keras-contrib layer in Keras-2 archives)
+        from deeplearning4j_tpu.nn.layers import LocalResponseNormalization
+        return LocalResponseNormalization(
+            k=float(k_cfg.get("k", 2.0)), n=int(k_cfg.get("n", 5)),
+            alpha=float(k_cfg.get("alpha", 1e-4)),
+            beta=float(k_cfg.get("beta", 0.75))), None
 
     if k_cls == "RepeatVector":
         return RepeatVector(n=int(k_cfg["n"])), None

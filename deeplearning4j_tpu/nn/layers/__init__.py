@@ -1,7 +1,7 @@
 from deeplearning4j_tpu.nn.layers.feedforward import (
     DenseLayer, EmbeddingLayer, ActivationLayer, DropoutLayer,
     OutputLayer, CenterLossOutputLayer, LossLayer, AutoEncoder,
-    RepeatVector, PermuteLayer,
+    RepeatVector, PermuteLayer, ReshapeLayer,
 )
 from deeplearning4j_tpu.nn.layers.convolution import (
     ConvolutionLayer, Convolution1DLayer, SubsamplingLayer,
@@ -29,7 +29,7 @@ from deeplearning4j_tpu.nn.layers.attention import (
 __all__ = [
     "DenseLayer", "EmbeddingLayer", "ActivationLayer", "DropoutLayer",
     "OutputLayer", "CenterLossOutputLayer", "LossLayer", "AutoEncoder",
-    "RepeatVector", "PermuteLayer",
+    "RepeatVector", "PermuteLayer", "ReshapeLayer",
     "ConvolutionLayer", "Convolution1DLayer", "SubsamplingLayer",
     "Subsampling1DLayer", "Upsampling2D", "ZeroPaddingLayer",
     "GlobalPoolingLayer", "Deconvolution2D", "SeparableConvolution2D",

@@ -330,3 +330,45 @@ class PermuteLayer(LayerConf):
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
         perm = (0,) + tuple(int(d) for d in self.dims)
         return jnp.transpose(x, perm), state
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class ReshapeLayer(LayerConf):
+    """Reshape the non-batch axes (the layer form of DL4J's
+    ReshapePreprocessor, used by modelimport KerasReshape.java; Keras
+    Reshape). target: non-batch shape; kind is inferred from its rank
+    (1 -> FF, 2 -> (T, C) sequence, 3 -> (H, W, C) image)."""
+    target: Tuple[int, ...] = ()    # one dim may be -1 (inferred, as Keras)
+
+    def _resolve(self, in_shape) -> Tuple[int, ...]:
+        import numpy as _np
+        total = int(_np.prod(in_shape))
+        tgt = [int(d) for d in self.target]
+        if tgt.count(-1) > 1:
+            raise ValueError(f"Reshape: at most one -1 in {self.target}")
+        if -1 in tgt:
+            rest = int(_np.prod([d for d in tgt if d != -1]))
+            if rest <= 0 or total % rest:
+                raise ValueError(
+                    f"Reshape: cannot infer -1 reshaping {in_shape} "
+                    f"into {self.target}")
+            tgt[tgt.index(-1)] = total // rest
+        if int(_np.prod(tgt)) != total:
+            raise ValueError(
+                f"Reshape: cannot reshape {tuple(in_shape)} (size {total}) "
+                f"into {self.target}")
+        return tuple(tgt)
+
+    def output_type(self, input_type: InputType) -> InputType:
+        shape = self._resolve(input_type.shape)
+        kind = {1: Kind.FF, 2: Kind.RNN, 3: Kind.CNN}.get(len(shape))
+        if kind is None:
+            raise ValueError(f"Reshape: unsupported rank {len(shape)}")
+        return InputType(kind, shape)
+
+    def has_params(self):
+        return False
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        return x.reshape((x.shape[0],) + self._resolve(x.shape[1:])), state

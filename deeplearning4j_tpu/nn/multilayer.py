@@ -411,7 +411,11 @@ class MultiLayerNetwork:
         deferred one chunk, so the dispatch pipeline never blocks on a
         device→host sync. The RNG stream, update math and listener calls are
         identical to the per-call path (bit-for-bit, tested) — only the
-        host/device overlap changes. Default from $DL4J_TPU_SCAN_STEPS or 1."""
+        host/device overlap changes. Default from $DL4J_TPU_SCAN_STEPS or 1.
+
+        Intended for dispatch-bound TPU loops. Caveat (PERF.md "mechanism
+        check"): XLA:CPU pessimizes convolutions inside scan, so conv nets
+        on CPU should keep scan_steps=1."""
         if self.params is None:
             self.init()
         if scan_steps is None:

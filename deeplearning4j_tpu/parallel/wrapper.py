@@ -200,6 +200,7 @@ class ParallelWrapper:
             for lst in net.listeners:
                 lst.on_epoch_start(net, net.epoch_count)
             etl_start = time.perf_counter()
+            loss = None
             for x, y, fm, lm in self._batches(source):
                 etl_ms = (time.perf_counter() - etl_start) * 1e3
                 bs = self._batch_count(x)
@@ -207,13 +208,20 @@ class ParallelWrapper:
                 rng, sub = jax.random.split(rng)
                 net.params, net.opt_state, net.state, loss = self._step_fn(
                     net.params, net.opt_state, net.state, x, y, fm, lm, sub)
-                net._score = float(loss)
-                for lst in net.listeners:
-                    lst.iteration_done(net, net.iteration_count,
-                                       net.epoch_count, net._score,
-                                       etl_ms, bs)
+                # the device->host loss fetch is a hard sync that caps
+                # dispatch pipelining; only pay it per-step when a
+                # listener consumes the value (score() reads the
+                # epoch-end catch-up below otherwise)
+                if net.listeners:
+                    net._score = float(loss)
+                    for lst in net.listeners:
+                        lst.iteration_done(net, net.iteration_count,
+                                           net.epoch_count, net._score,
+                                           etl_ms, bs)
                 net.iteration_count += 1
                 etl_start = time.perf_counter()
+            if loss is not None and not net.listeners:
+                net._score = float(loss)    # one catch-up fetch per epoch
             for lst in net.listeners:
                 lst.on_epoch_end(net, net.epoch_count)
             net.epoch_count += 1

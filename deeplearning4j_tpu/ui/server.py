@@ -107,7 +107,7 @@ function render(){
   const hsel=document.getElementById('hsel');
   const gkeys=Object.keys(last.gradients||{});
   if(hsel.options.length!==gkeys.length){
-    hsel.innerHTML=gkeys.map(k=>`<option>${k}</option>`).join('');}
+    hsel.innerHTML=gkeys.map(k=>`<option>${dl4j.esc(k)}</option>`).join('');}
   const histKey=hsel.value||gkeys[0];
   if(histKey&&last.gradients&&last.gradients[histKey]){
     const h=last.gradients[histKey];
@@ -182,7 +182,7 @@ function render(){
     .map(u=>[u.iteration,Math.log10((u.updates[k].mean_mag+1e-12)/(u.params[k].mean_mag+1e-12))])),{names:pkeys});
   const sel=document.getElementById('lpsel');
   if(sel.dataset.keys!==pkeys.join()){   // layer switch: rebuild options
-    sel.innerHTML=pkeys.map(k=>`<option>${k}</option>`).join('');
+    sel.innerHTML=pkeys.map(k=>`<option>${dl4j.esc(k)}</option>`).join('');
     sel.dataset.keys=pkeys.join();
   }
   const pk=sel.value||pkeys[0];

@@ -82,7 +82,7 @@ class _NetworkEstimator(BaseEstimator):
                 f"{type(self).__name__} is not fitted yet — call fit first")
 
 
-class DL4JClassifier(_NetworkEstimator, ClassifierMixin):
+class DL4JClassifier(ClassifierMixin, _NetworkEstimator):
     """Classifier estimator (SparkDl4jNetwork.scala's Estimator role).
 
     `conf` may be a ready MultiLayerConfiguration (its output head defines
@@ -127,7 +127,7 @@ class DL4JClassifier(_NetworkEstimator, ClassifierMixin):
         return self.classes_[proba.argmax(axis=1)]
 
 
-class DL4JRegressor(_NetworkEstimator, RegressorMixin):
+class DL4JRegressor(RegressorMixin, _NetworkEstimator):
     """Regressor estimator: identity/MSE head counterpart."""
 
     def __init__(self, conf=None, hidden=(64,), learning_rate=1e-2,
@@ -158,7 +158,7 @@ class DL4JRegressor(_NetworkEstimator, RegressorMixin):
         return out[:, 0] if self.n_outputs_ == 1 else out
 
 
-class AutoEncoderTransformer(_NetworkEstimator, TransformerMixin):
+class AutoEncoderTransformer(TransformerMixin, _NetworkEstimator):
     """Unsupervised feature transformer (AutoEncoder.scala /
     AutoEncoderWrapper.scala): fit trains a dense autoencoder on X via
     layerwise pretraining; transform returns the bottleneck encoding."""

@@ -1,0 +1,53 @@
+"""Quickstart: configure -> init -> fit -> evaluate -> save/load.
+
+Mirrors dl4j-examples tutorials 01/03/04 (MultiLayerNetwork basics,
+logistic regression, feed-forward) on synthetic blob data.
+Run: python examples/01_quickstart_mlp.py
+"""
+import numpy as np
+
+from deeplearning4j_tpu.data.iterator import ArrayDataSetIterator
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updaters import Adam
+from deeplearning4j_tpu.train.listeners import ScoreIterationListener
+from deeplearning4j_tpu.util.serialization import load_model, save_model
+
+
+def make_data(n=300, seed=0):
+    rs = np.random.RandomState(seed)
+    centers = rs.randn(3, 4) * 4
+    X = np.concatenate([centers[i] + rs.randn(n // 3, 4)
+                        for i in range(3)]).astype("float32")
+    Y = np.eye(3, dtype="float32")[np.repeat(np.arange(3), n // 3)]
+    return X, Y
+
+
+def main(epochs=30, tmpdir="/tmp"):
+    X, Y = make_data()
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(42)
+            .updater(Adam(1e-2))
+            .list()
+            .layer(DenseLayer(n_out=32, activation="relu"))
+            .layer(OutputLayer(n_out=3, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    net.set_listeners(ScoreIterationListener(10))
+    net.fit(ArrayDataSetIterator(X, Y, batch_size=50), epochs=epochs)
+    ev = net.evaluate(ArrayDataSetIterator(X, Y, batch_size=50))
+    print(f"accuracy: {ev.accuracy():.3f}")
+    path = f"{tmpdir}/quickstart_mlp.zip"
+    save_model(net, path)
+    net2 = load_model(path)
+    assert np.allclose(np.asarray(net.output(X[:4])),
+                       np.asarray(net2.output(X[:4])))
+    print(f"saved + reloaded {path}")
+    return ev.accuracy()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,39 @@
+"""ComputationGraph: multi-branch DAG with a merge vertex (tutorial 01's
+graph half). Run: python examples/02_computation_graph.py"""
+import numpy as np
+
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.graph_vertices import MergeVertex
+from deeplearning4j_tpu.nn.conf.network import GraphBuilder
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.updaters import Adam
+
+
+def main(epochs=25):
+    rs = np.random.RandomState(1)
+    X = rs.randn(240, 6).astype("float32")
+    y = (X @ rs.randn(6) > 0).astype(int)
+    Y = np.eye(2, dtype="float32")[y]
+
+    g = (GraphBuilder(NeuralNetConfiguration.Builder().seed(5)
+                      .updater(Adam(1e-2)))
+         .add_inputs("in").set_input_types(InputType.feed_forward(6)))
+    g.add_layer("wide", DenseLayer(n_out=16, activation="relu"), "in")
+    g.add_layer("deep1", DenseLayer(n_out=12, activation="relu"), "in")
+    g.add_layer("deep2", DenseLayer(n_out=12, activation="relu"), "deep1")
+    g.add_vertex("merge", MergeVertex(), "wide", "deep2")
+    g.add_layer("out", OutputLayer(n_out=2, activation="softmax",
+                                   loss="mcxent"), "merge")
+    g.set_outputs("out")
+
+    net = ComputationGraph(g.build()).init()
+    from deeplearning4j_tpu.data.iterator import ArrayDataSetIterator
+    net.fit(ArrayDataSetIterator(X, Y, batch_size=40), epochs=epochs)
+    acc = (np.asarray(net.output(X)).argmax(1) == y).mean()
+    print(f"wide&deep accuracy: {acc:.3f}")
+    return acc
+
+
+if __name__ == "__main__":
+    main()

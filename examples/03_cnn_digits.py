@@ -1,0 +1,28 @@
+"""LeNet-style CNN on sklearn's bundled handwritten digits (tutorial 07's
+conv role, zoo LeNet config). Run: python examples/03_cnn_digits.py"""
+import numpy as np
+from sklearn.datasets import load_digits
+
+from deeplearning4j_tpu.data.iterator import ArrayDataSetIterator
+from deeplearning4j_tpu.models.zoo import LeNet
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+def main(epochs=3, n_train=1500):
+    d = load_digits()
+    X8 = d.images.astype("float32") / 16.0
+    X = np.pad(np.repeat(np.repeat(X8, 3, axis=1), 3, axis=2),
+               ((0, 0), (2, 2), (2, 2)))[..., None]
+    Y = np.eye(10, dtype="float32")[d.target]
+    net = MultiLayerNetwork(LeNet().conf()).init()
+    net.fit(ArrayDataSetIterator(X[:n_train], Y[:n_train], batch_size=100),
+            epochs=epochs)
+    ev = net.evaluate(ArrayDataSetIterator(X[n_train:], Y[n_train:],
+                                           batch_size=99))
+    print(f"holdout accuracy after {epochs} epochs: {ev.accuracy():.3f}")
+    print(ev.stats())
+    return ev.accuracy()
+
+
+if __name__ == "__main__":
+    main(epochs=6)

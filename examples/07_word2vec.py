@@ -1,0 +1,28 @@
+"""Word2Vec skip-gram + nearest words + dashboard view (the
+Word2VecRawTextExample role). Run: python examples/07_word2vec.py"""
+import numpy as np
+
+from deeplearning4j_tpu.embeddings.word2vec import Word2Vec
+from deeplearning4j_tpu.text.sentenceiterator import CollectionSentenceIterator
+
+CORPUS = (
+    ["the king rules the castle with the queen"] * 25
+    + ["the queen rules the castle with the king"] * 25
+    + ["dogs chase cats through the garden"] * 25
+    + ["cats flee dogs across the garden"] * 25
+)
+
+
+def main(epochs=8):
+    w2v = Word2Vec(min_count=5, layer_size=24, seed=1, window=3,
+                   epochs=epochs)
+    w2v.fit(CollectionSentenceIterator(CORPUS))
+    print("nearest to 'king':", w2v.words_nearest("king", top_n=3))
+    print("king~queen similarity:",
+          round(w2v.similarity("king", "queen"), 3))
+    print("king~dogs similarity:", round(w2v.similarity("king", "dogs"), 3))
+    return w2v
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,43 @@
+"""Data-parallel training over the device mesh (ParallelWrapper — the
+dl4j-parallel-wrapper quickstart). On CPU, tests/conftest-style env vars
+give 8 virtual devices:
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 \\
+  python examples/08_parallel_training.py
+"""
+import numpy as np
+
+from deeplearning4j_tpu.data.iterator import ArrayDataSetIterator
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updaters import Adam
+from deeplearning4j_tpu.parallel import (
+    MeshConfig, ParallelWrapper, TrainingMode, build_mesh,
+)
+
+
+def main(epochs=10, mode=TrainingMode.SYNC_GRADIENTS):
+    rs = np.random.RandomState(6)
+    centers = rs.randn(4, 6) * 3
+    X = np.concatenate([centers[i] + rs.randn(64, 6)
+                        for i in range(4)]).astype("float32")
+    Y = np.eye(4, dtype="float32")[np.repeat(np.arange(4), 64)]
+
+    conf = (NeuralNetConfiguration.Builder().seed(8).updater(Adam(1e-2))
+            .list()
+            .layer(DenseLayer(n_out=24, activation="relu"))
+            .layer(OutputLayer(n_out=4, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.feed_forward(6))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    mesh = build_mesh(MeshConfig())       # all devices on the "data" axis
+    wrapper = ParallelWrapper(net, mesh=mesh, mode=mode)
+    wrapper.fit(ArrayDataSetIterator(X, Y, batch_size=64), epochs=epochs)
+    ev = net.evaluate(ArrayDataSetIterator(X, Y, batch_size=64))
+    print(f"{mesh.shape} {mode.value}: accuracy {ev.accuracy():.3f}")
+    return ev.accuracy()
+
+
+if __name__ == "__main__":
+    main()

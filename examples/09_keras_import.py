@@ -1,0 +1,37 @@
+"""Import a Keras model and keep training it here (KerasModelImport
+quickstart). Requires the bundled keras. Run:
+python examples/09_keras_import.py"""
+import os
+
+import numpy as np
+
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+
+
+def main(tmpdir="/tmp"):
+    import keras
+
+    from deeplearning4j_tpu.modelimport import KerasModelImport
+    m = keras.Sequential([
+        keras.layers.Input((10,)),
+        keras.layers.Dense(24, activation="relu"),
+        keras.layers.Dropout(0.1),
+        keras.layers.Dense(3, activation="softmax"),
+    ])
+    path = f"{tmpdir}/keras_example.h5"
+    m.save(path)
+    net = KerasModelImport.import_keras_sequential_model_and_weights(path)
+    x = np.random.RandomState(0).randn(4, 10).astype("float32")
+    np.testing.assert_allclose(np.asarray(net.output(x)),
+                               np.asarray(m(x)), atol=1e-5)
+    print("imported with exact forward parity; fine-tuning...")
+    rs = np.random.RandomState(1)
+    X = rs.randn(90, 10).astype("float32")
+    Y = np.eye(3, dtype="float32")[rs.randint(0, 3, 90)]
+    net.fit((X, Y), epochs=3, batch_size=30)
+    print("score after fine-tune:", round(net.score(), 4))
+    return net
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,27 @@
+"""Hyperparameter optimization via the sklearn estimator contract
+(tutorial 11's Arbiter role — GridSearchCV over DL4JClassifier).
+Run: python examples/10_hyperparameter_search.py"""
+import numpy as np
+
+
+def main():
+    from sklearn.model_selection import GridSearchCV
+
+    from deeplearning4j_tpu.ml import DL4JClassifier
+    rs = np.random.RandomState(9)
+    centers = rs.randn(3, 6) * 3
+    X = np.concatenate([centers[i] + rs.randn(60, 6)
+                        for i in range(3)]).astype("float32")
+    y = np.repeat(np.arange(3), 60)
+    gs = GridSearchCV(
+        DL4JClassifier(epochs=12, batch_size=45),
+        {"hidden": [(8,), (24,)], "learning_rate": [1e-2, 1e-3]},
+        cv=2, n_jobs=1)
+    gs.fit(X, y)
+    print("best params:", gs.best_params_,
+          "cv accuracy:", round(gs.best_score_, 3))
+    return gs
+
+
+if __name__ == "__main__":
+    main()

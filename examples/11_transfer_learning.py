@@ -1,0 +1,50 @@
+"""Transfer learning: freeze the torso, swap the head (DL4J
+TransferLearning API example). Run: python examples/11_transfer_learning.py"""
+import numpy as np
+
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.transferlearning import TransferLearning
+from deeplearning4j_tpu.nn.updaters import Adam
+
+
+def main():
+    rs = np.random.RandomState(10)
+    # pretrain a 4-class base model
+    centers = rs.randn(4, 6) * 3
+    Xb = np.concatenate([centers[i] + rs.randn(50, 6)
+                         for i in range(4)]).astype("float32")
+    Yb = np.eye(4, dtype="float32")[np.repeat(np.arange(4), 50)]
+    conf = (NeuralNetConfiguration.Builder().seed(11).updater(Adam(1e-2))
+            .list()
+            .layer(DenseLayer(n_out=24, activation="relu"))
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=4, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.feed_forward(6))
+            .build())
+    base = MultiLayerNetwork(conf).init()
+    base.fit((Xb, Yb), epochs=15, batch_size=50)
+
+    # new 2-class task on the same features: freeze torso, new head
+    new_net = (TransferLearning(base)
+               .set_feature_extractor(1)          # freeze layers 0..1
+               .remove_output_layer()
+               .add_layer(OutputLayer(n_out=2, activation="softmax",
+                                      loss="mcxent"))
+               .build())
+    Xn = Xb[:100]
+    Yn = np.eye(2, dtype="float32")[(np.repeat(np.arange(4), 50)[:100] >= 2)
+                                    .astype(int)]
+    frozen_before = np.asarray(new_net.params["0"]["W"]).copy()
+    new_net.fit((Xn, Yn), epochs=10, batch_size=50)
+    assert np.array_equal(frozen_before, np.asarray(new_net.params["0"]["W"]))
+    ev = new_net.evaluate((Xn, Yn))
+    print(f"fine-tuned head accuracy: {ev.accuracy():.3f} "
+          "(torso weights bit-frozen)")
+    return ev.accuracy()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,71 @@
+"""Every example under examples/ must run end-to-end (reduced settings) —
+the analog of keeping dl4j-examples compiling against the framework."""
+import importlib.util
+import os
+
+import pytest
+
+EXAMPLES = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "examples")
+
+
+def _load(name):
+    path = os.path.join(EXAMPLES, name)
+    spec = importlib.util.spec_from_file_location(name[:-3], path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_quickstart_mlp(tmp_path):
+    assert _load("01_quickstart_mlp.py").main(
+        epochs=15, tmpdir=str(tmp_path)) > 0.9
+
+
+def test_computation_graph():
+    assert _load("02_computation_graph.py").main(epochs=15) > 0.85
+
+
+@pytest.mark.slow
+def test_cnn_digits():
+    assert _load("03_cnn_digits.py").main(epochs=2) > 0.7
+
+
+@pytest.mark.slow
+def test_char_lstm():
+    out = _load("04_char_lstm.py").main(epochs=30, units=32)
+    assert len(out) == 41
+
+
+def test_autoencoder_anomaly():
+    assert _load("05_autoencoder_anomaly.py").main(epochs=40) > 0.8
+
+
+def test_early_stopping():
+    result = _load("06_early_stopping.py").main(max_epochs=40)
+    assert result.best_model is not None
+
+
+def test_word2vec():
+    w2v = _load("07_word2vec.py").main(epochs=4)
+    assert "queen" in w2v.words_nearest("king", top_n=3)
+
+
+def test_parallel_training():
+    assert _load("08_parallel_training.py").main(epochs=8) > 0.9
+
+
+def test_keras_import(tmp_path):
+    pytest.importorskip("keras")
+    net = _load("09_keras_import.py").main(tmpdir=str(tmp_path))
+    assert net.score() is not None
+
+
+@pytest.mark.slow
+def test_hyperparameter_search():
+    gs = _load("10_hyperparameter_search.py").main()
+    assert gs.best_score_ > 0.8
+
+
+def test_transfer_learning():
+    assert _load("11_transfer_learning.py").main() > 0.8

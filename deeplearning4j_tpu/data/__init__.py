@@ -5,9 +5,18 @@ from deeplearning4j_tpu.data.iterator import (
 )
 from deeplearning4j_tpu.data.async_iterator import AsyncDataSetIterator
 from deeplearning4j_tpu.data.utility_iterators import (
-    AsyncMultiDataSetIterator, DataSetIteratorSplitter,
-    EarlyTerminationDataSetIterator, IteratorDataSetIterator,
-    MultipleEpochsIterator, SamplingDataSetIterator,
+    AsyncMultiDataSetIterator, AsyncShieldDataSetIterator,
+    DataSetIteratorSplitter, EarlyTerminationDataSetIterator,
+    EarlyTerminationMultiDataSetIterator, IteratorDataSetIterator,
+    IteratorMultiDataSetIterator, MultiDataSetIteratorSplitter,
+    MultiDataSetWrapperIterator, MultipleEpochsIterator,
+    ReconstructionDataSetIterator, SamplingDataSetIterator,
+    SingletonMultiDataSetIterator,
+)
+from deeplearning4j_tpu.data.normalization import (
+    DataSetPreProcessor, ImagePreProcessingScaler,
+    MultiNormalizerStandardize, NormalizerMinMaxScaler,
+    NormalizerStandardize, VGG16ImagePreProcessor,
 )
 from deeplearning4j_tpu.data.fetchers import (
     Cifar10DataSetIterator, EmnistDataSetIterator, IrisDataSetIterator,
@@ -26,4 +35,11 @@ __all__ = [
     "IrisDataSetIterator", "UciSequenceDataSetIterator",
     "SvhnDataSetIterator", "TinyImageNetDataSetIterator",
     "LfwDataSetIterator",
+    "DataSetPreProcessor", "NormalizerStandardize", "NormalizerMinMaxScaler",
+    "ImagePreProcessingScaler", "VGG16ImagePreProcessor",
+    "MultiNormalizerStandardize",
+    "ReconstructionDataSetIterator", "AsyncShieldDataSetIterator",
+    "SingletonMultiDataSetIterator", "IteratorMultiDataSetIterator",
+    "EarlyTerminationMultiDataSetIterator", "MultiDataSetWrapperIterator",
+    "MultiDataSetIteratorSplitter",
 ]

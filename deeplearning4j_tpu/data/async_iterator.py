@@ -42,6 +42,11 @@ class AsyncDataSetIterator(DataSetIterator):
     def batch_size(self):
         return self._source.batch_size()
 
+    def set_pre_processor(self, pre_processor):
+        # DL4J AsyncDataSetIterator delegates to the backing iterator
+        self._source.set_pre_processor(pre_processor)
+        return self
+
     def _put(self, q: "queue.Queue", stop: "threading.Event", item) -> bool:
         """Bounded put that aborts when the consumer has gone away."""
         while not stop.is_set():

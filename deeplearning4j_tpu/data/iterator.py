@@ -15,7 +15,13 @@ from deeplearning4j_tpu.data.dataset import DataSet
 
 
 class DataSetIterator:
-    """Base: iterable of DataSet with reset()."""
+    """Base: iterable of DataSet with reset().
+
+    `set_pre_processor` attaches a DataSetPreProcessor (normalizer) the
+    DL4J way — source iterators route every yielded batch through
+    `self._pp(ds)` (DataSetIterator.setPreProcessor contract)."""
+
+    pre_processor = None
 
     def reset(self):
         pass
@@ -25,6 +31,14 @@ class DataSetIterator:
 
     def batch_size(self) -> Optional[int]:
         return None
+
+    def set_pre_processor(self, pre_processor) -> "DataSetIterator":
+        self.pre_processor = pre_processor
+        return self
+
+    def _pp(self, ds: DataSet) -> DataSet:
+        return self.pre_processor.preprocess(ds) \
+            if self.pre_processor is not None else ds
 
 
 class ArrayDataSetIterator(DataSetIterator):
@@ -64,12 +78,12 @@ class ArrayDataSetIterator(DataSetIterator):
             stop = n   # keep the partial batch when it's all we have
         for i in range(0, max(stop, 0), self._batch):
             sel = idx[i:i + self._batch]
-            yield DataSet(
+            yield self._pp(DataSet(
                 self.features[sel],
                 None if self.labels is None else self.labels[sel],
                 None if self.features_mask is None else self.features_mask[sel],
                 None if self.labels_mask is None else self.labels_mask[sel],
-            )
+            ))
 
 
 class ExistingDataSetIterator(DataSetIterator):
@@ -79,23 +93,40 @@ class ExistingDataSetIterator(DataSetIterator):
         self._datasets = list(datasets)
 
     def __iter__(self):
-        return iter(self._datasets)
+        return (self._pp(ds) for ds in self._datasets)
 
     def batch_size(self):
         return self._datasets[0].num_examples() if self._datasets else None
 
 
 class BenchmarkDataSetIterator(DataSetIterator):
-    """Yields the same cached batch N times — measures ETL-free training speed
-    (DL4J BenchmarkDataSetIterator.java)."""
+    """Yields the same cached batch N times — measures ETL-free training
+    speed. Both reference constructors (BenchmarkDataSetIterator.java):
+        BenchmarkDataSetIterator(dataset, iterations)
+        BenchmarkDataSetIterator(feature_shape, n_labels=C, n_batches=N)
+    the latter materializes one synthetic batch up front."""
 
-    def __init__(self, dataset: DataSet, iterations: int):
+    def __init__(self, dataset=None, iterations: int = 100, *,
+                 feature_shape=None, n_labels: int = 0,
+                 n_batches: Optional[int] = None, seed: int = 0):
+        if dataset is not None and not isinstance(dataset, DataSet):
+            # positional feature-shape form: (shape_tuple, n_labels=, ...)
+            feature_shape, dataset = tuple(dataset), None
+        if dataset is None:
+            if feature_shape is None or n_labels <= 0:
+                raise ValueError(
+                    "provide a DataSet or feature_shape + n_labels")
+            rs = np.random.RandomState(seed)
+            feats = rs.rand(*feature_shape).astype("float32")
+            labels = np.eye(n_labels, dtype="float32")[
+                rs.randint(0, n_labels, feature_shape[0])]
+            dataset = DataSet(feats, labels)
         self._ds = dataset
-        self._iters = int(iterations)
+        self._iters = int(n_batches if n_batches is not None else iterations)
 
     def __iter__(self):
         for _ in range(self._iters):
-            yield self._ds
+            yield self._pp(self._ds)
 
     def batch_size(self):
         return self._ds.num_examples()

@@ -22,7 +22,9 @@ from typing import Iterable, Iterator, List, Optional
 import numpy as np
 
 from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
-from deeplearning4j_tpu.data.iterator import DataSetIterator
+from deeplearning4j_tpu.data.iterator import (
+    BenchmarkDataSetIterator, DataSetIterator,
+)
 
 
 class EarlyTerminationDataSetIterator(DataSetIterator):
@@ -39,7 +41,7 @@ class EarlyTerminationDataSetIterator(DataSetIterator):
         for i, ds in enumerate(self.source):
             if i >= self.max_batches:
                 break
-            yield ds
+            yield self._pp(ds)
 
     def reset(self):
         self.source.reset()
@@ -55,7 +57,8 @@ class MultipleEpochsIterator(DataSetIterator):
 
     def __iter__(self) -> Iterator[DataSet]:
         for _ in range(self.n_epochs):
-            yield from self.source
+            for ds in self.source:
+                yield self._pp(ds)
             self.source.reset()
 
     def reset(self):
@@ -77,9 +80,9 @@ class _SplitView(DataSetIterator):
                 if self.train:
                     if i >= boundary:
                         break          # train view never drains the tail
-                    yield ds
+                    yield self._pp(ds)
                 elif i >= boundary:
-                    yield ds
+                    yield self._pp(ds)
         finally:
             self.parent.source.reset()
 
@@ -125,13 +128,13 @@ class SamplingDataSetIterator(DataSetIterator):
         n = len(self.dataset.features)
         for _ in range(self.total_batches):
             sel = rs.randint(0, n, self.batch_size)
-            yield DataSet(
+            yield self._pp(DataSet(
                 np.asarray(self.dataset.features)[sel],
                 np.asarray(self.dataset.labels)[sel],
                 None if self.dataset.features_mask is None
                 else np.asarray(self.dataset.features_mask)[sel],
                 None if self.dataset.labels_mask is None
-                else np.asarray(self.dataset.labels_mask)[sel])
+                else np.asarray(self.dataset.labels_mask)[sel]))
         self._epoch += 1
 
     def reset(self):
@@ -146,7 +149,7 @@ class IteratorDataSetIterator(DataSetIterator):
         self._items: List[DataSet] = list(iterable)
 
     def __iter__(self) -> Iterator[DataSet]:
-        return iter(self._items)
+        return (self._pp(ds) for ds in self._items)
 
     def reset(self):
         pass
@@ -227,8 +230,8 @@ class ReconstructionDataSetIterator(DataSetIterator):
 
     def __iter__(self):
         for ds in self.source:
-            yield DataSet(ds.features, ds.features, ds.features_mask,
-                          ds.features_mask)
+            yield self._pp(DataSet(ds.features, ds.features,
+                                   ds.features_mask, ds.features_mask))
 
 
 class AsyncShieldDataSetIterator(DataSetIterator):
@@ -248,31 +251,12 @@ class AsyncShieldDataSetIterator(DataSetIterator):
     def batch_size(self):
         return self.source.batch_size()
 
+    def set_pre_processor(self, pre_processor):
+        self.source.set_pre_processor(pre_processor)   # DL4J delegation
+        return self
+
     def __iter__(self):
         return iter(self.source)
-
-
-class BenchmarkDataSetIterator(DataSetIterator):
-    """Fixed synthetic batches for throughput measurement (DL4J
-    impl/BenchmarkDataSetIterator.java): one batch is materialized once
-    and yielded `n_batches` times per epoch — iteration cost is pure
-    framework/device time, no data generation in the loop."""
-
-    def __init__(self, feature_shape, n_labels: int, n_batches: int = 100,
-                 seed: int = 0):
-        rs = np.random.RandomState(seed)
-        feats = rs.rand(*feature_shape).astype("float32")
-        labels = np.eye(n_labels, dtype="float32")[
-            rs.randint(0, n_labels, feature_shape[0])]
-        self._ds = DataSet(feats, labels)
-        self.n_batches = int(n_batches)
-
-    def batch_size(self):
-        return int(self._ds.features.shape[0])
-
-    def __iter__(self):
-        for _ in range(self.n_batches):
-            yield self._ds
 
 
 class SingletonMultiDataSetIterator:
@@ -331,7 +315,7 @@ class MultiDataSetWrapperIterator(DataSetIterator):
                     f"{len(mds.labels)} outputs")
             fm = mds.features_masks[0] if mds.features_masks else None
             lm = mds.labels_masks[0] if mds.labels_masks else None
-            yield DataSet(mds.features[0], mds.labels[0], fm, lm)
+            yield self._pp(DataSet(mds.features[0], mds.labels[0], fm, lm))
 
 
 class MultiDataSetIteratorSplitter(DataSetIteratorSplitter):

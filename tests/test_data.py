@@ -337,3 +337,140 @@ class TestRound4UtilityIterators:
         assert ds[0].features.shape == (2, 3)
         single = SingletonMultiDataSetIterator(batches[0])
         assert len(list(single)) == 1
+
+
+class TestNormalizers:
+    """ND4J normalizer suite parity (NormalizerStandardize & co.)."""
+
+    def _iter(self, X, Y, bs=32):
+        from deeplearning4j_tpu.data.iterator import ArrayDataSetIterator
+        # drop_last=False so the fitted statistics cover every sample
+        return ArrayDataSetIterator(X, Y, batch_size=bs, drop_last=False)
+
+    def test_standardize_fit_transform_revert(self):
+        from deeplearning4j_tpu.data.normalization import (
+            NormalizerStandardize,
+        )
+        rs = np.random.RandomState(0)
+        X = (rs.randn(256, 5) * [1, 10, 0.1, 5, 2] + [3, -7, 0, 1, 9]) \
+            .astype("float32")
+        Y = rs.randn(256, 2).astype("float32") * 4 + 2
+        norm = NormalizerStandardize(fit_labels=True)
+        norm.fit(self._iter(X, Y))
+        Z = norm.transform(X)
+        np.testing.assert_allclose(Z.mean(0), 0.0, atol=1e-3)
+        np.testing.assert_allclose(Z.std(0), 1.0, atol=1e-2)
+        np.testing.assert_allclose(norm.revert_features(Z), X, atol=1e-3)
+        from deeplearning4j_tpu.data.dataset import DataSet
+        ds = norm.preprocess(DataSet(X, Y))
+        assert abs(np.asarray(ds.labels).mean()) < 0.1
+
+    def test_set_pre_processor_flows_through_iterator_and_training(self):
+        from deeplearning4j_tpu.data.normalization import (
+            NormalizerStandardize,
+        )
+        from deeplearning4j_tpu.nn.conf import (
+            InputType, NeuralNetConfiguration,
+        )
+        from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.nn.updaters import Adam
+        rs = np.random.RandomState(1)
+        centers = rs.randn(3, 4) * 2
+        # wildly different feature scales: training fails without norm
+        scales = np.array([1e-3, 1.0, 1e3, 10.0], np.float32)
+        X = (np.concatenate([centers[i] + rs.randn(60, 4)
+                             for i in range(3)]) * scales).astype("float32")
+        Y = np.eye(3, dtype="float32")[np.repeat(np.arange(3), 60)]
+        it = self._iter(X, Y, bs=60)
+        norm = NormalizerStandardize().fit(it)
+        it.set_pre_processor(norm)
+        batch = next(iter(it))     # one cluster, but unit-scale features
+        assert abs(np.asarray(batch.features)).max() < 8.0
+        assert abs(norm.transform(X).mean(0)).max() < 1e-3
+        conf = (NeuralNetConfiguration.Builder().seed(2).updater(Adam(1e-2))
+                .list()
+                .layer(DenseLayer(n_out=16, activation="relu"))
+                .layer(OutputLayer(n_out=3, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(4)).build())
+        net = MultiLayerNetwork(conf).init()
+        net.fit(it, epochs=30)
+        assert net.evaluate(it).accuracy() > 0.9
+
+    def test_minmax_and_image_scalers(self):
+        from deeplearning4j_tpu.data.normalization import (
+            ImagePreProcessingScaler, NormalizerMinMaxScaler,
+            VGG16ImagePreProcessor,
+        )
+        rs = np.random.RandomState(2)
+        X = (rs.rand(100, 6) * 50 - 25).astype("float32")
+        mm = NormalizerMinMaxScaler(-1.0, 1.0)
+        mm.fit(self._iter(X, np.zeros((100, 1), np.float32)))
+        Z = mm.transform(X)
+        assert Z.min() >= -1.0001 and Z.max() <= 1.0001
+        np.testing.assert_allclose(mm.revert_features(Z), X, atol=1e-3)
+        img = rs.randint(0, 256, (2, 4, 4, 3)).astype("float32")
+        np.testing.assert_allclose(
+            ImagePreProcessingScaler().transform(img), img / 255.0)
+        v = VGG16ImagePreProcessor().transform(img)
+        np.testing.assert_allclose(v, img - VGG16ImagePreProcessor.MEANS,
+                                   atol=1e-5)
+
+    def test_normalizer_serde_round_trip(self, tmp_path):
+        from deeplearning4j_tpu.data.normalization import (
+            NormalizerStandardize,
+        )
+        rs = np.random.RandomState(3)
+        X = rs.randn(64, 3).astype("float32") * 7 + 2
+        norm = NormalizerStandardize().fit(
+            self._iter(X, np.zeros((64, 1), np.float32)))
+        p = str(tmp_path / "norm.json")
+        norm.save(p)
+        back = NormalizerStandardize.restore(p)
+        np.testing.assert_allclose(back.transform(X), norm.transform(X))
+
+    def test_multi_normalizer(self):
+        from deeplearning4j_tpu.data.dataset import MultiDataSet
+        from deeplearning4j_tpu.data.normalization import (
+            MultiNormalizerStandardize,
+        )
+        rs = np.random.RandomState(4)
+        batches = [MultiDataSet(
+            (rs.randn(16, 3).astype("float32") * 5 + 1,
+             rs.randn(16, 2).astype("float32") * 0.1 - 3),
+            (np.zeros((16, 1), np.float32),), None, None)
+            for _ in range(6)]
+        norm = MultiNormalizerStandardize().fit(list(batches))
+        out = norm.preprocess(batches[0])
+        assert abs(np.asarray(out.features[0]).mean()) < 0.5
+        assert abs(np.asarray(out.features[1]).mean()) < 0.5
+
+    def test_pre_processor_respected_by_wrappers_and_async(self):
+        from deeplearning4j_tpu.data import (
+            AsyncDataSetIterator, EarlyTerminationDataSetIterator,
+            MultipleEpochsIterator, NormalizerStandardize,
+            SamplingDataSetIterator,
+        )
+        from deeplearning4j_tpu.data.dataset import DataSet
+        rs = np.random.RandomState(5)
+        X = (rs.randn(64, 4) * 100 + 50).astype("float32")
+        Y = np.zeros((64, 2), np.float32)
+        base = self._iter(X, Y, bs=32)
+        norm = NormalizerStandardize().fit(base)
+        # every wrapper/source flavor must honor its preprocessor
+        sources = [
+            self._iter(X, Y, bs=32).set_pre_processor(norm),
+            EarlyTerminationDataSetIterator(
+                self._iter(X, Y, bs=32), 1).set_pre_processor(norm),
+            MultipleEpochsIterator(
+                self._iter(X, Y, bs=32), 1).set_pre_processor(norm),
+            SamplingDataSetIterator(DataSet(X, Y), 32,
+                                    2).set_pre_processor(norm),
+            AsyncDataSetIterator(     # delegates to the backing iterator
+                self._iter(X, Y, bs=32), device_put=False
+            ).set_pre_processor(norm),
+        ]
+        for src in sources:
+            b = next(iter(src))
+            assert abs(np.asarray(b.features).mean()) < 5.0, type(src)

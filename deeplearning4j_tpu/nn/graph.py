@@ -563,6 +563,13 @@ class ComputationGraph:
         return rng
 
     def _iter_data(self, data):
+        if isinstance(data, (tuple, list)) and len(data) == 2 \
+                and all(hasattr(d, "shape") for d in data):
+            # (features, labels) ARRAY pair convenience, as
+            # MultiLayerNetwork.fit; anything else 2-long (a batch list,
+            # tuples of per-input arrays) iterates normally
+            data = MultiDataSet((np.asarray(data[0]),),
+                                (np.asarray(data[1]),), None, None)
         if isinstance(data, MultiDataSet):
             yield data
         elif isinstance(data, DataSet):

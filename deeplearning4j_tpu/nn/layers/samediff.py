@@ -72,3 +72,17 @@ class FrozenLayerWrapper(LayerConf):
         frozen = jax.tree_util.tree_map(lax.stop_gradient, params)
         # frozen layers run in inference mode (DL4J FrozenLayer semantics)
         return self.layer.apply(frozen, state, x, train=False, rng=rng, mask=mask)
+
+    def __getattr__(self, name):
+        # delegate the rest of the layer contract (score for output
+        # layers, apply_seq/rnn_step for recurrent ones, ...) so a frozen
+        # vertex stays a drop-in for its wrapped layer. Frozen params are
+        # stop-gradiented by the container through apply(); score() is
+        # only reached for output layers, whose gradient stops at the
+        # frozen dense weights the same way.
+        if name.startswith("__") or name == "layer":
+            raise AttributeError(name)
+        inner = object.__getattribute__(self, "layer")
+        if inner is None:
+            raise AttributeError(name)
+        return getattr(inner, name)

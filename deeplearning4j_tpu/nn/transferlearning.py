@@ -19,6 +19,7 @@ import dataclasses
 from typing import Any, Dict, List, Optional
 
 import jax
+import jax.numpy as jnp
 
 from deeplearning4j_tpu.nn.conf.base import LayerConf
 from deeplearning4j_tpu.nn.conf.network import MultiLayerConfiguration
@@ -115,14 +116,17 @@ class TransferLearning:
                 conf_changes["seed"] = self._fine_tune.seed
         new_conf = dataclasses.replace(src.conf, **conf_changes)
         net = MultiLayerNetwork(new_conf).init()
-        # copy weights for retained, non-reinitialized layers
+        # copy weights for retained, non-reinitialized layers. Real
+        # copies, not aliases: the derived network's train step donates
+        # its buffers, and donated aliases would delete the SOURCE
+        # network's params out from under it.
         for i in range(n_kept):
             if i in reinit:
                 continue
             net.params[str(i)] = jax.tree_util.tree_map(
-                lambda a: a, src.params[str(i)])
+                jnp.copy, src.params[str(i)])
             net.state[str(i)] = jax.tree_util.tree_map(
-                lambda a: a, src.state[str(i)])
+                jnp.copy, src.state[str(i)])
         net._build_optimizer()
         return net
 
@@ -185,4 +189,164 @@ class TransferLearningHelper:
             net.params[str(i)] = jax.tree_util.tree_map(
                 lambda a: jnp.array(a, copy=True),
                 self.head.params[str(i - self._split)])
+        return net
+
+
+class TransferLearningGraph:
+    """Surgical modification of a ComputationGraph (DL4J
+    TransferLearning.GraphBuilder): freeze by vertex name, remove
+    vertices/connections, attach new layers, swap outputs — keeping the
+    retained vertices' trained weights."""
+
+    def __init__(self, graph):
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        if not isinstance(graph, ComputationGraph):
+            raise TypeError("TransferLearningGraph wraps a ComputationGraph")
+        if graph.params is None:
+            raise ValueError("source graph must be initialized/trained")
+        self._net = graph
+        self._fine_tune: Optional[FineTuneConfiguration] = None
+        self._freeze_at: List[str] = []
+        self._removed: List[str] = []
+        self._added: List[tuple] = []        # (name, layer, inputs)
+        self._n_out_replace: Dict[str, int] = {}
+        self._outputs: Optional[tuple] = None
+
+    def fine_tune_configuration(self, cfg: FineTuneConfiguration):
+        self._fine_tune = cfg
+        return self
+
+    def set_feature_extractor(self, *vertex_names: str):
+        """Freeze the named vertices and every ancestor feeding them
+        (DL4J setFeatureExtractor(vertexName))."""
+        self._freeze_at.extend(vertex_names)
+        return self
+
+    def remove_vertex_and_connections(self, name: str):
+        self._removed.append(name)
+        return self
+
+    def add_layer(self, name: str, layer: LayerConf, *inputs: str):
+        self._added.append((name, layer, tuple(inputs)))
+        return self
+
+    def n_out_replace(self, name: str, n_out: int):
+        self._n_out_replace[name] = n_out
+        return self
+
+    def set_outputs(self, *names: str):
+        self._outputs = tuple(names)
+        return self
+
+    # ------------------------------------------------------------- build
+    def _ancestors(self, conf, targets) -> set:
+        out = set()
+        stack = list(targets)
+        while stack:
+            v = stack.pop()
+            if v in out or v in conf.network_inputs:
+                continue
+            out.add(v)
+            stack.extend(conf.vertices[v].inputs)
+        return out
+
+    def build(self):
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        src = self._net
+        conf = src.conf
+        known = set(conf.vertices) | set(conf.network_inputs)
+        referenced = (set(self._freeze_at) | set(self._removed)
+                      | set(self._n_out_replace))
+        unknown = sorted(referenced - known)
+        if unknown:
+            raise ValueError(f"unknown vertex names {unknown}; graph has "
+                             f"{sorted(conf.vertices)}")
+        for name in self._n_out_replace:
+            if not hasattr(conf.vertices[name].vertex, "n_out"):
+                raise ValueError(
+                    f"n_out_replace('{name}'): vertex type "
+                    f"{type(conf.vertices[name].vertex).__name__} has no "
+                    "n_out to replace")
+        removed = set(self._removed)
+        # removing a vertex also drops every descendant that depends on
+        # it — iterate to closure over vanished inputs
+        changed = True
+        while changed:
+            changed = False
+            for name, vd in conf.vertices.items():
+                if name in removed:
+                    continue
+                if any(i in removed for i in vd.inputs):
+                    removed.add(name)
+                    changed = True
+
+        frozen = self._ancestors(conf, self._freeze_at) if self._freeze_at \
+            else set()
+        # a width change invalidates every consumer whose fan-in changed,
+        # INCLUDING through parameterless pass-through vertices
+        # (Merge/ElementWise/...) that forward the new width downstream
+        reinit: set = set(self._n_out_replace)
+        width_changed = set(self._n_out_replace)
+        for name in conf.topological_order():
+            vd = conf.vertices.get(name)
+            if vd is None or name in width_changed:
+                continue
+            if any(i in width_changed for i in vd.inputs):
+                reinit.add(name)
+                is_layer = isinstance(vd.vertex, LayerConf)
+                if not is_layer or not vd.vertex.has_params():
+                    width_changed.add(name)   # width flows through
+
+        from deeplearning4j_tpu.nn.conf.network import VertexDef
+        new_vertices: Dict[str, Any] = {}
+        for name, vd in conf.vertices.items():
+            if name in removed:
+                continue
+            vertex = vd.vertex
+            if isinstance(vertex, FrozenLayerWrapper):
+                vertex = vertex.layer
+            if name in self._n_out_replace and hasattr(vertex, "n_out"):
+                vertex = dataclasses.replace(
+                    vertex, n_out=self._n_out_replace[name])
+            if self._fine_tune is not None and isinstance(vertex, LayerConf):
+                vertex = self._fine_tune.apply_to_layer(vertex)
+            if name in frozen and isinstance(vertex, LayerConf):
+                vertex = FrozenLayerWrapper(layer=vertex)
+            new_vertices[name] = dataclasses.replace(vd, vertex=vertex)
+        for name, layer, inputs in self._added:
+            missing = [i for i in inputs
+                       if i not in new_vertices
+                       and i not in conf.network_inputs]
+            if missing:
+                raise ValueError(f"add_layer('{name}'): unknown inputs "
+                                 f"{missing}")
+            new_vertices[name] = VertexDef(layer, tuple(inputs))
+
+        outputs = self._outputs if self._outputs is not None else tuple(
+            o for o in conf.network_outputs if o in new_vertices)
+        if not outputs:
+            raise ValueError("resulting graph has no outputs — call "
+                             "set_outputs(...)")
+        conf_changes = {"vertices": new_vertices,
+                        "network_outputs": outputs}
+        if self._fine_tune is not None:
+            if self._fine_tune.updater is not None:
+                conf_changes["updater"] = self._fine_tune.updater
+            if self._fine_tune.seed is not None:
+                conf_changes["seed"] = self._fine_tune.seed
+        new_conf = dataclasses.replace(conf, **conf_changes)
+        net = ComputationGraph(new_conf).init()
+        added_names = {n for n, _, _ in self._added}
+        for name in new_vertices:
+            if name in added_names or name in reinit:
+                continue
+            if name in src.params:
+                # real copies — donation in the derived net's train step
+                # must not delete the source network's buffers
+                net.params[name] = jax.tree_util.tree_map(
+                    jnp.copy, src.params[name])
+            if src.state and name in src.state:
+                net.state[name] = jax.tree_util.tree_map(
+                    jnp.copy, src.state[name])
+        net._build_optimizer()
         return net

@@ -369,3 +369,150 @@ def test_async_checkpoint_preserves_counters_and_head_survives_unfreeze():
     # the head is still alive and usable afterwards
     out = np.asarray(helper.head.output(feats[:4]))
     assert np.all(np.isfinite(out))
+
+
+def test_transfer_learning_graph_freeze_swap_head():
+    """TransferLearning.GraphBuilder parity: freeze ancestors by vertex
+    name, remove a head, attach a new one, keep trained torso weights."""
+    import dataclasses  # noqa: F401
+    from deeplearning4j_tpu.nn.conf.network import GraphBuilder
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.nn.transferlearning import TransferLearningGraph
+
+    rs = np.random.RandomState(0)
+    centers = rs.randn(4, 6) * 3
+    y4 = np.repeat(np.arange(4), 30)
+    X = (centers[y4] + rs.randn(120, 6)).astype("float32")
+    Y4 = np.eye(4, dtype="float32")[y4]
+
+    g = (GraphBuilder(NeuralNetConfiguration.Builder().seed(1)
+                      .updater(Adam(1e-2)))
+         .add_inputs("in").set_input_types(InputType.feed_forward(6)))
+    g.add_layer("torso1", DenseLayer(n_out=16, activation="relu"), "in")
+    g.add_layer("torso2", DenseLayer(n_out=12, activation="relu"), "torso1")
+    g.add_layer("head", OutputLayer(n_out=4, activation="softmax",
+                                    loss="mcxent"), "torso2")
+    g.set_outputs("head")
+    src = ComputationGraph(g.build()).init()
+    src.fit((X, Y4), epochs=30)
+    torso_w = np.asarray(src.params["torso1"]["W"]).copy()
+
+    new = (TransferLearningGraph(src)
+           .set_feature_extractor("torso2")
+           .remove_vertex_and_connections("head")
+           .add_layer("new_head", OutputLayer(n_out=2, activation="softmax",
+                                              loss="mcxent"), "torso2")
+           .set_outputs("new_head")
+           .build())
+    # trained torso carried over
+    np.testing.assert_array_equal(np.asarray(new.params["torso1"]["W"]),
+                                  torso_w)
+    Y2 = np.eye(2, dtype="float32")[(y4 >= 2).astype(int)]
+    new.fit((X, Y2), epochs=40)
+    # frozen vertices bit-identical after training
+    np.testing.assert_array_equal(np.asarray(new.params["torso1"]["W"]),
+                                  torso_w)
+    out = np.asarray(new.output(X))
+    assert out.shape == (120, 2)
+    acc = (out.argmax(1) == (y4 >= 2)).mean()
+    assert acc > 0.7
+
+
+def test_transfer_learning_graph_n_out_replace_reinits_consumer():
+    from deeplearning4j_tpu.nn.conf.network import GraphBuilder
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.nn.transferlearning import TransferLearningGraph
+
+    rs = np.random.RandomState(1)
+    X = rs.randn(60, 5).astype("float32")
+    Y = np.eye(3, dtype="float32")[rs.randint(0, 3, 60)]
+    g = (GraphBuilder(NeuralNetConfiguration.Builder().seed(2)
+                      .updater(Adam(1e-2)))
+         .add_inputs("in").set_input_types(InputType.feed_forward(5)))
+    g.add_layer("d", DenseLayer(n_out=8, activation="relu"), "in")
+    g.add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                   loss="mcxent"), "d")
+    g.set_outputs("out")
+    src = ComputationGraph(g.build()).init()
+    new = TransferLearningGraph(src).n_out_replace("d", 20).build()
+    assert np.asarray(new.params["d"]["W"]).shape == (5, 20)
+    assert np.asarray(new.params["out"]["W"]).shape == (20, 3)
+    assert np.asarray(new.output(X)).shape == (60, 3)
+
+
+def test_transfer_learning_does_not_invalidate_source_network():
+    """Regression: build() must COPY retained weights — the derived net's
+    donated train step used to delete the source's buffers (aliasing)."""
+    X, Y = _blobs()
+    src = MultiLayerNetwork(_mlp()).init()
+    src.fit((X, Y), epochs=2, batch_size=64)
+    new = (TransferLearning(src)
+           .set_feature_extractor(0)
+           .remove_output_layer()
+           .add_layer(OutputLayer(n_out=2, activation="softmax",
+                                  loss="mcxent"))
+           .build())
+    y2 = np.eye(2, dtype="float32")[np.zeros(len(X), int)]
+    new.fit((X, y2), epochs=2, batch_size=64)
+    # the source is still fully usable after the derived net trained
+    out = np.asarray(src.output(X[:4]))
+    assert np.isfinite(out).all()
+
+
+def test_transfer_learning_graph_validation_and_merge_reinit():
+    """Review r4: typo'd names fail fast; width changes propagate through
+    parameterless merge vertices; frozen output vertices stay legal."""
+    from deeplearning4j_tpu.nn.conf.graph_vertices import MergeVertex
+    from deeplearning4j_tpu.nn.conf.network import GraphBuilder
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.nn.transferlearning import TransferLearningGraph
+
+    g = (GraphBuilder(NeuralNetConfiguration.Builder().seed(3)
+                      .updater(Adam(1e-2)))
+         .add_inputs("in").set_input_types(InputType.feed_forward(6)))
+    g.add_layer("d1", DenseLayer(n_out=8, activation="relu"), "in")
+    g.add_layer("d2", DenseLayer(n_out=8, activation="relu"), "in")
+    g.add_vertex("m", MergeVertex(), "d1", "d2")
+    g.add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                   loss="mcxent"), "m")
+    g.set_outputs("out")
+    src = ComputationGraph(g.build()).init()
+
+    with pytest.raises(ValueError, match="unknown vertex"):
+        TransferLearningGraph(src).set_feature_extractor("dens1").build()
+    with pytest.raises(ValueError, match="no n_out"):
+        TransferLearningGraph(src).n_out_replace("m", 20).build()
+
+    # width change through the merge: 'out' must be re-initialized
+    new = TransferLearningGraph(src).n_out_replace("d1", 20).build()
+    assert np.asarray(new.params["out"]["W"]).shape == (28, 3)
+    X = np.random.RandomState(0).randn(4, 6).astype("float32")
+    assert np.asarray(new.output(X)).shape == (4, 3)
+
+    # freezing the whole net incl. the output vertex still builds + runs
+    frozen = TransferLearningGraph(src).set_feature_extractor("out").build()
+    Y = np.eye(3, dtype="float32")[np.zeros(4, int)]
+    before = np.asarray(frozen.params["out"]["W"]).copy()
+    frozen.fit((X, Y), epochs=2)
+    np.testing.assert_array_equal(before,
+                                  np.asarray(frozen.params["out"]["W"]))
+
+
+def test_graph_fit_two_batch_list_not_misparsed():
+    """fit([(X1,Y1),(X2,Y2)]) is a 2-batch list, not an array pair."""
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.nn.conf.network import GraphBuilder
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    rs = np.random.RandomState(1)
+    g = (GraphBuilder(NeuralNetConfiguration.Builder().seed(1)
+                      .updater(Adam(1e-2)))
+         .add_inputs("in").set_input_types(InputType.feed_forward(4)))
+    g.add_layer("out", OutputLayer(n_out=2, activation="softmax",
+                                   loss="mcxent"), "in")
+    g.set_outputs("out")
+    net = ComputationGraph(g.build()).init()
+    batches = [DataSet(rs.randn(8, 4).astype("float32"),
+                       np.eye(2, dtype="float32")[rs.randint(0, 2, 8)])
+               for _ in range(2)]
+    net.fit(batches)                    # 2-long list of DataSets
+    assert net.iteration_count == 2

@@ -254,6 +254,51 @@ def main():
         net.params, net.opt_state, net.state = (net2.params,
                                                 net2.opt_state, net2.state)
 
+    # --- optional attention micro-bench (DL4J_TPU_BENCH_ATTENTION=1):
+    # dense XLA attention vs the fused Pallas flash kernel on a causal
+    # transformer shape; rides along in "sweep" without touching the
+    # headline metric
+    if os.environ.get("DL4J_TPU_BENCH_ATTENTION") == "1":
+        try:
+            from deeplearning4j_tpu.nn.layers.attention import (
+                dot_product_attention,
+            )
+            from deeplearning4j_tpu.ops import flash_attention
+            b_, t_, h_, d_ = (4, 2048, 8, 64) if on_tpu else (2, 256, 4, 32)
+            rs2 = np.random.RandomState(1)
+            dt_attn = jnp.bfloat16 if on_tpu else jnp.float32
+            qkv = [jnp.asarray(rs2.randn(b_, t_, h_, d_), dt_attn)
+                   for _ in range(3)]
+
+            def time_attn(fn):
+                out = fn(*qkv)
+                np.asarray(out[0, 0, 0])        # sync
+                best_dt = None
+                for _ in range(best_of):
+                    t0 = time.perf_counter()
+                    out = fn(*qkv)
+                    np.asarray(out[0, 0, 0])
+                    el = time.perf_counter() - t0
+                    best_dt = el if best_dt is None else min(best_dt, el)
+                return best_dt
+
+            dense_fn = jax.jit(lambda q, k, v: dot_product_attention(
+                q, k, v, causal=True))
+            flash_fn = jax.jit(lambda q, k, v: flash_attention(
+                q, k, v, causal=True, interpret=not on_tpu))
+            dense_s = time_attn(dense_fn)
+            flash_s = time_attn(flash_fn)
+            results.append({
+                "mode": "attention-micro",
+                "shape": [b_, t_, h_, d_],
+                "dense_ms": round(dense_s * 1e3, 3),
+                "flash_ms": round(flash_s * 1e3, 3),
+                "flash_speedup": round(dense_s / max(flash_s, 1e-9), 3),
+            })
+        except Exception as e:
+            results.append({"mode": "attention-micro",
+                            "error": str(e)[:120]})
+
     best = max((r for r in results if "imgs_sec" in r),
                key=lambda r: r["imgs_sec"], default=None)
     if best is None:            # every config errored — still emit JSON

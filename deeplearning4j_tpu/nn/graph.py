@@ -210,7 +210,7 @@ class ComputationGraph:
         stored under '__pre__<name>' so score() sees features, not
         post-activation output (the analog of DL4J output layers keeping
         preOutput for computeScore)."""
-        from deeplearning4j_tpu.nn.multilayer import _RECURRENT_CLASSES
+        from deeplearning4j_tpu.nn.multilayer import _is_stateful_recurrent
         if self._vertex_types is None:
             self._vertex_types = self._resolve_types()
         params = self._cast_params(params)
@@ -252,8 +252,7 @@ class ComputationGraph:
                 sub_rng, noise_rng = jax.random.split(sub_rng)
                 layer_params = apply_weight_noise(vd.vertex, layer_params,
                                                   train, noise_rng)
-            if carries is not None and \
-                    type(vd.vertex).__name__ in _RECURRENT_CLASSES:
+            if carries is not None and _is_stateful_recurrent(vd.vertex):
                 y, carry = vd.vertex.apply_seq(
                     layer_params, x, carries.get(name), train=train,
                     rng=sub_rng, mask=m)
@@ -567,9 +566,9 @@ class ComputationGraph:
                 and all(hasattr(d, "shape") for d in data):
             # (features, labels) ARRAY pair convenience, as
             # MultiLayerNetwork.fit; anything else 2-long (a batch list,
-            # tuples of per-input arrays) iterates normally
-            data = MultiDataSet((np.asarray(data[0]),),
-                                (np.asarray(data[1]),), None, None)
+            # tuples of per-input arrays) iterates normally. Arrays pass
+            # through as-is — no host round-trip for device-resident data.
+            data = MultiDataSet((data[0],), (data[1],), None, None)
         if isinstance(data, MultiDataSet):
             yield data
         elif isinstance(data, DataSet):

@@ -73,13 +73,21 @@ class FrozenLayerWrapper(LayerConf):
         # frozen layers run in inference mode (DL4J FrozenLayer semantics)
         return self.layer.apply(frozen, state, x, train=False, rng=rng, mask=mask)
 
+    def apply_seq(self, params, x, carry, *, train=False, rng=None,
+                  mask=None):
+        frozen = jax.tree_util.tree_map(lax.stop_gradient, params)
+        return self.layer.apply_seq(frozen, x, carry, train=False,
+                                    rng=rng, mask=mask)
+
+    def rnn_step(self, params, x_t, carry):
+        frozen = jax.tree_util.tree_map(lax.stop_gradient, params)
+        return self.layer.rnn_step(frozen, x_t, carry)
+
     def __getattr__(self, name):
         # delegate the rest of the layer contract (score for output
-        # layers, apply_seq/rnn_step for recurrent ones, ...) so a frozen
-        # vertex stays a drop-in for its wrapped layer. Frozen params are
-        # stop-gradiented by the container through apply(); score() is
-        # only reached for output layers, whose gradient stops at the
-        # frozen dense weights the same way.
+        # layers, regularization_score, n_out, ...) so a frozen vertex
+        # stays a drop-in for its wrapped layer; stateful entry points
+        # above freeze their params explicitly.
         if name.startswith("__") or name == "layer":
             raise AttributeError(name)
         inner = object.__getattribute__(self, "layer")

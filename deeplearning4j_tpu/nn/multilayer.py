@@ -67,6 +67,16 @@ _KIND_BY_CLASS = {
 _RECURRENT_CLASSES = {"LSTM", "GravesLSTM", "SimpleRnn", "GRU"}
 
 
+def _is_stateful_recurrent(layer) -> bool:
+    """Recurrent-carry dispatch, unwrapping FrozenLayerWrapper so a
+    frozen LSTM keeps its rnn_time_step/tbptt state semantics."""
+    inner = getattr(layer, "layer", None)
+    name = type(inner if inner is not None
+                and type(layer).__name__ == "FrozenLayerWrapper"
+                else layer).__name__
+    return name in _RECURRENT_CLASSES
+
+
 def _scan_incompatible_listeners(listeners) -> bool:
     """Listeners that inspect the model (params/opt state) or capture
     gradients need iteration_done in lockstep with the params — the
@@ -289,7 +299,7 @@ class MultiLayerNetwork:
                 sub_rng, noise_rng = jax.random.split(sub_rng)
                 layer_params = apply_weight_noise(layer, layer_params, train,
                                                   noise_rng)
-            if carries is not None and type(layer).__name__ in _RECURRENT_CLASSES:
+            if carries is not None and _is_stateful_recurrent(layer):
                 y, carry = layer.apply_seq(layer_params, x, carries.get(key),
                                            train=train, rng=sub_rng, mask=mask)
                 new_carries[key] = carry

@@ -97,8 +97,18 @@ class TransferLearning:
                 base = dataclasses.replace(base,
                                            n_out=self._n_out_replace[i])
                 reinit.add(i)
-                if i + 1 < keep:
-                    reinit.add(i + 1)   # fan-in changed
+                # the width change invalidates every following layer up
+                # to and including the next one with its own n_out (BN /
+                # activation / dropout are width-transparent: their
+                # params, if any, are shaped by the new width AND the
+                # width flows on to the next projection)
+                for j in range(i + 1, keep):
+                    reinit.add(j)
+                    nxt = src.layers[j]
+                    nxt = nxt.layer if isinstance(nxt, FrozenLayerWrapper) \
+                        else nxt
+                    if getattr(nxt, "n_out", 0):
+                        break
             if self._fine_tune is not None:
                 base = self._fine_tune.apply_to_layer(base)
             if self._freeze_until is not None and i <= self._freeze_until:
@@ -293,9 +303,13 @@ class TransferLearningGraph:
                 continue
             if any(i in width_changed for i in vd.inputs):
                 reinit.add(name)
-                is_layer = isinstance(vd.vertex, LayerConf)
-                if not is_layer or not vd.vertex.has_params():
-                    width_changed.add(name)   # width flows through
+                vertex = vd.vertex
+                if isinstance(vertex, FrozenLayerWrapper):
+                    vertex = vertex.layer
+                # width flows through anything without its own n_out
+                # projection (Merge/ElementWise, BatchNorm, activations)
+                if not getattr(vertex, "n_out", 0):
+                    width_changed.add(name)
 
         from deeplearning4j_tpu.nn.conf.network import VertexDef
         new_vertices: Dict[str, Any] = {}
@@ -314,6 +328,11 @@ class TransferLearningGraph:
                 vertex = FrozenLayerWrapper(layer=vertex)
             new_vertices[name] = dataclasses.replace(vd, vertex=vertex)
         for name, layer, inputs in self._added:
+            if name in new_vertices:
+                raise ValueError(
+                    f"add_layer('{name}'): a vertex with that name is "
+                    "already retained — remove it first or pick another "
+                    "name")
             missing = [i for i in inputs
                        if i not in new_vertices
                        and i not in conf.network_inputs]

@@ -1,0 +1,32 @@
+"""Barnes-Hut t-SNE on the digits dataset + live dashboard scatter (the
+reference's t-SNE tutorial + TsneModule view). Run:
+python examples/12_tsne_visualization.py"""
+import numpy as np
+from sklearn.datasets import load_digits
+
+from deeplearning4j_tpu.manifold import BarnesHutTsne
+
+
+def main(n=500, max_iter=350, serve=False):
+    d = load_digits()
+    X = (d.images[:n].reshape(n, -1) / 16.0).astype("float32")
+    labels = d.target[:n]
+    tsne = BarnesHutTsne(perplexity=25, theta=0.5, max_iter=max_iter,
+                         seed=7)
+    Y = tsne.fit_transform(X)
+    # neighbor purity: how often the nearest embedded point shares a digit
+    d2 = ((Y[:, None] - Y[None]) ** 2).sum(-1)
+    np.fill_diagonal(d2, np.inf)
+    purity = (labels[d2.argmin(1)] == labels).mean()
+    print(f"KL={tsne.kl_divergence_:.4f}  1-NN purity={purity:.3f}")
+    if serve:
+        from deeplearning4j_tpu.ui import UIServer
+        server = UIServer.get_instance()
+        server.post_tsne("digits", Y, labels=[str(c) for c in labels])
+        print(f"view at {server.url}tsne")
+    return purity
+
+
+if __name__ == "__main__":
+    main(serve=True)
+    input("serving — press enter to exit\n")

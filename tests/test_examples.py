@@ -69,3 +69,8 @@ def test_hyperparameter_search():
 
 def test_transfer_learning():
     assert _load("11_transfer_learning.py").main() > 0.8
+
+
+@pytest.mark.slow
+def test_tsne_visualization():
+    assert _load("12_tsne_visualization.py").main(n=300, max_iter=250) > 0.75

@@ -516,3 +516,50 @@ def test_graph_fit_two_batch_list_not_misparsed():
                for _ in range(2)]
     net.fit(batches)                    # 2-long list of DataSets
     assert net.iteration_count == 2
+
+
+def test_transfer_learning_mln_width_change_through_batchnorm():
+    """Review r4: n_out_replace must re-init past width-transparent
+    layers (BatchNorm) down to the next projection."""
+    from deeplearning4j_tpu.nn.layers import BatchNormalization
+    conf = (NeuralNetConfiguration.Builder().seed(4).updater(Adam(1e-2))
+            .list()
+            .layer(DenseLayer(n_out=8, activation="relu"))
+            .layer(BatchNormalization())
+            .layer(DenseLayer(n_out=6, activation="relu"))
+            .layer(OutputLayer(n_out=3, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.feed_forward(5)).build())
+    src = MultiLayerNetwork(conf).init()
+    new = TransferLearning(src).n_out_replace(0, 20).build()
+    assert np.asarray(new.params["0"]["W"]).shape == (5, 20)
+    assert np.asarray(new.params["1"]["gamma"]).shape == (20,)
+    assert np.asarray(new.params["2"]["W"]).shape == (20, 6)
+    # the final output layer keeps its trained weights (width unchanged)
+    np.testing.assert_array_equal(np.asarray(new.params["3"]["W"]),
+                                  np.asarray(src.params["3"]["W"]))
+    X = np.random.RandomState(0).randn(4, 5).astype("float32")
+    assert np.asarray(new.output(X)).shape == (4, 3)
+
+
+def test_frozen_lstm_keeps_streaming_state():
+    """Review r4: a FrozenLayerWrapper'd LSTM must still dispatch through
+    the stateful apply_seq path (rnn_time_step carries)."""
+    from deeplearning4j_tpu.nn.layers import LSTM, RnnOutputLayer
+    conf = (NeuralNetConfiguration.Builder().seed(5).updater(Adam(1e-2))
+            .list()
+            .layer(LSTM(n_out=8))
+            .layer(RnnOutputLayer(n_out=2, activation="softmax",
+                                  loss="mcxent"))
+            .set_input_type(InputType.recurrent(3, 6)).build())
+    src = MultiLayerNetwork(conf).init()
+    frozen = (TransferLearning(src).set_feature_extractor(0)
+              .build())
+    rs = np.random.RandomState(1)
+    x = rs.randn(2, 6, 3).astype("float32")
+    full = np.asarray(frozen.output(x))
+    frozen.rnn_clear_previous_state()
+    stepped = np.concatenate(
+        [np.asarray(frozen.rnn_time_step(x[:, t:t + 1])) for t in range(6)],
+        axis=1)
+    np.testing.assert_allclose(stepped, full, atol=1e-5)

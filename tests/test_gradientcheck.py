@@ -482,3 +482,62 @@ def test_gc_gru(reset_after):
                 RnnOutputLayer(n_out=2, activation="softmax", loss="mcxent")],
                InputType.recurrent(4, 5))
     _check(net, X, Y, fmask=mask)
+
+
+def test_gc_locally_connected_1d():
+    from deeplearning4j_tpu.nn.layers import (
+        GlobalPoolingLayer, LocallyConnected1D,
+    )
+    X = RS.randn(4, 6, 3).astype("float32")
+    Y = np.eye(2, dtype="float32")[RS.randint(0, 2, 4)]
+    net = _net([LocallyConnected1D(n_out=4, kernel=3, activation="tanh"),
+                GlobalPoolingLayer(pooling_type="avg"),
+                OutputLayer(n_out=2, activation="softmax", loss="mcxent")],
+               InputType.recurrent(3, 6))
+    _check(net, X, Y)
+
+
+def test_gc_locally_connected_2d():
+    from deeplearning4j_tpu.nn.layers import (
+        GlobalPoolingLayer, LocallyConnected2D,
+    )
+    X = RS.randn(3, 5, 5, 2).astype("float32")
+    Y = np.eye(2, dtype="float32")[RS.randint(0, 2, 3)]
+    net = _net([LocallyConnected2D(n_out=3, kernel=(2, 2),
+                                   activation="tanh"),
+                GlobalPoolingLayer(pooling_type="max"),
+                OutputLayer(n_out=2, activation="softmax", loss="mcxent")],
+               InputType.convolutional(5, 5, 2))
+    _check(net, X, Y)
+
+
+def test_gc_repeat_permute_reshape_chain():
+    from deeplearning4j_tpu.nn.layers import (
+        GlobalPoolingLayer, PermuteLayer, RepeatVector, ReshapeLayer,
+    )
+    X = RS.randn(4, 6).astype("float32")
+    Y = np.eye(2, dtype="float32")[RS.randint(0, 2, 4)]
+    net = _net([DenseLayer(n_out=6, activation="tanh"),
+                RepeatVector(n=4),          # (B, 4, 6)
+                PermuteLayer(dims=(2, 1)),  # (B, 6, 4)
+                ReshapeLayer(target=(8, 3)),
+                GlobalPoolingLayer(pooling_type="avg"),
+                OutputLayer(n_out=2, activation="softmax", loss="mcxent")],
+               InputType.feed_forward(6))
+    _check(net, X, Y)
+
+
+def test_gc_cropping_padding_upsampling_1d():
+    from deeplearning4j_tpu.nn.layers import (
+        Cropping1D, GlobalPoolingLayer, Upsampling1D, ZeroPadding1DLayer,
+    )
+    X = RS.randn(3, 8, 3).astype("float32")
+    Y = np.eye(2, dtype="float32")[RS.randint(0, 2, 3)]
+    net = _net([Cropping1D(cropping=(1, 2)),
+                Upsampling1D(size=2),
+                ZeroPadding1DLayer(padding=(1, 1)),
+                LSTM(n_out=5),
+                GlobalPoolingLayer(pooling_type="avg"),
+                OutputLayer(n_out=2, activation="softmax", loss="mcxent")],
+               InputType.recurrent(3, 8))
+    _check(net, X, Y)

@@ -43,6 +43,32 @@ _ACTIVATIONS = {
 }
 
 
+# Keras loss names -> ours (KerasLossUtils.mapLossFunction)
+_LOSS_MAP = {
+    "mean_squared_error": "mse", "mse": "mse",
+    "mean_absolute_error": "mae", "mae": "mae",
+    "categorical_crossentropy": "mcxent",
+    "sparse_categorical_crossentropy": "sparse_mcxent",
+    "binary_crossentropy": "xent",
+    "kullback_leibler_divergence": "kl_divergence", "kld": "kl_divergence",
+    "poisson": "poisson",
+    "cosine_proximity": "cosine_proximity",
+    "hinge": "hinge", "squared_hinge": "squared_hinge",
+}
+
+
+def _map_loss(name) -> str:
+    """Keras loss -> ours; unknown losses refuse loudly (a silently
+    different training objective is worse than an import error)."""
+    if isinstance(name, dict):
+        name = name.get("class_name", name.get("config", {}).get("name", ""))
+    key = str(name).lower()
+    if key not in _LOSS_MAP:
+        raise ValueError(f"Unsupported Keras loss '{name}' "
+                         f"(mappable: {sorted(_LOSS_MAP)})")
+    return _LOSS_MAP[key]
+
+
 def _act(name) -> str:
     if isinstance(name, dict):      # serialized activation object
         name = name.get("class_name", "linear").lower()
@@ -103,7 +129,10 @@ class KerasModelImport:
             cfg = json.loads(raw)
             updater = _updater_from_training_config(f.attrs.get(
                 "training_config"))
-            net, importers = _build_from_config(cfg, updater=updater)
+            output_loss = _loss_from_training_config(f.attrs.get(
+                "training_config"))
+            net, importers = _build_from_config(cfg, updater=updater,
+                                                output_loss=output_loss)
             net.init()
             weights_root = f["model_weights"] if "model_weights" in f else f
             for name, load in importers:
@@ -224,14 +253,40 @@ def _updater_from_training_config(raw):
                   beta2=float(ocfg.get("beta_2", 0.999)))
 
 
-def _build_from_config(cfg: dict, updater=None):
+def _build_from_config(cfg: dict, updater=None, output_loss=None):
     cls = cfg.get("class_name")
     inner = cfg.get("config", cfg)
     if cls == "Sequential":
-        return _build_sequential(inner, updater=updater)
+        return _build_sequential(inner, updater=updater,
+                                 output_loss=output_loss)
     if cls in ("Model", "Functional"):
-        return _build_functional(inner, updater=updater)
+        return _build_functional(inner, updater=updater,
+                                 output_loss=output_loss)
     raise ValueError(f"Unsupported Keras model class '{cls}'")
+
+
+def _loss_from_training_config(raw):
+    """The compiled model's loss (KerasLoss.java's real role): mapped to
+    our registry when recognized, None when absent/unmappable (fall back
+    to the activation heuristic rather than failing the import —
+    inference parity never depends on the training loss)."""
+    if raw is None:
+        return None
+    if isinstance(raw, bytes):
+        raw = raw.decode("utf-8")
+    try:
+        tc = json.loads(raw)
+    except (TypeError, ValueError):
+        return None
+    loss = tc.get("loss")
+    if isinstance(loss, dict):
+        loss = (loss.get("config", {}) or {}).get("name",
+                                                  loss.get("class_name"))
+    if isinstance(loss, (list, tuple)):
+        loss = loss[0] if loss else None
+    if loss is None:
+        return None
+    return _LOSS_MAP.get(str(loss).lower())
 
 
 def _input_type_from_shape(shape) -> InputType:
@@ -245,7 +300,7 @@ def _input_type_from_shape(shape) -> InputType:
     raise ValueError(f"Unsupported input shape {shape}")
 
 
-def _build_sequential(cfg: dict, updater=None):
+def _build_sequential(cfg: dict, updater=None, output_loss=None):
     from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
     from deeplearning4j_tpu.nn.updaters import Adam
     layers_cfg = cfg["layers"]
@@ -290,7 +345,8 @@ def _build_sequential(cfg: dict, updater=None):
             seen_real += 1
             is_last_real = seen_real == n_real
         layer, loader = _map_layer(k_cls, k_cfg, is_last_real,
-                                   sequence=cur_seq)
+                                   sequence=cur_seq,
+                                   output_loss=output_loss)
         cur_seq = _sequence_after(k_cls, cur_seq, k_cfg)
         if layer is None:
             continue
@@ -358,7 +414,7 @@ def _bind_mln_loader(loader, index):
     return load
 
 
-def _build_functional(cfg: dict, updater=None):
+def _build_functional(cfg: dict, updater=None, output_loss=None):
     from deeplearning4j_tpu.nn.conf.network import (
         GraphBuilder, NeuralNetConfiguration,
     )
@@ -422,7 +478,8 @@ def _build_functional(cfg: dict, updater=None):
             seq_of[name] = in_seq
             continue
         layer, loader = _map_layer(k_cls, k_cfg, name in out_names,
-                                   sequence=in_seq)
+                                   sequence=in_seq,
+                                   output_loss=output_loss)
         seq_of[name] = _sequence_after(k_cls, in_seq, k_cfg)
         if layer is None:
             flatten_alias[name] = inbound[0]
@@ -505,9 +562,9 @@ def _sequence_after(k_cls: str, cur_seq: bool, k_cfg: dict = None) -> bool:
     if k_cls in ("GlobalAveragePooling1D", "GlobalMaxPooling1D",
                  "Flatten"):
         return False
-    if k_cls in ("Conv1D", "MaxPooling1D", "AveragePooling1D",
-                 "Cropping1D", "UpSampling1D", "ZeroPadding1D",
-                 "LocallyConnected1D", "Masking"):
+    if k_cls in ("Conv1D", "AtrousConvolution1D", "MaxPooling1D",
+                 "AveragePooling1D", "Cropping1D", "UpSampling1D",
+                 "ZeroPadding1D", "LocallyConnected1D", "Masking"):
         return cur_seq          # 1D conv/pool/pad keep (B, T, C) sequences
     if k_cls == "Reshape":
         return len(k_cfg.get("target_shape", ())) == 2   # (T, C) -> seq
@@ -522,7 +579,7 @@ def _sequence_after(k_cls: str, cur_seq: bool, k_cfg: dict = None) -> bool:
 
 # -------------------------------------------------------------- layer maps
 def _map_layer(k_cls: str, k_cfg: dict, is_output: bool,
-               sequence: bool = False):
+               sequence: bool = False, output_loss=None):
     """Returns (LayerConf | None, loader | None). loader(params, state,
     weights) copies Keras weights into our pytrees."""
     from deeplearning4j_tpu.nn.layers import (
@@ -537,6 +594,20 @@ def _map_layer(k_cls: str, k_cfg: dict, is_output: bool,
     )
     import jax.numpy as jnp
 
+    if k_cls in ("AtrousConvolution1D", "AtrousConvolution2D"):
+        # genuine Keras-1 archives use the old field names — normalize
+        # them to the Keras-2 keys the conv branches read
+        legacy = {"nb_filter": "filters", "filter_length": "kernel_size",
+                  "subsample_length": "strides", "subsample": "strides",
+                  "border_mode": "padding", "atrous_rate": "dilation_rate"}
+        k_cfg = dict(k_cfg)
+        for old_key, new_key in legacy.items():
+            if old_key in k_cfg and new_key not in k_cfg:
+                k_cfg[new_key] = k_cfg.pop(old_key)
+        if "kernel_size" not in k_cfg and "nb_row" in k_cfg:
+            k_cfg["kernel_size"] = [k_cfg.pop("nb_row"),
+                                    k_cfg.pop("nb_col")]
+
     def set_wb(params, state, w):
         params["W"] = jnp.asarray(w[0])
         if len(w) > 1 and "b" in params:
@@ -544,22 +615,26 @@ def _map_layer(k_cls: str, k_cfg: dict, is_output: bool,
 
     if k_cls == "Dense":
         act = _act(k_cfg.get("activation", "linear"))
+        # the compiled model's loss (training_config) wins over the
+        # activation heuristic — the KerasLoss.java role
+        heur = "mcxent" if act == "softmax" else "mse"
+        out_loss = output_loss or heur
         if sequence:
             # Keras Dense on a 3D input is time-distributed; RnnOutputLayer
             # is the (B, T, F) dense projection here (its loss only engages
             # when it terminates a training network)
             return RnnOutputLayer(
                 n_out=int(k_cfg["units"]), activation=act,
-                loss="mcxent" if act == "softmax" else "mse",
+                loss=out_loss if is_output else heur,
                 has_bias=k_cfg.get("use_bias", True)), set_wb
-        if is_output and act == "softmax":
+        if is_output and (act == "softmax" or output_loss is not None):
             return OutputLayer(n_out=int(k_cfg["units"]), activation=act,
-                               loss="mcxent",
+                               loss=out_loss,
                                has_bias=k_cfg.get("use_bias", True)), set_wb
         return DenseLayer(n_out=int(k_cfg["units"]), activation=act,
                           has_bias=k_cfg.get("use_bias", True)), set_wb
 
-    if k_cls == "Conv2D":
+    if k_cls in ("Conv2D", "AtrousConvolution2D"):
         return ConvolutionLayer(
             n_out=int(k_cfg["filters"]),
             kernel=_pair(k_cfg.get("kernel_size", 3)),
@@ -773,13 +848,24 @@ def _map_layer(k_cls: str, k_cfg: dict, is_output: bool,
         inner = k_cfg["layer"]
         inner_cls = inner.get("class_name")
         inner_cfg = inner.get("config", {})
-        return _map_layer(inner_cls, inner_cfg, is_output, sequence=True)
+        return _map_layer(inner_cls, inner_cfg, is_output, sequence=True,
+                          output_loss=output_loss)
 
     def _one(v) -> int:
         """Scalar from a Keras 1D size field (stored scalar or 1-tuple)."""
         return int(v[0] if isinstance(v, (list, tuple)) else v)
 
-    if k_cls == "Conv1D":
+    if k_cls == "Loss":
+        # KerasLoss.java: a bare training-loss head over the incoming
+        # activations (model compiled with a loss but no trailing Dense)
+        from deeplearning4j_tpu.nn.layers import LossLayer, RnnLossLayer
+        loss = _map_loss(k_cfg.get("loss", "mse"))
+        cls = RnnLossLayer if sequence else LossLayer
+        return cls(loss=loss), None
+
+    if k_cls in ("AtrousConvolution1D", "Conv1D"):
+        # Keras-1 atrous convs are dilated convs under an older name
+        # (KerasAtrousConvolution1D.java); keys normalized above
         from deeplearning4j_tpu.nn.layers import Convolution1DLayer
         if k_cfg.get("padding") == "causal":
             raise ValueError("Conv1D: padding='causal' is not mapped "

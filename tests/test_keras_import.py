@@ -569,3 +569,51 @@ def test_reshape_with_inferred_dim_parity(tmp_path):
     x = np.random.RandomState(26).randn(3, 24).astype("float32")
     np.testing.assert_allclose(np.asarray(net.output(x)),
                                np.asarray(m(x)), atol=1e-5)
+
+
+def test_loss_and_atrous_config_mapping():
+    """KerasLoss.java + KerasAtrousConvolution mappers (Keras-1/2-era
+    archives; Keras 3 has neither, so map configs directly)."""
+    from deeplearning4j_tpu.modelimport.keras import _map_layer
+    from deeplearning4j_tpu.nn.layers import (
+        Convolution1DLayer, ConvolutionLayer, LossLayer, RnnLossLayer,
+    )
+    layer, loader = _map_layer("Loss", {"loss": "binary_crossentropy"},
+                               True)
+    assert isinstance(layer, LossLayer) and layer.loss == "xent"
+    layer, _ = _map_layer("Loss", {"loss": "categorical_crossentropy"},
+                          True, sequence=True)
+    assert isinstance(layer, RnnLossLayer) and layer.loss == "mcxent"
+    layer, _ = _map_layer(
+        "AtrousConvolution1D",
+        {"filters": 4, "kernel_size": [3], "atrous_rate": [2],
+         "padding": "same", "activation": "relu"}, False, sequence=True)
+    assert isinstance(layer, Convolution1DLayer) and layer.dilation == 2
+    layer, _ = _map_layer(
+        "AtrousConvolution2D",
+        {"filters": 4, "kernel_size": [3, 3], "atrous_rate": [2, 2],
+         "padding": "same", "activation": "relu"}, False)
+    assert isinstance(layer, ConvolutionLayer) and layer.dilation == (2, 2)
+
+
+def test_compiled_loss_flows_to_output_layer(tmp_path):
+    """The training_config loss (KerasLoss role) must override the
+    activation heuristic on the imported output layer."""
+    m = keras.Sequential([
+        keras.layers.Input((6,)),
+        keras.layers.Dense(8, activation="relu"),
+        keras.layers.Dense(1, activation="sigmoid"),
+    ])
+    m.compile(optimizer="adam", loss="binary_crossentropy")
+    p = _save(m, tmp_path)
+    net = KerasModelImport.import_keras_sequential_model_and_weights(p)
+    assert type(net.layers[-1]).__name__ == "OutputLayer"
+    assert net.layers[-1].loss == "xent"
+    x = np.random.RandomState(27).randn(4, 6).astype("float32")
+    np.testing.assert_allclose(np.asarray(net.output(x)),
+                               np.asarray(m(x)), atol=1e-5)
+    # and it trains against that loss
+    y = (np.random.RandomState(28).rand(32, 1) > 0.5).astype("float32")
+    X = np.random.RandomState(29).randn(32, 6).astype("float32")
+    net.fit((X, y), batch_size=16, epochs=2)
+    assert np.isfinite(net.score())

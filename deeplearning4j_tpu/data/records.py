@@ -44,7 +44,15 @@ class CollectionRecordReader(RecordReader):
 
 
 class CSVRecordReader(RecordReader):
-    """CSV lines -> float/str records (DataVec CSVRecordReader)."""
+    """CSV lines -> float/str records (DataVec CSVRecordReader).
+
+    `to_matrix()` is the native C++ fast path (`native/src/csv.cpp`, one
+    strict parse into a float32 matrix — the data-loader role the
+    reference delegates to native DataVec), used by
+    RecordReaderDataSetIterator; anything the strict parser rejects
+    (quoting, non-numeric fields, hex floats, f32-overflowing literals,
+    ragged rows) yields None and consumers fall back to the python csv
+    path."""
 
     def __init__(self, path: str, skip_lines: int = 0, delimiter: str = ",",
                  numeric: bool = True):
@@ -53,6 +61,25 @@ class CSVRecordReader(RecordReader):
         self.delimiter = delimiter
         self.numeric = numeric
 
+    def to_matrix(self):
+        """float32 (rows, cols) matrix via the native parser, or None if
+        the file is not strictly numeric / too large / no toolchain.
+        records() itself stays on the python csv module — its contract is
+        float64 lists; the float32 fast path belongs to the consumers
+        that produce float32 anyway (RecordReaderDataSetIterator)."""
+        if not self.numeric or len(self.delimiter.encode()) != 1:
+            return None
+        import os as _os
+        limit = int(_os.environ.get("DL4J_TPU_CSV_FAST_MAX_BYTES",
+                                    1 << 30))
+        try:
+            if _os.path.getsize(self.path) > limit:
+                return None     # keep huge files on the streaming path
+        except OSError:
+            return None
+        return parse_numeric_csv(self.path, self.delimiter,
+                                 self.skip_lines)
+
     def records(self):
         with open(self.path, newline="") as f:
             reader = csv.reader(f, delimiter=self.delimiter)
@@ -60,6 +87,35 @@ class CSVRecordReader(RecordReader):
                 if i < self.skip_lines or not row:
                     continue
                 yield [float(v) for v in row] if self.numeric else row
+
+
+def parse_numeric_csv(path: str, delimiter: str = ",",
+                      skip_lines: int = 0):
+    """Strict native numeric-CSV parse -> float32 matrix, or None when
+    the native library is unavailable or the file fails strict parsing
+    (caller falls back to the python reader)."""
+    import ctypes
+
+    from deeplearning4j_tpu import native
+    if not native.available():
+        return None
+    lib = native.get_lib()
+    with open(path, "rb") as f:
+        data = f.read()
+    delim = ctypes.c_char(delimiter.encode())
+    ncols = ctypes.c_int64(0)
+    rows = lib.csv_parse_f32(data, len(data), delim, skip_lines, None, 0,
+                             ctypes.byref(ncols))
+    if rows < 0:
+        return None
+    out = np.empty((rows, ncols.value), np.float32)
+    filled = lib.csv_parse_f32(
+        data, len(data), delim, skip_lines,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), rows,
+        ctypes.byref(ncols))
+    if filled != rows:
+        return None
+    return out
 
 
 class SequenceRecordReader:
@@ -124,6 +180,13 @@ class RecordReaderDataSetIterator(DataSetIterator):
         self.reader.reset()
 
     def __iter__(self):
+        # native fast path: numeric CSV parsed once into a float32 matrix
+        # (identical batches — _to_dataset produces float32 regardless)
+        mat = getattr(self.reader, "to_matrix", lambda: None)()
+        if mat is not None:
+            for i in range(0, len(mat), self._batch):
+                yield self._to_dataset(mat[i:i + self._batch])
+            return
         buf = []
         for rec in self.reader.records():
             buf.append(rec)

@@ -61,7 +61,10 @@ def _map_loss(name) -> str:
     """Keras loss -> ours; unknown losses refuse loudly (a silently
     different training objective is worse than an import error)."""
     if isinstance(name, dict):
-        name = name.get("class_name", name.get("config", {}).get("name", ""))
+        # serialized loss objects: config.name is the snake_case registry
+        # key; class_name is CamelCase and only a last resort
+        name = (name.get("config", {}) or {}).get(
+            "name", name.get("class_name", ""))
     key = str(name).lower()
     if key not in _LOSS_MAP:
         raise ValueError(f"Unsupported Keras loss '{name}' "
@@ -283,7 +286,14 @@ def _loss_from_training_config(raw):
         loss = (loss.get("config", {}) or {}).get("name",
                                                   loss.get("class_name"))
     if isinstance(loss, (list, tuple)):
-        loss = loss[0] if loss else None
+        # multi-output models: per-output losses can differ — applying
+        # loss[0] to every head would silently train secondary outputs
+        # against the wrong objective, so defer to the per-layer
+        # activation heuristic instead
+        uniq = {str(l).lower() for l in loss}
+        if len(uniq) != 1:
+            return None
+        loss = next(iter(uniq))
     if loss is None:
         return None
     return _LOSS_MAP.get(str(loss).lower())

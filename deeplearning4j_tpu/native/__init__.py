@@ -24,7 +24,8 @@ log = logging.getLogger("deeplearning4j_tpu")
 _SRC_DIR = os.path.join(os.path.dirname(__file__), "src")
 _SRCS = [os.path.join(_SRC_DIR, "dl4jtpu_native.cpp"),
          os.path.join(_SRC_DIR, "ndarray_ops.cpp"),
-         os.path.join(_SRC_DIR, "sptree.cpp")]
+         os.path.join(_SRC_DIR, "sptree.cpp"),
+         os.path.join(_SRC_DIR, "csv.cpp")]
 _lib: Optional[ctypes.CDLL] = None
 _build_failed = False
 
@@ -127,6 +128,9 @@ def _declare_ndarray_ops(lib: ctypes.CDLL) -> None:
     lib.bh_repulsion_f32.restype = ctypes.c_double
     lib.bh_repulsion_f32.argtypes = [f32p, i64, i32, f32, f32p,
                                      ctypes.POINTER(i64)]
+    lib.csv_parse_f32.restype = i64
+    lib.csv_parse_f32.argtypes = [ctypes.c_char_p, i64, ctypes.c_char,
+                                  i64, f32p, i64, ctypes.POINTER(i64)]
     lib.scale_u8_f32.restype = None
     lib.scale_u8_f32.argtypes = [u8p, i64, f32, f32, f32p]
 

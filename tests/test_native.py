@@ -287,3 +287,60 @@ def test_ndarray_argmax_empty_raises_and_rdiv_exact():
     x = nd.HostNDArray(np.array([1e-40, 2.0], np.float32))
     out = (1e-5 / x).numpy()
     assert np.isfinite(out[0]) and out[0] == np.float32(1e-5) / np.float32(1e-40)
+
+
+def test_native_csv_parser_matches_python_and_falls_back(tmp_path):
+    """Strict C++ numeric-CSV fast path: identical values to the python
+    reader, loud fallback (None) for anything non-numeric/ragged."""
+    from deeplearning4j_tpu.data.records import (
+        CSVRecordReader, parse_numeric_csv,
+    )
+    rs = np.random.RandomState(0)
+    M = rs.randn(500, 8).astype("float32")
+    p = tmp_path / "num.csv"
+    with open(p, "w") as f:
+        f.write("h1,h2,h3,h4,h5,h6,h7,h8\n")      # header skipped
+        for row in M:
+            f.write(",".join(f"{v:.6g}" for v in row) + "\n")
+    mat = parse_numeric_csv(str(p), ",", skip_lines=1)
+    if not native.available():
+        assert mat is None
+        return
+    assert mat.shape == (500, 8)
+    np.testing.assert_allclose(mat, M, rtol=1e-5)
+    # records() keeps the python float64-list contract
+    rows = list(CSVRecordReader(str(p), skip_lines=1).records())
+    assert isinstance(rows[0], list)
+    np.testing.assert_allclose(np.asarray(rows, np.float32), M, rtol=1e-5)
+    # strict parser rejects what python float() would treat differently
+    hexf = tmp_path / "hex.csv"
+    hexf.write_text("1,0x10\n")
+    assert parse_numeric_csv(str(hexf)) is None
+    over = tmp_path / "over.csv"
+    over.write_text("1e39,2\n")
+    assert parse_numeric_csv(str(over)) is None
+
+    # non-numeric and ragged files fall back (None from the fast path)
+    bad = tmp_path / "bad.csv"
+    bad.write_text("1,2,3\n4,abc,6\n")
+    assert parse_numeric_csv(str(bad)) is None
+    ragged = tmp_path / "ragged.csv"
+    ragged.write_text("1,2,3\n4,5\n")
+    assert parse_numeric_csv(str(ragged)) is None
+    # python fallback still raises its usual error for non-numeric
+    with pytest.raises(ValueError):
+        list(CSVRecordReader(str(bad)).records())
+
+    # and the full RecordReaderDataSetIterator flow on the fast path
+    from deeplearning4j_tpu.data.records import RecordReaderDataSetIterator
+    lab = tmp_path / "labeled.csv"
+    with open(lab, "w") as f:
+        for i in range(30):
+            f.write(f"{i * 0.1:.3f},{i * 0.2:.3f},{i % 3}\n")
+    it = RecordReaderDataSetIterator(CSVRecordReader(str(lab)),
+                                     batch_size=10, label_index=2,
+                                     num_classes=3)
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[0].features.shape == (10, 2)
+    assert batches[0].labels.shape == (10, 3)

@@ -67,18 +67,23 @@ class CSVRecordReader(RecordReader):
         records() itself stays on the python csv module — its contract is
         float64 lists; the float32 fast path belongs to the consumers
         that produce float32 anyway (RecordReaderDataSetIterator)."""
-        if not self.numeric or len(self.delimiter.encode()) != 1:
+        if not self.numeric:
             return None
-        import os as _os
-        limit = int(_os.environ.get("DL4J_TPU_CSV_FAST_MAX_BYTES",
-                                    1 << 30))
+        limit = int(os.environ.get("DL4J_TPU_CSV_FAST_MAX_BYTES", 1 << 30))
         try:
-            if _os.path.getsize(self.path) > limit:
+            stat = os.stat(self.path)
+            if stat.st_size > limit:
                 return None     # keep huge files on the streaming path
         except OSError:
             return None
-        return parse_numeric_csv(self.path, self.delimiter,
-                                 self.skip_lines)
+        key = (stat.st_mtime_ns, stat.st_size)
+        cached = getattr(self, "_matrix_cache", None)
+        if cached is not None and cached[0] == key:
+            return cached[1]    # multi-epoch fit: parse once
+        mat = parse_numeric_csv(self.path, self.delimiter,
+                                self.skip_lines)
+        self._matrix_cache = (key, mat)
+        return mat
 
     def records(self):
         with open(self.path, newline="") as f:
@@ -97,7 +102,7 @@ def parse_numeric_csv(path: str, delimiter: str = ",",
     import ctypes
 
     from deeplearning4j_tpu import native
-    if not native.available():
+    if len(delimiter.encode()) != 1 or not native.available():
         return None
     lib = native.get_lib()
     with open(path, "rb") as f:
@@ -180,6 +185,9 @@ class RecordReaderDataSetIterator(DataSetIterator):
         self.reader.reset()
 
     def __iter__(self):
+        if getattr(self.reader, "is_image", False):
+            yield from self._iter_image_batches()
+            return
         # native fast path: numeric CSV parsed once into a float32 matrix
         # (identical batches — _to_dataset produces float32 regardless)
         mat = getattr(self.reader, "to_matrix", lambda: None)()
@@ -195,6 +203,28 @@ class RecordReaderDataSetIterator(DataSetIterator):
                 buf = []
         if buf:
             yield self._to_dataset(buf)
+
+    def _iter_image_batches(self):
+        imgs, labels = [], []
+        for img, lab in self.reader.records():
+            imgs.append(img)
+            labels.append(lab)
+            if len(imgs) == self._batch:
+                yield self._image_dataset(imgs, labels)
+                imgs, labels = [], []
+        if imgs:
+            yield self._image_dataset(imgs, labels)
+
+    def _image_dataset(self, imgs, labels) -> DataSet:
+        feats = np.stack(imgs).astype("float32")        # (B, H, W, C)
+        if self.label_index is None:    # unlabeled, as the tabular path
+            return DataSet(feats)
+        if self.regression:
+            return DataSet(feats, np.asarray(labels, "float32")[:, None])
+        if self.num_classes is None:
+            raise ValueError("num_classes required for classification")
+        return DataSet(feats, np.eye(self.num_classes, dtype="float32")[
+            np.asarray(labels, int)])
 
     def _to_dataset(self, rows) -> DataSet:
         arr = np.asarray(rows, "float32")
@@ -369,3 +399,72 @@ class RecordReaderMultiDataSetIterator(DataSetIterator):
         if lo is None:
             return a
         return a[:, lo:(a.shape[1] if hi is None else hi + 1)]
+
+
+class ImageRecordReader(RecordReader):
+    """Images-from-directories reader (DataVec ImageRecordReader +
+    ParentPathLabelGenerator): label = parent directory name, images
+    resized to (height, width) and scaled to [0, 1] float32 NHWC.
+
+    Usage (the canonical DL4J image-pipeline quickstart):
+        rr = ImageRecordReader(32, 32, 3)
+        rr.initialize("/data/train")        # train/<label>/*.png
+        it = RecordReaderDataSetIterator(rr, batch_size=64,
+                                         label_index=-1,
+                                         num_classes=rr.num_labels())
+    """
+
+    IMAGE_EXTENSIONS = (".png", ".jpg", ".jpeg", ".bmp", ".gif")
+
+    def __init__(self, height: int, width: int, channels: int = 3,
+                 shuffle: bool = False, seed: int = 0):
+        self.height = int(height)
+        self.width = int(width)
+        self.channels = int(channels)
+        self.shuffle = shuffle
+        self.seed = seed
+        self._files: List[Tuple[str, int]] = []
+        self._labels: List[str] = []
+
+    def initialize(self, root_dir: str):
+        labels = sorted(
+            d for d in os.listdir(root_dir)
+            if os.path.isdir(os.path.join(root_dir, d)))
+        self._labels = labels
+        files = []
+        for idx, label in enumerate(labels):
+            d = os.path.join(root_dir, label)
+            for fn in sorted(os.listdir(d)):
+                if fn.lower().endswith(self.IMAGE_EXTENSIONS):
+                    files.append((os.path.join(d, fn), idx))
+        if self.shuffle:
+            rs = np.random.RandomState(self.seed)
+            rs.shuffle(files)
+        self._files = files
+        return self
+
+    def labels(self) -> List[str]:
+        return list(self._labels)
+
+    def num_labels(self) -> int:
+        return len(self._labels)
+
+    def _load(self, path: str) -> np.ndarray:
+        from PIL import Image
+        img = Image.open(path)
+        img = img.convert("L" if self.channels == 1 else "RGB")
+        img = img.resize((self.width, self.height))
+        arr = np.asarray(img, np.float32) / 255.0
+        if arr.ndim == 2:
+            arr = arr[..., None]
+        return arr
+
+    def records(self):
+        """Yields (image (H, W, C) float32, label_idx) pairs; the bridge
+        iterator recognizes the image shape and builds NHWC batches."""
+        if not self._files:
+            raise RuntimeError("call initialize(root_dir) first")
+        for path, label in self._files:
+            yield (self._load(path), label)
+
+    is_image = True

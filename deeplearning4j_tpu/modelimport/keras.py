@@ -290,10 +290,11 @@ def _loss_from_training_config(raw):
         # loss[0] to every head would silently train secondary outputs
         # against the wrong objective, so defer to the per-layer
         # activation heuristic instead
-        uniq = {str(l).lower() for l in loss}
-        if len(uniq) != 1:
+        uniq = {_LOSS_MAP.get(str(l).lower()) for l in loss}
+        if len(uniq) != 1:      # per-output objectives differ: heuristic
             return None
         loss = next(iter(uniq))
+        return loss             # already mapped (None when unmappable)
     if loss is None:
         return None
     return _LOSS_MAP.get(str(loss).lower())

@@ -13,6 +13,17 @@
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
+#include <locale.h>
+
+namespace {
+// strtof is LC_NUMERIC-dependent; parse under an explicit "C" locale so
+// accept/reject behavior matches python float() regardless of process
+// locale settings
+locale_t c_locale() {
+    static locale_t loc = newlocale(LC_ALL_MASK, "C", (locale_t)0);
+    return loc;
+}
+}   // namespace
 
 extern "C" {
 
@@ -74,12 +85,13 @@ int64_t csv_parse_f32(const char* buf, int64_t n, char delim,
                 const char* fe = buf + i;
                 while (fs < fe && std::isspace((unsigned char)*fs)) ++fs;
                 if (fs == fe) return -1;        // empty field: not numeric
-                // strtof accepts hex floats ("0x10") that python float()
-                // rejects — refuse them so both parsers agree
+                // strtof accepts hex floats ("0x10") and nan payloads
+                // ("nan(abc)") that python float() rejects — refuse both
+                // so the parsers agree
                 for (const char* q = fs; q < fe; ++q)
-                    if (*q == 'x' || *q == 'X') return -1;
+                    if (*q == 'x' || *q == 'X' || *q == '(') return -1;
                 char* parse_end = nullptr;
-                float v = std::strtof(fs, &parse_end);
+                float v = strtof_l(fs, &parse_end, c_locale());
                 if (parse_end == fs) return -1;
                 while (parse_end < fe &&
                        std::isspace((unsigned char)*parse_end))

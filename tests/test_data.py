@@ -474,3 +474,60 @@ class TestNormalizers:
         for src in sources:
             b = next(iter(src))
             assert abs(np.asarray(b.features).mean()) < 5.0, type(src)
+
+
+def test_image_record_reader_end_to_end(tmp_path):
+    """DataVec ImageRecordReader + ParentPathLabelGenerator flow: label
+    dirs -> resized NHWC batches -> a CNN trains on them."""
+    from PIL import Image
+
+    from deeplearning4j_tpu.data.records import (
+        ImageRecordReader, RecordReaderDataSetIterator,
+    )
+    rs = np.random.RandomState(0)
+    # two classes with distinguishable mean intensity
+    for label, base in (("dark", 40), ("light", 200)):
+        d = tmp_path / "train" / label
+        d.mkdir(parents=True)
+        for i in range(12):
+            arr = np.clip(base + rs.randn(10, 12, 3) * 10, 0,
+                          255).astype("uint8")
+            Image.fromarray(arr).save(d / f"{i}.png")
+
+    rr = ImageRecordReader(8, 8, 3).initialize(str(tmp_path / "train"))
+    assert rr.labels() == ["dark", "light"]
+    it = RecordReaderDataSetIterator(rr, batch_size=6, label_index=-1,
+                                     num_classes=2)
+    batches = list(it)
+    assert len(batches) == 4
+    assert batches[0].features.shape == (6, 8, 8, 3)
+    assert batches[0].labels.shape == (6, 2)
+    assert 0.0 <= batches[0].features.min() <= batches[0].features.max() <= 1.0
+
+    # trains end to end
+    from deeplearning4j_tpu.nn.conf import (
+        InputType, NeuralNetConfiguration,
+    )
+    from deeplearning4j_tpu.nn.layers import (
+        ConvolutionLayer, GlobalPoolingLayer, OutputLayer,
+    )
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.updaters import Adam
+    conf = (NeuralNetConfiguration.Builder().seed(1).updater(Adam(1e-2))
+            .list()
+            .layer(ConvolutionLayer(n_out=4, kernel=(3, 3),
+                                    activation="relu"))
+            .layer(GlobalPoolingLayer(pooling_type="avg"))
+            .layer(OutputLayer(n_out=2, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.convolutional(8, 8, 3)).build())
+    net = MultiLayerNetwork(conf).init()
+    net.fit(it, epochs=15)
+    assert net.evaluate(it).accuracy() > 0.9
+
+    # grayscale channel mode
+    rr1 = ImageRecordReader(8, 8, 1).initialize(str(tmp_path / "train"))
+    b = next(iter(RecordReaderDataSetIterator(rr1, batch_size=4,
+                                              label_index=-1,
+                                              num_classes=2)))
+    assert b.features.shape == (4, 8, 8, 1)

@@ -18,6 +18,11 @@ from deeplearning4j_tpu.data.normalization import (
     MultiNormalizerStandardize, NormalizerMinMaxScaler,
     NormalizerStandardize, VGG16ImagePreProcessor,
 )
+from deeplearning4j_tpu.data.records import (
+    CSVRecordReader, CSVSequenceRecordReader, CollectionRecordReader,
+    CollectionSequenceRecordReader, ImageRecordReader,
+    RecordReaderDataSetIterator, SequenceRecordReaderDataSetIterator,
+)
 from deeplearning4j_tpu.data.fetchers import (
     Cifar10DataSetIterator, EmnistDataSetIterator, IrisDataSetIterator,
     LfwDataSetIterator, MnistDataSetIterator, SvhnDataSetIterator,
@@ -42,4 +47,7 @@ __all__ = [
     "SingletonMultiDataSetIterator", "IteratorMultiDataSetIterator",
     "EarlyTerminationMultiDataSetIterator", "MultiDataSetWrapperIterator",
     "MultiDataSetIteratorSplitter",
+    "CSVRecordReader", "CSVSequenceRecordReader", "CollectionRecordReader",
+    "CollectionSequenceRecordReader", "ImageRecordReader",
+    "RecordReaderDataSetIterator", "SequenceRecordReaderDataSetIterator",
 ]

@@ -74,3 +74,7 @@ def test_transfer_learning():
 @pytest.mark.slow
 def test_tsne_visualization():
     assert _load("12_tsne_visualization.py").main(n=300, max_iter=250) > 0.75
+
+
+def test_custom_layer():
+    assert _load("13_custom_layer.py").main(epochs=30) > 0.9

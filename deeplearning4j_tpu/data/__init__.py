@@ -21,7 +21,8 @@ from deeplearning4j_tpu.data.normalization import (
 from deeplearning4j_tpu.data.records import (
     CSVRecordReader, CSVSequenceRecordReader, CollectionRecordReader,
     CollectionSequenceRecordReader, ImageRecordReader,
-    RecordReaderDataSetIterator, SequenceRecordReaderDataSetIterator,
+    RecordReaderDataSetIterator, RecordReaderMultiDataSetIterator,
+    SequenceRecordReaderDataSetIterator,
 )
 from deeplearning4j_tpu.data.fetchers import (
     Cifar10DataSetIterator, EmnistDataSetIterator, IrisDataSetIterator,
@@ -49,5 +50,6 @@ __all__ = [
     "MultiDataSetIteratorSplitter",
     "CSVRecordReader", "CSVSequenceRecordReader", "CollectionRecordReader",
     "CollectionSequenceRecordReader", "ImageRecordReader",
-    "RecordReaderDataSetIterator", "SequenceRecordReaderDataSetIterator",
+    "RecordReaderDataSetIterator", "RecordReaderMultiDataSetIterator",
+    "SequenceRecordReaderDataSetIterator",
 ]

@@ -6,7 +6,7 @@ distances + argmin) is one jit-compiled device program per iteration.
 """
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import Optional
 
 import jax
 import jax.numpy as jnp

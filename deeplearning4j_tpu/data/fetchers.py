@@ -22,7 +22,7 @@ from __future__ import annotations
 import gzip
 import os
 import struct
-from typing import Optional, Tuple
+from typing import Optional
 
 import numpy as np
 

@@ -18,7 +18,7 @@ for real multi-host corpora.
 from __future__ import annotations
 
 import logging
-from typing import Iterable, List, Optional
+from typing import List
 
 import numpy as np
 

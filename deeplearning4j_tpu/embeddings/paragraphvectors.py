@@ -10,7 +10,7 @@ gradient steps on a fresh label vector with frozen word tables
 """
 from __future__ import annotations
 
-from typing import Iterable, List, Sequence, Tuple
+from typing import Iterable, List, Tuple
 
 import jax.numpy as jnp
 import numpy as np

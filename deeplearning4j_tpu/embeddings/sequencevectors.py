@@ -15,7 +15,7 @@ embarrassingly batched, deterministic, and the tables stay in HBM.
 from __future__ import annotations
 
 import logging
-from typing import Iterable, List, Optional, Sequence
+from typing import Iterable, List
 
 import jax
 import jax.numpy as jnp

@@ -11,7 +11,7 @@ softmax. Usage mirrors DL4J's builder:
 """
 from __future__ import annotations
 
-from typing import Iterable, List, Optional
+from typing import Iterable, List
 
 from deeplearning4j_tpu.embeddings.sequencevectors import SequenceVectors
 

@@ -6,8 +6,7 @@ WordVectorSerializer` (word2vec text format write/read).
 """
 from __future__ import annotations
 
-import io
-from typing import Iterable, List, Optional, Sequence
+from typing import List, Optional, Sequence
 
 import numpy as np
 

@@ -8,7 +8,7 @@ iterator-driven evaluation; masks follow DL4J time-series semantics.
 """
 from __future__ import annotations
 
-from typing import Dict, List, Optional
+from typing import List, Optional
 
 import numpy as np
 

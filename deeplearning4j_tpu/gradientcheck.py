@@ -14,7 +14,6 @@ insists on double precision.
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional
 
 import jax
 import jax.numpy as jnp

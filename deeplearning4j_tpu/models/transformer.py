@@ -16,7 +16,6 @@ mesh —
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional, Tuple
 
 from jax.sharding import PartitionSpec as P
 

@@ -11,7 +11,7 @@ Each vertex is a frozen dataclass with `output_type(*input_types)` and
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional, Tuple
+from typing import Tuple
 
 import jax.numpy as jnp
 

@@ -17,7 +17,7 @@ foundation the sequence-parallel / ring-attention machinery
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional, Tuple
+from typing import Optional
 
 import jax
 import jax.numpy as jnp

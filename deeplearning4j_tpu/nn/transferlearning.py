@@ -22,7 +22,6 @@ import jax
 import jax.numpy as jnp
 
 from deeplearning4j_tpu.nn.conf.base import LayerConf
-from deeplearning4j_tpu.nn.conf.network import MultiLayerConfiguration
 from deeplearning4j_tpu.nn.layers.samediff import FrozenLayerWrapper
 from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 

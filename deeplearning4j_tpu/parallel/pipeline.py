@@ -33,7 +33,7 @@ dropout is not applied on this path (TransformerLM defaults to 0).
 from __future__ import annotations
 
 import logging
-from typing import List, Optional
+from typing import Optional
 
 import jax
 import jax.numpy as jnp

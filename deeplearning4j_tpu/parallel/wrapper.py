@@ -29,7 +29,7 @@ from __future__ import annotations
 import enum
 import logging
 import time
-from typing import Any, Optional
+from typing import Optional
 
 import jax
 import jax.numpy as jnp

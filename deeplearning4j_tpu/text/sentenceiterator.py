@@ -2,7 +2,7 @@
 from __future__ import annotations
 
 import os
-from typing import Iterable, Iterator, List, Optional
+from typing import Iterable, Iterator
 
 
 class SentenceIterator:

@@ -9,7 +9,7 @@ callable; preprocessors normalize each token.
 from __future__ import annotations
 
 import re
-from typing import Callable, List, Optional
+from typing import List
 
 
 class CommonPreprocessor:

@@ -12,11 +12,10 @@ savers (`InMemoryModelSaver`, `LocalFileModelSaver`), and
 """
 from __future__ import annotations
 
-import copy
 import dataclasses
 import os
 import time
-from typing import Any, Callable, List, Optional
+from typing import Any, List, Optional
 
 import jax
 import numpy as np

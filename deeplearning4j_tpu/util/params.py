@@ -9,7 +9,6 @@ used by checkpointing, parameter averaging, and transfer learning.
 """
 from __future__ import annotations
 
-from typing import Dict, List, Tuple
 
 import jax
 import jax.numpy as jnp

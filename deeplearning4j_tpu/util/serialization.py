@@ -18,7 +18,6 @@ from __future__ import annotations
 import io
 import json
 import zipfile
-from typing import Union
 
 import jax
 import jax.numpy as jnp

@@ -592,6 +592,37 @@ class ComputationGraph:
             None, None, False, None)
         return float(loss)
 
+    def evaluate_roc(self, data, batch_size: int = 32):
+        """Binary ROC on the (single-output) graph (DL4J evaluateROC)."""
+        from deeplearning4j_tpu.eval.roc import ROC
+        return self._evaluate_with(ROC(), data, batch_size)
+
+    def evaluate_roc_multi_class(self, data, batch_size: int = 32):
+        """One-vs-all per-class ROC (DL4J evaluateROCMultiClass)."""
+        from deeplearning4j_tpu.eval.roc import ROCMultiClass
+        return self._evaluate_with(ROCMultiClass(), data, batch_size)
+
+    def _evaluate_with(self, ev, data, batch_size: int = 32):
+        """Feed an eval accumulator from the first output, chunked by
+        batch_size and excluding mask-padded entries."""
+        for mds in self._iter_data(data):
+            labels = np.asarray(mds.labels[0])
+            lm = None if mds.labels_masks is None else mds.labels_masks[0]
+            n = labels.shape[0]
+            for i in range(0, n, batch_size):
+                out = self.output(*(f[i:i + batch_size]
+                                    for f in mds.features))
+                out = out[0] if isinstance(out, (tuple, list)) else out
+                lab = labels[i:i + batch_size]
+                preds = np.asarray(out)
+                if lm is not None:
+                    m = np.asarray(lm[i:i + batch_size]).astype(bool)
+                    lab, preds = lab[m], preds[m]
+                ev.eval(lab, preds)
+        if hasattr(data, "reset"):
+            data.reset()
+        return ev
+
     def evaluate(self, data, batch_size: int = 32):
         from deeplearning4j_tpu.eval.evaluation import Evaluation
         ev = Evaluation()

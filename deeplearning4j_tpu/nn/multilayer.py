@@ -702,6 +702,30 @@ class MultiLayerNetwork:
         iterator.reset()
         return ev
 
+    def evaluate_roc(self, data, batch_size: int = 32):
+        """Binary ROC evaluation (DL4J evaluateROC(DataSetIterator))."""
+        from deeplearning4j_tpu.eval.roc import ROC
+        return self._evaluate_with(ROC(), data, batch_size)
+
+    def evaluate_roc_multi_class(self, data, batch_size: int = 32):
+        """One-vs-all per-class ROC (DL4J evaluateROCMultiClass)."""
+        from deeplearning4j_tpu.eval.roc import ROCMultiClass
+        return self._evaluate_with(ROCMultiClass(), data, batch_size)
+
+    def _evaluate_with(self, ev, data, batch_size: int = 32):
+        iterator = self._as_iterator(data, batch_size)
+        for ds in iterator:
+            labels = np.asarray(ds.labels)
+            preds = np.asarray(self.output(ds.features))
+            if ds.labels_mask is not None:
+                # keep only unmasked steps/examples — padded entries must
+                # not enter the ROC accumulators (evaluate() parity)
+                m = np.asarray(ds.labels_mask).astype(bool)
+                labels, preds = labels[m], preds[m]
+            ev.eval(labels, preds)
+        iterator.reset()
+        return ev
+
     def evaluate_regression(self, data, batch_size: int = 32):
         from deeplearning4j_tpu.eval.regression import RegressionEvaluation
         iterator = self._as_iterator(data, batch_size)

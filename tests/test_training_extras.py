@@ -563,3 +563,63 @@ def test_frozen_lstm_keeps_streaming_state():
         [np.asarray(frozen.rnn_time_step(x[:, t:t + 1])) for t in range(6)],
         axis=1)
     np.testing.assert_allclose(stepped, full, atol=1e-5)
+
+
+def test_evaluate_roc_on_both_containers():
+    """DL4J evaluateROC / evaluateROCMultiClass parity methods."""
+    rs = np.random.RandomState(7)
+    X = rs.randn(200, 4).astype("float32")
+    y = (X[:, 0] + 0.3 * rs.randn(200) > 0).astype(int)
+    Y = np.eye(2, dtype="float32")[y]
+    conf = (NeuralNetConfiguration.Builder().seed(1).updater(Adam(1e-2))
+            .list()
+            .layer(DenseLayer(n_out=8, activation="relu"))
+            .layer(OutputLayer(n_out=2, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4)).build())
+    net = MultiLayerNetwork(conf).init()
+    net.fit((X, Y), epochs=20, batch_size=50)
+    roc = net.evaluate_roc((X, Y))
+    assert roc.calculate_auc() > 0.9
+    rocm = net.evaluate_roc_multi_class((X, Y))
+    assert rocm.calculate_auc(0) > 0.9 and rocm.calculate_auc(1) > 0.9
+
+    # graph variant
+    from deeplearning4j_tpu.nn.conf.network import GraphBuilder
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    g = (GraphBuilder(NeuralNetConfiguration.Builder().seed(2)
+                      .updater(Adam(1e-2)))
+         .add_inputs("in").set_input_types(InputType.feed_forward(4)))
+    g.add_layer("out", OutputLayer(n_out=2, activation="softmax",
+                                   loss="mcxent"), "in")
+    g.set_outputs("out")
+    gnet = ComputationGraph(g.build()).init()
+    gnet.fit((X, Y), epochs=150)   # one full-batch step per epoch
+    assert gnet.evaluate_roc((X, Y), batch_size=64).calculate_auc() > 0.85
+    gm = gnet.evaluate_roc_multi_class((X, Y), batch_size=64)
+    assert gm.calculate_auc(1) > 0.85
+
+
+def test_evaluate_roc_excludes_masked_steps():
+    """Padded timesteps must not enter the ROC accumulators."""
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.data.iterator import ExistingDataSetIterator
+    from deeplearning4j_tpu.nn.layers import LSTM, RnnOutputLayer
+    rs = np.random.RandomState(8)
+    conf = (NeuralNetConfiguration.Builder().seed(3).updater(Adam(1e-2))
+            .list()
+            .layer(LSTM(n_out=6))
+            .layer(RnnOutputLayer(n_out=2, activation="softmax",
+                                  loss="mcxent"))
+            .set_input_type(InputType.recurrent(3, 5)).build())
+    net = MultiLayerNetwork(conf).init()
+    X = rs.randn(8, 5, 3).astype("float32")
+    Y = np.eye(2, dtype="float32")[rs.randint(0, 2, (8, 5))]
+    # padded tail steps carry all-zero labels that would poison the ROC
+    lm = np.ones((8, 5), np.float32)
+    lm[:, 3:] = 0.0
+    Y[:, 3:] = 0.0
+    roc = net.evaluate_roc(
+        ExistingDataSetIterator([DataSet(X, Y, None, lm)]))
+    # 8 examples x 3 valid steps accumulated, not 40
+    assert sum(len(a) for a in roc._labels) == 24

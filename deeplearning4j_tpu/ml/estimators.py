@@ -81,6 +81,31 @@ class _NetworkEstimator(BaseEstimator):
             raise RuntimeError(
                 f"{type(self).__name__} is not fitted yet — call fit first")
 
+    # ------------------------------------------------- pickle / joblib
+    # the fitted network holds optax closures that don't pickle; route
+    # persistence through the checkpoint-zip format instead so
+    # pickle/joblib.dump of a fitted estimator Just Works
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        net = state.pop("network_", None)
+        if net is not None:
+            import io
+
+            from deeplearning4j_tpu.util.serialization import save_model
+            buf = io.BytesIO()
+            save_model(net, buf)
+            state["_network_blob_"] = buf.getvalue()
+        return state
+
+    def __setstate__(self, state):
+        blob = state.pop("_network_blob_", None)
+        self.__dict__.update(state)
+        if blob is not None:
+            import io
+
+            from deeplearning4j_tpu.util.serialization import load_model
+            self.network_ = load_model(io.BytesIO(blob))
+
 
 class DL4JClassifier(ClassifierMixin, _NetworkEstimator):
     """Classifier estimator (SparkDl4jNetwork.scala's Estimator role).

@@ -605,6 +605,7 @@ class ComputationGraph:
     def _evaluate_with(self, ev, data, batch_size: int = 32):
         """Feed an eval accumulator from the first output, chunked by
         batch_size and excluding mask-padded entries."""
+        from deeplearning4j_tpu.nn.multilayer import _masked_eval_pair
         for mds in self._iter_data(data):
             labels = np.asarray(mds.labels[0])
             lm = None if mds.labels_masks is None else mds.labels_masks[0]
@@ -613,27 +614,18 @@ class ComputationGraph:
                 out = self.output(*(f[i:i + batch_size]
                                     for f in mds.features))
                 out = out[0] if isinstance(out, (tuple, list)) else out
-                lab = labels[i:i + batch_size]
-                preds = np.asarray(out)
-                if lm is not None:
-                    m = np.asarray(lm[i:i + batch_size]).astype(bool)
-                    lab, preds = lab[m], preds[m]
-                ev.eval(lab, preds)
+                ev.eval(*_masked_eval_pair(
+                    labels[i:i + batch_size], np.asarray(out),
+                    None if lm is None else lm[i:i + batch_size]))
         if hasattr(data, "reset"):
             data.reset()
         return ev
 
     def evaluate(self, data, batch_size: int = 32):
+        """First-output classification evaluation (DL4J evaluate);
+        mask-padded steps excluded, chunked by batch_size."""
         from deeplearning4j_tpu.eval.evaluation import Evaluation
-        ev = Evaluation()
-        for mds in self._iter_data(data):
-            preds = self.output(*mds.features)
-            if isinstance(preds, tuple):
-                preds = preds[0]
-            ev.eval(np.asarray(mds.labels[0]), np.asarray(preds))
-        if hasattr(data, "reset"):
-            data.reset()
-        return ev
+        return self._evaluate_with(Evaluation(), data, batch_size)
 
     # ----------------------------------------------------- recurrent state
     def rnn_time_step(self, *inputs):

@@ -139,6 +139,21 @@ def _as_jnp(a, dtype=None):
     return arr
 
 
+def _masked_eval_pair(labels, preds, labels_mask):
+    """Normalize (labels, preds) for the eval accumulators: drop
+    mask-padded entries (mask reshaped to the labels' leading dims, so
+    (B,T), (B,T,1) and (B,) layouts all work) and flatten remaining
+    rank>=3 sequences to (N, C) so per-class accumulators see the class
+    axis."""
+    if labels_mask is not None:
+        m = np.asarray(labels_mask).astype(bool).reshape(labels.shape[:-1])
+        labels, preds = labels[m], preds[m]
+    if labels.ndim >= 3:
+        labels = labels.reshape(-1, labels.shape[-1])
+        preds = preds.reshape(-1, preds.shape[-1])
+    return labels, preds
+
+
 def validate_layer_conf(layer: LayerConf):
     """Fail fast on unresolvable names at init time (typos in activation /
     weight_init / loss would otherwise only surface at first forward)."""
@@ -693,14 +708,7 @@ class MultiLayerNetwork:
     def evaluate(self, data, batch_size: int = 32):
         """Classification evaluation (DL4J evaluate(DataSetIterator))."""
         from deeplearning4j_tpu.eval.evaluation import Evaluation
-        iterator = self._as_iterator(data, batch_size)
-        ev = Evaluation()
-        for ds in iterator:
-            preds = np.asarray(self.output(ds.features))
-            ev.eval(np.asarray(ds.labels), preds,
-                    mask=None if ds.labels_mask is None else np.asarray(ds.labels_mask))
-        iterator.reset()
-        return ev
+        return self._evaluate_with(Evaluation(), data, batch_size)
 
     def evaluate_roc(self, data, batch_size: int = 32):
         """Binary ROC evaluation (DL4J evaluateROC(DataSetIterator))."""
@@ -715,25 +723,15 @@ class MultiLayerNetwork:
     def _evaluate_with(self, ev, data, batch_size: int = 32):
         iterator = self._as_iterator(data, batch_size)
         for ds in iterator:
-            labels = np.asarray(ds.labels)
-            preds = np.asarray(self.output(ds.features))
-            if ds.labels_mask is not None:
-                # keep only unmasked steps/examples — padded entries must
-                # not enter the ROC accumulators (evaluate() parity)
-                m = np.asarray(ds.labels_mask).astype(bool)
-                labels, preds = labels[m], preds[m]
-            ev.eval(labels, preds)
+            ev.eval(*_masked_eval_pair(
+                np.asarray(ds.labels), np.asarray(self.output(ds.features)),
+                ds.labels_mask))
         iterator.reset()
         return ev
 
     def evaluate_regression(self, data, batch_size: int = 32):
         from deeplearning4j_tpu.eval.regression import RegressionEvaluation
-        iterator = self._as_iterator(data, batch_size)
-        ev = RegressionEvaluation()
-        for ds in iterator:
-            preds = np.asarray(self.output(ds.features))
-            ev.eval(np.asarray(ds.labels), preds)
-        iterator.reset()
+        ev = self._evaluate_with(RegressionEvaluation(), data, batch_size)
         return ev
 
     # ----------------------------------------------------- recurrent state

@@ -65,3 +65,21 @@ def test_autoencoder_transformer_reduces_dim():
     Z = tf.fit_transform(X)
     assert Z.shape == (150, 4)
     assert np.isfinite(Z).all()
+
+
+def test_estimator_pickle_round_trip():
+    """joblib/pickle persistence of fitted estimators rides the
+    checkpoint-zip format (optax closures don't pickle directly)."""
+    import pickle
+
+    X, y = _cls_data(n=90)
+    clf = DL4JClassifier(hidden=(8,), epochs=10, batch_size=30).fit(X, y)
+    back = pickle.loads(pickle.dumps(clf))
+    assert (back.predict(X) == clf.predict(X)).all()
+    np.testing.assert_allclose(back.predict_proba(X), clf.predict_proba(X),
+                               atol=1e-6)
+    # fitted-and-restored estimator can keep training
+    back.fit(X, y)
+    # unfitted estimators round-trip too (GridSearchCV clones pickle)
+    assert not hasattr(pickle.loads(pickle.dumps(DL4JClassifier())),
+                       "network_")

@@ -623,3 +623,31 @@ def test_evaluate_roc_excludes_masked_steps():
         ExistingDataSetIterator([DataSet(X, Y, None, lm)]))
     # 8 examples x 3 valid steps accumulated, not 40
     assert sum(len(a) for a in roc._labels) == 24
+
+
+def test_evaluate_roc_3d_unmasked_keeps_class_axis():
+    """Review r4: unmasked (B,T,2) sequence labels must flatten to (N,2)
+    so ROC strips the class axis instead of pooling both columns."""
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.data.iterator import ExistingDataSetIterator
+    from deeplearning4j_tpu.nn.layers import LSTM, RnnOutputLayer
+    rs = np.random.RandomState(9)
+    conf = (NeuralNetConfiguration.Builder().seed(4).updater(Adam(1e-2))
+            .list()
+            .layer(LSTM(n_out=4))
+            .layer(RnnOutputLayer(n_out=2, activation="softmax",
+                                  loss="mcxent"))
+            .set_input_type(InputType.recurrent(3, 4)).build())
+    net = MultiLayerNetwork(conf).init()
+    X = rs.randn(6, 4, 3).astype("float32")
+    Y = np.eye(2, dtype="float32")[rs.randint(0, 2, (6, 4))]
+    roc = net.evaluate_roc(ExistingDataSetIterator([DataSet(X, Y)]))
+    # 6 examples x 4 steps, ONE accumulated entry per step (class axis
+    # stripped), not 48 pooled values
+    assert sum(len(a) for a in roc._labels) == 24
+    # trailing-singleton mask layout accepted
+    lm = np.ones((6, 4, 1), np.float32)
+    lm[:, 2:] = 0.0
+    roc2 = net.evaluate_roc(
+        ExistingDataSetIterator([DataSet(X, Y, None, lm)]))
+    assert sum(len(a) for a in roc2._labels) == 12

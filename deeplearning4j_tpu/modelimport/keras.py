@@ -425,6 +425,11 @@ def _bind_mln_loader(loader, index):
     return load
 
 
+def _vertex_name(name: str, node_idx: int) -> str:
+    """Vertex name for one call site of a (possibly shared) Keras layer."""
+    return name if node_idx == 0 else f"{name}__call{node_idx}"
+
+
 def _build_functional(cfg: dict, updater=None, output_loss=None):
     from deeplearning4j_tpu.nn.conf.network import (
         GraphBuilder, NeuralNetConfiguration,
@@ -438,16 +443,25 @@ def _build_functional(cfg: dict, updater=None, output_loss=None):
     inputs = []
     input_types = []
     importers = []
-    out_names = _io_names(cfg.get("output_layers", []))
+    out_names = _io_vertex_names(cfg.get("output_layers", []))
     flatten_alias: Dict[str, str] = {}
     mask_pending: Dict[str, float] = {}   # Masking node -> mask_value
     seq_of: Dict[str, bool] = {}
+    _WEIGHTLESS = {"Flatten", "Masking", "Dropout", "Activation",
+                   "Add", "Concatenate", "Average", "Maximum", "Subtract",
+                   "Multiply", "LeakyReLU", "ELU", "ReLU", "Softmax",
+                   "SpatialDropout1D", "SpatialDropout2D", "GaussianNoise",
+                   "GaussianDropout", "AlphaDropout", "Permute", "Reshape",
+                   "RepeatVector", "Cropping1D", "Cropping2D",
+                   "UpSampling1D", "UpSampling2D", "ZeroPadding1D",
+                   "ZeroPadding2D", "MaxPooling1D", "MaxPooling2D",
+                   "AveragePooling1D", "AveragePooling2D",
+                   "GlobalAveragePooling1D", "GlobalAveragePooling2D",
+                   "GlobalMaxPooling1D", "GlobalMaxPooling2D"}
     for lc in cfg["layers"]:
         k_cls = lc["class_name"]
         k_cfg = lc.get("config", {})
         name = k_cfg.get("name", lc.get("name"))
-        raw_inbound = _inbound_names(lc)
-        inbound = [flatten_alias.get(n, n) for n in raw_inbound]
         if k_cls == "InputLayer":
             shape = k_cfg.get("batch_shape") or k_cfg.get(
                 "batch_input_shape")
@@ -456,68 +470,102 @@ def _build_functional(cfg: dict, updater=None, output_loss=None):
             input_types.append(t)
             seq_of[name] = t.kind.value == "rnn"
             continue
-        in_seq = seq_of.get(inbound[0], False) if inbound else False
-        if k_cls == "Flatten":
-            flatten_alias[name] = inbound[0]   # auto preprocessor
-            seq_of[name] = False
-            continue
-        if k_cls == "Masking":
-            # alias through; consumers get wrapped in MaskZeroLayer
-            flatten_alias[name] = inbound[0]
-            mask_pending[name] = float(k_cfg.get("mask_value", 0.0))
-            seq_of[name] = in_seq
-            continue
         if k_cls in ("NotEqual", "Any"):
             # Keras 3 materializes Masking's mask as NotEqual -> Any op
-            # nodes feeding downstream `mask` kwargs (which _inbound_names
-            # ignores); the Masking node itself carries the semantics
+            # nodes feeding downstream `mask` kwargs (which the inbound
+            # walker ignores); the Masking node carries the semantics
             continue
-        carried = next((mask_pending[n] for n in raw_inbound
-                        if n in mask_pending), None)
-        if k_cls in ("Add", "Concatenate", "Average", "Maximum",
-                     "Subtract", "Multiply"):
+        call_sites = _inbound_per_node(lc)
+        if len(call_sites) > 1 and k_cls not in _WEIGHTLESS:
+            # Keras shares ONE weight set across call sites; vertices here
+            # are per-call-site with COPIED weights, so forward parity
+            # holds at import but further training unties them
+            import logging
+            logging.getLogger("deeplearning4j_tpu").warning(
+                "shared Keras layer '%s' (%d call sites): imported as "
+                "per-call-site vertices with copied weights — training "
+                "will untie them", name, len(call_sites))
+        for node_idx, entries in enumerate(call_sites):
+            vname = _vertex_name(name, node_idx)
+            raw_inbound = [_vertex_name(n, ni) for n, ni in entries]
+            inbound = [flatten_alias.get(n, n) for n in raw_inbound]
+            in_seq = seq_of.get(inbound[0], False) if inbound else False
+            if k_cls == "Flatten":
+                flatten_alias[vname] = inbound[0]   # auto preprocessor
+                seq_of[vname] = False
+                continue
+            if k_cls == "Masking":
+                # alias through; consumers get wrapped in MaskZeroLayer
+                flatten_alias[vname] = inbound[0]
+                mask_pending[vname] = float(k_cfg.get("mask_value", 0.0))
+                seq_of[vname] = in_seq
+                continue
+            carried = next((mask_pending[n] for n in raw_inbound
+                            if n in mask_pending), None)
+            if k_cls in ("Add", "Concatenate", "Average", "Maximum",
+                         "Subtract", "Multiply"):
+                if carried is not None:
+                    raise ValueError(
+                        f"Keras Masking cannot propagate through a "
+                        f"'{k_cls}' merge; supply features_mask "
+                        "explicitly instead.")
+                vertex = MergeVertex() if k_cls == "Concatenate" else \
+                    ElementWiseVertex(op={"Add": "add",
+                                          "Subtract": "subtract",
+                                          "Multiply": "product",
+                                          "Average": "average",
+                                          "Maximum": "max"}[k_cls])
+                g.add_vertex(vname, vertex, *inbound)
+                seq_of[vname] = in_seq
+                continue
+            layer, loader = _map_layer(k_cls, k_cfg, vname in out_names,
+                                       sequence=in_seq,
+                                       output_loss=output_loss)
+            seq_of[vname] = _sequence_after(k_cls, in_seq, k_cfg)
+            if layer is None:
+                flatten_alias[vname] = inbound[0]
+                if carried is not None:
+                    mask_pending[vname] = carried
+                continue
             if carried is not None:
-                raise ValueError(
-                    f"Keras Masking cannot propagate through a '{k_cls}' "
-                    "merge; supply features_mask explicitly instead.")
-            vertex = MergeVertex() if k_cls == "Concatenate" else \
-                ElementWiseVertex(op={"Add": "add", "Subtract": "subtract",
-                                      "Multiply": "product",
-                                      "Average": "average",
-                                      "Maximum": "max"}[k_cls])
-            g.add_vertex(name, vertex, *inbound)
-            seq_of[name] = in_seq
-            continue
-        layer, loader = _map_layer(k_cls, k_cfg, name in out_names,
-                                   sequence=in_seq,
-                                   output_loss=output_loss)
-        seq_of[name] = _sequence_after(k_cls, in_seq, k_cfg)
-        if layer is None:
-            flatten_alias[name] = inbound[0]
-            if carried is not None:
-                mask_pending[name] = carried
-            continue
-        if carried is not None:
-            if _recurrent_capable(layer):
-                layer = _wrap_mask_zero(layer, carried, k_cls)
-                if seq_of[name]:    # masked steps now exact zeros
-                    mask_pending[name] = 0.0
-            elif k_cls in _MASK_TRANSPARENT:
-                mask_pending[name] = carried    # zero-preserving passthrough
-            else:
-                raise ValueError(
-                    f"Keras Masking cannot propagate through '{k_cls}': "
-                    "masked steps would stop being exact zeros. Supply "
-                    "features_mask explicitly instead.")
-        g.add_layer(name, layer, *inbound)
-        if loader:
-            importers.append((name, _bind_graph_loader(loader, name)))
+                if _recurrent_capable(layer):
+                    layer = _wrap_mask_zero(layer, carried, k_cls)
+                    if seq_of[vname]:   # masked steps now exact zeros
+                        mask_pending[vname] = 0.0
+                elif k_cls in _MASK_TRANSPARENT:
+                    mask_pending[vname] = carried   # zero-preserving
+                else:
+                    raise ValueError(
+                        f"Keras Masking cannot propagate through "
+                        f"'{k_cls}': masked steps would stop being exact "
+                        "zeros. Supply features_mask explicitly instead.")
+            g.add_layer(vname, layer, *inbound)
+            if loader:
+                # every call-site vertex loads the SAME keras weight group
+                importers.append((name, _bind_graph_loader(loader, vname)))
     g.add_inputs(*inputs)
     g.set_input_types(*input_types)
     g.set_outputs(*out_names)
     from deeplearning4j_tpu.nn.graph import ComputationGraph
     net = ComputationGraph(g.build())
     return net, importers
+
+
+def _io_vertex_names(v) -> List[str]:
+    """output_layers entries -> per-call-site vertex names (the entry's
+    node index selects WHICH call of a shared layer is the output)."""
+    if not v:
+        return []
+    if isinstance(v, list) and isinstance(v[0], str):
+        return [_vertex_name(v[0], int(v[1]) if len(v) > 1 else 0)]
+    out = []
+    for o in v:
+        if isinstance(o, list):
+            out.append(_vertex_name(
+                o[0], int(o[1]) if len(o) > 1 else 0))
+        else:
+            out.append(str(o))
+    return out
 
 
 def _bind_graph_loader(loader, name):
@@ -528,34 +576,33 @@ def _bind_graph_loader(loader, name):
     return load
 
 
-def _io_names(v) -> List[str]:
-    """input_layers/output_layers entries: Keras 2 nests [["name",0,0],...];
-    Keras 3 flattens a single output to ["name", 0, 0]."""
-    if not v:
-        return []
-    if isinstance(v, list) and isinstance(v[0], str):
-        return [v[0]]
-    return [o[0] if isinstance(o, list) else o for o in v]
 
-
-def _inbound_names(lc) -> List[str]:
-    out = []
+def _inbound_per_node(lc) -> List[List[Tuple[str, int]]]:
+    """One entry per CALL SITE of this layer: the list of
+    (producer_name, producer_node_index) pairs that call consumes.
+    Multiple call sites = a shared layer (weight reuse in Keras)."""
+    nodes_out: List[List[Tuple[str, int]]] = []
     for node in lc.get("inbound_nodes", []):
+        cur: List[Tuple[str, int]] = []
         if isinstance(node, dict):      # Keras 3 style
             args = node.get("args", [])
 
             def walk(a):
                 if isinstance(a, dict) and "config" in a and \
                         "keras_history" in a.get("config", {}):
-                    out.append(a["config"]["keras_history"][0])
+                    h = a["config"]["keras_history"]
+                    cur.append((h[0], int(h[1]) if len(h) > 1 else 0))
                 elif isinstance(a, (list, tuple)):
                     for x in a:
                         walk(x)
             walk(args)
-        else:                           # Keras 2: [[name, 0, 0, {}], ...]
+        else:                           # Keras 2: [[name, node, 0, {}],..]
             for entry in node:
-                out.append(entry[0])
-    return out
+                cur.append((entry[0],
+                            int(entry[1]) if len(entry) > 1 else 0))
+        nodes_out.append(cur)
+    return nodes_out
+
 
 
 def _sequence_after(k_cls: str, cur_seq: bool, k_cfg: dict = None) -> bool:

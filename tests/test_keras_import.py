@@ -617,3 +617,25 @@ def test_compiled_loss_flows_to_output_layer(tmp_path):
     X = np.random.RandomState(29).randn(32, 6).astype("float32")
     net.fit((X, y), batch_size=16, epochs=2)
     assert np.isfinite(net.score())
+
+
+def test_functional_shared_layer_parity(tmp_path):
+    """A layer called at two sites (Keras weight sharing) imports as
+    per-call-site vertices with copied weights — forward parity exact
+    (previously silently wrong: both calls' inputs were concatenated
+    into one vertex)."""
+    shared = keras.layers.Dense(4, activation="relu", name="shared")
+    ia = keras.layers.Input((3,), name="a")
+    ib = keras.layers.Input((3,), name="b")
+    merged = keras.layers.Concatenate()([shared(ia), shared(ib)])
+    out = keras.layers.Dense(2, activation="softmax")(merged)
+    m = keras.Model([ia, ib], out)
+    p = _save(m, tmp_path)
+    net = KerasModelImport.import_keras_model_and_weights(p)
+    xa = np.random.RandomState(30).randn(4, 3).astype("float32")
+    xb = np.random.RandomState(31).randn(4, 3).astype("float32")
+    np.testing.assert_allclose(np.asarray(net.output(xa, xb)),
+                               np.asarray(m([xa, xb])), atol=1e-5)
+    # both call-site vertices hold the same (copied) weights
+    np.testing.assert_array_equal(np.asarray(net.params["shared"]["W"]),
+                                  np.asarray(net.params["shared__call1"]["W"]))

@@ -62,6 +62,16 @@ class MultiLayerConfiguration:
     def to_json(self) -> str:
         return json.dumps(self.to_dict(), indent=2)
 
+    def to_yaml(self) -> str:
+        """YAML form (DL4J MultiLayerConfiguration.toYaml)."""
+        import yaml
+        return yaml.safe_dump(self.to_dict(), sort_keys=False)
+
+    @staticmethod
+    def from_yaml(s: str) -> "MultiLayerConfiguration":
+        import yaml
+        return MultiLayerConfiguration.from_dict(yaml.safe_load(s))
+
     @staticmethod
     def from_dict(d: dict) -> "MultiLayerConfiguration":
         return MultiLayerConfiguration(
@@ -307,6 +317,16 @@ class ComputationGraphConfiguration:
 
     def to_json(self) -> str:
         return json.dumps(self.to_dict(), indent=2)
+
+    def to_yaml(self) -> str:
+        """YAML form (DL4J ComputationGraphConfiguration.toYaml)."""
+        import yaml
+        return yaml.safe_dump(self.to_dict(), sort_keys=False)
+
+    @staticmethod
+    def from_yaml(s: str) -> "ComputationGraphConfiguration":
+        import yaml
+        return ComputationGraphConfiguration.from_dict(yaml.safe_load(s))
 
     @staticmethod
     def from_dict(d: dict) -> "ComputationGraphConfiguration":

@@ -107,3 +107,31 @@ def test_round4_layer_conf_json_round_trip():
                                 loss="mcxent"))
              .set_input_type(InputType.convolutional(4, 4, 2)).build())
     assert MultiLayerConfiguration.from_json(conf2.to_json()) == conf2
+
+
+def test_yaml_conf_round_trip():
+    """DL4J toYaml/fromYaml parity on both configuration classes."""
+    from deeplearning4j_tpu.nn.conf.base import InputType
+    from deeplearning4j_tpu.nn.conf.network import (
+        ComputationGraphConfiguration, GraphBuilder,
+        MultiLayerConfiguration, NeuralNetConfiguration,
+    )
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.updaters import Adam
+
+    conf = (NeuralNetConfiguration.Builder().seed(11).updater(Adam(2e-3))
+            .list()
+            .layer(DenseLayer(n_out=8, activation="relu", dropout=0.25))
+            .layer(OutputLayer(n_out=3, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.feed_forward(5)).build())
+    assert MultiLayerConfiguration.from_yaml(conf.to_yaml()) == conf
+
+    g = (GraphBuilder(NeuralNetConfiguration.Builder().seed(3)
+                      .updater(Adam(1e-3)))
+         .add_inputs("in").set_input_types(InputType.feed_forward(4)))
+    g.add_layer("out", OutputLayer(n_out=2, activation="softmax",
+                                   loss="mcxent"), "in")
+    g.set_outputs("out")
+    gconf = g.build()
+    assert ComputationGraphConfiguration.from_yaml(gconf.to_yaml()) == gconf

@@ -135,3 +135,21 @@ def test_yaml_conf_round_trip():
     g.set_outputs("out")
     gconf = g.build()
     assert ComputationGraphConfiguration.from_yaml(gconf.to_yaml()) == gconf
+
+
+def test_golden_yaml_fixture_loads():
+    """Format-drift guard: the committed v1 YAML conf must keep loading
+    (the same golden-fixture discipline as the JSON/zip artifacts)."""
+    import os
+
+    from deeplearning4j_tpu.nn.conf.network import MultiLayerConfiguration
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    p = os.path.join(os.path.dirname(__file__), "fixtures",
+                     "golden_conf_v1.yaml")
+    conf = MultiLayerConfiguration.from_yaml(open(p).read())
+    assert conf.seed == 2026
+    assert len(conf.layers) == 2
+    assert type(conf.layers[0]).__name__ == "DenseLayer"
+    assert conf.layers[0].dropout == 0.1
+    net = MultiLayerNetwork(conf).init()
+    assert net.num_params() > 0

@@ -254,11 +254,13 @@ def main():
         net.params, net.opt_state, net.state = (net2.params,
                                                 net2.opt_state, net2.state)
 
-    # --- optional attention micro-bench (DL4J_TPU_BENCH_ATTENTION=1):
+    # --- attention micro-bench (default ON for TPU runs;
+    # DL4J_TPU_BENCH_ATTENTION=0 disables, =1 forces on CPU):
     # dense XLA attention vs the fused Pallas flash kernel on a causal
     # transformer shape; rides along in "sweep" without touching the
     # headline metric
-    if os.environ.get("DL4J_TPU_BENCH_ATTENTION") == "1":
+    if os.environ.get("DL4J_TPU_BENCH_ATTENTION",
+                      "1" if on_tpu else "0") == "1":
         try:
             from deeplearning4j_tpu.nn.layers.attention import (
                 dot_product_attention,

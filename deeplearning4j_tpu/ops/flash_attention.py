@@ -13,8 +13,10 @@ Semantics match dot_product_attention exactly (tested):
 - (B, T, H, D) layout, f32 accumulation, 1/sqrt(D) scaling;
 - optional causal masking;
 - optional (B, Tk) 0/1 key-validity mask, fully-masked query rows emit 0;
-- backward pass: custom VJP that recomputes through the O(T*block)
-  blockwise path (flash-style recomputation — no stored score matrix).
+- backward pass: true flash backward — two Pallas passes (dq over key
+  blocks; dk/dv over query blocks) recomputing the probabilities from
+  the saved per-row log-sum-exp, so the score matrix never materializes
+  in either direction; cross-attention shapes (tq != tk) included.
 
 On CPU the kernel runs under `interpret=True` (numerically identical,
 slow) — callers gate on backend; tests run interpret mode.
@@ -32,9 +34,27 @@ from jax.experimental.pallas import tpu as pltpu
 NEG = -1e30
 
 
-def _attn_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, m_scr, l_scr,
-                 acc_scr, *, causal: bool, block_q: int, block_k: int,
-                 scale: float):
+def _masked_scores(q, k, kmask, qi, kj, *, causal, block_q, block_k,
+                   scale):
+    """Scaled masked scores for one (q block, k block) tile — the ONE
+    copy of the masking semantics, shared by the forward kernel and the
+    backward recomputation."""
+    s = scale * jax.lax.dot_general(
+        q.astype(jnp.float32), k.astype(jnp.float32),
+        (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+    s = jnp.where(kmask[None, :] > 0, s, NEG)
+    if causal:
+        qpos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        kpos = kj * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(qpos >= kpos, s, NEG)
+    return s
+
+
+def _attn_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, m_scr,
+                 l_scr, acc_scr, *, causal: bool, block_q: int,
+                 block_k: int, scale: float):
     """Grid (B*H, q_blocks, k_blocks), k innermost: each step folds ONE
     (block_k, D) K/V tile into the running (m, l, acc) scratch — only one
     K and one V tile are VMEM-resident at a time, so sequence length is
@@ -55,19 +75,10 @@ def _attn_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, m_scr, l_scr,
 
     @pl.when(live)
     def _step():
-        q = q_ref[0].astype(jnp.float32) * scale        # (bq, D)
-        k = k_ref[0].astype(jnp.float32)                # (bk, D)
+        s = _masked_scores(q_ref[0], k_ref[0], mask_ref[0], qi, kj,
+                           causal=causal, block_q=block_q,
+                           block_k=block_k, scale=scale)
         v = v_ref[0].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
-        kmask = mask_ref[0]
-        s = jnp.where(kmask[None, :] > 0, s, NEG)
-        if causal:
-            qpos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            kpos = kj * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(qpos >= kpos, s, NEG)
         m = m_scr[...]
         m_new = jnp.maximum(m, s.max(-1))
         # exp(NEG - NEG) == 1 for all-masked rows: zero those terms
@@ -84,9 +95,14 @@ def _attn_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, m_scr, l_scr,
     @pl.when(kj == nkb - 1)
     def _finish():
         m = m_scr[...]
-        out = acc_scr[...] / jnp.maximum(l_scr[...], 1e-30)[:, None]
+        l = l_scr[...]
+        out = acc_scr[...] / jnp.maximum(l, 1e-30)[:, None]
         out = jnp.where((m <= NEG / 2)[:, None], 0.0, out)
         o_ref[0] = out.astype(o_ref.dtype)
+        # log-sum-exp per q row, the backward residual; +NEG-> +inf for
+        # fully-masked rows so exp(s - lse) vanishes there in the bwd
+        lse = m + jnp.log(jnp.maximum(l, 1e-30))
+        lse_ref[0] = jnp.where(m <= NEG / 2, -NEG, lse)
 
 
 def _flash_call(q, k, v, mask, causal: bool, block_q: int, block_k: int,
@@ -105,7 +121,7 @@ def _flash_call(q, k, v, mask, causal: bool, block_q: int, block_k: int,
     kernel = functools.partial(_attn_kernel, causal=causal,
                                block_q=block_q, block_k=block_k,
                                scale=scale)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(b * h, tq // block_q, tk // block_k),
         in_specs=[
@@ -115,9 +131,14 @@ def _flash_call(q, k, v, mask, causal: bool, block_q: int, block_k: int,
             pl.BlockSpec((1, block_k),
                          lambda bh, qi, kj, _h=h: (bh // _h, kj)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d),
-                               lambda bh, qi, kj: (bh, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, kj: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q), lambda bh, qi, kj: (bh, qi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, tq), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q,), jnp.float32),
             pltpu.VMEM((block_q,), jnp.float32),
@@ -125,41 +146,173 @@ def _flash_call(q, k, v, mask, causal: bool, block_q: int, block_k: int,
         ],
         interpret=interpret,
     )(qh, kh, vh, mask)
-    return out.reshape(b, h, tq, d).transpose(0, 2, 1, 3)
+    return out.reshape(b, h, tq, d).transpose(0, 2, 1, 3), lse
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
 def _flash(q, k, v, mask, causal, block_q, block_k, interpret):
-    return _flash_call(q, k, v, mask, causal, block_q, block_k, interpret)
+    out, _ = _flash_call(q, k, v, mask, causal, block_q, block_k,
+                         interpret)
+    return out
 
 
 def _flash_fwd(q, k, v, mask, causal, block_q, block_k, interpret):
-    out = _flash_call(q, k, v, mask, causal, block_q, block_k, interpret)
-    return out, (q, k, v, mask)
+    out, lse = _flash_call(q, k, v, mask, causal, block_q, block_k,
+                           interpret)
+    return out, (q, k, v, mask, out, lse)
+
+
+def _bwd_scores(q_ref, k_ref, mask_ref, lse_row, qi, kj, *, causal,
+                block_q, block_k, scale):
+    """Recompute the softmax probabilities p = exp(s - lse) for one
+    (q block, k block) tile via the shared masked-scores helper."""
+    s = _masked_scores(q_ref[0], k_ref[0], mask_ref[0], qi, kj,
+                       causal=causal, block_q=block_q, block_k=block_k,
+                       scale=scale)
+    p = jnp.exp(s - lse_row[:, None])
+    return jnp.where(s > NEG / 2, p, 0.0)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   mask_ref, dq_ref, dq_scr, *, causal, block_q, block_k,
+                   scale):
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+    nkb = pl.num_programs(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    live = (kj * block_k <= (qi + 1) * block_q - 1) if causal else True
+
+    @pl.when(live)
+    def _step():
+        p = _bwd_scores(q_ref, k_ref, mask_ref, lse_ref[0], qi, kj,
+                        causal=causal, block_q=block_q, block_k=block_k,
+                        scale=scale)
+        do = do_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0][:, None])
+        k = k_ref[0].astype(jnp.float32)
+        dq_scr[...] += scale * jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(kj == nkb - 1)
+    def _finish():
+        dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    mask_ref, dk_ref, dv_ref, dk_scr, dv_scr, *, causal,
+                    block_q, block_k, scale):
+    kj = pl.program_id(1)
+    qi = pl.program_id(2)
+    nqb = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    live = (kj * block_k <= (qi + 1) * block_q - 1) if causal else True
+
+    @pl.when(live)
+    def _step():
+        p = _bwd_scores(q_ref, k_ref, mask_ref, lse_ref[0], qi, kj,
+                        causal=causal, block_q=block_q, block_k=block_k,
+                        scale=scale)
+        do = do_ref[0].astype(jnp.float32)
+        dv_scr[...] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0][:, None])
+        q = q_ref[0].astype(jnp.float32)
+        dk_scr[...] += scale * jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qi == nqb - 1)
+    def _finish():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
 
 
 def _flash_bwd(causal, block_q, block_k, interpret, res, g):
-    # flash-style recomputation: the O(T*block) blockwise path computes the
-    # same function, so its VJP is the true gradient — and never holds the
-    # full score matrix either. blockwise assumes square self-attention
-    # (tq == tk); cross-attention gradients recompute densely instead.
-    q, k, v, mask = res
-    if q.shape[1] == k.shape[1]:
-        from deeplearning4j_tpu.parallel.ring import blockwise_attention
+    """True flash backward: two Pallas passes (dq over k blocks; dk/dv
+    over q blocks) recomputing p from the saved LSE — the score matrix
+    never materializes, matching the forward's memory shape."""
+    q, k, v, mask, out, lse = res
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    scale = 1.0 / float(d) ** 0.5
+    g = g.astype(jnp.float32)
+    # delta_i = rowsum(dO * O) (the softmax-jacobian diagonal term)
+    delta = jnp.sum(g * out.astype(jnp.float32), axis=-1)   # (B, T, H)
+    gh = g.transpose(0, 2, 1, 3).reshape(b * h, tq, d)
+    qh = q.transpose(0, 2, 1, 3).reshape(b * h, tq, d)
+    kh = k.transpose(0, 2, 1, 3).reshape(b * h, tk, d)
+    vh = v.transpose(0, 2, 1, 3).reshape(b * h, tk, d)
+    dh = delta.transpose(0, 2, 1).reshape(b * h, tq)
+    m_in = (jnp.ones((b, tk), jnp.float32) if mask is None
+            else mask.astype(jnp.float32))
 
-        def f(q, k, v):
-            return blockwise_attention(q, k, v, block_size=block_k,
-                                       causal=causal, mask=mask)
-    else:
-        from deeplearning4j_tpu.nn.layers.attention import (
-            dot_product_attention,
-        )
+    common = dict(causal=causal, block_q=block_q, block_k=block_k,
+                  scale=scale)
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, **common),
+        grid=(b * h, tq // block_q, tk // block_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, kj: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, kj: (bh, kj, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, kj: (bh, kj, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, kj: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q), lambda bh, qi, kj: (bh, qi)),
+            pl.BlockSpec((1, block_q), lambda bh, qi, kj: (bh, qi)),
+            pl.BlockSpec((1, block_k),
+                         lambda bh, qi, kj, _h=h: (bh // _h, kj)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d),
+                               lambda bh, qi, kj: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(qh, kh, vh, gh, lse, dh, m_in)
 
-        def f(q, k, v):
-            return dot_product_attention(q, k, v, mask=mask, causal=causal)
-    _, vjp = jax.vjp(f, q, k, v)
-    dq, dk, dv = vjp(g.astype(q.dtype))
-    return dq, dk, dv, None
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, **common),
+        grid=(b * h, tk // block_k, tq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, kj, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, kj, qi: (bh, kj, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, kj, qi: (bh, kj, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bh, kj, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q), lambda bh, kj, qi: (bh, qi)),
+            pl.BlockSpec((1, block_q), lambda bh, kj, qi: (bh, qi)),
+            pl.BlockSpec((1, block_k),
+                         lambda bh, kj, qi, _h=h: (bh // _h, kj)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda bh, kj, qi: (bh, kj, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, kj, qi: (bh, kj, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, tk, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, tk, d), v.dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        interpret=interpret,
+    )(qh, kh, vh, gh, lse, dh, m_in)
+
+    reshape = lambda a, t: a.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+    return reshape(dq, tq), reshape(dk, tk), reshape(dv, tk), None
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -176,9 +329,8 @@ def flash_attention(q, k, v, *, mask=None, causal: bool = False,
     tk = k.shape[1]
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    # one block size for q and k so the recomputing backward (blockwise,
-    # which assumes tq == tk == multiple of its block) lines up
-    block_q = block_k = min(block_q, block_k, max(tq, 1), max(tk, 1))
+    block_q = min(block_q, max(tq, 1))
+    block_k = min(block_k, max(tk, 1))
     pq = (-tq) % block_q
     pk = (-tk) % block_k
     if mask is None and pk:

@@ -102,3 +102,46 @@ def test_mha_flash_impl_matches_dense_and_trains():
     for key in params:
         np.testing.assert_allclose(np.asarray(gf[key]), np.asarray(gd[key]),
                                    atol=2e-4, rtol=2e-4, err_msg=key)
+
+
+def test_flash_cross_attention_gradients():
+    """tq != tk (cross-attention): the Pallas backward has no square
+    assumption — gradients must match the dense reference."""
+    rs = np.random.RandomState(8)
+    q = jnp.asarray(rs.randn(2, 24, 4, 16).astype("float32"))
+    k = jnp.asarray(rs.randn(2, 40, 4, 16).astype("float32"))
+    v = jnp.asarray(rs.randn(2, 40, 4, 16).astype("float32"))
+    mask = jnp.asarray((rs.rand(2, 40) > 0.2).astype("float32"))
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, mask=mask,
+                                       block_q=8, block_k=8) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(dot_product_attention(q, k, v, mask=mask) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-4, rtol=5e-4)
+    # unequal q/k block sizes are legal (no square assumption anywhere)
+    out_uneq = flash_attention(q, k, v, mask=mask, block_q=8, block_k=20)
+    ref = dot_product_attention(q, k, v, mask=mask)
+    np.testing.assert_allclose(np.asarray(out_uneq), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_flash_bf16_gradients_finite_and_close():
+    rs = np.random.RandomState(9)
+    mk = lambda: jnp.asarray(rs.randn(2, 16, 2, 8), jnp.bfloat16)
+    q, k, v = mk(), mk(), mk()
+
+    def loss(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True, block_q=8,
+                                       block_k=8).astype(jnp.float32) ** 2)
+
+    g = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    for a in g:
+        assert a.dtype == jnp.bfloat16
+        assert np.isfinite(np.asarray(a, np.float32)).all()

@@ -151,15 +151,13 @@ def _flash_call(q, k, v, mask, causal: bool, block_q: int, block_k: int,
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
 def _flash(q, k, v, mask, causal, block_q, block_k, interpret):
-    out, _ = _flash_call(q, k, v, mask, causal, block_q, block_k,
-                         interpret)
-    return out
+    return _flash_call(q, k, v, mask, causal, block_q, block_k, interpret)
 
 
 def _flash_fwd(q, k, v, mask, causal, block_q, block_k, interpret):
     out, lse = _flash_call(q, k, v, mask, causal, block_q, block_k,
                            interpret)
-    return out, (q, k, v, mask, out, lse)
+    return (out, lse), (q, k, v, mask, out, lse)
 
 
 def _bwd_scores(q_ref, k_ref, mask_ref, lse_row, qi, kj, *, causal,
@@ -249,6 +247,8 @@ def _flash_bwd(causal, block_q, block_k, interpret, res, g):
     over q blocks) recomputing p from the saved LSE — the score matrix
     never materializes, matching the forward's memory shape."""
     q, k, v, mask, out, lse = res
+    g, _ = g                      # (d_out, d_lse); the LSE output is a
+    # forward-only composition residual — its cotangent is ignored
     b, tq, h, d = q.shape
     tk = k.shape[1]
     scale = 1.0 / float(d) ** 0.5
@@ -320,11 +320,21 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 def flash_attention(q, k, v, *, mask=None, causal: bool = False,
                     block_q: int = 128, block_k: int = 128,
-                    interpret: Optional[bool] = None):
+                    interpret: Optional[bool] = None,
+                    return_lse: bool = False):
     """Fused flash attention on (B, T, H, D); see module docstring.
 
     Sequence lengths are padded to the block size internally (padded keys
-    are mask-excluded; padded query rows are sliced off)."""
+    are mask-excluded; padded query rows are sliced off).
+
+    return_lse=True additionally returns the per-row log-sum-exp
+    ((B, T, H), the softmax normalizer in log space) so partial results
+    over DIFFERENT key shards can be merged exactly:
+        m = max(lse1, lse2); w_i = exp(lse_i - m)
+        out = (w1*out1 + w2*out2) / (w1 + w2); lse = m + log(w1 + w2)
+    — the composition rule ring/context parallelism uses across chips.
+    The LSE output is forward-only (its cotangent is ignored);
+    differentiate through the merged OUTPUT instead."""
     b, tq, h, d = q.shape
     tk = k.shape[1]
     if interpret is None:
@@ -341,5 +351,9 @@ def flash_attention(q, k, v, *, mask=None, causal: bool = False,
         v = jnp.pad(v, ((0, 0), (0, pk), (0, 0), (0, 0)))
         if mask is not None:
             mask = jnp.pad(mask, ((0, 0), (0, pk)))
-    out = _flash(q, k, v, mask, causal, block_q, block_k, interpret)
-    return out[:, :tq]
+    out, lse = _flash(q, k, v, mask, causal, block_q, block_k, interpret)
+    if not return_lse:
+        return out[:, :tq]
+    b, _, h, d = q.shape
+    lse = lse.reshape(b, h, -1).transpose(0, 2, 1)[:, :tq]
+    return out[:, :tq], jax.lax.stop_gradient(lse)

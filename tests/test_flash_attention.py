@@ -145,3 +145,25 @@ def test_flash_bf16_gradients_finite_and_close():
     for a in g:
         assert a.dtype == jnp.bfloat16
         assert np.isfinite(np.asarray(a, np.float32)).all()
+
+
+def test_flash_lse_shard_merge_identity():
+    """return_lse enables exact cross-shard composition: flash over two
+    key shards merged via the LSE rule == flash over the full keys —
+    the building block ring/context parallelism uses across chips."""
+    rs = np.random.RandomState(10)
+    q = jnp.asarray(rs.randn(2, 16, 2, 8).astype("float32"))
+    k = jnp.asarray(rs.randn(2, 32, 2, 8).astype("float32"))
+    v = jnp.asarray(rs.randn(2, 32, 2, 8).astype("float32"))
+    full = flash_attention(q, k, v, block_q=8, block_k=8)
+
+    o1, l1 = flash_attention(q, k[:, :16], v[:, :16], block_q=8,
+                             block_k=8, return_lse=True)
+    o2, l2 = flash_attention(q, k[:, 16:], v[:, 16:], block_q=8,
+                             block_k=8, return_lse=True)
+    m = jnp.maximum(l1, l2)
+    w1 = jnp.exp(l1 - m)[..., None]
+    w2 = jnp.exp(l2 - m)[..., None]
+    merged = (w1 * o1 + w2 * o2) / (w1 + w2)
+    np.testing.assert_allclose(np.asarray(merged), np.asarray(full),
+                               atol=2e-5, rtol=2e-5)

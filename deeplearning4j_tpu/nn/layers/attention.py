@@ -224,14 +224,29 @@ class MultiHeadAttention(LayerConf):
             q = rope(q, pos)
             k = rope(k, pos)
         drop = self.attention_dropout if train else 0.0
+        # fused-kernel eligibility, shared by the context-parallel and
+        # single-device dispatches (the Pallas interpreter off-TPU would
+        # be far slower than XLA; the kernel has no dropout RNG)
+        use_flash = (self.attention_impl == "flash" and drop == 0.0
+                     and jax.default_backend() == "tpu")
         if _CONTEXT_PARALLEL_AXIS is not None:
-            from deeplearning4j_tpu.parallel.ring import ring_self_attention
-            out = ring_self_attention(q, k, v,
-                                      axis_name=_CONTEXT_PARALLEL_AXIS,
-                                      causal=self.causal, mask=mask,
-                                      dropout=drop, rng=attn_rng)
-        elif self.attention_impl == "flash" and drop == 0.0 \
-                and jax.default_backend() == "tpu":
+            if use_flash:
+                from deeplearning4j_tpu.parallel.ring import (
+                    ring_flash_self_attention,
+                )
+                out = ring_flash_self_attention(
+                    q, k, v, axis_name=_CONTEXT_PARALLEL_AXIS,
+                    causal=self.causal, mask=mask,
+                    block_q=self.block_size, block_k=self.block_size)
+            else:
+                from deeplearning4j_tpu.parallel.ring import (
+                    ring_self_attention,
+                )
+                out = ring_self_attention(q, k, v,
+                                          axis_name=_CONTEXT_PARALLEL_AXIS,
+                                          causal=self.causal, mask=mask,
+                                          dropout=drop, rng=attn_rng)
+        elif use_flash:
             from deeplearning4j_tpu.ops import flash_attention
             out = flash_attention(q, k, v, mask=mask, causal=self.causal,
                                   block_q=self.block_size,

@@ -247,14 +247,20 @@ def _flash_bwd(causal, block_q, block_k, interpret, res, g):
     over q blocks) recomputing p from the saved LSE — the score matrix
     never materializes, matching the forward's memory shape."""
     q, k, v, mask, out, lse = res
-    g, _ = g                      # (d_out, d_lse); the LSE output is a
-    # forward-only composition residual — its cotangent is ignored
+    g, g_lse = g                  # cotangents of (out, lse)
     b, tq, h, d = q.shape
     tk = k.shape[1]
     scale = 1.0 / float(d) ** 0.5
     g = g.astype(jnp.float32)
-    # delta_i = rowsum(dO * O) (the softmax-jacobian diagonal term)
+    # delta_i = rowsum(dO * O) (the softmax-jacobian diagonal term).
+    # The LSE output is differentiable too: d lse_i / d s_ij = p_ij, so
+    # its cotangent folds in as ds = p * (dp - (delta - g_lse)) — no
+    # kernel change, just an effective delta.
     delta = jnp.sum(g * out.astype(jnp.float32), axis=-1)   # (B, T, H)
+    # (g_lse is always instantiated — zeros when lse was unused; XLA
+    # folds the subtraction away in that case)
+    g_lse_bth = g_lse.astype(jnp.float32)                   # (bh, tq)
+    delta = delta - g_lse_bth.reshape(b, h, tq).transpose(0, 2, 1)
     gh = g.transpose(0, 2, 1, 3).reshape(b * h, tq, d)
     qh = q.transpose(0, 2, 1, 3).reshape(b * h, tq, d)
     kh = k.transpose(0, 2, 1, 3).reshape(b * h, tk, d)
@@ -333,8 +339,9 @@ def flash_attention(q, k, v, *, mask=None, causal: bool = False,
         m = max(lse1, lse2); w_i = exp(lse_i - m)
         out = (w1*out1 + w2*out2) / (w1 + w2); lse = m + log(w1 + w2)
     — the composition rule ring/context parallelism uses across chips.
-    The LSE output is forward-only (its cotangent is ignored);
-    differentiate through the merged OUTPUT instead."""
+    The LSE output is fully differentiable (its cotangent folds into the
+    backward's delta term), so merged results train correctly through
+    plain autodiff of the merge arithmetic."""
     b, tq, h, d = q.shape
     tk = k.shape[1]
     if interpret is None:
@@ -356,4 +363,8 @@ def flash_attention(q, k, v, *, mask=None, causal: bool = False,
         return out[:, :tq]
     b, _, h, d = q.shape
     lse = lse.reshape(b, h, -1).transpose(0, 2, 1)[:, :tq]
-    return out[:, :tq], jax.lax.stop_gradient(lse)
+    # kernel-internal fully-masked-row sentinel (+inf, needed by its own
+    # backward) -> large-NEGATIVE lse at the public boundary, so the
+    # documented merge rule gives those rows zero weight directly
+    lse = jnp.where(lse >= -NEG / 10, jnp.asarray(NEG, lse.dtype), lse)
+    return out[:, :tq], lse

@@ -174,3 +174,59 @@ def blockwise_attention(q, k, v, *, block_size: int = 512,
     (o, m, l), _ = jax.lax.scan(body, (o, m, l), jnp.arange(n_blocks))
     out = o / jnp.maximum(l.transpose(0, 2, 1)[..., None], 1e-30)
     return out.astype(q.dtype)
+
+
+def ring_flash_self_attention(q, k, v, *, axis_name: str = SEQ_AXIS,
+                              causal: bool = True, mask=None,
+                              block_q: int = 128, block_k: int = 128):
+    """Ring attention with the FUSED Pallas flash kernel per shard pair
+    (ops/flash_attention.py), composed across ring steps with the exact
+    LSE merge rule. Per-pair causality never needs position offsets
+    inside the kernel: the diagonal pair (ring step 0) is locally causal,
+    earlier shards attend fully, later shards are excluded entirely via
+    the merge weights — shard granularity makes those the only cases.
+    The LSE output is differentiable, so training through the merge is
+    exact (tested against dense attention). No dropout (the kernel has
+    no RNG plumbing); callers fall back to ring_self_attention for it."""
+    from deeplearning4j_tpu.ops import flash_attention
+
+    size = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b, t_loc, h, d = q.shape
+    NEG = -1e30
+
+    def rotate(x):
+        return jax.lax.ppermute(
+            x, axis_name, [(j, (j + 1) % size) for j in range(size)])
+
+    # unnormalized accumulation (one divide at the end, matching the
+    # sibling online-softmax loops): num = sum_s o_s * w_s, z = sum_s w_s
+    # with w_s = exp(lse_s - m_acc) rescaled as the running max moves
+    num = jnp.zeros((b, t_loc, h, d), jnp.float32)
+    z = jnp.zeros((b, t_loc, h), jnp.float32)
+    m_acc = jnp.full((b, t_loc, h), NEG, jnp.float32)
+    k_cur, v_cur, mask_cur = k, v, mask
+    for s in range(size):
+        src = (idx - s) % size
+        o_s, l_s = flash_attention(
+            q, k_cur, v_cur, mask=mask_cur,
+            causal=(causal and s == 0),     # diagonal pair only
+            block_q=block_q, block_k=block_k, return_lse=True)
+        l_s = l_s.astype(jnp.float32)
+        if causal and s > 0:
+            # ring step s>0 holds shard `src`; it is entirely in the past
+            # iff src < idx, else entirely in the future -> excluded
+            l_s = jnp.where(src < idx, l_s, NEG)
+        m_new = jnp.maximum(m_acc, l_s)
+        corr = jnp.exp(m_acc - m_new)
+        w_s = jnp.exp(l_s - m_new)
+        num = num * corr[..., None] + w_s[..., None] * o_s.astype(
+            jnp.float32)
+        z = z * corr + w_s
+        m_acc = m_new
+        if s + 1 < size:
+            k_cur = rotate(k_cur)
+            v_cur = rotate(v_cur)
+            mask_cur = None if mask_cur is None else rotate(mask_cur)
+    out = num / jnp.maximum(z, 1e-30)[..., None]
+    return out.astype(q.dtype)

@@ -78,3 +78,8 @@ def test_tsne_visualization():
 
 def test_custom_layer():
     assert _load("13_custom_layer.py").main(epochs=30) > 0.9
+
+
+@pytest.mark.slow
+def test_long_context_ring():
+    _load("14_long_context_ring.py").main(epochs=4)

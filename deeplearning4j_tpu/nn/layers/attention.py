@@ -166,11 +166,12 @@ class MultiHeadAttention(LayerConf):
     attention_dropout: float = 0.0
     weight_init: str = "xavier"
     has_bias: bool = False
-    # "dense" | "blockwise" (O(T*block) memory, single device) | "flash"
-    # (fused Pallas kernel, ops/flash_attention.py — same memory shape as
-    # blockwise but one kernel; attention dropout falls back to blockwise
-    # since the kernel has no RNG plumbing); under a ContextParallelTrainer
-    # the layer automatically switches to ring attention regardless
+    # "dense" | "blockwise" (O(T*block) memory) | "flash" (fused Pallas
+    # kernel, ops/flash_attention.py). On TPU, dropout-free blockwise AND
+    # flash both run the fused kernel (same algorithm; the kernel is its
+    # fastest realization); with attention dropout or off-TPU they use
+    # the XLA blockwise lowering. Under a ContextParallelTrainer the
+    # layer switches to ring attention (fused per-shard on TPU)
     attention_impl: str = "dense"
     block_size: int = 512
 
@@ -227,7 +228,10 @@ class MultiHeadAttention(LayerConf):
         # fused-kernel eligibility, shared by the context-parallel and
         # single-device dispatches (the Pallas interpreter off-TPU would
         # be far slower than XLA; the kernel has no dropout RNG)
-        use_flash = (self.attention_impl == "flash" and drop == 0.0
+        # "blockwise" is the algorithm; on TPU the fused flash kernel IS
+        # its fastest realization, so both impls ride it when eligible
+        use_flash = (self.attention_impl in ("flash", "blockwise")
+                     and drop == 0.0
                      and jax.default_backend() == "tpu")
         if _CONTEXT_PARALLEL_AXIS is not None:
             if use_flash:

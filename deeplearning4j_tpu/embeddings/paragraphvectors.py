@@ -18,8 +18,6 @@ import numpy as np
 from deeplearning4j_tpu.embeddings.sequencevectors import (
     SequenceVectors, _sg_ns_step,
 )
-from deeplearning4j_tpu.embeddings.vocab import VocabCache
-from deeplearning4j_tpu.embeddings.wordvectors import WordVectors
 
 
 class ParagraphVectors(SequenceVectors):

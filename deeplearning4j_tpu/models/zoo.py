@@ -33,7 +33,7 @@ from deeplearning4j_tpu.nn.layers import (
 )
 from deeplearning4j_tpu.nn.graph import ComputationGraph
 from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
-from deeplearning4j_tpu.nn.updaters import Adam, Nesterovs, Sgd
+from deeplearning4j_tpu.nn.updaters import Adam, Nesterovs
 
 
 class ZooModel:

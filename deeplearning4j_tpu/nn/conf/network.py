@@ -25,7 +25,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from deeplearning4j_tpu.nn.conf.base import (
     InputType, LayerConf, layer_from_dict, layer_to_dict,
 )
-from deeplearning4j_tpu.nn.updaters import Sgd, Updater, get_updater
+from deeplearning4j_tpu.nn.updaters import Sgd, get_updater
 
 
 @dataclasses.dataclass(frozen=True)

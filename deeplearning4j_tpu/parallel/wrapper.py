@@ -36,7 +36,6 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from deeplearning4j_tpu.data.dataset import DataSet
 from deeplearning4j_tpu.data.iterator import DataSetIterator
 from deeplearning4j_tpu.parallel.mesh import (
     DATA_AXIS, build_mesh, MeshConfig, stacked_sharding,

@@ -39,7 +39,6 @@ class DataSetLossCalculator(ScoreCalculator):
         self.batch_size = batch_size
 
     def calculate(self, model) -> float:
-        from deeplearning4j_tpu.data.dataset import DataSet
         iterator = model._as_iterator(self.data, self.batch_size) \
             if not hasattr(self.data, "reset") else self.data
         total, count = 0.0, 0

@@ -1,4 +1,9 @@
-"""Model import (DL4J deeplearning4j-modelimport parity)."""
+"""Model import (DL4J deeplearning4j-modelimport parity) + the DL4J
+checkpoint artifact bridge (ModelSerializer zip format, both directions)."""
 from deeplearning4j_tpu.modelimport.keras import KerasModelImport
+from deeplearning4j_tpu.modelimport.dl4j import (
+    restore_multilayer_network, save_dl4j_model,
+)
 
-__all__ = ["KerasModelImport"]
+__all__ = ["KerasModelImport", "restore_multilayer_network",
+           "save_dl4j_model"]

@@ -1,0 +1,924 @@
+"""DL4J artifact bridge: read AND write the reference's checkpoint format.
+
+The reference persists trained models as a zip of three entries
+(ModelSerializer.java:109-173):
+
+  configuration.json -- MultiLayerConfiguration Jackson JSON
+                        (MultiLayerConfiguration.java toJson)
+  coefficients.bin   -- Nd4j.write(model.params()) binary: the single flat
+                        parameter row-vector (MultiLayerNetwork.params())
+  updaterState.bin   -- Nd4j.write(updater.getStateViewArray()) (optional)
+
+This module implements both directions so a DL4J user can carry a trained
+artifact across (restore_multilayer_network) and back (save_dl4j_model):
+
+* the ND4J single-array binary codec (BaseDataBuffer.write semantics: each
+  buffer = Java-modified-UTF allocation-mode tag, int32 big-endian length,
+  UTF dtype name, then big-endian elements; an INDArray is the shape-info
+  int buffer followed by the data buffer; shape-info layout
+  [rank, *shape, *stride, offset, elementWiseStride, orderChar]);
+* the Jackson layer-config tree (Layer.java:55 WRAPPER_OBJECT type names:
+  "dense", "convolution", "subsampling", "batchNormalization", "LSTM",
+  "output", ...), mapped into this framework's LayerConf dataclasses;
+* the flat parameter layout, per the reference param initializers:
+    dense/output/embedding: W ('f'-order, nIn x nOut) then b
+        (DefaultParamInitializer.java init)
+    convolution: b FIRST, then W ('c'-order, [nOut, nIn, kH, kW])
+        (ConvolutionParamInitializer.java init / createWeightMatrix)
+    batch-norm: gamma, beta, global mean, global var
+        (BatchNormalizationParamInitializer.java init)
+    LSTM: W_in ('f', nIn x 4H), W_rec ('f', H x 4H), b(4H), gate blocks in
+        IFOG order (LSTMParamInitializer.java init + bias comment)
+  with the TPU-side layout conversions applied on the way in/out:
+    conv  IOhw -> HWIO transpose (NCHW kernels -> NHWC/HWIO for XLA);
+    LSTM  IFOG -> IFGO gate-block permutation (this framework splits
+          z into i,f,g,o -- nn/layers/recurrent.py _lstm_scan);
+    dense-after-conv row permutation (the reference flattens activations
+          NCHW 'c'-order; this framework flattens NHWC).
+
+GravesLSTM is intentionally NOT importable: the reference wires its three
+peephole columns to the forget / input-modulation / output gates
+(LSTMHelpers.java:235,259,302 -- wFF, wGG, wOO), whereas this framework's
+GravesLSTM follows Graves 2013 (peepholes on input/forget/output). The
+parameters are not semantically transferable; we refuse loudly rather than
+import a silently-different model.
+
+Updater state: MultiLayerUpdater concatenates per-block state views. For the
+overwhelmingly common uniform-updater case there is ONE block spanning all
+layers, and the per-updater layouts are: Adam/AdaMax/Nadam/AMSGrad
+[m(all params), v(all params)], Nesterovs/momentum [trace], AdaGrad
+[accumulated sq grads], RmsProp [sq avg], Sgd/NoOp []. m/v/trace views are
+shaped exactly like the params, so they undergo the same per-layer layout
+conversions, then graft into the optax state tree.
+"""
+from __future__ import annotations
+
+import dataclasses
+import io
+import json
+import struct
+import zipfile
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.nn.conf.base import InputType, Kind
+from deeplearning4j_tpu.nn.conf.network import MultiLayerConfiguration
+from deeplearning4j_tpu.nn import updaters as upd
+
+# ======================================================================
+# ND4J binary array codec
+# ======================================================================
+
+_DTYPES = {"FLOAT": (">f4", 4), "DOUBLE": (">f8", 8),
+           "INT": (">i4", 4), "LONG": (">i8", 8), "HALF": (">f2", 2)}
+
+
+def _write_java_utf(f, s: str) -> None:
+    b = s.encode("utf-8")           # ASCII names only -> modified UTF == UTF-8
+    f.write(struct.pack(">H", len(b)))
+    f.write(b)
+
+
+def _read_java_utf(f) -> str:
+    (n,) = struct.unpack(">H", f.read(2))
+    return f.read(n).decode("utf-8")
+
+
+def _write_buffer(f, arr: np.ndarray, dtype_name: str) -> None:
+    _write_java_utf(f, "DIRECT")                 # allocation mode tag
+    flat = np.ascontiguousarray(arr).ravel()
+    f.write(struct.pack(">i", flat.size))
+    _write_java_utf(f, dtype_name)
+    f.write(flat.astype(_DTYPES[dtype_name][0]).tobytes())
+
+
+def _read_buffer(f) -> np.ndarray:
+    _read_java_utf(f)                            # allocation mode; ignored
+    (length,) = struct.unpack(">i", f.read(4))
+    dtype_name = _read_java_utf(f)
+    if dtype_name == "COMPRESSED":
+        raise ValueError("compressed ND4J buffers are not supported")
+    np_dt, size = _DTYPES[dtype_name]
+    return np.frombuffer(f.read(length * size), dtype=np_dt).copy()
+
+
+def write_nd4j_array(f, arr: np.ndarray) -> None:
+    """Serialize `arr` in the Nd4j.write(INDArray, DataOutputStream) format
+    (shape-info int buffer, then the data buffer). Data is written f32,
+    c-order, matching DL4J's default float dtype."""
+    arr = np.asarray(arr)
+    if arr.ndim == 1:               # DL4J params() is a [1, N] row vector
+        arr = arr.reshape(1, -1)
+    rank = arr.ndim
+    shape = list(arr.shape)
+    strides = []                    # c-order element strides
+    acc = 1
+    for d in reversed(shape):
+        strides.insert(0, acc)
+        acc *= d
+    shape_info = np.array([rank] + shape + strides + [0, 1, ord("c")],
+                          dtype=np.int32)
+    _write_buffer(f, shape_info, "INT")
+    _write_buffer(f, arr, "FLOAT")
+
+
+def read_nd4j_array(f) -> np.ndarray:
+    """Inverse of write_nd4j_array (Nd4j.read semantics). Handles c- and
+    f-ordered source arrays via the shape-info order char."""
+    shape_info = _read_buffer(f)
+    rank = int(shape_info[0])
+    shape = [int(x) for x in shape_info[1:1 + rank]]
+    order = chr(int(shape_info[2 * rank + 3])) if rank else "c"
+    data = _read_buffer(f)
+    n = int(np.prod(shape)) if shape else data.size
+    arr = data[:n].astype(np.float32) if data.dtype.kind == "f" else data[:n]
+    return arr.reshape(shape, order=order if order in ("c", "f") else "c")
+
+
+# ======================================================================
+# Jackson <-> LayerConf maps
+# ======================================================================
+
+_ACT_FROM = {
+    "ActivationReLU": "relu", "ActivationReLU6": "relu6",
+    "ActivationIdentity": "identity", "ActivationTanH": "tanh",
+    "ActivationSigmoid": "sigmoid", "ActivationSoftmax": "softmax",
+    "ActivationLReLU": "leakyrelu", "ActivationELU": "elu",
+    "ActivationSELU": "selu", "ActivationSoftPlus": "softplus",
+    "ActivationSoftSign": "softsign", "ActivationHardSigmoid": "hardsigmoid",
+    "ActivationHardTanH": "hardtanh", "ActivationCube": "cube",
+    "ActivationRationalTanh": "rationaltanh",
+    "ActivationRectifiedTanh": "rectifiedtanh", "ActivationSwish": "swish",
+    "ActivationGELU": "gelu",
+    "ActivationThresholdedReLU": "thresholdedrelu",
+}
+_ACT_TO = {v: k for k, v in _ACT_FROM.items()}
+_ACT_TO["linear"] = "ActivationIdentity"
+
+_LOSS_FROM = {
+    "LossMCXENT": "mcxent", "LossMSE": "mse", "LossMAE": "mae",
+    "LossL2": "mse", "LossL1": "mae",
+    "LossBinaryXENT": "binary_crossentropy",
+    "LossNegativeLogLikelihood": "negativeloglikelihood",
+    "LossKLD": "kl_divergence", "LossPoisson": "poisson",
+    "LossCosineProximity": "cosine_proximity", "LossHinge": "hinge",
+    "LossSquaredHinge": "squared_hinge",
+}
+_LOSS_TO = {"mcxent": "LossMCXENT", "mse": "LossMSE", "mae": "LossMAE",
+            "binary_crossentropy": "LossBinaryXENT",
+            "xent": "LossBinaryXENT",
+            "negativeloglikelihood": "LossNegativeLogLikelihood",
+            "kl_divergence": "LossKLD", "poisson": "LossPoisson",
+            "cosine_proximity": "LossCosineProximity", "hinge": "LossHinge",
+            "squared_hinge": "LossSquaredHinge"}
+
+
+def _act_from(d: Any, default: str = "identity") -> str:
+    """activationFn {"@class": ...} (or legacy "activationFunction" string)."""
+    if d is None:
+        return default
+    if isinstance(d, str):                       # pre-0.8 legacy string form
+        return d.lower()
+    cls = d.get("@class", "").rsplit(".", 1)[-1]
+    if cls in _ACT_FROM:
+        return _ACT_FROM[cls]
+    raise ValueError(f"unsupported DL4J activation: {cls}")
+
+
+def _act_to(name: str) -> dict:
+    if name not in _ACT_TO:
+        raise ValueError(f"activation {name!r} has no DL4J class mapping")
+    return {"@class": "org.nd4j.linalg.activations.impl." + _ACT_TO[name]}
+
+
+def _loss_from(d: Any) -> str:
+    if d is None:
+        return "mcxent"
+    if isinstance(d, str):
+        key = d.upper()
+        legacy = {"MCXENT": "mcxent", "MSE": "mse",
+                  "NEGATIVELOGLIKELIHOOD": "negativeloglikelihood",
+                  "XENT": "binary_crossentropy"}
+        if key in legacy:
+            return legacy[key]
+        raise ValueError(f"unsupported DL4J loss: {d}")
+    cls = d.get("@class", "").rsplit(".", 1)[-1]
+    if cls in _LOSS_FROM:
+        return _LOSS_FROM[cls]
+    raise ValueError(f"unsupported DL4J loss: {cls}")
+
+
+def _loss_to(name: str) -> dict:
+    if name not in _LOSS_TO:
+        raise ValueError(f"loss {name!r} has no DL4J class mapping")
+    return {"@class": "org.nd4j.linalg.lossfunctions.impl." + _LOSS_TO[name]}
+
+
+def _updater_from(d: Any) -> upd.Updater:
+    """iUpdater {"@class": "org.nd4j.linalg.learning.config.X", ...}."""
+    if d is None:
+        return upd.Sgd(1e-2)
+    cls = d.get("@class", "").rsplit(".", 1)[-1]
+    lr = float(d.get("learningRate", 1e-3))
+    if cls == "Sgd":
+        return upd.Sgd(lr)
+    if cls == "Adam":
+        return upd.Adam(lr, beta1=float(d.get("beta1", 0.9)),
+                        beta2=float(d.get("beta2", 0.999)),
+                        epsilon=float(d.get("epsilon", 1e-8)))
+    if cls == "AdaMax":
+        return upd.AdaMax(lr, beta1=float(d.get("beta1", 0.9)),
+                          beta2=float(d.get("beta2", 0.999)))
+    if cls == "Nadam":
+        return upd.Nadam(lr, beta1=float(d.get("beta1", 0.9)),
+                         beta2=float(d.get("beta2", 0.999)))
+    if cls == "Nesterovs":
+        return upd.Nesterovs(lr, momentum=float(d.get("momentum", 0.9)))
+    if cls == "AdaGrad":
+        return upd.AdaGrad(lr)
+    if cls == "RmsProp":
+        return upd.RmsProp(lr, decay=float(d.get("rmsDecay", 0.95)),
+                           epsilon=float(d.get("epsilon", 1e-8)))
+    if cls == "AdaDelta":
+        return upd.AdaDelta(rho=float(d.get("rho", 0.95)),
+                            epsilon=float(d.get("epsilon", 1e-6)))
+    if cls == "NoOp":
+        return upd.NoOp()
+    raise ValueError(f"unsupported DL4J updater: {cls}")
+
+
+def _updater_to(u: upd.Updater) -> dict:
+    base = "org.nd4j.linalg.learning.config."
+    name = type(u).__name__
+    if name == "Sgd":
+        return {"@class": base + "Sgd", "learningRate": u.learning_rate}
+    if name in ("Adam", "AdaMax", "Nadam"):
+        return {"@class": base + name, "learningRate": u.learning_rate,
+                "beta1": u.beta1, "beta2": u.beta2,
+                "epsilon": getattr(u, "epsilon", 1e-8)}
+    if name == "Nesterovs":
+        return {"@class": base + "Nesterovs", "learningRate": u.learning_rate,
+                "momentum": u.momentum}
+    if name == "AdaGrad":
+        return {"@class": base + "AdaGrad", "learningRate": u.learning_rate}
+    if name == "RmsProp":
+        return {"@class": base + "RmsProp", "learningRate": u.learning_rate,
+                "rmsDecay": u.decay, "epsilon": u.epsilon}
+    if name == "AdaDelta":
+        return {"@class": base + "AdaDelta", "rho": u.rho,
+                "epsilon": u.epsilon}
+    if name == "NoOp":
+        return {"@class": base + "NoOp"}
+    raise ValueError(f"updater {name} has no DL4J class mapping")
+
+
+# ======================================================================
+# Layer conf parsing (import direction)
+# ======================================================================
+
+class UnsupportedLayerError(ValueError):
+    pass
+
+
+def _pair(v, default) -> Tuple[int, int]:
+    if v is None:
+        return default
+    if isinstance(v, (int, float)):
+        return (int(v), int(v))
+    return (int(v[0]), int(v[1]))
+
+
+def _dropout_from(d: Any) -> float:
+    """iDropout {"@class": "...dropout.Dropout", "p": retainProb} -> this
+    framework's DROP probability (DL4J's p is the RETAIN probability —
+    Dropout.java applyDropout keeps activations with prob p)."""
+    if not d:
+        return 0.0
+    cls = d.get("@class", "").rsplit(".", 1)[-1]
+    if cls != "Dropout":
+        raise UnsupportedLayerError(
+            f"unsupported iDropout variant {cls!r} (only standard Dropout "
+            "imports; re-export without AlphaDropout/GaussianDropout)")
+    return 1.0 - float(d.get("p", 1.0))
+
+
+def _apply_common(layers, d: dict):
+    """Overlay the regularization config (input dropout, l1/l2) onto the
+    layer that carries the parameters — silently dropping it would resume
+    training under different regularization than the artifact was trained
+    with."""
+    drop = _dropout_from(d.get("iDropout"))
+    l1 = float(d.get("l1", 0.0) or 0.0)
+    l2 = float(d.get("l2", 0.0) or 0.0)
+    if drop or l1 or l2:
+        layers = list(layers)
+        layers[-1] = dataclasses.replace(layers[-1], dropout=drop,
+                                         l1=l1, l2=l2)
+    return layers
+
+
+def _parse_layer(kind: str, d: dict):
+    """One DL4J layer JSON -> list of our LayerConfs (padding may expand to
+    [ZeroPaddingLayer, Conv]; parameters always belong to the LAST conf in
+    the list)."""
+    from deeplearning4j_tpu.nn.layers import (
+        ActivationLayer, BatchNormalization, ConvolutionLayer, DenseLayer,
+        DropoutLayer, EmbeddingLayer, GlobalPoolingLayer, LossLayer, LSTM,
+        OutputLayer, RnnOutputLayer, SubsamplingLayer, Upsampling2D,
+        ZeroPaddingLayer,
+    )
+    act = _act_from(d.get("activationFn", d.get("activationFunction")))
+    nin = int(d.get("nin", 0) or 0)
+    nout = int(d.get("nout", 0) or 0)
+    has_bias = bool(d.get("hasBias", True))
+    name = d.get("layerName")
+
+    if kind == "dense":
+        return [DenseLayer(name=name, n_in=nin or None, n_out=nout,
+                           activation=act, has_bias=has_bias)]
+    if kind == "ElementWiseMult":
+        from deeplearning4j_tpu.nn.layers import ElementWiseMultiplicationLayer
+        return [ElementWiseMultiplicationLayer(
+            name=name, n_in=nin or None, n_out=nout, activation=act)]
+    if kind == "embedding":
+        return [EmbeddingLayer(name=name, n_in=nin or None, n_out=nout,
+                               has_bias=has_bias)]
+    if kind == "output":
+        return [OutputLayer(name=name, n_in=nin or None, n_out=nout,
+                            activation=act if act != "identity" else "softmax",
+                            loss=_loss_from(d.get("lossFn", d.get("lossFunction"))),
+                            has_bias=has_bias)]
+    if kind == "rnnoutput":
+        return [RnnOutputLayer(name=name, n_in=nin or None, n_out=nout,
+                               activation=act if act != "identity" else "softmax",
+                               loss=_loss_from(d.get("lossFn", d.get("lossFunction"))),
+                               )]
+    if kind == "loss":
+        return [LossLayer(name=name, activation=act,
+                          loss=_loss_from(d.get("lossFn", d.get("lossFunction"))))]
+    if kind == "activation":
+        return [ActivationLayer(name=name, activation=act)]
+    if kind == "dropout":
+        return [DropoutLayer(name=name)]
+    if kind in ("convolution", "subsampling"):
+        kernel = _pair(d.get("kernelSize"), (3, 3) if kind == "convolution" else (2, 2))
+        stride = _pair(d.get("stride"), (1, 1) if kind == "convolution" else (2, 2))
+        pad = _pair(d.get("padding"), (0, 0))
+        mode = (d.get("convolutionMode") or "Truncate").lower()
+        out: List[Any] = []
+        if pad != (0, 0) and mode != "same":
+            out.append(ZeroPaddingLayer(
+                padding=(pad[0], pad[0], pad[1], pad[1])))
+        if kind == "convolution":
+            out.append(ConvolutionLayer(
+                name=name, n_in=nin or None, n_out=nout, kernel=kernel,
+                stride=stride, dilation=_pair(d.get("dilation"), (1, 1)),
+                convolution_mode=mode, activation=act, has_bias=has_bias))
+        else:
+            ptype = (d.get("poolingType") or "MAX").lower()
+            out.append(SubsamplingLayer(
+                name=name, kernel=kernel, stride=stride, pooling_type=ptype,
+                convolution_mode=mode, pnorm=int(d.get("pnorm", 2) or 2)))
+        return out
+    if kind == "batchNormalization":
+        return [BatchNormalization(
+            name=name, epsilon=float(d.get("eps", 1e-5)),
+            decay=float(d.get("decay", 0.9)),
+            gamma_init=float(d.get("gamma", 1.0)),
+            beta_init=float(d.get("beta", 0.0)),
+            lock_gamma_beta=bool(d.get("lockGammaBeta", False)))]
+    if kind == "LSTM":
+        return [LSTM(name=name, n_in=nin or None, n_out=nout,
+                     activation=act if act != "identity" else "tanh",
+                     gate_activation=_act_from(
+                         d.get("gateActivationFn"), "sigmoid"),
+                     forget_gate_bias_init=float(
+                         d.get("forgetGateBiasInit", 1.0)))]
+    if kind == "gravesLSTM":
+        raise UnsupportedLayerError(
+            "GravesLSTM peephole parameters are not transferable: the "
+            "reference wires wFF/wGG/wOO to the forget/input-modulation/"
+            "output gates (LSTMHelpers.java:235,259,302) while this "
+            "framework follows Graves 2013 (input/forget/output). "
+            "Re-export the model with plain LSTM layers.")
+    if kind == "GlobalPooling":
+        ptype = (d.get("poolingType") or "MAX").lower()
+        return [GlobalPoolingLayer(name=name, pooling_type=ptype,
+                                   pnorm=int(d.get("pnorm", 2) or 2))]
+    if kind == "zeroPadding":
+        p = d.get("padding") or [0, 0, 0, 0]
+        if len(p) == 2:
+            p = [p[0], p[0], p[1], p[1]]
+        return [ZeroPaddingLayer(name=name, padding=tuple(int(x) for x in p))]
+    if kind == "Upsampling2D":
+        return [Upsampling2D(name=name, size=_pair(d.get("size"), (2, 2)))]
+    raise UnsupportedLayerError(f"unsupported DL4J layer type: {kind!r}")
+
+
+# ======================================================================
+# Flat-vector <-> param-tree conversion
+# ======================================================================
+
+def _nchw_to_nhwc_perm(h: int, w: int, c: int) -> np.ndarray:
+    """Row permutation for dense weights after a conv->ff flatten boundary:
+    perm[i_nhwc] = i_nchw for the same (h, w, c) position, so
+    W_ours = W_dl4j[perm]. (CnnToFeedForwardPreProcessor flattens 'c'-order
+    NCHW; this framework flattens NHWC.)"""
+    return np.arange(c * h * w).reshape(c, h, w).transpose(1, 2, 0).ravel()
+
+
+def _ifog_to_ifgo(mat: np.ndarray, H: int, axis: int) -> np.ndarray:
+    """Swap the O and G gate blocks along `axis` (reference IFOG order ->
+    this framework's i,f,g,o split order)."""
+    idx = np.concatenate([np.arange(0, 2 * H),            # i, f
+                          np.arange(3 * H, 4 * H),        # g  (ref block 4)
+                          np.arange(2 * H, 3 * H)])       # o  (ref block 3)
+    return np.take(mat, idx, axis=axis)
+
+
+def _layer_num_params(layer, in_type: InputType) -> int:
+    cls = type(layer).__name__
+    if cls in ("DenseLayer", "OutputLayer", "RnnOutputLayer", "EmbeddingLayer"):
+        nin = layer.n_in or in_type.features
+        return nin * layer.n_out + (layer.n_out if layer.has_bias else 0)
+    if cls == "ElementWiseMultiplicationLayer":
+        return 2 * (layer.n_out or in_type.features)
+    if cls == "ConvolutionLayer":
+        nin = layer.n_in or in_type.shape[2]
+        kh, kw = layer.kernel
+        return nin * layer.n_out * kh * kw + (layer.n_out if layer.has_bias else 0)
+    if cls == "BatchNormalization":
+        n = in_type.features
+        return (2 * n if not layer.lock_gamma_beta else 0) + 2 * n
+    if cls == "LSTM":
+        nin = layer.n_in or in_type.features
+        H = layer.n_out
+        return nin * 4 * H + H * 4 * H + 4 * H
+    return 0
+
+
+def _decode_layer_params(layer, in_type: InputType, seg: np.ndarray,
+                         raw_in: Optional[InputType] = None):
+    """One reference flat segment -> (params dict, state dict) in this
+    framework's layout. Inverse of _encode_layer_params. `in_type` is the
+    post-preprocessor input type (what the layer actually sees); `raw_in`
+    the pre-preprocessor one — a CNN raw_in on an FF layer marks the
+    flatten boundary where the reference's NCHW 'c'-order row layout needs
+    the NHWC permutation."""
+    cls = type(layer).__name__
+    if cls in ("DenseLayer", "OutputLayer", "RnnOutputLayer", "EmbeddingLayer"):
+        nin = layer.n_in or in_type.features
+        nout = layer.n_out
+        W = seg[:nin * nout].reshape((nin, nout), order="F")
+        if (raw_in is not None and raw_in.kind == Kind.CNN
+                and cls != "EmbeddingLayer"):
+            h, w, c = raw_in.shape
+            W = W[_nchw_to_nhwc_perm(h, w, c)]
+        params = {"W": W}
+        if layer.has_bias:
+            params["b"] = seg[nin * nout:nin * nout + nout]
+        return params, {}
+    if cls == "ElementWiseMultiplicationLayer":
+        n = layer.n_out or in_type.features
+        return {"W": seg[:n], "b": seg[n:2 * n]}, {}
+    if cls == "ConvolutionLayer":
+        nin = layer.n_in or in_type.shape[2]
+        nout = layer.n_out
+        kh, kw = layer.kernel
+        off = 0
+        params = {}
+        if layer.has_bias:
+            params["b"] = seg[:nout]
+            off = nout
+        W = seg[off:off + nout * nin * kh * kw].reshape(
+            (nout, nin, kh, kw), order="C")          # 'c'-order per reference
+        params["W"] = W.transpose(2, 3, 1, 0)        # OIhw -> HWIO
+        return params, {}
+    if cls == "BatchNormalization":
+        n = in_type.features
+        params = {}
+        off = 0
+        if not layer.lock_gamma_beta:
+            params = {"gamma": seg[:n], "beta": seg[n:2 * n]}
+            off = 2 * n
+        state = {"mean": seg[off:off + n], "var": seg[off + n:off + 2 * n]}
+        return params, state
+    if cls == "LSTM":
+        nin = layer.n_in or in_type.features
+        H = layer.n_out
+        nw, nr = nin * 4 * H, H * 4 * H
+        W = seg[:nw].reshape((nin, 4 * H), order="F")
+        R = seg[nw:nw + nr].reshape((H, 4 * H), order="F")
+        b = seg[nw + nr:nw + nr + 4 * H]
+        return {"W": _ifog_to_ifgo(W, H, 1),
+                "R": _ifog_to_ifgo(R, H, 1),
+                "b": _ifog_to_ifgo(b, H, 0)}, {}
+    return {}, {}
+
+
+def _encode_layer_params(layer, in_type: InputType, params: dict,
+                         state: dict,
+                         raw_in: Optional[InputType] = None) -> np.ndarray:
+    """This framework's per-layer params -> the reference flat segment."""
+    cls = type(layer).__name__
+    P = {k: np.asarray(v, np.float32) for k, v in (params or {}).items()}
+    S = {k: np.asarray(v, np.float32) for k, v in (state or {}).items()}
+    if cls in ("DenseLayer", "OutputLayer", "RnnOutputLayer", "EmbeddingLayer"):
+        W = P["W"]
+        if (raw_in is not None and raw_in.kind == Kind.CNN
+                and cls != "EmbeddingLayer"):
+            h, w, c = raw_in.shape
+            inv = np.empty_like(perm := _nchw_to_nhwc_perm(h, w, c))
+            inv[perm] = np.arange(perm.size)
+            W = W[inv]
+        out = [W.ravel(order="F")]
+        if layer.has_bias:
+            out.append(P["b"].ravel())
+        return np.concatenate(out)
+    if cls == "ElementWiseMultiplicationLayer":
+        return np.concatenate([P["W"].ravel(), P["b"].ravel()])
+    if cls == "ConvolutionLayer":
+        out = []
+        if layer.has_bias:
+            out.append(P["b"].ravel())
+        out.append(P["W"].transpose(3, 2, 0, 1).ravel(order="C"))
+        return np.concatenate(out)
+    if cls == "BatchNormalization":
+        out = []
+        if not layer.lock_gamma_beta:
+            out += [P["gamma"].ravel(), P["beta"].ravel()]
+        out += [S["mean"].ravel(), S["var"].ravel()]
+        return np.concatenate(out)
+    if cls == "LSTM":
+        H = layer.n_out
+        # inverse of IFOG->IFGO is IFGO->IFOG: swap blocks back
+        idx = np.concatenate([np.arange(0, 2 * H), np.arange(3 * H, 4 * H),
+                              np.arange(2 * H, 3 * H)])
+        return np.concatenate([
+            np.take(P["W"], idx, 1).ravel(order="F"),
+            np.take(P["R"], idx, 1).ravel(order="F"),
+            np.take(P["b"], idx, 0).ravel()])
+    return np.zeros((0,), np.float32)
+
+
+# ======================================================================
+# Import: restore_multilayer_network
+# ======================================================================
+
+def parse_dl4j_conf(conf_json: str):
+    """Reference MultiLayerConfiguration JSON -> (our MultiLayerConfiguration,
+    dl4j_to_ours: list mapping each reference layer index to the index of the
+    OUR layer that carries its parameters)."""
+    d = json.loads(conf_json)
+    if "confs" not in d:
+        raise ValueError(
+            "not a MultiLayerConfiguration (ComputationGraph import is not "
+            "supported; 'confs' entry missing)")
+    our_layers: List[Any] = []
+    owner: List[int] = []
+    seed = 0
+    updater = None
+    for conf in d["confs"]:
+        seed = int(conf.get("seed", seed) or 0)
+        (kind, body), = conf["layer"].items()
+        iupd = body.get("iUpdater")
+        if updater is None and iupd is not None:
+            updater = _updater_from(iupd)
+        expansion = _apply_common(_parse_layer(kind, body), body)
+        our_layers.extend(expansion)
+        owner.append(len(our_layers) - 1)
+    bp = (d.get("backpropType") or "Standard")
+    ours = MultiLayerConfiguration(
+        layers=tuple(our_layers), seed=seed,
+        updater=updater or upd.Sgd(1e-2),
+        backprop_type="tbptt" if bp == "TruncatedBPTT" else "standard",
+        tbptt_fwd_length=int(d.get("tbpttFwdLength", 20) or 20),
+        tbptt_back_length=int(d.get("tbpttBackLength", 20) or 20),
+    )
+    return ours, owner
+
+
+def _infer_input_type(d_conf: dict, our_layers) -> Optional[InputType]:
+    """Best-effort input-type recovery. FF nets: feed_forward(nin of first
+    parameterized layer). CNN/RNN inputs generally need the caller to pass
+    input_type= (the reference JSON does not store the input H/W/T)."""
+    first = our_layers[0]
+    cls = type(first).__name__
+    if cls in ("DenseLayer", "OutputLayer", "EmbeddingLayer") and first.n_in:
+        return InputType.feed_forward(first.n_in)
+    # FeedForwardToCnnPreProcessor at index 0 records the image dims
+    pre = (d_conf.get("inputPreProcessors") or {}).get("0")
+    if pre and "FeedForwardToCnn" in pre.get("@class", ""):
+        return InputType.convolutional(int(pre["inputHeight"]),
+                                       int(pre["inputWidth"]),
+                                       int(pre["numChannels"]))
+    return None
+
+
+def restore_multilayer_network(path, load_updater: bool = True,
+                               input_type: Optional[InputType] = None):
+    """Load a reference-produced model zip (ModelSerializer.writeModel
+    output) into a ready-to-run MultiLayerNetwork.
+
+    `input_type` is required for convolutional/recurrent inputs (the
+    reference JSON does not persist the input image/sequence dims unless a
+    FeedForwardToCnnPreProcessor is present)."""
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    with zipfile.ZipFile(path, "r") as zf:
+        names = set(zf.namelist())
+        conf_json = zf.read("configuration.json").decode("utf-8")
+        coeffs = (read_nd4j_array(io.BytesIO(zf.read("coefficients.bin")))
+                  if "coefficients.bin" in names else None)
+        updater_state = (read_nd4j_array(io.BytesIO(zf.read("updaterState.bin")))
+                         if "updaterState.bin" in names and load_updater
+                         else None)
+
+    conf, owner = parse_dl4j_conf(conf_json)
+    if input_type is None:
+        input_type = _infer_input_type(json.loads(conf_json), conf.layers)
+    if input_type is None:
+        raise ValueError(
+            "cannot infer the network input type from the configuration; "
+            "pass input_type=InputType.convolutional(h, w, c) / "
+            ".recurrent(features, timesteps) / .feed_forward(n)")
+    conf = dataclasses.replace(conf, input_type=input_type)
+    net = MultiLayerNetwork(conf).init()
+
+    if coeffs is not None:
+        flat = np.asarray(coeffs, np.float32).ravel()
+        _load_flat(net, owner, flat)
+        if updater_state is not None:
+            _load_updater_state(net, owner,
+                                np.asarray(updater_state, np.float32).ravel())
+    return net
+
+
+def _segments(net, owner):
+    """Yield (our_layer_index, layer, post_type, raw_type, size) in
+    reference layer order, for every parameterized reference layer.
+    post_type = net._input_types[i] (after auto preprocessing); raw_type =
+    the previous layer's raw output type, which still knows the CNN shape
+    at a flatten boundary."""
+    raw_types = []
+    cur_raw = net.conf.input_type
+    for i, layer in enumerate(net.layers):
+        raw_types.append(cur_raw)
+        cur_raw = layer.output_type(net._input_types[i])
+    for our_i in owner:
+        layer = net.layers[our_i]
+        in_type = net._input_types[our_i]
+        size = _layer_num_params(layer, in_type)
+        if size:
+            yield our_i, layer, in_type, raw_types[our_i], size
+
+
+def _load_flat(net, owner, flat: np.ndarray) -> None:
+    offset = 0
+    for our_i, layer, in_type, raw_in, size in _segments(net, owner):
+        seg = flat[offset:offset + size]
+        if seg.size != size:
+            raise ValueError(
+                f"coefficients.bin too short: layer {our_i} "
+                f"({type(layer).__name__}) wants {size} params at offset "
+                f"{offset}, got {seg.size}")
+        params, state = _decode_layer_params(layer, in_type, seg, raw_in)
+        _graft(net, our_i, params, state)
+        offset += size
+    if offset != flat.size:
+        raise ValueError(f"coefficients.bin length mismatch: consumed "
+                         f"{offset} of {flat.size} values")
+
+
+def _graft(net, our_i: int, params: dict, state: dict) -> None:
+    import jax.numpy as jnp
+    key = str(our_i)
+    for k, v in params.items():
+        tmpl = net.params[key][k]
+        net.params[key][k] = jnp.asarray(
+            np.asarray(v, np.float32).reshape(tmpl.shape), tmpl.dtype)
+    for k, v in state.items():
+        tmpl = net.state[key][k]
+        net.state[key][k] = jnp.asarray(
+            np.asarray(v, np.float32).reshape(tmpl.shape), tmpl.dtype)
+
+
+def _updater_state_slots(u: upd.Updater) -> int:
+    name = type(u).__name__
+    return {"Adam": 2, "AdamW": 2, "AMSGrad": 3, "Nadam": 2, "AdaMax": 2,
+            "Nesterovs": 1, "Momentum": 1, "AdaGrad": 1, "RmsProp": 1,
+            "AdaDelta": 2, "Sgd": 0, "NoOp": 0}.get(name, 0)
+
+
+def _load_updater_state(net, owner, flat: np.ndarray) -> None:
+    """Graft the reference updater state view into the optax state tree.
+    Assumes the uniform-updater single-block layout (see module docstring);
+    anything else is skipped with a warning rather than mis-imported."""
+    import logging
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    u = net.conf.updater
+    slots = _updater_state_slots(u)
+    n = sum(size for *_x, size in _segments(net, owner))
+    if slots == 0 or flat.size != slots * n:
+        if flat.size:
+            logging.getLogger("deeplearning4j_tpu").warning(
+                "updaterState.bin length %d does not match the uniform "
+                "%s layout (%d slots x %d params); skipping updater import",
+                flat.size, type(u).__name__, slots, n)
+        return
+
+    # decode each slot with the SAME per-layer layout conversion as params
+    def decode_slot(slot_flat):
+        tree = {}
+        offset = 0
+        for our_i, layer, in_type, raw_in, size in _segments(net, owner):
+            params, state = _decode_layer_params(
+                layer, in_type, slot_flat[offset:offset + size], raw_in)
+            merged = dict(params)
+            merged.update(state)        # BN mean/var not in optax state; drop below
+            tree[str(our_i)] = {
+                k: jnp.asarray(np.asarray(v, np.float32).reshape(
+                    np.asarray(net.params[str(our_i)][k]).shape))
+                for k, v in merged.items() if k in net.params[str(our_i)]}
+            offset += size
+        return tree
+
+    slot_trees = [decode_slot(flat[i * n:(i + 1) * n]) for i in range(slots)]
+
+    def fill(template_tree, slot_tree):
+        """Overlay slot values onto a params-shaped pytree, keeping leaves
+        that the reference does not carry (e.g. BN has no updater state for
+        mean/var on our side because they are not trainable here)."""
+        out = jax.tree_util.tree_map(lambda x: x, template_tree)
+        for lk, lv in slot_tree.items():
+            for pk, pv in lv.items():
+                out[lk][pk] = pv
+        return out
+
+    name = type(u).__name__
+    new_state = []
+    for s in net.opt_state if isinstance(net.opt_state, tuple) else (net.opt_state,):
+        if isinstance(s, optax.ScaleByAdamState) and name in (
+                "Adam", "AdamW", "Nadam", "AdaMax"):
+            s = s._replace(mu=fill(s.mu, slot_trees[0]),
+                           nu=fill(s.nu, slot_trees[1]))
+        elif isinstance(s, optax.TraceState) and name in ("Nesterovs",
+                                                          "Momentum"):
+            s = s._replace(trace=fill(s.trace, slot_trees[0]))
+        elif isinstance(s, optax.ScaleByRssState) and name == "AdaGrad":
+            s = s._replace(sum_of_squares=fill(s.sum_of_squares,
+                                               slot_trees[0]))
+        elif isinstance(s, optax.ScaleByRmsState) and name == "RmsProp":
+            s = s._replace(nu=fill(s.nu, slot_trees[0]))
+        elif isinstance(s, optax.ScaleByAdaDeltaState) and name == "AdaDelta":
+            # nd4j AdaDeltaUpdater state view = [msg | msdx] (sq-grad avg,
+            # sq-update avg) -> optax e_g / e_x
+            s = s._replace(e_g=fill(s.e_g, slot_trees[0]),
+                           e_x=fill(s.e_x, slot_trees[1]))
+        new_state.append(s)
+    net.opt_state = (tuple(new_state)
+                     if isinstance(net.opt_state, tuple) else new_state[0])
+
+
+# ======================================================================
+# Export: save_dl4j_model
+# ======================================================================
+
+_KIND_TO = {"DenseLayer": "dense", "OutputLayer": "output",
+            "ElementWiseMultiplicationLayer": "ElementWiseMult",
+            "RnnOutputLayer": "rnnoutput", "LossLayer": "loss",
+            "EmbeddingLayer": "embedding", "ActivationLayer": "activation",
+            "DropoutLayer": "dropout", "ConvolutionLayer": "convolution",
+            "SubsamplingLayer": "subsampling",
+            "BatchNormalization": "batchNormalization", "LSTM": "LSTM",
+            "GlobalPoolingLayer": "GlobalPooling",
+            "ZeroPaddingLayer": "zeroPadding", "Upsampling2D": "Upsampling2D"}
+
+
+def _layer_to_dl4j_json(layer, in_type: InputType) -> Tuple[str, dict]:
+    cls = type(layer).__name__
+    if cls not in _KIND_TO:
+        raise UnsupportedLayerError(
+            f"{cls} has no DL4J JSON mapping; export supports the shared "
+            f"layer subset: {sorted(_KIND_TO)}")
+    kind = _KIND_TO[cls]
+    body: Dict[str, Any] = {"layerName": layer.name}
+    if isinstance(layer.dropout, (int, float)) and layer.dropout > 0:
+        body["iDropout"] = {
+            "@class": "org.deeplearning4j.nn.conf.dropout.Dropout",
+            "p": 1.0 - float(layer.dropout)}     # DL4J p = retain prob
+    if layer.l1:
+        body["l1"] = layer.l1
+    if layer.l2:
+        body["l2"] = layer.l2
+    if hasattr(layer, "activation"):
+        body["activationFn"] = _act_to(layer.activation)
+    if hasattr(layer, "n_out") and getattr(layer, "n_out", 0):
+        body["nout"] = layer.n_out
+        nin = getattr(layer, "n_in", None)
+        body["nin"] = nin or (in_type.shape[2] if in_type.kind == Kind.CNN
+                              else in_type.flat_size
+                              if in_type.kind != Kind.RNN
+                              else in_type.features)
+    if hasattr(layer, "loss"):
+        body["lossFn"] = _loss_to(layer.loss)
+    if hasattr(layer, "has_bias"):
+        body["hasBias"] = layer.has_bias
+    if cls in ("ConvolutionLayer", "SubsamplingLayer"):
+        body["kernelSize"] = list(layer.kernel)
+        body["stride"] = list(layer.stride)
+        body["padding"] = [0, 0]
+        body["convolutionMode"] = layer.convolution_mode.capitalize()
+        if cls == "ConvolutionLayer":
+            body["dilation"] = list(layer.dilation)
+        else:
+            body["poolingType"] = layer.pooling_type.upper()
+            body["pnorm"] = layer.pnorm
+    if cls == "BatchNormalization":
+        body.update(eps=layer.epsilon, decay=layer.decay,
+                    gamma=layer.gamma_init, beta=layer.beta_init,
+                    lockGammaBeta=layer.lock_gamma_beta)
+    if cls == "LSTM":
+        body["gateActivationFn"] = _act_to(layer.gate_activation)
+        body["forgetGateBiasInit"] = layer.forget_gate_bias_init
+    return kind, body
+
+
+def save_dl4j_model(net, path, save_updater: bool = True) -> None:
+    """Write this framework's MultiLayerNetwork as a reference-format model
+    zip (configuration.json + coefficients.bin [+ updaterState.bin]), so the
+    artifact can travel back to a DL4J deployment. Layout conversions are
+    the exact inverses of the import path."""
+    import optax
+
+    confs = []
+    for i, layer in enumerate(net.layers):
+        in_type = net._input_types[i]
+        kind, body = _layer_to_dl4j_json(layer, in_type)
+        body["iUpdater"] = _updater_to(net.conf.updater)
+        confs.append({"layer": {kind: body}, "seed": net.conf.seed})
+    top = {
+        "backprop": True,
+        "backpropType": ("TruncatedBPTT"
+                         if net.conf.backprop_type == "tbptt" else "Standard"),
+        "tbpttFwdLength": net.conf.tbptt_fwd_length,
+        "tbpttBackLength": net.conf.tbptt_back_length,
+        "confs": confs,
+        "pretrain": False,
+    }
+    owner = list(range(len(net.layers)))
+    flat_parts = []
+    for our_i, layer, in_type, raw_in, _size in _segments(net, owner):
+        flat_parts.append(_encode_layer_params(
+            layer, in_type, net.params[str(our_i)], net.state[str(our_i)],
+            raw_in))
+    flat = (np.concatenate(flat_parts) if flat_parts
+            else np.zeros((0,), np.float32))
+
+    upd_flat = None
+    if save_updater:
+        u = net.conf.updater
+        slots = _updater_state_slots(u)
+        states = (net.opt_state if isinstance(net.opt_state, tuple)
+                  else (net.opt_state,))
+        slot_trees = None
+        for s in states:
+            if isinstance(s, optax.ScaleByAdamState):
+                slot_trees = [s.mu, s.nu][:slots]
+            elif isinstance(s, optax.TraceState):
+                slot_trees = [s.trace]
+            elif isinstance(s, optax.ScaleByRssState):
+                slot_trees = [s.sum_of_squares]
+            elif isinstance(s, optax.ScaleByRmsState):
+                slot_trees = [s.nu]
+            elif isinstance(s, optax.ScaleByAdaDeltaState):
+                slot_trees = [s.e_g, s.e_x]
+            if slot_trees is not None:
+                break
+        if slot_trees is not None:
+            parts = []
+            for tree in slot_trees:
+                for our_i, layer, in_type, raw_in, _size in _segments(net, owner):
+                    lp = {k: tree[str(our_i)][k]
+                          for k in net.params[str(our_i)]}
+                    # positions the reference updater tracks but we don't
+                    # (BN running mean/var are non-trainable here) -> zeros
+                    zstate = {k: np.zeros(np.asarray(v).shape, np.float32)
+                              for k, v in net.state.get(str(our_i), {}).items()}
+                    parts.append(_encode_layer_params(
+                        layer, in_type, lp, zstate, raw_in))
+            upd_flat = np.concatenate(parts) if parts else None
+
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
+        zf.writestr("configuration.json", json.dumps(top, indent=2))
+        buf = io.BytesIO()
+        write_nd4j_array(buf, flat)
+        zf.writestr("coefficients.bin", buf.getvalue())
+        if upd_flat is not None:
+            buf = io.BytesIO()
+            write_nd4j_array(buf, upd_flat)
+            zf.writestr("updaterState.bin", buf.getvalue())

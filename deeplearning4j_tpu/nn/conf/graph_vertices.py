@@ -238,3 +238,23 @@ class ReverseTimeSeriesVertex(GraphVertexConf):
 
     def apply(self, *inputs):
         return jnp.flip(inputs[0], axis=1)
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class PoolHelperVertex(GraphVertexConf):
+    """Strip the first spatial row and column of a CNN activation
+    (DL4J nn/conf/graph/PoolHelperVertex.java + impl
+    nn/graph/vertex/impl/PoolHelperVertex.java) — compensates the
+    off-by-one pooling of Caffe-imported GoogLeNet-style models."""
+
+    def output_type(self, *input_types: InputType) -> InputType:
+        t = input_types[0]
+        if t.kind != Kind.CNN:
+            raise ValueError("PoolHelperVertex expects CNN input, got "
+                             f"{t.kind}")
+        h, w, c = t.shape
+        return InputType.convolutional(h - 1, w - 1, c)
+
+    def apply(self, *inputs):
+        return inputs[0][:, 1:, 1:, :]     # NHWC: drop first row + column

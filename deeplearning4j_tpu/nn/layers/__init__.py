@@ -1,6 +1,7 @@
 from deeplearning4j_tpu.nn.layers.feedforward import (
     DenseLayer, EmbeddingLayer, ActivationLayer, DropoutLayer,
     OutputLayer, CenterLossOutputLayer, LossLayer, AutoEncoder,
+    ElementWiseMultiplicationLayer,
     RepeatVector, PermuteLayer, ReshapeLayer,
 )
 from deeplearning4j_tpu.nn.layers.convolution import (
@@ -29,6 +30,7 @@ from deeplearning4j_tpu.nn.layers.attention import (
 __all__ = [
     "DenseLayer", "EmbeddingLayer", "ActivationLayer", "DropoutLayer",
     "OutputLayer", "CenterLossOutputLayer", "LossLayer", "AutoEncoder",
+    "ElementWiseMultiplicationLayer",
     "RepeatVector", "PermuteLayer", "ReshapeLayer",
     "ConvolutionLayer", "Convolution1DLayer", "SubsamplingLayer",
     "Subsampling1DLayer", "Upsampling2D", "ZeroPaddingLayer",

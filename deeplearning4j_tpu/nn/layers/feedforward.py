@@ -57,6 +57,44 @@ class DenseLayer(LayerConf):
 
 @register_layer
 @dataclasses.dataclass(frozen=True)
+class ElementWiseMultiplicationLayer(LayerConf):
+    """out = activation(x * w + b) with a learnable per-feature weight
+    vector w and bias b; input and output size are equal
+    (DL4J nn/conf/layers/misc/ElementWiseMultiplicationLayer.java, impl
+    nn/layers/feedforward/elementwise/ElementWiseMultiplicationLayer.java,
+    params ElementWiseParamInitializer — W is a length-nOut vector)."""
+    n_out: int = 0                      # == n_in; inferred when 0
+    n_in: Optional[int] = None
+    activation: str = "identity"
+    weight_init: str = "xavier"
+    bias_init: float = 0.0
+
+    def output_type(self, input_type: InputType) -> InputType:
+        n = self.n_out or input_type.features
+        if self.n_in and self.n_in != n:
+            raise ValueError("ElementWiseMultiplicationLayer requires "
+                             f"n_in == n_out, got {self.n_in} vs {n}")
+        return InputType.feed_forward(n)
+
+    def init(self, key, input_type: InputType, dtype=jnp.float32):
+        n = self.n_out or input_type.features
+        if input_type.features != n:
+            raise ValueError("ElementWiseMultiplicationLayer requires "
+                             f"n_in == n_out, got {input_type.features} "
+                             f"vs {n}")
+        w_init = get_initializer(self.weight_init)
+        params = {"W": w_init(key, (n,), n, n, dtype),
+                  "b": jnp.full((n,), self.bias_init, dtype)}
+        return params, {}
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        x = self.maybe_dropout_input(x, train, rng)
+        return get_activation(self.activation)(
+            x * params["W"] + params["b"]), state
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
 class EmbeddingLayer(LayerConf):
     """Index -> embedding row. Input: (B,) or (B,1) integer indices.
     DL4J's EmbeddingLayer is mathematically a one-hot matmul; on TPU we use a

@@ -541,3 +541,42 @@ def test_gc_cropping_padding_upsampling_1d():
                 OutputLayer(n_out=2, activation="softmax", loss="mcxent")],
                InputType.recurrent(3, 8))
     _check(net, X, Y)
+
+
+# ------------------------------------------------- round-5 parity closers
+def test_gc_elementwise_multiplication():
+    """ElementWiseMultiplicationLayer: out = act(x * w + b)
+    (reference nn/conf/layers/misc/ElementWiseMultiplicationLayer.java)."""
+    from deeplearning4j_tpu.nn.layers import ElementWiseMultiplicationLayer
+    X, Y = _ff_data()
+    net = _net([DenseLayer(n_out=6, activation="tanh"),
+                ElementWiseMultiplicationLayer(n_out=6, activation="sigmoid"),
+                OutputLayer(n_out=3, activation="softmax", loss="mcxent")],
+               InputType.feed_forward(5), l1=1e-3, l2=1e-3)
+    _check(net, X, Y)
+
+
+def test_gc_poolhelper_vertex():
+    """PoolHelperVertex strips the first spatial row/col inside a graph
+    (reference nn/conf/graph/PoolHelperVertex.java)."""
+    from deeplearning4j_tpu.nn.conf.graph_vertices import PoolHelperVertex
+    from deeplearning4j_tpu.nn.conf.network import GraphBuilder
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.nn.layers import ConvolutionLayer
+
+    X, Y = _cnn_data(n=3, h=6, w=6, ch=2, c=3)
+    g = (GraphBuilder(NeuralNetConfiguration.Builder().seed(0)
+                      .updater(Sgd(1e-2)))
+         .add_inputs("in")
+         .set_input_types(InputType.convolutional(6, 6, 2)))
+    g.add_layer("c", ConvolutionLayer(n_out=3, kernel=(3, 3),
+                                      convolution_mode="same",
+                                      activation="tanh"), "in")
+    g.add_vertex("ph", PoolHelperVertex(), "c")
+    g.add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                   loss="mcxent"), "ph")
+    g.set_outputs("out")
+    gn = ComputationGraph(g.build()).init()
+    # shape: 6x6 conv-same -> 6x6, poolhelper -> 5x5
+    res = check_gradients(gn, X, Y, max_per_param=24)
+    assert res.passed, (res.worst_param, res.max_rel_error, res.failures[:3])

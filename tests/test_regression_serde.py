@@ -153,3 +153,53 @@ def test_golden_yaml_fixture_loads():
     assert conf.layers[0].dropout == 0.1
     net = MultiLayerNetwork(conf).init()
     assert net.num_params() > 0
+
+
+def test_round5_layer_conf_json_round_trip():
+    """Round-5 parity closers survive the JSON round trip and run."""
+    import numpy as np
+
+    from deeplearning4j_tpu.nn.conf.base import InputType
+    from deeplearning4j_tpu.nn.conf.network import (
+        MultiLayerConfiguration, NeuralNetConfiguration,
+    )
+    from deeplearning4j_tpu.nn.layers import (
+        DenseLayer, ElementWiseMultiplicationLayer, OutputLayer,
+    )
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    conf = (NeuralNetConfiguration.Builder().seed(5).list()
+            .layer(DenseLayer(n_out=8, activation="relu"))
+            .layer(ElementWiseMultiplicationLayer(n_out=8,
+                                                  activation="tanh"))
+            .layer(OutputLayer(n_out=3, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    back = MultiLayerConfiguration.from_json(conf.to_json())
+    assert back == conf
+    net = MultiLayerNetwork(back).init()
+    assert np.asarray(net.output(np.zeros((2, 4), "float32"))).shape == (2, 3)
+
+    # PoolHelperVertex graph conf round-trips too
+    from deeplearning4j_tpu.nn.conf.graph_vertices import PoolHelperVertex
+    from deeplearning4j_tpu.nn.conf.network import (
+        ComputationGraphConfiguration, GraphBuilder,
+    )
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.nn.layers import ConvolutionLayer
+    g = (GraphBuilder(NeuralNetConfiguration.Builder().seed(5))
+         .add_inputs("in")
+         .set_input_types(InputType.convolutional(5, 5, 2)))
+    g.add_layer("c", ConvolutionLayer(n_out=2, kernel=(3, 3),
+                                      convolution_mode="same"), "in")
+    g.add_vertex("ph", PoolHelperVertex(), "c")
+    g.add_layer("out", OutputLayer(n_out=2), "ph")
+    g.set_outputs("out")
+    gconf = g.build()
+    gback = ComputationGraphConfiguration.from_json(gconf.to_json())
+    assert gback == gconf
+    gn = ComputationGraph(gback).init()
+    out = gn.output(np.zeros((1, 5, 5, 2), "float32"))
+    arr = np.asarray(out[0] if isinstance(out, (list, tuple)) else out)
+    assert arr.shape == (1, 2)

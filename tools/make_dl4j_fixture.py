@@ -1,0 +1,99 @@
+"""Generate the golden DL4J-format fixture zip + expected outputs.
+
+Writes tests/fixtures/dl4j/mlp_mnistlike.zip in the REFERENCE's on-disk
+format (ModelSerializer.java zip entries; Jackson WRAPPER_OBJECT layer JSON;
+Nd4j binary coefficients in the reference flat param order) and an expected
+forward output computed by an independent NumPy oracle — deliberately not by
+the serializer under test, so test_golden_dl4j_fixture is a genuine
+cross-implementation regression check.
+
+Run once: python tools/make_dl4j_fixture.py
+"""
+import io
+import json
+import os
+import struct
+import sys
+import zipfile
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXDIR = os.path.join(REPO, "tests", "fixtures", "dl4j")
+
+
+def write_utf(f, s):
+    b = s.encode()
+    f.write(struct.pack(">H", len(b)) + b)
+
+
+def write_nd4j(f, arr):
+    arr = np.asarray(arr, np.float32).reshape(1, -1)
+    si = [2, 1, arr.size, arr.size, 1, 0, 1, ord("c")]
+    write_utf(f, "DIRECT")
+    f.write(struct.pack(">i", len(si)))
+    write_utf(f, "INT")
+    f.write(np.asarray(si, ">i4").tobytes())
+    write_utf(f, "DIRECT")
+    f.write(struct.pack(">i", arr.size))
+    write_utf(f, "FLOAT")
+    f.write(arr.astype(">f4").tobytes())
+
+
+def main():
+    os.makedirs(FIXDIR, exist_ok=True)
+    rs = np.random.RandomState(20260730)
+    nin, nh, nout = 16, 12, 5
+    W1 = (rs.randn(nin, nh) * 0.3).astype(np.float32)
+    b1 = (rs.randn(nh) * 0.1).astype(np.float32)
+    W2 = (rs.randn(nh, nout) * 0.3).astype(np.float32)
+    b2 = (rs.randn(nout) * 0.1).astype(np.float32)
+    flat = np.concatenate([W1.ravel(order="F"), b1,
+                           W2.ravel(order="F"), b2])
+
+    act = "org.nd4j.linalg.activations.impl.Activation"
+    conf = {
+        "backprop": True, "backpropType": "Standard", "pretrain": False,
+        "confs": [
+            {"layer": {"dense": {
+                "activationFn": {"@class": act + "ReLU"},
+                "nin": nin, "nout": nh, "hasBias": True,
+                "layerName": "dense0",
+                "iUpdater": {"@class":
+                             "org.nd4j.linalg.learning.config.Adam",
+                             "learningRate": 1e-3, "beta1": 0.9,
+                             "beta2": 0.999, "epsilon": 1e-8}}},
+             "seed": 12345},
+            {"layer": {"output": {
+                "activationFn": {"@class": act + "Softmax"},
+                "nin": nh, "nout": nout, "hasBias": True,
+                "lossFn": {"@class":
+                           "org.nd4j.linalg.lossfunctions.impl.LossMCXENT"},
+                "iUpdater": {"@class":
+                             "org.nd4j.linalg.learning.config.Adam",
+                             "learningRate": 1e-3, "beta1": 0.9,
+                             "beta2": 0.999, "epsilon": 1e-8}}},
+             "seed": 12345},
+        ],
+    }
+
+    zpath = os.path.join(FIXDIR, "mlp_mnistlike.zip")
+    with zipfile.ZipFile(zpath, "w", zipfile.ZIP_DEFLATED) as zf:
+        zf.writestr("configuration.json", json.dumps(conf, indent=2))
+        buf = io.BytesIO()
+        write_nd4j(buf, flat)
+        zf.writestr("coefficients.bin", buf.getvalue())
+
+    # independent oracle forward
+    x = rs.randn(3, nin).astype(np.float32)
+    h = np.maximum(x @ W1 + b1, 0.0)
+    z = h @ W2 + b2
+    e = np.exp(z - z.max(-1, keepdims=True))
+    y = e / e.sum(-1, keepdims=True)
+    with open(os.path.join(FIXDIR, "mlp_mnistlike_expected.json"), "w") as f:
+        json.dump({"input": x.tolist(), "output": y.tolist()}, f)
+    print("wrote", zpath)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

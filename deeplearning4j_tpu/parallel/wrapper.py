@@ -256,6 +256,24 @@ class ParallelWrapper:
         self._stacked = None
         rng = jax.random.PRNGKey(net.conf.seed + 131071)
         losses = None
+        # listener callbacks are deferred ONE iteration: the loss fetch for
+        # step i happens after step i+1 has been dispatched, so the
+        # device->host sync overlaps device compute instead of serializing
+        # the dispatch pipeline (same deferral the scan-fit path uses;
+        # call arguments are unchanged, only wall-clock timing moves)
+        pending = None              # (losses-or-None, iteration, batch_size)
+
+        def flush_pending():
+            nonlocal pending
+            if pending is None:
+                return
+            pl, pit, pbs = pending
+            pending = None
+            if pl is not None:
+                net._score = float(jnp.mean(pl))
+            for lst in net.listeners:
+                lst.iteration_done(net, pit, net.epoch_count, net._score,
+                                   0.0, pbs)
         try:
             for _ in range(epochs):
                 for lst in net.listeners:
@@ -271,20 +289,25 @@ class ParallelWrapper:
                     at_avg = self._local_steps % self.averaging_frequency == 0
                     if at_avg:
                         sp, so, ss = self._avg_fn(sp, so, ss)
-                    # the blocking device->host loss fetch serializes the
-                    # dispatch pipeline — only pay it when someone reads
-                    # the value: listeners each iteration, otherwise at
-                    # averaging barriers only
+                    # the deferred callback for iteration i must observe the
+                    # score AS OF iteration i — flush before this
+                    # iteration's own score update can overwrite it
+                    if net.listeners:
+                        flush_pending()
+                    # blocking loss fetches only where someone reads the
+                    # value; with listeners the fetch rides the deferred
+                    # flush
                     if self.report_score_after_averaging:
                         if at_avg:
                             net._score = float(jnp.mean(losses))
-                    elif bool(net.listeners) or at_avg:
+                    elif not net.listeners and at_avg:
                         net._score = float(jnp.mean(losses))
-                    for lst in net.listeners:
-                        lst.iteration_done(net, net.iteration_count,
-                                           net.epoch_count, net._score, 0.0,
-                                           bs)
+                    if net.listeners:
+                        pending = (
+                            None if self.report_score_after_averaging
+                            else losses, net.iteration_count, bs)
                     net.iteration_count += 1
+                flush_pending()
                 for lst in net.listeners:
                     lst.on_epoch_end(net, net.epoch_count)
                 net.epoch_count += 1
@@ -295,6 +318,13 @@ class ParallelWrapper:
                         not self.report_score_after_averaging:
                     net._score = float(jnp.mean(losses))
         finally:
+            # a deferred listener callback must not be lost when fit aborts
+            # mid-epoch (the fetch itself may fail if buffers were donated
+            # into the failing step — then there is nothing to deliver)
+            try:
+                flush_pending()
+            except Exception:
+                pass
             # final average + write back to the wrapped network; preserves
             # progress even when fit is interrupted between steps
             try:

@@ -14,13 +14,14 @@ HTML page that polls JSON endpoints and draws SVG charts (no external JS,
 zero egress).
 """
 from deeplearning4j_tpu.ui.storage import (
-    FileStatsStorage, InMemoryStatsStorage, StatsRecord, StatsStorage,
-    StatsStorageRouter,
+    FileStatsStorage, InMemoryStatsStorage, RemoteUIStatsStorageRouter,
+    StatsRecord, StatsStorage, StatsStorageRouter,
 )
 from deeplearning4j_tpu.ui.stats import StatsListener
 from deeplearning4j_tpu.ui.server import UIServer
 
 __all__ = [
-    "FileStatsStorage", "InMemoryStatsStorage", "StatsRecord",
+    "FileStatsStorage", "InMemoryStatsStorage",
+    "RemoteUIStatsStorageRouter", "StatsRecord",
     "StatsStorage", "StatsStorageRouter", "StatsListener", "UIServer",
 ]

@@ -364,6 +364,23 @@ class _Handler(BaseHTTPRequestHandler):
         # body {"points": [[x, y, label?], ...]}
         ui: "UIServer" = self.server.ui           # type: ignore[attr-defined]
         url = urlparse(self.path)
+        if url.path == "/remoteReceive":
+            # RemoteReceiverModule.java:60 parity: workers' remote stats
+            # routers POST record batches here; they land in the storage
+            # registered via UIServer.enable_remote_listener()
+            length = int(self.headers.get("Content-Length", "0"))
+            try:
+                body = json.loads(self.rfile.read(length) or b"{}")
+                n = ui.receive_remote(body.get("records", []))
+            except (ValueError, KeyError, TypeError) as e:
+                self._json({"error": f"bad body: {e}"}, code=400)
+                return
+            if n is None:
+                self._json({"error": "remote listener not enabled"},
+                           code=409)
+                return
+            self._json({"ok": True, "n": n})
+            return
         if url.path.startswith("/tsne/post/"):
             sid = unquote(url.path.rsplit("/", 1)[-1])
             length = int(self.headers.get("Content-Length", "0"))
@@ -390,12 +407,17 @@ class UIServer:
 
     _instance: Optional["UIServer"] = None
 
-    def __init__(self, port: int = 0):
+    def __init__(self, port: int = 0, host: str = "127.0.0.1"):
+        """`host` defaults to loopback; a driver accepting remote worker
+        stats from OTHER hosts (enable_remote_listener + workers using
+        RemoteUIStatsStorageRouter) must bind host="0.0.0.0" like the
+        reference's Play server does."""
         self._storages: list = []
         self._tsne_sessions: Dict[str, list] = {}
         self._tsne_lock = threading.Lock()
         self._word_vectors = None
-        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
+        self._remote_storage = None
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.ui = self                    # type: ignore[attr-defined]
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(target=self._httpd.serve_forever,
@@ -416,6 +438,44 @@ class UIServer:
         """Attach a stats storage to visualize (UIServer.attach parity)."""
         if storage not in self._storages:
             self._storages.append(storage)
+
+    def enable_remote_listener(self, storage: Optional[StatsStorage] = None,
+                               attach: bool = True) -> StatsStorage:
+        """Accept stats POSTed by RemoteUIStatsStorageRouter workers at
+        /remoteReceive, routing them into `storage` (a fresh
+        InMemoryStatsStorage when omitted). Mirrors
+        PlayUIServer.enableRemoteListener / RemoteReceiverModule."""
+        if storage is None:
+            from deeplearning4j_tpu.ui.storage import InMemoryStatsStorage
+            storage = InMemoryStatsStorage()
+        self._remote_storage = storage
+        if attach:
+            self.attach(storage)
+        return storage
+
+    def disable_remote_listener(self):
+        self._remote_storage = None
+
+    def receive_remote(self, records) -> Optional[int]:
+        """Route one POSTed record batch into the remote-listener storage.
+        Returns the record count, or None if remote receiving is off.
+        The whole batch is parsed BEFORE anything is stored: a malformed
+        record rejects the batch atomically, so the sender's whole-batch
+        retry cannot duplicate a partially-committed prefix."""
+        from deeplearning4j_tpu.ui.storage import StatsRecord
+        if self._remote_storage is None:
+            return None
+        parsed = []
+        for entry in records:
+            entry = dict(entry)
+            kind = entry.pop("kind", "update")
+            parsed.append((kind, StatsRecord(**entry)))
+        for kind, rec in parsed:
+            if kind == "static":
+                self._remote_storage.put_static_info(rec)
+            else:
+                self._remote_storage.put_update(rec)
+        return len(parsed)
 
     def detach(self, storage: StatsStorage):
         if storage in self._storages:

@@ -197,3 +197,126 @@ class FileStatsStorage(StatsStorage):
 
 def new_session_id(prefix: str = "train") -> str:
     return f"{prefix}-{int(time.time() * 1000):x}-{os.getpid()}"
+
+
+class RemoteUIStatsStorageRouter(StatsStorageRouter):
+    """Streams StatsRecords to a remote UIServer over HTTP, so N worker
+    processes/hosts feed ONE live dashboard.
+
+    Parity: `api/storage/impl/RemoteUIStatsStorageRouter.java` (async
+    queue + posting thread, exponential-backoff retries, shutdown after
+    `max_retries` consecutive failures) posting to the receiver route the
+    reference serves at POST /remoteReceive
+    (`deeplearning4j-play/.../remote/RemoteReceiverModule.java:60`).
+    Records batch per drain: one POST carries everything queued since the
+    last one, so high-frequency listeners don't serialize on HTTP RTTs.
+
+    Usage on a worker (any process/host that can reach the driver):
+        router = RemoteUIStatsStorageRouter("http://driver:9000")
+        net.set_listeners(StatsListener(router))
+    and on the driver (bind 0.0.0.0 when workers live on OTHER hosts;
+    the default loopback bind only serves same-host workers):
+        UIServer(port=9000, host="0.0.0.0").enable_remote_listener()
+    """
+
+    def __init__(self, address: str, max_retries: int = 10,
+                 retry_delay_ms: int = 1000,
+                 retry_backoff_factor: float = 2.0,
+                 path: str = "/remoteReceive"):
+        import queue as _queue
+        self.url = address.rstrip("/") + path
+        self.max_retries = max_retries
+        self.retry_delay = retry_delay_ms / 1000.0
+        self.backoff = retry_backoff_factor
+        self._q: "_queue.Queue" = _queue.Queue()
+        self._shutdown = threading.Event()
+        self._idle = threading.Event()
+        self._idle.set()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="RemoteStatsRouter")
+        self._thread.start()
+
+    # -- StatsStorageRouter write API -----------------------------------
+    def put_static_info(self, record: StatsRecord):
+        self._enqueue("static", record)
+
+    def put_update(self, record: StatsRecord):
+        self._enqueue("update", record)
+
+    def _enqueue(self, kind: str, record: StatsRecord):
+        if self._shutdown.is_set():
+            import logging
+            logging.getLogger("deeplearning4j_tpu").warning(
+                "RemoteUIStatsStorageRouter is shut down (too many "
+                "consecutive post failures); dropping record")
+            return
+        self._idle.clear()
+        self._q.put((kind, record))
+
+    # -- posting thread ---------------------------------------------------
+    def _drain(self):
+        batch = []
+        try:
+            while True:
+                batch.append(self._q.get_nowait())
+        except Exception:
+            pass
+        return batch
+
+    def _post(self, batch) -> bool:
+        import urllib.request
+        body = json.dumps({"records": [
+            {"kind": kind, **dataclasses.asdict(rec)}
+            for kind, rec in batch]}).encode()
+        req = urllib.request.Request(
+            self.url, data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return 200 <= resp.status < 300
+
+    def _run(self):
+        while not self._shutdown.is_set():
+            if self._q.empty():
+                self._idle.set()
+                time.sleep(0.05)
+                continue
+            batch = self._drain()
+            delay = self.retry_delay
+            for attempt in range(self.max_retries + 1):
+                try:
+                    if self._post(batch):
+                        break
+                except Exception:
+                    pass
+                if attempt == self.max_retries:
+                    # a batch that exhausted every retry shuts the router
+                    # down, like the reference's repeated-failure shutdown —
+                    # later records are dropped with a warning, training is
+                    # never blocked on a dead dashboard
+                    self._shutdown.set()
+                    self._idle.set()
+                    return
+                # interruptible backoff: close() must not wait out the
+                # exponential retry schedule
+                if self._shutdown.wait(delay):
+                    self._idle.set()
+                    return
+                delay *= self.backoff
+        self._idle.set()
+
+    # -- lifecycle --------------------------------------------------------
+    def flush(self, timeout: float = 10.0) -> bool:
+        """Block until everything queued so far has been posted (or the
+        router shut down). Returns True if fully drained."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if self._shutdown.is_set():
+                return False
+            if self._q.empty() and self._idle.is_set():
+                return True
+            time.sleep(0.02)
+        return False
+
+    def close(self):
+        self.flush()
+        self._shutdown.set()

@@ -476,3 +476,34 @@ def test_ragged_batch_is_exact_not_double_weighted():
                 np.asarray(single.params[k][pk]),
                 np.asarray(dist.params[k][pk]),
                 rtol=2e-6, atol=2e-6, err_msg=f"{k}/{pk}")
+
+
+def test_averaging_listener_deferred_fetch_scores_in_order():
+    """Listener callbacks in AVERAGING mode are deferred one iteration (the
+    loss fetch overlaps the next dispatched step) but must deliver every
+    iteration exactly once, in order, with finite per-iteration scores."""
+    from deeplearning4j_tpu.train.listeners import TrainingListener
+
+    class Capture(TrainingListener):
+        def __init__(self):
+            self.calls = []
+
+        def iteration_done(self, model, iteration, epoch, score,
+                           etl_ms, batch_size):
+            self.calls.append((iteration, epoch, score))
+
+    net = MultiLayerNetwork(_mlp()).init()
+    cap = Capture()
+    net.set_listeners(cap)
+    rs = np.random.RandomState(0)
+    X = rs.rand(32, 8).astype("float32")
+    Y = np.eye(4, dtype="float32")[rs.randint(0, 4, 32)]
+    w = ParallelWrapper(net, mode=TrainingMode.AVERAGING,
+                        averaging_frequency=2)
+    w.fit(ArrayDataSetIterator(X, Y, batch_size=16), epochs=2)
+    its = [c[0] for c in cap.calls]
+    assert its == sorted(its) and len(its) == len(set(its))
+    assert len(cap.calls) == 4          # 2 batches x 2 epochs
+    assert all(np.isfinite(c[2]) for c in cap.calls)
+    epochs_seen = [c[1] for c in cap.calls]
+    assert epochs_seen == [0, 0, 1, 1]  # flushed before epoch rollover

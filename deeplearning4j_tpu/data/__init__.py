@@ -5,13 +5,20 @@ from deeplearning4j_tpu.data.iterator import (
 )
 from deeplearning4j_tpu.data.async_iterator import AsyncDataSetIterator
 from deeplearning4j_tpu.data.utility_iterators import (
-    AsyncMultiDataSetIterator, AsyncShieldDataSetIterator,
-    DataSetIteratorSplitter, EarlyTerminationDataSetIterator,
-    EarlyTerminationMultiDataSetIterator, IteratorDataSetIterator,
-    IteratorMultiDataSetIterator, MultiDataSetIteratorSplitter,
-    MultiDataSetWrapperIterator, MultipleEpochsIterator,
-    ReconstructionDataSetIterator, SamplingDataSetIterator,
-    SingletonMultiDataSetIterator,
+    AbstractDataSetIterator, AsyncMultiDataSetIterator,
+    AsyncShieldDataSetIterator, CombinedMultiDataSetPreProcessor,
+    CombinedPreProcessor, DataSetCallback, DataSetIteratorSplitter,
+    DefaultCallback, DoublesDataSetIterator,
+    DummyPreProcessor, EarlyTerminationDataSetIterator,
+    EarlyTerminationMultiDataSetIterator, FileSplitDataSetIterator,
+    FloatsDataSetIterator, INDArrayDataSetIterator, InequalityHandling,
+    InterleavedDataSetCallback, IteratorDataSetIterator,
+    IteratorMultiDataSetIterator, JointParallelDataSetIterator,
+    ListDataSetIterator, MovingWindowBaseDataSetIterator,
+    MultiDataSetIteratorSplitter, MultiDataSetWrapperIterator,
+    MultipleEpochsIterator, ReconstructionDataSetIterator,
+    SamplingDataSetIterator, SingletonMultiDataSetIterator,
+    WorkspacesShieldDataSetIterator, load_dataset, save_dataset,
 )
 from deeplearning4j_tpu.data.normalization import (
     DataSetPreProcessor, ImagePreProcessingScaler,
@@ -48,6 +55,14 @@ __all__ = [
     "SingletonMultiDataSetIterator", "IteratorMultiDataSetIterator",
     "EarlyTerminationMultiDataSetIterator", "MultiDataSetWrapperIterator",
     "MultiDataSetIteratorSplitter",
+    "AbstractDataSetIterator", "FloatsDataSetIterator",
+    "DoublesDataSetIterator", "INDArrayDataSetIterator",
+    "ListDataSetIterator", "FileSplitDataSetIterator",
+    "DummyPreProcessor", "CombinedPreProcessor",
+    "CombinedMultiDataSetPreProcessor", "WorkspacesShieldDataSetIterator",
+    "MovingWindowBaseDataSetIterator", "DataSetCallback", "DefaultCallback",
+    "InterleavedDataSetCallback", "JointParallelDataSetIterator",
+    "InequalityHandling", "save_dataset", "load_dataset",
     "CSVRecordReader", "CSVSequenceRecordReader", "CollectionRecordReader",
     "CollectionSequenceRecordReader", "ImageRecordReader",
     "RecordReaderDataSetIterator", "RecordReaderMultiDataSetIterator",

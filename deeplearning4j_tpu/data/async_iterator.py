@@ -24,7 +24,13 @@ _SENTINEL = object()
 
 class AsyncDataSetIterator(DataSetIterator):
     def __init__(self, source: DataSetIterator, queue_size: int = 4,
-                 device_put: bool = True, device=None):
+                 device_put: bool = True, device=None, callback=None):
+        """`callback` is a DataSetCallback (data/utility_iterators.py)
+        applied to each batch on the prefetch thread AFTER the default
+        device_put — the reference's DataSetCallback seam
+        (AsyncDataSetIterator.java callback ctor arg); pass
+        InterleavedDataSetCallback to round-robin batches over devices
+        (set device_put=False so the callback owns placement)."""
         if getattr(source, "async_supported", True) is False:
             # AsyncShieldDataSetIterator semantics: pass through unwrapped
             self._passthrough = source
@@ -34,6 +40,7 @@ class AsyncDataSetIterator(DataSetIterator):
         self._queue_size = int(queue_size)
         self._device_put = device_put
         self._device = device
+        self._callback = callback
 
     def reset(self):
         self._source.reset()
@@ -69,6 +76,9 @@ class AsyncDataSetIterator(DataSetIterator):
                         None if ds.features_mask is None else jax.device_put(ds.features_mask, dev),
                         None if ds.labels_mask is None else jax.device_put(ds.labels_mask, dev),
                     )
+                if self._callback is not None:
+                    out = self._callback.call(ds)
+                    ds = ds if out is None else out
                 if not self._put(q, stop, ds):
                     return
         except BaseException as e:      # surface worker errors to the consumer
@@ -78,8 +88,17 @@ class AsyncDataSetIterator(DataSetIterator):
 
     def __iter__(self):
         if self._passthrough is not None:
-            return iter(self._passthrough)
+            # shielded sources skip the prefetch thread, but the callback
+            # contract (device placement) must still hold
+            if self._callback is None:
+                return iter(self._passthrough)
+            return self._iter_passthrough()
         return self._iter_async()
+
+    def _iter_passthrough(self):
+        for ds in self._passthrough:
+            out = self._callback.call(ds)
+            yield ds if out is None else out
 
     def _iter_async(self):
         q: "queue.Queue" = queue.Queue(maxsize=self._queue_size)

@@ -9,9 +9,21 @@ plain iterable), the async MULTI-dataset shield
 `ReconstructionDataSetIterator`, `AsyncShieldDataSetIterator`,
 `BenchmarkDataSetIterator`, `SingletonMultiDataSetIterator`,
 `IteratorMultiDataSetIterator`, `EarlyTerminationMultiDataSetIterator`,
-`MultiDataSetWrapperIterator` and `MultiDataSetIteratorSplitter`.
-`Floats/Doubles/INDArrayDataSetIterator` collapse into
-`ArrayDataSetIterator` (numpy is the only array currency here).
+`MultiDataSetWrapperIterator` and `MultiDataSetIteratorSplitter`, plus
+(round 5) the full tail: `AbstractDataSetIterator` with the typed
+`Floats/Doubles/INDArrayDataSetIterator` variants, `ListDataSetIterator`,
+`FileSplitDataSetIterator` (+ save_dataset/load_dataset),
+`Dummy/Combined[MultiDataSet]PreProcessor`,
+`WorkspacesShieldDataSetIterator` (device-donation detach analog),
+`MovingWindowBaseDataSetIterator`, the `DataSetCallback` family
+(Default/Interleaved per-device prefetch), and
+`JointParallelDataSetIterator` with PASS/STOP/RESET inequality handling.
+
+Not reproduced (internal plumbing their Java ancestors needed but numpy/
+JSON make moot): `BaseFileIterator`'s temp-file shuffling,
+`DataSetDeserializer` (binary serde — .npz here), `MultiBoolean` (bitset
+helper), `FileSplitParallelDataSetIterator` (compose
+`FileSplitDataSetIterator` + `JointParallelDataSetIterator`).
 """
 from __future__ import annotations
 
@@ -324,3 +336,339 @@ class MultiDataSetIteratorSplitter(DataSetIteratorSplitter):
     items, so the whole split/rewind machinery (including the
     rewind-on-early-break invariant) is shared with the DataSet
     variant."""
+
+
+# ---------------------------------------------------------------------------
+# round-5 tail: typed pair-backed iterators, list re-batching, file splits,
+# pre-processor combinators, detach shield, moving windows, per-device
+# callbacks, joint parallel iteration — the remainder of the reference's
+# deeplearning4j-utility-iterators inventory.
+# ---------------------------------------------------------------------------
+
+class AbstractDataSetIterator(DataSetIterator):
+    """Batch an iterable of (features, labels) pairs
+    (reference AbstractDataSetIterator.java — the backing for the typed
+    Floats/Doubles/INDArray variants)."""
+    _dtype = None               # None = keep the pairs' own dtype
+
+    def __init__(self, iterable: Iterable, batch_size: int = 8):
+        self._iterable = iterable
+        self._batch = int(batch_size)
+
+    def batch_size(self):
+        return self._batch
+
+    def reset(self):
+        if hasattr(self._iterable, "reset"):
+            self._iterable.reset()
+
+    def __iter__(self):
+        feats, labs = [], []
+
+        def flush():
+            ds = DataSet(np.stack(feats), np.stack(labs))
+            feats.clear()
+            labs.clear()
+            return self._pp(ds)
+
+        for f, lab in self._iterable:
+            feats.append(np.asarray(f, self._dtype))
+            labs.append(np.asarray(lab, self._dtype))
+            if len(feats) == self._batch:
+                yield flush()
+        if feats:
+            yield flush()
+
+
+class FloatsDataSetIterator(AbstractDataSetIterator):
+    """float32 pair iterator (reference FloatsDataSetIterator.java)."""
+    _dtype = np.float32
+
+
+class DoublesDataSetIterator(AbstractDataSetIterator):
+    """float64 pair iterator (reference DoublesDataSetIterator.java)."""
+    _dtype = np.float64
+
+
+class INDArrayDataSetIterator(AbstractDataSetIterator):
+    """Array-pair iterator keeping the source dtype
+    (reference INDArrayDataSetIterator.java; ndarray == numpy here)."""
+    _dtype = None
+
+
+class ListDataSetIterator(DataSetIterator):
+    """Re-batch a collection of (often single-example) DataSets
+    (reference ListDataSetIterator.java)."""
+
+    def __init__(self, datasets: List[DataSet], batch: int = 32):
+        self._datasets = list(datasets)
+        self._batch = int(batch)
+
+    def batch_size(self):
+        return self._batch
+
+    def reset(self):
+        pass
+
+    def __iter__(self):
+        def cat(arrs):
+            if any(a is None for a in arrs):
+                return None
+            return np.concatenate([np.asarray(a) for a in arrs])
+
+        pend: List[DataSet] = []
+        n = 0
+        for ds in self._datasets:
+            pend.append(ds)
+            n += ds.num_examples()
+            while n >= self._batch:
+                take, rest, acc = [], [], 0
+                for d in pend:
+                    if acc < self._batch:
+                        room = self._batch - acc
+                        if d.num_examples() <= room:
+                            take.append(d)
+                            acc += d.num_examples()
+                        else:
+                            head, tail = d.split_test_and_train(room)
+                            take.append(head)
+                            rest.append(tail)
+                            acc += room
+                    else:
+                        rest.append(d)
+                yield self._pp(DataSet(
+                    cat([d.features for d in take]),
+                    cat([d.labels for d in take]),
+                    cat([d.features_mask for d in take]),
+                    cat([d.labels_mask for d in take])))
+                pend, n = rest, sum(d.num_examples() for d in rest)
+        if pend:
+            yield self._pp(DataSet(
+                *(cat([getattr(d, a) for d in pend])
+                  for a in ("features", "labels", "features_mask",
+                            "labels_mask"))))
+
+
+class DummyPreProcessor:
+    """No-op pre-processor (reference DummyPreProcessor.java). Implements
+    the same `preprocess` contract as data/normalization.py so it attaches
+    via iterator.set_pre_processor."""
+
+    def preprocess(self, ds):
+        return ds
+
+
+class CombinedPreProcessor:
+    """Chain pre-processors in order (reference CombinedPreProcessor.java,
+    minus the Jackson builder). Members follow the codebase-wide
+    `preprocess(ds) -> ds` contract (DataSetPreProcessor,
+    data/normalization.py), so existing normalizers compose directly."""
+
+    def __init__(self, *pre_processors):
+        self._pps = pre_processors
+
+    def preprocess(self, ds):
+        for pp in self._pps:
+            out = pp.preprocess(ds)
+            ds = ds if out is None else out
+        return ds
+
+
+class CombinedMultiDataSetPreProcessor(CombinedPreProcessor):
+    """MultiDataSet variant (reference CombinedMultiDataSetPreProcessor)."""
+
+
+class WorkspacesShieldDataSetIterator(DataSetIterator):
+    """Detach every yielded DataSet into fresh host arrays
+    (reference WorkspacesShieldDataSetIterator.java detaches workspace
+    buffers; here the hazard is holding references into device buffers
+    that a later jitted step DONATES — np.array copies make the batch
+    safe to retain)."""
+
+    def __init__(self, source: DataSetIterator):
+        self._source = source
+
+    def batch_size(self):
+        return self._source.batch_size()
+
+    def reset(self):
+        self._source.reset()
+
+    def __iter__(self):
+        for ds in self._source:
+            yield self._pp(DataSet(*(
+                None if a is None else np.array(a)
+                for a in (ds.features, ds.labels, ds.features_mask,
+                          ds.labels_mask))))
+
+
+class MovingWindowBaseDataSetIterator(DataSetIterator):
+    """Sliding example windows over one DataSet
+    (reference MovingWindowBaseDataSetIterator + MovingWindowDataSetFetcher:
+    every window of `window` consecutive examples, advancing by `stride`)."""
+
+    def __init__(self, dataset: DataSet, window: int, stride: int = None):
+        self._ds = dataset
+        self._window = int(window)
+        self._stride = int(stride) if stride else self._window
+
+    def batch_size(self):
+        return self._window
+
+    def reset(self):
+        pass
+
+    def __iter__(self):
+        n = self._ds.num_examples()
+
+        def cut(a, lo, hi):
+            return None if a is None else np.asarray(a)[lo:hi]
+
+        for lo in range(0, max(n - self._window, 0) + 1, self._stride):
+            hi = lo + self._window
+            if hi > n:
+                break
+            yield self._pp(DataSet(
+                cut(self._ds.features, lo, hi),
+                cut(self._ds.labels, lo, hi),
+                cut(self._ds.features_mask, lo, hi),
+                cut(self._ds.labels_mask, lo, hi)))
+
+
+def save_dataset(ds: DataSet, path: str) -> None:
+    """Persist one DataSet as an .npz (the file currency of
+    FileSplitDataSetIterator; reference DataSets serialize via
+    DataSet.save)."""
+    arrays = {}
+    for key in ("features", "labels", "features_mask", "labels_mask"):
+        a = getattr(ds, key)
+        if a is not None:
+            arrays[key] = np.asarray(a)
+    np.savez(path, **arrays)
+
+
+def load_dataset(path: str) -> DataSet:
+    with np.load(path) as z:
+        return DataSet(*(z[k] if k in z else None
+                         for k in ("features", "labels", "features_mask",
+                                   "labels_mask")))
+
+
+class FileSplitDataSetIterator(DataSetIterator):
+    """One DataSet per file (reference FileSplitDataSetIterator.java:
+    list of files + a FileCallback that turns each file into a DataSet;
+    default callback loads the .npz written by save_dataset)."""
+
+    def __init__(self, files: List[str], callback=None):
+        self._files = list(files)
+        self._callback = callback or load_dataset
+
+    def batch_size(self):
+        return None
+
+    def reset(self):
+        pass
+
+    def __iter__(self):
+        for path in self._files:
+            yield self._pp(self._callback(path))
+
+
+# ------------------------------------------------------- device callbacks
+
+class DataSetCallback:
+    """Hook applied to every prefetched batch inside AsyncDataSetIterator
+    (reference callback/DataSetCallback.java)."""
+
+    def call(self, ds):
+        return ds
+
+
+class DefaultCallback(DataSetCallback):
+    """Pin each batch to one device (reference DefaultCallback.java does
+    the workspace/device touch; here an explicit jax.device_put so the
+    host->HBM DMA happens on the prefetch thread)."""
+
+    def __init__(self, device=None):
+        self._device = device
+
+    def call(self, ds):
+        import jax
+        dev = self._device or jax.local_devices()[0]
+        return DataSet(*(None if a is None else jax.device_put(a, dev)
+                         for a in (ds.features, ds.labels,
+                                   ds.features_mask, ds.labels_mask)))
+
+
+class InterleavedDataSetCallback(DataSetCallback):
+    """Round-robin consecutive batches across local devices (reference
+    callback/InterleavedDataSetCallback.java) — per-device prefetch for
+    multi-replica consumers without a sharded iterator."""
+
+    def __init__(self, devices=None):
+        self._devices = devices
+        self._i = 0
+
+    def call(self, ds):
+        import jax
+        devs = self._devices or jax.local_devices()
+        dev = devs[self._i % len(devs)]
+        self._i += 1
+        return DataSet(*(None if a is None else jax.device_put(a, dev)
+                         for a in (ds.features, ds.labels,
+                                   ds.features_mask, ds.labels_mask)))
+
+
+# --------------------------------------------------- joint parallel source
+
+class InequalityHandling:
+    """What JointParallelDataSetIterator does when one attached source
+    runs dry before the others (reference
+    parallel/JointParallelDataSetIterator.java + InequalityHandling)."""
+    PASS = "pass"               # skip the empty source, keep the rest
+    STOP_EVERYONE = "stop"      # end the whole joint stream
+    RESET = "reset"             # rewind the empty source and keep going
+
+
+class JointParallelDataSetIterator(DataSetIterator):
+    """Interleave several iterators round-robin — the per-device feed shape
+    ParallelWrapper consumes (reference JointParallelDataSetIterator).
+    `inequality` picks the semantics when sources are unequal length; RESET
+    loops short sources for one full pass of the longest."""
+
+    def __init__(self, *sources: DataSetIterator,
+                 inequality: str = InequalityHandling.PASS):
+        if not sources:
+            raise ValueError("need at least one source iterator")
+        self._sources = list(sources)
+        self._inequality = inequality
+
+    def batch_size(self):
+        return self._sources[0].batch_size()
+
+    def reset(self):
+        for s in self._sources:
+            s.reset()
+
+    def __iter__(self):
+        iters = [iter(s) for s in self._sources]
+        done = [False] * len(iters)          # exhausted at least once
+        while not all(done):
+            for i, it in enumerate(iters):
+                if done[i] and self._inequality != InequalityHandling.RESET:
+                    continue
+                try:
+                    yield self._pp(next(it))
+                except StopIteration:
+                    if self._inequality == InequalityHandling.STOP_EVERYONE:
+                        return
+                    done[i] = True
+                    if (self._inequality == InequalityHandling.RESET
+                            and not all(done)):
+                        # loop the short source until the longest finishes
+                        self._sources[i].reset()
+                        iters[i] = iter(self._sources[i])
+                        try:
+                            yield self._pp(next(iters[i]))
+                        except StopIteration:
+                            pass

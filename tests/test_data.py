@@ -531,3 +531,139 @@ def test_image_record_reader_end_to_end(tmp_path):
                                               label_index=-1,
                                               num_classes=2)))
     assert b.features.shape == (4, 8, 8, 1)
+
+
+# ------------------------------------------------ round-5 iterator tail
+
+class TestUtilityIteratorTail:
+    def test_typed_pair_iterators(self):
+        from deeplearning4j_tpu.data import (
+            DoublesDataSetIterator, FloatsDataSetIterator,
+            INDArrayDataSetIterator,
+        )
+        pairs = [(np.full(3, i), np.eye(2)[i % 2]) for i in range(5)]
+        it = FloatsDataSetIterator(pairs, batch_size=2)
+        batches = list(it)
+        assert [b.num_examples() for b in batches] == [2, 2, 1]
+        assert batches[0].features.dtype == np.float32
+        assert list(DoublesDataSetIterator(pairs, batch_size=5))[
+            0].features.dtype == np.float64
+        src = [(np.zeros(3, np.int16), np.zeros(2, np.int16))]
+        assert list(INDArrayDataSetIterator(src, 1))[
+            0].features.dtype == np.int16
+        # re-iterable: second pass yields the same batches
+        assert len(list(it)) == 3
+
+    def test_list_dataset_iterator_rebatches(self):
+        from deeplearning4j_tpu.data import DataSet, ListDataSetIterator
+        singles = [DataSet(np.full((1, 2), i, "float32"),
+                           np.eye(3, dtype="float32")[[i % 3]])
+                   for i in range(7)]
+        out = list(ListDataSetIterator(singles, batch=3))
+        assert [d.num_examples() for d in out] == [3, 3, 1]
+        np.testing.assert_array_equal(out[0].features[:, 0], [0, 1, 2])
+        np.testing.assert_array_equal(out[2].features[:, 0], [6])
+
+    def test_pre_processor_combinators(self):
+        from deeplearning4j_tpu.data import (
+            CombinedPreProcessor, DataSet, DummyPreProcessor,
+        )
+
+        class AddOne:
+            def preprocess(self, ds):
+                return DataSet(ds.features + 1, ds.labels)
+
+        ds = DataSet(np.zeros((2, 2), "float32"), np.zeros((2, 1), "float32"))
+        assert DummyPreProcessor().preprocess(ds) is ds
+        out = CombinedPreProcessor(AddOne(), DummyPreProcessor(),
+                                   AddOne()).preprocess(ds)
+        np.testing.assert_array_equal(np.asarray(out.features),
+                                      np.full((2, 2), 2.0))
+
+    def test_workspaces_shield_detaches(self):
+        from deeplearning4j_tpu.data import (
+            ArrayDataSetIterator, WorkspacesShieldDataSetIterator,
+        )
+        X = np.arange(8, dtype="float32").reshape(4, 2)
+        Y = np.eye(2, dtype="float32")[[0, 1, 0, 1]]
+        src = ArrayDataSetIterator(X, Y, batch_size=2)
+        batches = list(WorkspacesShieldDataSetIterator(src))
+        assert all(isinstance(b.features, np.ndarray) for b in batches)
+        batches[0].features[0, 0] = 99.0        # mutating the copy...
+        assert X[0, 0] == 0.0                   # ...never touches the source
+
+    def test_moving_window_iterator(self):
+        from deeplearning4j_tpu.data import (
+            DataSet, MovingWindowBaseDataSetIterator,
+        )
+        ds = DataSet(np.arange(10, dtype="float32")[:, None],
+                     np.arange(10, dtype="float32")[:, None])
+        wins = list(MovingWindowBaseDataSetIterator(ds, window=4, stride=3))
+        assert [tuple(np.asarray(w.features[:, 0]).astype(int))
+                for w in wins] == [(0, 1, 2, 3), (3, 4, 5, 6), (6, 7, 8, 9)]
+
+    def test_file_split_iterator_roundtrip(self, tmp_path):
+        from deeplearning4j_tpu.data import (
+            DataSet, FileSplitDataSetIterator, load_dataset, save_dataset,
+        )
+        files = []
+        for i in range(3):
+            ds = DataSet(np.full((2, 2), i, "float32"),
+                         np.eye(2, dtype="float32"))
+            p = str(tmp_path / f"ds{i}.npz")
+            save_dataset(ds, p)
+            files.append(p)
+        out = list(FileSplitDataSetIterator(files))
+        assert len(out) == 3
+        np.testing.assert_array_equal(out[2].features,
+                                      np.full((2, 2), 2.0))
+        one = load_dataset(files[1])
+        assert one.features_mask is None
+
+    def test_async_iterator_interleaved_callback(self):
+        import jax
+
+        from deeplearning4j_tpu.data import (
+            ArrayDataSetIterator, AsyncDataSetIterator,
+            InterleavedDataSetCallback,
+        )
+        X = np.random.RandomState(0).rand(16, 3).astype("float32")
+        Y = np.eye(2, dtype="float32")[np.arange(16) % 2]
+        it = AsyncDataSetIterator(
+            ArrayDataSetIterator(X, Y, batch_size=2),
+            device_put=False,
+            callback=InterleavedDataSetCallback(jax.devices()[:4]))
+        devs = [next(iter(b.features.devices())) for b in it]
+        assert len(devs) == 8
+        assert [d.id for d in devs] == [0, 1, 2, 3, 0, 1, 2, 3]
+
+    def test_joint_parallel_iterator_modes(self):
+        from deeplearning4j_tpu.data import (
+            ArrayDataSetIterator, InequalityHandling,
+            JointParallelDataSetIterator,
+        )
+
+        def src(n, val):
+            X = np.full((n, 2), val, "float32")
+            Y = np.eye(2, dtype="float32")[np.zeros(n, int)]
+            return ArrayDataSetIterator(X, Y, batch_size=1)
+
+        # PASS: short source drops out, long one keeps going
+        vals = [float(b.features[0, 0]) for b in
+                JointParallelDataSetIterator(
+                    src(2, 1.0), src(4, 2.0),
+                    inequality=InequalityHandling.PASS)]
+        assert vals == [1.0, 2.0, 1.0, 2.0, 2.0, 2.0]
+        # STOP_EVERYONE: the first exhaustion ends the joint stream
+        vals = [float(b.features[0, 0]) for b in
+                JointParallelDataSetIterator(
+                    src(2, 1.0), src(4, 2.0),
+                    inequality=InequalityHandling.STOP_EVERYONE)]
+        assert vals == [1.0, 2.0, 1.0, 2.0]
+        # RESET: short source loops until the longest finishes one pass
+        vals = [float(b.features[0, 0]) for b in
+                JointParallelDataSetIterator(
+                    src(2, 1.0), src(4, 2.0),
+                    inequality=InequalityHandling.RESET)]
+        assert vals[:6] == [1.0, 2.0, 1.0, 2.0, 1.0, 2.0]
+        assert vals.count(2.0) == 4

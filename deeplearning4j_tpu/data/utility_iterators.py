@@ -352,6 +352,12 @@ class AbstractDataSetIterator(DataSetIterator):
     _dtype = None               # None = keep the pairs' own dtype
 
     def __init__(self, iterable: Iterable, batch_size: int = 8):
+        # a one-shot generator would silently yield ZERO batches from the
+        # second epoch on (reset() can't rewind it) — materialize anything
+        # that can't rewind itself so multi-epoch fit() keeps training
+        if not (hasattr(iterable, "reset")
+                or isinstance(iterable, (list, tuple))):
+            iterable = list(iterable)
         self._iterable = iterable
         self._batch = int(batch_size)
 
@@ -653,22 +659,47 @@ class JointParallelDataSetIterator(DataSetIterator):
     def __iter__(self):
         iters = [iter(s) for s in self._sources]
         done = [False] * len(iters)          # exhausted at least once
+        if self._inequality != InequalityHandling.RESET:
+            while not all(done):
+                for i, it in enumerate(iters):
+                    if done[i]:
+                        continue
+                    try:
+                        yield self._pp(next(it))
+                    except StopIteration:
+                        if (self._inequality
+                                == InequalityHandling.STOP_EVERYONE):
+                            return
+                        done[i] = True
+            return
+        # RESET: loop short sources for exactly one full pass of the
+        # longest. Rounds are assembled before yielding so the round in
+        # which the LAST live source ends is discarded entirely — equal
+        # length sources never produce a spurious reset batch.
         while not all(done):
+            slots = [None] * len(iters)
+            fresh = [False] * len(iters)
             for i, it in enumerate(iters):
-                if done[i] and self._inequality != InequalityHandling.RESET:
+                if done[i]:
                     continue
                 try:
-                    yield self._pp(next(it))
+                    slots[i] = next(it)
+                    fresh[i] = True
                 except StopIteration:
-                    if self._inequality == InequalityHandling.STOP_EVERYONE:
-                        return
                     done[i] = True
-                    if (self._inequality == InequalityHandling.RESET
-                            and not all(done)):
-                        # loop the short source until the longest finishes
+            if all(done) and not any(fresh):
+                return               # the round where everything ended
+            if not all(done):
+                # refill the slots of already-finished sources by looping
+                for i in range(len(iters)):
+                    if not fresh[i]:
                         self._sources[i].reset()
                         iters[i] = iter(self._sources[i])
                         try:
-                            yield self._pp(next(iters[i]))
+                            slots[i] = next(iters[i])
+                            fresh[i] = True
                         except StopIteration:
                             pass
+            for i, s in enumerate(slots):
+                if fresh[i]:
+                    yield self._pp(s)

@@ -328,7 +328,13 @@ def _parse_layer(kind: str, d: dict):
         OutputLayer, RnnOutputLayer, SubsamplingLayer, Upsampling2D,
         ZeroPaddingLayer,
     )
-    act = _act_from(d.get("activationFn", d.get("activationFunction")))
+    raw_act = d.get("activationFn", d.get("activationFunction"))
+    act = _act_from(raw_act)
+    # default activations apply ONLY when the JSON omits the field — an
+    # explicit ActivationIdentity on an output head (the standard DL4J
+    # regression pattern, Identity + LossMSE) must survive as identity
+    head_act = "softmax" if raw_act is None else act
+    lstm_act = "tanh" if raw_act is None else act
     nin = int(d.get("nin", 0) or 0)
     nout = int(d.get("nout", 0) or 0)
     has_bias = bool(d.get("hasBias", True))
@@ -346,12 +352,12 @@ def _parse_layer(kind: str, d: dict):
                                has_bias=has_bias)]
     if kind == "output":
         return [OutputLayer(name=name, n_in=nin or None, n_out=nout,
-                            activation=act if act != "identity" else "softmax",
+                            activation=head_act,
                             loss=_loss_from(d.get("lossFn", d.get("lossFunction"))),
                             has_bias=has_bias)]
     if kind == "rnnoutput":
         return [RnnOutputLayer(name=name, n_in=nin or None, n_out=nout,
-                               activation=act if act != "identity" else "softmax",
+                               activation=head_act,
                                loss=_loss_from(d.get("lossFn", d.get("lossFunction"))),
                                )]
     if kind == "loss":
@@ -390,7 +396,7 @@ def _parse_layer(kind: str, d: dict):
             lock_gamma_beta=bool(d.get("lockGammaBeta", False)))]
     if kind == "LSTM":
         return [LSTM(name=name, n_in=nin or None, n_out=nout,
-                     activation=act if act != "identity" else "tanh",
+                     activation=lstm_act,
                      gate_activation=_act_from(
                          d.get("gateActivationFn"), "sigmoid"),
                      forget_gate_bias_init=float(
@@ -711,10 +717,13 @@ def _updater_state_slots(u: upd.Updater) -> int:
             "AdaDelta": 2, "Sgd": 0, "NoOp": 0}.get(name, 0)
 
 
-def _load_updater_state(net, owner, flat: np.ndarray) -> None:
+def _graft_updater_state(net, segments, flat: np.ndarray) -> None:
     """Graft the reference updater state view into the optax state tree.
-    Assumes the uniform-updater single-block layout (see module docstring);
-    anything else is skipped with a warning rather than mis-imported."""
+    `segments` is a list of (key, layer, post_type, raw_type, size) — the
+    flat-order contract for either container (layer index keys for
+    MultiLayerNetwork, vertex names for ComputationGraph). Assumes the
+    uniform-updater single-block layout (see module docstring); anything
+    else is skipped with a warning rather than mis-imported."""
     import logging
     import jax
     import jax.numpy as jnp
@@ -722,7 +731,7 @@ def _load_updater_state(net, owner, flat: np.ndarray) -> None:
 
     u = net.conf.updater
     slots = _updater_state_slots(u)
-    n = sum(size for *_x, size in _segments(net, owner))
+    n = sum(size for *_x, size in segments)
     if slots == 0 or flat.size != slots * n:
         if flat.size:
             logging.getLogger("deeplearning4j_tpu").warning(
@@ -735,15 +744,15 @@ def _load_updater_state(net, owner, flat: np.ndarray) -> None:
     def decode_slot(slot_flat):
         tree = {}
         offset = 0
-        for our_i, layer, in_type, raw_in, size in _segments(net, owner):
+        for key, layer, in_type, raw_in, size in segments:
             params, state = _decode_layer_params(
                 layer, in_type, slot_flat[offset:offset + size], raw_in)
             merged = dict(params)
             merged.update(state)        # BN mean/var not in optax state; drop below
-            tree[str(our_i)] = {
+            tree[key] = {
                 k: jnp.asarray(np.asarray(v, np.float32).reshape(
-                    np.asarray(net.params[str(our_i)][k]).shape))
-                for k, v in merged.items() if k in net.params[str(our_i)]}
+                    np.asarray(net.params[key][k]).shape))
+                for k, v in merged.items() if k in net.params[key]}
             offset += size
         return tree
 
@@ -760,12 +769,19 @@ def _load_updater_state(net, owner, flat: np.ndarray) -> None:
         return out
 
     name = type(u).__name__
+    amsgrad_state = getattr(optax, "ScaleByAmsgradState", ())
     new_state = []
     for s in net.opt_state if isinstance(net.opt_state, tuple) else (net.opt_state,):
         if isinstance(s, optax.ScaleByAdamState) and name in (
                 "Adam", "AdamW", "Nadam", "AdaMax"):
             s = s._replace(mu=fill(s.mu, slot_trees[0]),
                            nu=fill(s.nu, slot_trees[1]))
+        elif amsgrad_state and isinstance(s, amsgrad_state) \
+                and name == "AMSGrad":
+            # nd4j AMSGradUpdater state view = [m | v | vHat]
+            s = s._replace(mu=fill(s.mu, slot_trees[0]),
+                           nu=fill(s.nu, slot_trees[1]),
+                           nu_max=fill(s.nu_max, slot_trees[2]))
         elif isinstance(s, optax.TraceState) and name in ("Nesterovs",
                                                           "Momentum"):
             s = s._replace(trace=fill(s.trace, slot_trees[0]))
@@ -782,6 +798,12 @@ def _load_updater_state(net, owner, flat: np.ndarray) -> None:
         new_state.append(s)
     net.opt_state = (tuple(new_state)
                      if isinstance(net.opt_state, tuple) else new_state[0])
+
+
+def _load_updater_state(net, owner, flat: np.ndarray) -> None:
+    _graft_updater_state(
+        net, [(str(i), lay, post, raw, size)
+              for i, lay, post, raw, size in _segments(net, owner)], flat)
 
 
 # ======================================================================
@@ -922,3 +944,212 @@ def save_dl4j_model(net, path, save_updater: bool = True) -> None:
             buf = io.BytesIO()
             write_nd4j_array(buf, upd_flat)
             zf.writestr("updaterState.bin", buf.getvalue())
+
+
+# ======================================================================
+# ComputationGraph import (ModelSerializer.restoreComputationGraph)
+# ======================================================================
+
+def _parse_graph_vertex(body: dict):
+    """One non-layer GraphVertex JSON (WRAPPER_OBJECT, GraphVertex.java:40
+    subtype names) -> our GraphVertexConf."""
+    from deeplearning4j_tpu.nn.conf import graph_vertices as gv
+    (kind, d), = body.items()
+    d = d or {}
+    if kind == "MergeVertex":
+        return gv.MergeVertex()
+    if kind == "ElementWiseVertex":
+        return gv.ElementWiseVertex(op=(d.get("op") or "Add").lower())
+    if kind == "SubsetVertex":
+        return gv.SubsetVertex(from_idx=int(d.get("from", 0)),
+                               to_idx=int(d.get("to", 0)))
+    if kind == "ScaleVertex":
+        return gv.ScaleVertex(scale=float(d.get("scaleFactor", 1.0)))
+    if kind == "ShiftVertex":
+        return gv.ShiftVertex(shift=float(d.get("shiftFactor", 0.0)))
+    if kind == "StackVertex":
+        return gv.StackVertex()
+    if kind == "UnstackVertex":
+        return gv.UnstackVertex(from_idx=int(d.get("from", 0)),
+                                stack_size=int(d.get("stackSize", 1)))
+    if kind == "L2Vertex":
+        return gv.L2Vertex()
+    if kind == "L2NormalizeVertex":
+        return gv.L2NormalizeVertex()
+    if kind == "ReverseTimeSeriesVertex":
+        return gv.ReverseTimeSeriesVertex()
+    if kind == "LastTimeStepVertex":
+        return gv.LastTimeStepVertex()
+    if kind == "DuplicateToTimeSeriesVertex":
+        return gv.DuplicateToTimeSeriesVertex()
+    if kind == "PoolHelperVertex":
+        return gv.PoolHelperVertex()
+    raise UnsupportedLayerError(f"unsupported DL4J graph vertex: {kind!r}")
+
+
+def _dl4j_topo_order(network_inputs, vertex_names, vertex_inputs):
+    """Reproduce ComputationGraph.topologicalSortOrder() (Kahn's algorithm
+    over indices assigned inputs-first then JSON vertex order, FIFO queue,
+    ascending tie-break) — this IS the flat parameter order contract."""
+    from collections import deque
+    names = list(network_inputs) + list(vertex_names)
+    idx = {n: i for i, n in enumerate(names)}
+    incoming = {i: set() for i in range(len(names))}
+    outgoing = {i: set() for i in range(len(names))}
+    for vn in vertex_names:
+        for src in vertex_inputs.get(vn, []) or []:
+            incoming[idx[vn]].add(idx[src])
+            outgoing[idx[src]].add(idx[vn])
+    q = deque(sorted(i for i in range(len(names)) if not incoming[i]))
+    out = []
+    while q:
+        nxt = q.popleft()
+        out.append(nxt)
+        for o in sorted(outgoing[nxt]):
+            incoming[o].discard(nxt)
+            if not incoming[o]:
+                q.append(o)
+    if len(out) != len(names):
+        raise ValueError("cycle in ComputationGraph configuration")
+    return [names[i] for i in out]
+
+
+def parse_dl4j_graph_conf(conf_json: str, input_types=None):
+    """Reference ComputationGraphConfiguration JSON -> (our
+    ComputationGraphConfiguration, layer-vertex names in the reference's
+    flat-parameter order)."""
+    from deeplearning4j_tpu.nn.conf.network import GraphBuilder
+
+    d = json.loads(conf_json)
+    if "vertices" not in d or "networkInputs" not in d:
+        raise ValueError("not a ComputationGraphConfiguration "
+                         "('vertices'/'networkInputs' missing)")
+    net_inputs = list(d["networkInputs"])
+    net_outputs = list(d.get("networkOutputs", []))
+    vertices = d["vertices"]                 # JSON object order preserved
+    vertex_inputs = d.get("vertexInputs", {})
+
+    from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+    parent = NeuralNetConfiguration.Builder()
+    updater = None
+    seed = 0
+    g = GraphBuilder(parent).add_inputs(*net_inputs)
+
+    layer_owner: Dict[str, Any] = {}         # vertex name -> param layer
+    for vname, vbody in vertices.items():
+        (vkind, vd), = vbody.items()
+        ins = list(vertex_inputs.get(vname, []))
+        if vkind == "LayerVertex":
+            nnconf = vd.get("layerConf") or {}
+            seed = int(nnconf.get("seed", seed) or 0)
+            (lkind, lbody), = nnconf["layer"].items()
+            iupd = lbody.get("iUpdater")
+            if updater is None and iupd is not None:
+                updater = _updater_from(iupd)
+            expansion = _apply_common(_parse_layer(lkind, lbody), lbody)
+            prev = ins
+            for i, lay in enumerate(expansion):
+                last = i == len(expansion) - 1
+                nm = vname if last else f"{vname}__pre{i}"
+                g.add_layer(nm, lay, *prev)
+                prev = [nm]
+            layer_owner[vname] = expansion[-1]
+        else:
+            g.add_vertex(vname, _parse_graph_vertex(vbody), *ins)
+    g.set_outputs(*net_outputs)
+
+    parent._seed = seed
+    parent._updater = updater or upd.Sgd(1e-2)
+    bp = d.get("backpropType") or "Standard"
+    if bp == "TruncatedBPTT":
+        g.backprop_type("tbptt", int(d.get("tbpttFwdLength", 20) or 20),
+                        int(d.get("tbpttBackLength", 20) or 20))
+    if input_types is not None:
+        g.set_input_types(*input_types)
+    else:
+        inferred = []
+        # mirror _infer_input_type's restriction: only genuinely
+        # feed-forward consumers allow FF inference — an LSTM/Conv nin
+        # would silently build the wrong input kind
+        _FF_CONSUMERS = ("DenseLayer", "OutputLayer", "EmbeddingLayer",
+                         "ElementWiseMultiplicationLayer")
+        for iname in net_inputs:
+            ft = None
+            for vname, lay in layer_owner.items():
+                if iname in (vertex_inputs.get(vname) or []) and \
+                        type(lay).__name__ in _FF_CONSUMERS and \
+                        getattr(lay, "n_in", None):
+                    ft = InputType.feed_forward(lay.n_in)
+                    break
+            if ft is None:
+                raise ValueError(
+                    f"cannot infer the input type of graph input {iname!r}; "
+                    "pass input_types=[InputType...] in network-input order")
+            inferred.append(ft)
+        g.set_input_types(*inferred)
+
+    topo = _dl4j_topo_order(net_inputs, list(vertices.keys()), vertex_inputs)
+    layer_order = [n for n in topo if n in layer_owner]
+    return g.build(), layer_order
+
+
+def _graph_segments(gnet, layer_order):
+    """(vertex_name, layer, post_in_type, raw_in_type, size) per
+    param-carrying vertex, in the reference's flat order."""
+    from deeplearning4j_tpu.nn.conf.base import preprocessed_type
+    for name in layer_order:
+        vd = gnet.conf.vertices[name]
+        layer = vd.vertex
+        raw = gnet._vertex_types[vd.inputs[0]]
+        post = raw
+        need = gnet._pre_kind[name]
+        if need is not None and raw.kind != need:
+            post = preprocessed_type(raw, need)
+        size = _layer_num_params(layer, post)
+        if size:
+            yield name, layer, post, raw, size
+
+
+def restore_computation_graph(path, load_updater: bool = True,
+                              input_types=None):
+    """Load a reference-produced ComputationGraph model zip
+    (ModelSerializer.restoreComputationGraph, ModelSerializer.java:250+)
+    into a ready-to-run ComputationGraph. `input_types` is a sequence of
+    InputType in networkInputs order (required unless every graph input
+    feeds a layer that declares nin)."""
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    with zipfile.ZipFile(path, "r") as zf:
+        names = set(zf.namelist())
+        conf_json = zf.read("configuration.json").decode("utf-8")
+        coeffs = (read_nd4j_array(io.BytesIO(zf.read("coefficients.bin")))
+                  if "coefficients.bin" in names else None)
+        updater_state = (read_nd4j_array(io.BytesIO(zf.read("updaterState.bin")))
+                         if "updaterState.bin" in names and load_updater
+                         else None)
+
+    conf, layer_order = parse_dl4j_graph_conf(conf_json, input_types)
+    gnet = ComputationGraph(conf).init()
+
+    if coeffs is not None:
+        flat = np.asarray(coeffs, np.float32).ravel()
+        offset = 0
+        for name, layer, post, raw, size in _graph_segments(gnet,
+                                                            layer_order):
+            params, state = _decode_layer_params(
+                layer, post, flat[offset:offset + size], raw)
+            _graft(gnet, name, params, state)
+            offset += size
+        if offset != flat.size:
+            raise ValueError(f"coefficients.bin length mismatch: consumed "
+                             f"{offset} of {flat.size} values")
+        if updater_state is not None:
+            _load_graph_updater_state(
+                gnet, layer_order,
+                np.asarray(updater_state, np.float32).ravel())
+    return gnet
+
+
+def _load_graph_updater_state(gnet, layer_order, flat: np.ndarray) -> None:
+    _graft_updater_state(gnet, list(_graph_segments(gnet, layer_order)),
+                         flat)

@@ -667,3 +667,30 @@ class TestUtilityIteratorTail:
                     inequality=InequalityHandling.RESET)]
         assert vals[:6] == [1.0, 2.0, 1.0, 2.0, 1.0, 2.0]
         assert vals.count(2.0) == 4
+
+
+class TestUtilityIteratorTailFixes:
+    def test_reset_mode_equal_length_no_spurious_batch(self):
+        from deeplearning4j_tpu.data import (
+            ArrayDataSetIterator, InequalityHandling,
+            JointParallelDataSetIterator,
+        )
+
+        def src(n, val):
+            X = np.full((n, 2), val, "float32")
+            Y = np.eye(2, dtype="float32")[np.zeros(n, int)]
+            return ArrayDataSetIterator(X, Y, batch_size=1)
+
+        vals = [float(b.features[0, 0]) for b in
+                JointParallelDataSetIterator(
+                    src(2, 1.0), src(2, 2.0),
+                    inequality=InequalityHandling.RESET)]
+        assert vals == [1.0, 2.0, 1.0, 2.0]     # no reset tail
+
+    def test_typed_iterator_materializes_generator(self):
+        from deeplearning4j_tpu.data import FloatsDataSetIterator
+        gen = ((np.full(2, i), np.eye(2)[i % 2]) for i in range(4))
+        it = FloatsDataSetIterator(gen, batch_size=2)
+        assert len(list(it)) == 2
+        it.reset()
+        assert len(list(it)) == 2               # second epoch still trains

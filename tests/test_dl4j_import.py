@@ -488,3 +488,136 @@ def test_adadelta_updater_state():
                                rtol=1e-6)
     np.testing.assert_allclose(np.asarray(st.e_x["1"]["b"]), msdx[-3:],
                                rtol=1e-6)
+
+
+def test_regression_head_identity_survives_import():
+    """Explicit ActivationIdentity + LossMSE (the standard DL4J regression
+    head) must NOT be rewritten to softmax on import."""
+    rs = np.random.RandomState(12)
+    W = rs.randn(3, 1).astype(np.float32)
+    b = rs.randn(1).astype(np.float32)
+    flat = np.concatenate([W.ravel(order="F"), b])
+    cj = _conf_json([
+        ("output", {"activationFn": _act("Identity"), "nin": 3, "nout": 1,
+                    "hasBias": True,
+                    "lossFn": {"@class":
+                               "org.nd4j.linalg.lossfunctions.impl.LossMSE"}}),
+    ])
+    net = restore_multilayer_network(_zip_bytes(cj, flat))
+    assert net.layers[0].activation == "identity"
+    assert net.layers[0].loss == "mse"
+    x = rs.randn(4, 3).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(net.output(x)), x @ W + b,
+                               rtol=1e-5, atol=1e-6)
+    # absent activationFn still defaults to softmax
+    cj2 = _conf_json([
+        ("output", {"nin": 3, "nout": 2, "hasBias": True,
+                    "lossFn": {"@class":
+                               "org.nd4j.linalg.lossfunctions.impl.LossMCXENT"}}),
+    ])
+    flat2 = np.concatenate([rs.randn(6).astype(np.float32),
+                            rs.randn(2).astype(np.float32)])
+    net2 = restore_multilayer_network(_zip_bytes(cj2, flat2))
+    assert net2.layers[0].activation == "softmax"
+
+
+# ----------------------------------------------------------- graph import
+
+def _graph_zip(vertices, vertex_inputs, inputs, outputs, flat,
+               updater=None):
+    conf = {"networkInputs": inputs, "networkOutputs": outputs,
+            "vertices": vertices, "vertexInputs": vertex_inputs,
+            "backprop": True, "backpropType": "Standard"}
+    return _zip_bytes(json.dumps(conf), flat, updater)
+
+
+def _layer_vertex(kind, body):
+    body = dict(body)
+    body.setdefault("iUpdater", _adam())
+    return {"LayerVertex": {"layerConf": {"layer": {kind: body},
+                                          "seed": 12345}}}
+
+
+def test_graph_import_merge_topology():
+    """Branching graph: in -> d1, in -> d2, merge(d1,d2) -> output. Flat
+    params follow the reference's Kahn topological order (in,d1,d2,out)."""
+    from deeplearning4j_tpu.modelimport.dl4j import restore_computation_graph
+
+    rs = np.random.RandomState(20)
+    W1 = rs.randn(4, 3).astype(np.float32)
+    b1 = rs.randn(3).astype(np.float32)
+    W2 = rs.randn(4, 5).astype(np.float32)
+    b2 = rs.randn(5).astype(np.float32)
+    Wo = rs.randn(8, 2).astype(np.float32)
+    bo = rs.randn(2).astype(np.float32)
+    flat = np.concatenate([W1.ravel(order="F"), b1,
+                           W2.ravel(order="F"), b2,
+                           Wo.ravel(order="F"), bo])
+    vertices = {
+        "d1": _layer_vertex("dense", {"activationFn": _act("TanH"),
+                                      "nin": 4, "nout": 3,
+                                      "hasBias": True}),
+        "d2": _layer_vertex("dense", {"activationFn": _act_relu(),
+                                      "nin": 4, "nout": 5,
+                                      "hasBias": True}),
+        "m": {"MergeVertex": {}},
+        "out": _layer_vertex("output", {
+            "activationFn": _act("Softmax"), "nin": 8, "nout": 2,
+            "hasBias": True,
+            "lossFn": {"@class":
+                       "org.nd4j.linalg.lossfunctions.impl.LossMCXENT"}}),
+    }
+    vertex_inputs = {"d1": ["in"], "d2": ["in"], "m": ["d1", "d2"],
+                     "out": ["m"]}
+    gnet = restore_computation_graph(_graph_zip(
+        vertices, vertex_inputs, ["in"], ["out"], flat))
+    x = rs.randn(6, 4).astype(np.float32)
+    h1 = np.tanh(x @ W1 + b1)
+    h2 = np.maximum(x @ W2 + b2, 0)
+    oracle = _softmax(np.concatenate([h1, h2], 1) @ Wo + bo)
+    out = gnet.output(x)
+    ours = np.asarray(out[0] if isinstance(out, (list, tuple)) else out)
+    np.testing.assert_allclose(ours, oracle, rtol=1e-5, atol=1e-6)
+
+
+def test_graph_import_updater_state_and_elementwise_vertex():
+    from deeplearning4j_tpu.modelimport.dl4j import restore_computation_graph
+
+    rs = np.random.RandomState(21)
+    W1 = rs.randn(4, 4).astype(np.float32)
+    b1 = rs.randn(4).astype(np.float32)
+    Wo = rs.randn(4, 2).astype(np.float32)
+    bo = rs.randn(2).astype(np.float32)
+    flat = np.concatenate([W1.ravel(order="F"), b1,
+                           Wo.ravel(order="F"), bo])
+    vertices = {
+        "d1": _layer_vertex("dense", {"activationFn": _act("TanH"),
+                                      "nin": 4, "nout": 4,
+                                      "hasBias": True}),
+        "res": {"ElementWiseVertex": {"op": "Add"}},
+        "out": _layer_vertex("output", {
+            "activationFn": _act("Softmax"), "nin": 4, "nout": 2,
+            "hasBias": True,
+            "lossFn": {"@class":
+                       "org.nd4j.linalg.lossfunctions.impl.LossMCXENT"}}),
+    }
+    vertex_inputs = {"d1": ["in"], "res": ["d1", "in"], "out": ["res"]}
+    n = flat.size
+    m = rs.randn(n).astype(np.float32)
+    v = np.abs(rs.randn(n)).astype(np.float32)
+    gnet = restore_computation_graph(
+        _graph_zip(vertices, vertex_inputs, ["in"], ["out"], flat,
+                   updater=np.concatenate([m, v])))
+    x = rs.randn(3, 4).astype(np.float32)
+    h = np.tanh(x @ W1 + b1) + x                   # residual add
+    oracle = _softmax(h @ Wo + bo)
+    out = gnet.output(x)
+    ours = np.asarray(out[0] if isinstance(out, (list, tuple)) else out)
+    np.testing.assert_allclose(ours, oracle, rtol=1e-5, atol=1e-6)
+    import optax
+    adam = [s for s in gnet.opt_state
+            if isinstance(s, optax.ScaleByAdamState)][0]
+    np.testing.assert_allclose(np.asarray(adam.mu["d1"]["W"]),
+                               m[:16].reshape((4, 4), order="F"), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(adam.nu["out"]["b"]), v[-2:],
+                               rtol=1e-6)

@@ -690,9 +690,17 @@ class JointParallelDataSetIterator(DataSetIterator):
             if all(done) and not any(fresh):
                 return               # the round where everything ended
             if not all(done):
-                # refill the slots of already-finished sources by looping
+                # refill the slots of already-finished sources by looping:
+                # keep pulling from the CURRENT rewound iterator (so the
+                # short source cycles through all its batches), resetting
+                # only when it runs out again
                 for i in range(len(iters)):
-                    if not fresh[i]:
+                    if fresh[i]:
+                        continue
+                    try:
+                        slots[i] = next(iters[i])
+                        fresh[i] = True
+                    except StopIteration:
                         self._sources[i].reset()
                         iters[i] = iter(self._sources[i])
                         try:

@@ -2,8 +2,10 @@
 checkpoint artifact bridge (ModelSerializer zip format, both directions)."""
 from deeplearning4j_tpu.modelimport.keras import KerasModelImport
 from deeplearning4j_tpu.modelimport.dl4j import (
-    restore_computation_graph, restore_multilayer_network, save_dl4j_model,
+    add_normalizer_to_model, restore_computation_graph,
+    restore_multilayer_network, restore_normalizer, save_dl4j_model,
 )
 
-__all__ = ["KerasModelImport", "restore_computation_graph",
-           "restore_multilayer_network", "save_dl4j_model"]
+__all__ = ["KerasModelImport", "add_normalizer_to_model",
+           "restore_computation_graph", "restore_multilayer_network",
+           "restore_normalizer", "save_dl4j_model"]

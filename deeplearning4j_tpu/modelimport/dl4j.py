@@ -1153,3 +1153,106 @@ def restore_computation_graph(path, load_updater: bool = True,
 def _load_graph_updater_state(gnet, layer_order, flat: np.ndarray) -> None:
     _graft_updater_state(gnet, list(_graph_segments(gnet, layer_order)),
                          flat)
+
+
+# ======================================================================
+# normalizer.bin (ModelSerializer.addNormalizerToModel /
+# restoreNormalizerFromFile; nd4j NormalizerSerializer strategies)
+# ======================================================================
+#
+# Wire format (nd4j NormalizerSerializer + per-type strategy):
+#   Java-UTF header = NormalizerType enum name ("STANDARDIZE" | "MIN_MAX")
+#   STANDARDIZE (StandardizeSerializerStrategy):
+#       boolean fitLabel; Nd4j(mean); Nd4j(std) [; labelMean; labelStd]
+#   MIN_MAX (MinMaxSerializerStrategy):
+#       boolean fitLabel; double targetMin; double targetMax;
+#       Nd4j(min); Nd4j(max) [; labelMin; labelMax]
+
+def read_normalizer(f):
+    """Parse a normalizer.bin stream into this framework's normalizer
+    objects (data/normalization.py)."""
+    from deeplearning4j_tpu.data.normalization import (
+        NormalizerMinMaxScaler, NormalizerStandardize,
+    )
+    ntype = _read_java_utf(f)
+    if ntype == "STANDARDIZE":
+        fit_label = bool(f.read(1)[0])
+        norm = NormalizerStandardize(fit_labels=fit_label)
+        norm.feature_mean = read_nd4j_array(f).ravel().astype(np.float32)
+        norm.feature_std = read_nd4j_array(f).ravel().astype(np.float32)
+        if fit_label:
+            norm.label_mean = read_nd4j_array(f).ravel().astype(np.float32)
+            norm.label_std = read_nd4j_array(f).ravel().astype(np.float32)
+        return norm
+    if ntype == "MIN_MAX":
+        fit_label = bool(f.read(1)[0])
+        (lo,) = struct.unpack(">d", f.read(8))
+        (hi,) = struct.unpack(">d", f.read(8))
+        norm = NormalizerMinMaxScaler(lo=lo, hi=hi)
+        norm.feature_min = read_nd4j_array(f).ravel().astype(np.float32)
+        norm.feature_max = read_nd4j_array(f).ravel().astype(np.float32)
+        if fit_label:
+            # consume labelMin/labelMax so the stream position stays
+            # valid, but our MinMax scaler has no label-scaling mode —
+            # dropped loudly, not silently
+            read_nd4j_array(f)
+            read_nd4j_array(f)
+            import logging
+            logging.getLogger("deeplearning4j_tpu").warning(
+                "normalizer.bin MIN_MAX was fitted with fitLabel=true; "
+                "label min/max stats are dropped (NormalizerMinMaxScaler "
+                "here scales features only)")
+        return norm
+    raise UnsupportedLayerError(
+        f"unsupported normalizer type {ntype!r} in normalizer.bin "
+        "(STANDARDIZE and MIN_MAX import)")
+
+
+def write_normalizer(f, norm) -> None:
+    """Inverse of read_normalizer, for artifacts travelling back."""
+    from deeplearning4j_tpu.data.normalization import (
+        NormalizerMinMaxScaler, NormalizerStandardize,
+    )
+    if isinstance(norm, NormalizerStandardize):
+        _write_java_utf(f, "STANDARDIZE")
+        fit_label = norm.label_mean is not None
+        f.write(bytes([1 if fit_label else 0]))
+        write_nd4j_array(f, norm.feature_mean)
+        write_nd4j_array(f, norm.feature_std)
+        if fit_label:
+            write_nd4j_array(f, norm.label_mean)
+            write_nd4j_array(f, norm.label_std)
+        return
+    if isinstance(norm, NormalizerMinMaxScaler):
+        _write_java_utf(f, "MIN_MAX")
+        f.write(bytes([0]))
+        f.write(struct.pack(">d", norm.lo))
+        f.write(struct.pack(">d", norm.hi))
+        write_nd4j_array(f, norm.feature_min)
+        write_nd4j_array(f, norm.feature_max)
+        return
+    raise UnsupportedLayerError(
+        f"{type(norm).__name__} has no normalizer.bin mapping")
+
+
+def restore_normalizer(path):
+    """restoreNormalizerFromFile parity: read the normalizer saved inside
+    a model zip (returns None when the zip has no normalizer entry)."""
+    with zipfile.ZipFile(path, "r") as zf:
+        if "normalizer.bin" not in zf.namelist():
+            return None
+        return read_normalizer(io.BytesIO(zf.read("normalizer.bin")))
+
+
+def add_normalizer_to_model(path, norm) -> None:
+    """addNormalizerToModel parity: attach (or replace) the normalizer
+    entry of an existing model zip in place."""
+    with zipfile.ZipFile(path, "r") as zf:
+        entries = [(n, zf.read(n)) for n in zf.namelist()
+                   if n != "normalizer.bin"]
+    buf = io.BytesIO()
+    write_normalizer(buf, norm)
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
+        for n, data in entries:
+            zf.writestr(n, data)
+        zf.writestr("normalizer.bin", buf.getvalue())

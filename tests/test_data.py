@@ -694,3 +694,25 @@ class TestUtilityIteratorTailFixes:
         assert len(list(it)) == 2
         it.reset()
         assert len(list(it)) == 2               # second epoch still trains
+
+
+def test_reset_mode_short_source_cycles_all_batches():
+    """RESET must cycle the short source through ALL its batches, not
+    repeat only the first one after each reset."""
+    from deeplearning4j_tpu.data import (
+        ArrayDataSetIterator, InequalityHandling,
+        JointParallelDataSetIterator,
+    )
+
+    def src(vals):
+        X = np.asarray(vals, "float32")[:, None]
+        Y = np.eye(2, dtype="float32")[np.zeros(len(vals), int)]
+        return ArrayDataSetIterator(X, Y, batch_size=1)
+
+    out = [float(b.features[0, 0]) for b in
+           JointParallelDataSetIterator(
+               src([1, 2]), src([10, 20, 30, 40, 50]),
+               inequality=InequalityHandling.RESET)]
+    shorts = [v for v in out if v < 10]
+    assert shorts == [1.0, 2.0, 1.0, 2.0, 1.0]      # cycles, not 1,2,1,1,1
+    assert [v for v in out if v >= 10] == [10.0, 20.0, 30.0, 40.0, 50.0]

@@ -621,3 +621,119 @@ def test_graph_import_updater_state_and_elementwise_vertex():
                                m[:16].reshape((4, 4), order="F"), rtol=1e-6)
     np.testing.assert_allclose(np.asarray(adam.nu["out"]["b"]), v[-2:],
                                rtol=1e-6)
+
+
+# ----------------------------------------------------------- normalizer
+
+def test_normalizer_bin_roundtrip(tmp_path):
+    from deeplearning4j_tpu.data.normalization import (
+        NormalizerMinMaxScaler, NormalizerStandardize,
+    )
+    from deeplearning4j_tpu.modelimport import (
+        add_normalizer_to_model, restore_normalizer,
+    )
+
+    rs = np.random.RandomState(30)
+    _, cj, flat = _mlp_fixture(rs)
+    p = tmp_path / "model.zip"
+    with open(p, "wb") as fh:
+        fh.write(_zip_bytes(cj, flat).getvalue())
+
+    assert restore_normalizer(p) is None       # no entry yet
+
+    norm = NormalizerStandardize(fit_labels=True)
+    norm.feature_mean = rs.randn(4).astype(np.float32)
+    norm.feature_std = (np.abs(rs.randn(4)) + 0.5).astype(np.float32)
+    norm.label_mean = rs.randn(3).astype(np.float32)
+    norm.label_std = (np.abs(rs.randn(3)) + 0.5).astype(np.float32)
+    add_normalizer_to_model(p, norm)
+
+    back = restore_normalizer(p)
+    np.testing.assert_allclose(back.feature_mean, norm.feature_mean)
+    np.testing.assert_allclose(back.label_std, norm.label_std)
+    # the model entries survived the in-place rewrite
+    net = restore_multilayer_network(p)
+    x = rs.randn(2, 4).astype(np.float32)
+    assert np.asarray(net.output(x)).shape == (2, 3)
+
+    # min-max variant with target range
+    mm = NormalizerMinMaxScaler(lo=-1.0, hi=1.0)
+    mm.feature_min = rs.randn(4).astype(np.float32)
+    mm.feature_max = mm.feature_min + 2.0
+    add_normalizer_to_model(p, mm)             # replaces the entry
+    back2 = restore_normalizer(p)
+    assert isinstance(back2, NormalizerMinMaxScaler)
+    assert back2.lo == -1.0 and back2.hi == 1.0
+    np.testing.assert_allclose(back2.feature_max, mm.feature_max)
+
+
+def test_normalizer_bin_reference_layout():
+    """Byte-level check of the STANDARDIZE strategy layout: UTF type tag,
+    boolean fitLabel, then Nd4j arrays — so a reference-produced stream
+    parses correctly."""
+    import struct as _struct
+
+    from deeplearning4j_tpu.modelimport.dl4j import (
+        read_normalizer, write_nd4j_array,
+    )
+
+    buf = io.BytesIO()
+    tag = b"STANDARDIZE"
+    buf.write(_struct.pack(">H", len(tag)) + tag)
+    buf.write(bytes([0]))                      # fitLabel = false
+    write_nd4j_array(buf, np.asarray([1.0, 2.0], np.float32))
+    write_nd4j_array(buf, np.asarray([0.5, 0.25], np.float32))
+    buf.seek(0)
+    norm = read_normalizer(buf)
+    np.testing.assert_allclose(norm.feature_mean, [1.0, 2.0])
+    np.testing.assert_allclose(norm.feature_std, [0.5, 0.25])
+
+
+def test_golden_cnn_fixture():
+    """Committed reference-format CNN zip: NCHW fixture input is fed NHWC
+    here; outputs must match the NumPy NCHW oracle byte-stably."""
+    net = restore_multilayer_network(
+        os.path.join(FIXDIR, "cnn_mnistlike.zip"),
+        input_type=InputType.convolutional(10, 10, 1))
+    with open(os.path.join(FIXDIR, "cnn_mnistlike_expected.json")) as f:
+        exp = json.load(f)
+    x = np.asarray(exp["input_nchw"], np.float32).transpose(0, 2, 3, 1)
+    np.testing.assert_allclose(np.asarray(net.output(x)),
+                               np.asarray(exp["output"], np.float32),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_golden_lstm_fixture():
+    net = restore_multilayer_network(
+        os.path.join(FIXDIR, "lstm_chars.zip"),
+        input_type=InputType.recurrent(3, 6))
+    with open(os.path.join(FIXDIR, "lstm_chars_expected.json")) as f:
+        exp = json.load(f)
+    np.testing.assert_allclose(
+        np.asarray(net.output(np.asarray(exp["input"], np.float32))),
+        np.asarray(exp["output"], np.float32), rtol=1e-4, atol=1e-5)
+
+
+def test_normalizer_minmax_fitlabel_consumed_and_warned(caplog):
+    """fitLabel=true MIN_MAX streams parse fully (label arrays consumed)
+    and warn that label stats are dropped."""
+    import logging
+    import struct as _struct
+
+    from deeplearning4j_tpu.modelimport.dl4j import (
+        read_normalizer, write_nd4j_array,
+    )
+    buf = io.BytesIO()
+    tag = b"MIN_MAX"
+    buf.write(_struct.pack(">H", len(tag)) + tag)
+    buf.write(bytes([1]))                          # fitLabel = true
+    buf.write(_struct.pack(">d", 0.0))
+    buf.write(_struct.pack(">d", 1.0))
+    for arr in ([1.0, 2.0], [3.0, 4.0], [0.0], [1.0]):
+        write_nd4j_array(buf, np.asarray(arr, np.float32))
+    buf.seek(0)
+    with caplog.at_level(logging.WARNING, logger="deeplearning4j_tpu"):
+        norm = read_normalizer(buf)
+    np.testing.assert_allclose(norm.feature_max, [3.0, 4.0])
+    assert buf.read() == b""                       # fully consumed
+    assert any("fitLabel" in r.message for r in caplog.records)

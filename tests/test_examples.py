@@ -83,3 +83,8 @@ def test_custom_layer():
 @pytest.mark.slow
 def test_long_context_ring():
     _load("14_long_context_ring.py").main(epochs=4)
+
+
+def test_dl4j_artifact_migration(tmp_path):
+    assert _load("15_dl4j_artifact_migration.py").main(
+        tmpdir=str(tmp_path)) > 0.9

@@ -13,7 +13,6 @@ import io
 import json
 import os
 import struct
-import sys
 import zipfile
 
 import numpy as np
@@ -79,10 +78,11 @@ def main():
 
     zpath = os.path.join(FIXDIR, "mlp_mnistlike.zip")
     with zipfile.ZipFile(zpath, "w", zipfile.ZIP_DEFLATED) as zf:
-        zf.writestr("configuration.json", json.dumps(conf, indent=2))
+        zf.writestr(_entry("configuration.json"),
+                    json.dumps(conf, indent=2))
         buf = io.BytesIO()
         write_nd4j(buf, flat)
-        zf.writestr("coefficients.bin", buf.getvalue())
+        zf.writestr(_entry("coefficients.bin"), buf.getvalue())
 
     # independent oracle forward
     x = rs.randn(3, nin).astype(np.float32)
@@ -95,5 +95,134 @@ def main():
     print("wrote", zpath)
 
 
+def _softmax(z):
+    e = np.exp(z - z.max(-1, keepdims=True))
+    return e / e.sum(-1, keepdims=True)
+
+
+ACT = "org.nd4j.linalg.activations.impl.Activation"
+MCXENT = {"@class": "org.nd4j.linalg.lossfunctions.impl.LossMCXENT"}
+ADAM = {"@class": "org.nd4j.linalg.learning.config.Adam",
+        "learningRate": 1e-3, "beta1": 0.9, "beta2": 0.999,
+        "epsilon": 1e-8}
+
+
+FIXED_STAMP = (2026, 1, 1, 0, 0, 0)   # byte-deterministic regeneration
+
+
+def _entry(name):
+    return zipfile.ZipInfo(name, date_time=FIXED_STAMP)
+
+
+def _zip_model(name, confs, flat):
+    conf = {"backprop": True, "backpropType": "Standard", "pretrain": False,
+            "confs": confs}
+    zpath = os.path.join(FIXDIR, name)
+    with zipfile.ZipFile(zpath, "w", zipfile.ZIP_DEFLATED) as zf:
+        zf.writestr(_entry("configuration.json"),
+                    json.dumps(conf, indent=2))
+        buf = io.BytesIO()
+        write_nd4j(buf, flat)
+        zf.writestr(_entry("coefficients.bin"), buf.getvalue())
+    print("wrote", zpath)
+
+
+def _conf(kind, body):
+    body = dict(body)
+    body["iUpdater"] = ADAM
+    return {"layer": {kind: body}, "seed": 12345}
+
+
+def make_cnn():
+    """conv(1->4,3x3) relu -> maxpool 2x2 -> softmax(10) on 10x10x1,
+    reference NCHW layout; oracle = NumPy loops, NOT the importer."""
+    rs = np.random.RandomState(20260731)
+    Wc = (rs.randn(4, 1, 3, 3) * 0.4).astype(np.float32)   # (O,I,kh,kw)
+    bc = (rs.randn(4) * 0.1).astype(np.float32)
+    # 10x10 conv-valid -> 8x8, pool -> 4x4; flatten NCHW = 4*4*4 = 64
+    Wd = (rs.randn(64, 10) * 0.2).astype(np.float32)
+    bd = (rs.randn(10) * 0.1).astype(np.float32)
+    flat = np.concatenate([bc, Wc.ravel(order="C"),
+                           Wd.ravel(order="F"), bd])
+    confs = [
+        _conf("convolution", {"activationFn": {"@class": ACT + "ReLU"},
+                              "nin": 1, "nout": 4, "kernelSize": [3, 3],
+                              "stride": [1, 1], "padding": [0, 0],
+                              "convolutionMode": "Truncate",
+                              "hasBias": True}),
+        _conf("subsampling", {"kernelSize": [2, 2], "stride": [2, 2],
+                              "padding": [0, 0], "poolingType": "MAX",
+                              "convolutionMode": "Truncate"}),
+        _conf("output", {"activationFn": {"@class": ACT + "Softmax"},
+                         "nin": 64, "nout": 10, "hasBias": True,
+                         "lossFn": MCXENT}),
+    ]
+    _zip_model("cnn_mnistlike.zip", confs, flat)
+
+    x = rs.randn(2, 1, 10, 10).astype(np.float32)          # NCHW
+    B = x.shape[0]
+    h = np.zeros((B, 4, 8, 8), np.float32)
+    for i in range(8):
+        for j in range(8):
+            patch = x[:, :, i:i + 3, j:j + 3]
+            h[:, :, i, j] = np.einsum("bchw,ochw->bo", patch, Wc)
+    h = np.maximum(h + bc[None, :, None, None], 0)
+    p = np.zeros((B, 4, 4, 4), np.float32)
+    for i in range(4):
+        for j in range(4):
+            p[:, :, i, j] = h[:, :, 2 * i:2 * i + 2,
+                              2 * j:2 * j + 2].max((2, 3))
+    y = _softmax(p.reshape(B, -1) @ Wd + bd)
+    with open(os.path.join(FIXDIR, "cnn_mnistlike_expected.json"),
+              "w") as f:
+        json.dump({"input_nchw": x.tolist(), "output": y.tolist()}, f)
+
+
+def make_lstm():
+    """LSTM(3->5) -> rnnoutput softmax(2); reference IFOG gate order."""
+    rs = np.random.RandomState(20260732)
+    nin, H = 3, 5
+    W = (rs.randn(nin, 4 * H) * 0.4).astype(np.float32)
+    R = (rs.randn(H, 4 * H) * 0.4).astype(np.float32)
+    b = (rs.randn(4 * H) * 0.1).astype(np.float32)
+    Wo = (rs.randn(H, 2) * 0.4).astype(np.float32)
+    bo = (rs.randn(2) * 0.1).astype(np.float32)
+    flat = np.concatenate([W.ravel(order="F"), R.ravel(order="F"), b,
+                           Wo.ravel(order="F"), bo])
+    confs = [
+        _conf("LSTM", {"activationFn": {"@class": ACT + "TanH"},
+                       "nin": nin, "nout": H,
+                       "gateActivationFn": {"@class": ACT + "Sigmoid"},
+                       "forgetGateBiasInit": 1.0}),
+        _conf("rnnoutput", {"activationFn": {"@class": ACT + "Softmax"},
+                            "nin": H, "nout": 2, "lossFn": MCXENT}),
+    ]
+    _zip_model("lstm_chars.zip", confs, flat)
+
+    x = rs.randn(2, 6, nin).astype(np.float32)     # (B, T, F)
+
+    def sig(z):
+        return 1.0 / (1.0 + np.exp(-z))
+
+    B, T, _ = x.shape
+    h = np.zeros((B, H), np.float32)
+    c = np.zeros((B, H), np.float32)
+    hs = np.zeros((B, T, H), np.float32)
+    for t in range(T):
+        z = x[:, t] @ W + h @ R + b
+        i = sig(z[:, :H])
+        f = sig(z[:, H:2 * H])
+        o = sig(z[:, 2 * H:3 * H])            # reference IFOG block order
+        g = np.tanh(z[:, 3 * H:])
+        c = f * c + i * g
+        h = o * np.tanh(c)
+        hs[:, t] = h
+    y = _softmax(hs @ Wo + bo)
+    with open(os.path.join(FIXDIR, "lstm_chars_expected.json"), "w") as f:
+        json.dump({"input": x.tolist(), "output": y.tolist()}, f)
+
+
 if __name__ == "__main__":
-    sys.exit(main())
+    main()
+    make_cnn()
+    make_lstm()

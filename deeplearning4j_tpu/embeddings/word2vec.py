@@ -37,3 +37,40 @@ class Word2Vec(SequenceVectors):
                 toks = [t for t in toks if t not in self.stop_words]
             if toks:
                 yield toks
+
+    # ------------------------------------------------- native vocab pass
+    def _native_counts(self, source):
+        """C++ batch token counting (native/src/tokenizer.cpp) when the
+        corpus and tokenizer allow it: a list of ASCII sentences under the
+        DefaultTokenizerFactory with CommonPreprocessor (or none). Returns
+        None to fall back to the per-sentence Python pass — only list/
+        tuple sources qualify so a generator is never half-consumed."""
+        from deeplearning4j_tpu.text.native_tokenizer import (
+            NativeCorpusEncoder,
+        )
+        from deeplearning4j_tpu.text.tokenization import (
+            CommonPreprocessor, DefaultTokenizerFactory,
+        )
+        if not isinstance(source, (list, tuple)):
+            return None
+        if type(self.tokenizer) is not DefaultTokenizerFactory:
+            return None
+        pp = self.tokenizer.preprocessor
+        if pp is not None and type(pp) is not CommonPreprocessor:
+            return None
+        if not all(isinstance(s, str) for s in source):
+            return None
+        enc = NativeCorpusEncoder(common_preprocess=pp is not None)
+        return enc.count_or_none(list(source))
+
+    def build_vocab(self, source):
+        counts = self._native_counts(source)
+        if counts is None:
+            return super().build_vocab(source)
+        for w, c in counts.items():
+            if w not in self.stop_words:
+                self.vocab.add_token(w, c)
+        self.vocab.build(self.min_count)
+        if self.use_hs:
+            self.vocab.build_huffman()
+        return self

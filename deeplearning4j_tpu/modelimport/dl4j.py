@@ -1247,12 +1247,25 @@ def restore_normalizer(path):
 def add_normalizer_to_model(path, norm) -> None:
     """addNormalizerToModel parity: attach (or replace) the normalizer
     entry of an existing model zip in place."""
+    import os
+    import tempfile
     with zipfile.ZipFile(path, "r") as zf:
         entries = [(n, zf.read(n)) for n in zf.namelist()
                    if n != "normalizer.bin"]
     buf = io.BytesIO()
     write_normalizer(buf, norm)
-    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
-        for n, data in entries:
-            zf.writestr(n, data)
-        zf.writestr("normalizer.bin", buf.getvalue())
+    # write-then-rename: a crash mid-write must not destroy the original
+    # model artifact
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(os.path.abspath(path))
+                               or ".", suffix=".zip.tmp")
+    os.close(fd)
+    try:
+        with zipfile.ZipFile(tmp, "w", zipfile.ZIP_DEFLATED) as zf:
+            for n, data in entries:
+                zf.writestr(n, data)
+            zf.writestr("normalizer.bin", buf.getvalue())
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise

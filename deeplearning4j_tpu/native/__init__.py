@@ -24,7 +24,8 @@ _SRC_DIR = os.path.join(os.path.dirname(__file__), "src")
 _SRCS = [os.path.join(_SRC_DIR, "dl4jtpu_native.cpp"),
          os.path.join(_SRC_DIR, "ndarray_ops.cpp"),
          os.path.join(_SRC_DIR, "sptree.cpp"),
-         os.path.join(_SRC_DIR, "csv.cpp")]
+         os.path.join(_SRC_DIR, "csv.cpp"),
+         os.path.join(_SRC_DIR, "tokenizer.cpp")]
 _lib: Optional[ctypes.CDLL] = None
 _build_failed = False
 
@@ -132,6 +133,28 @@ def _declare_ndarray_ops(lib: ctypes.CDLL) -> None:
                                   i64, f32p, i64, ctypes.POINTER(i64)]
     lib.scale_u8_f32.restype = None
     lib.scale_u8_f32.argtypes = [u8p, i64, f32, f32, f32p]
+    # batch tokenizer (src/tokenizer.cpp)
+    i64p = ctypes.POINTER(i64)
+    i32p = ctypes.POINTER(i32)
+    lib.dl4j_vocab_create.restype = ctypes.c_void_p
+    lib.dl4j_vocab_create.argtypes = [ctypes.c_char_p, i64p, i64]
+    lib.dl4j_vocab_free.restype = None
+    lib.dl4j_vocab_free.argtypes = [ctypes.c_void_p]
+    lib.dl4j_tokenize_encode.restype = i64
+    lib.dl4j_tokenize_encode.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, i64, i32, i32,
+        i32p, i64, i64p, i64, i64p]
+    lib.dl4j_count_tokens.restype = ctypes.c_void_p
+    lib.dl4j_count_tokens.argtypes = [ctypes.c_char_p, i64, i32]
+    lib.dl4j_counts_size.restype = i64
+    lib.dl4j_counts_size.argtypes = [ctypes.c_void_p]
+    lib.dl4j_counts_blob_len.restype = i64
+    lib.dl4j_counts_blob_len.argtypes = [ctypes.c_void_p]
+    lib.dl4j_counts_export.restype = None
+    lib.dl4j_counts_export.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                       i64p, i64p]
+    lib.dl4j_counts_free.restype = None
+    lib.dl4j_counts_free.argtypes = [ctypes.c_void_p]
 
 
 def get_lib() -> Optional[ctypes.CDLL]:

@@ -344,3 +344,128 @@ def test_native_csv_parser_matches_python_and_falls_back(tmp_path):
     assert len(batches) == 3
     assert batches[0].features.shape == (10, 2)
     assert batches[0].labels.shape == (10, 3)
+
+
+# ------------------------------------------------- native batch tokenizer
+
+class TestNativeTokenizer:
+    def setup_method(self):
+        from deeplearning4j_tpu import native
+        if not native.available():
+            pytest.skip("no native toolchain")
+
+    def test_count_parity_with_python_tokenizer(self):
+        from collections import Counter
+
+        from deeplearning4j_tpu.text.native_tokenizer import (
+            NativeCorpusEncoder,
+        )
+        from deeplearning4j_tpu.text.tokenization import (
+            CommonPreprocessor, DefaultTokenizerFactory,
+        )
+        docs = [
+            "The QUICK brown fox, jumped over 12 lazy dogs!",
+            "Hello... world; (parens) [brackets] \"quotes\" 'single'",
+            "a/b c|d e?f g!h i;j",
+            "",
+            "repeated repeated repeated words words",
+        ]
+        tf = DefaultTokenizerFactory(CommonPreprocessor())
+        expected = Counter()
+        for d in docs:
+            expected.update(tf.tokenize(d))
+        got = NativeCorpusEncoder().count_or_none(docs)
+        assert got is not None
+        assert got == dict(expected)
+
+    def test_encode_parity_and_oov(self):
+        from deeplearning4j_tpu.text.native_tokenizer import (
+            NativeCorpusEncoder,
+        )
+        from deeplearning4j_tpu.text.tokenization import (
+            CommonPreprocessor, DefaultTokenizerFactory,
+        )
+        docs = ["The cat sat, on the MAT!", "dog und cat 99", ""]
+        word2id = {"the": 7, "cat": 3, "sat": 5, "on": 2, "mat": 11,
+                   "dog": 13}
+        enc = NativeCorpusEncoder()
+        out = enc.encode_or_none(docs, word2id)
+        assert out is not None and len(out) == 3
+        tf = DefaultTokenizerFactory(CommonPreprocessor())
+        for d, ids in zip(docs, out):
+            exp = [word2id[t] for t in tf.tokenize(d) if t in word2id]
+            assert list(ids) == exp
+        # keep_oov marks unknowns as -1 ("und" and the stripped "99" -> "")
+        out2 = enc.encode_or_none(docs, word2id, keep_oov=True)
+        assert list(out2[1]) == [13, -1, 3]
+
+    def test_non_ascii_falls_back(self):
+        from deeplearning4j_tpu.text.native_tokenizer import (
+            NativeCorpusEncoder,
+        )
+        assert NativeCorpusEncoder().encode_or_none(
+            ["héllo wörld"], {"hello": 0}) is None
+
+    def test_newline_in_doc_falls_back(self):
+        from deeplearning4j_tpu.text.native_tokenizer import (
+            NativeCorpusEncoder,
+        )
+        assert NativeCorpusEncoder().encode_or_none(
+            ["two\nlines"], {"two": 0}) is None
+
+
+def test_word2vec_native_vocab_matches_python_pass():
+    """Word2Vec.build_vocab's C++ counting pass must produce the identical
+    vocabulary (words, counts, frequency order) as the Python pass."""
+    from deeplearning4j_tpu import native
+    if not native.available():
+        pytest.skip("no native toolchain")
+    from deeplearning4j_tpu.embeddings.word2vec import Word2Vec
+
+    corpus = ["The king and the queen ruled.",
+              "A dog and a cat; the dog barked!",
+              "king queen king queen KING"] * 3
+    w_native = Word2Vec(layer_size=8, min_count=2)
+    w_native.build_vocab(corpus)
+    assert w_native._native_counts(corpus) is not None  # fast path taken
+
+    w_py = Word2Vec(layer_size=8, min_count=2)
+    # force the Python pass by handing a generator (not list/tuple)
+    w_py.build_vocab(iter(corpus))
+
+    assert len(w_native.vocab) == len(w_py.vocab) > 0
+    for i in range(len(w_py.vocab)):
+        wa, wb = w_native.vocab.word_for(i), w_py.vocab.word_for(i)
+        assert wa == wb
+        assert w_native.vocab.count_of(wa) == w_py.vocab.count_of(wb)
+
+
+def test_native_tokenizer_fs_gs_rs_us_separators():
+    """Python str.split() splits on \\x1c-\\x1f; the native pass must
+    agree (review finding: vocab divergence on FS/GS separators)."""
+    from deeplearning4j_tpu import native
+    if not native.available():
+        pytest.skip("no native toolchain")
+    from collections import Counter
+
+    from deeplearning4j_tpu.text.native_tokenizer import NativeCorpusEncoder
+    from deeplearning4j_tpu.text.tokenization import (
+        CommonPreprocessor, DefaultTokenizerFactory,
+    )
+    docs = ["a\x1cb c", "d\x1de\x1ef\x1fg"]
+    tf = DefaultTokenizerFactory(CommonPreprocessor())
+    exp = Counter()
+    for d in docs:
+        exp.update(tf.tokenize(d))
+    got = NativeCorpusEncoder().count_or_none(docs)
+    assert got == dict(exp)
+
+
+def test_native_encoder_empty_vocab_keep_oov():
+    from deeplearning4j_tpu import native
+    if not native.available():
+        pytest.skip("no native toolchain")
+    from deeplearning4j_tpu.text.native_tokenizer import NativeCorpusEncoder
+    out = NativeCorpusEncoder().encode_or_none(
+        ["hello world"], {}, keep_oov=True)
+    assert out is not None and list(out[0]) == [-1, -1]

@@ -111,7 +111,11 @@ FIXED_STAMP = (2026, 1, 1, 0, 0, 0)   # byte-deterministic regeneration
 
 
 def _entry(name):
-    return zipfile.ZipInfo(name, date_time=FIXED_STAMP)
+    # writestr(ZipInfo, ...) takes the compression from the ZipInfo, NOT
+    # the archive default — set it explicitly or entries come out STORED
+    zi = zipfile.ZipInfo(name, date_time=FIXED_STAMP)
+    zi.compress_type = zipfile.ZIP_DEFLATED
+    return zi
 
 
 def _zip_model(name, confs, flat):

@@ -34,9 +34,7 @@ from typing import Iterable, Iterator, List
 import numpy as np
 
 from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
-from deeplearning4j_tpu.data.iterator import (
-    BenchmarkDataSetIterator, DataSetIterator,
-)
+from deeplearning4j_tpu.data.iterator import DataSetIterator
 
 
 class EarlyTerminationDataSetIterator(DataSetIterator):

@@ -1256,6 +1256,7 @@ def add_normalizer_to_model(path, norm) -> None:
     write_normalizer(buf, norm)
     # write-then-rename: a crash mid-write must not destroy the original
     # model artifact
+    orig_mode = os.stat(path).st_mode
     fd, tmp = tempfile.mkstemp(dir=os.path.dirname(os.path.abspath(path))
                                or ".", suffix=".zip.tmp")
     os.close(fd)
@@ -1264,7 +1265,8 @@ def add_normalizer_to_model(path, norm) -> None:
             for n, data in entries:
                 zf.writestr(n, data)
             zf.writestr("normalizer.bin", buf.getvalue())
-        os.replace(tmp, path)
+        os.chmod(tmp, orig_mode)        # mkstemp creates 0600; keep the
+        os.replace(tmp, path)           # artifact's sharing permissions
     except BaseException:
         if os.path.exists(tmp):
             os.unlink(tmp)

@@ -129,7 +129,9 @@ void dl4j_vocab_free(void* h) { delete (Vocab*)h; }
 // Encode a '\n'-separated corpus. Writes token ids to out_ids (OOV tokens
 // are skipped unless keep_oov, then written as -1), per-doc END offsets
 // into doc_ends. Returns total ids written, or -(needed) if max_out was
-// too small (call again with a bigger buffer).
+// too small (call again with a bigger buffer), or INT64_MIN when
+// max_docs is too small (distinct from the resize protocol — a resize
+// loop must not spin on it).
 int64_t dl4j_tokenize_encode(void* vocab_h, const char* text, int64_t len,
                              int common, int keep_oov,
                              int32_t* out_ids, int64_t max_out,
@@ -138,7 +140,7 @@ int64_t dl4j_tokenize_encode(void* vocab_h, const char* text, int64_t len,
     auto* vocab = (Vocab*)vocab_h;
     auto lines = split_lines(text, len);
     int64_t n_docs = (int64_t)lines.size();
-    if (n_docs > max_docs) return -1;
+    if (n_docs > max_docs) return INT64_MIN;
     std::vector<std::vector<int32_t>> per_doc((size_t)n_docs);
 
 #ifdef _OPENMP

@@ -229,10 +229,15 @@ class MultiHeadAttention(LayerConf):
         # single-device dispatches (the Pallas interpreter off-TPU would
         # be far slower than XLA; the kernel has no dropout RNG)
         # "blockwise" is the algorithm; on TPU the fused flash kernel IS
-        # its fastest realization, so both impls ride it when eligible
+        # its fastest realization, so both impls ride it when eligible.
+        # DL4J_TPU_FLASH=0 is the first-contact kill switch: if the Pallas
+        # kernel miscompiles on real hardware, everything falls back to
+        # the lax online-softmax paths without a code edit.
+        import os
         use_flash = (self.attention_impl in ("flash", "blockwise")
                      and drop == 0.0
-                     and jax.default_backend() == "tpu")
+                     and jax.default_backend() == "tpu"
+                     and os.environ.get("DL4J_TPU_FLASH", "1") != "0")
         if _CONTEXT_PARALLEL_AXIS is not None:
             if use_flash:
                 from deeplearning4j_tpu.parallel.ring import (
@@ -255,10 +260,12 @@ class MultiHeadAttention(LayerConf):
             out = flash_attention(q, k, v, mask=mask, causal=self.causal,
                                   block_q=self.block_size,
                                   block_k=self.block_size)
-        elif self.attention_impl == "flash":
+        elif self.attention_impl in ("flash", "blockwise"):
             # off-TPU (the Pallas interpreter would be orders of magnitude
-            # slower than XLA) or dropout on: blockwise recomputation,
-            # padded to the block size like the flash wrapper pads
+            # slower than XLA), dropout on, or DL4J_TPU_FLASH=0: blockwise
+            # recomputation, clamped + padded to the block size like the
+            # flash wrapper pads — a sequence shorter than / not divisible
+            # by block_size must work, not raise
             from deeplearning4j_tpu.parallel.ring import blockwise_attention
             t = q.shape[1]
             bs = min(self.block_size, t)
@@ -277,11 +284,6 @@ class MultiHeadAttention(LayerConf):
                 out = blockwise_attention(q, k, v, block_size=bs,
                                           causal=self.causal, mask=mask,
                                           dropout=drop, rng=attn_rng)
-        elif self.attention_impl == "blockwise":
-            from deeplearning4j_tpu.parallel.ring import blockwise_attention
-            out = blockwise_attention(q, k, v, block_size=self.block_size,
-                                      causal=self.causal, mask=mask,
-                                      dropout=drop, rng=attn_rng)
         else:
             out = dot_product_attention(
                 q, k, v, mask=mask, causal=self.causal,

@@ -325,7 +325,8 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 def flash_attention(q, k, v, *, mask=None, causal: bool = False,
-                    block_q: int = 128, block_k: int = 128,
+                    block_q: Optional[int] = None,
+                    block_k: Optional[int] = None,
                     interpret: Optional[bool] = None,
                     return_lse: bool = False):
     """Fused flash attention on (B, T, H, D); see module docstring.
@@ -346,6 +347,15 @@ def flash_attention(q, k, v, *, mask=None, causal: bool = False,
     tk = k.shape[1]
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    # block sizes: DL4J_TPU_FLASH_BLOCK_Q/K take PRECEDENCE over caller
+    # arguments — they are the first-contact VMEM/tiling recovery knobs
+    # (PERF.md) and must work even for layers that pass explicit sizes
+    # (MultiHeadAttention forwards its block_size config here)
+    import os
+    bq_env = os.environ.get("DL4J_TPU_FLASH_BLOCK_Q")
+    bk_env = os.environ.get("DL4J_TPU_FLASH_BLOCK_K")
+    block_q = int(bq_env) if bq_env else (block_q or 128)
+    block_k = int(bk_env) if bk_env else (block_k or 128)
     block_q = min(block_q, max(tq, 1))
     block_k = min(block_k, max(tk, 1))
     pq = (-tq) % block_q

@@ -103,8 +103,10 @@ class NativeCorpusEncoder:
             return None
         try:
             raw = text.encode()
-            max_out = max(len(raw), 1)
+            # worst case is single-char tokens with single-char gaps
+            max_out = max((len(raw) + 1) // 2, 1)
             n_docs = len(docs)
+            int64_min = np.iinfo(np.int64).min
             while True:
                 out_ids = np.zeros(max_out, np.int32)
                 doc_ends = np.zeros(n_docs, np.int64)
@@ -116,6 +118,8 @@ class NativeCorpusEncoder:
                     max_out,
                     doc_ends.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
                     n_docs, ctypes.byref(n_docs_out))
+                if total == int64_min:      # doc-count overflow, not a
+                    return None             # resizable condition
                 if total >= 0:
                     break
                 max_out = -total            # buffer was too small; resize

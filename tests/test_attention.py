@@ -484,3 +484,22 @@ def test_pipeline_parallel_rejects_mixed_precision_and_stateful():
          .set_input_type(InputType.recurrent(1, 8)).build())
     with pytest.raises(ValueError, match="carries state"):
         PipelineParallelTrainer(MultiLayerNetwork(b).init(), mesh)
+
+
+def test_blockwise_impl_handles_non_divisible_sequence():
+    """attention_impl="blockwise" with T < or not divisible by block_size
+    must clamp + pad like the flash fallback, not raise (round-5 fix)."""
+    from deeplearning4j_tpu.nn.layers import MultiHeadAttention
+
+    rs = np.random.RandomState(0)
+    layer = MultiHeadAttention(n_out=16, n_heads=2,
+                               attention_impl="blockwise")  # block 512
+    params, state = layer.init(jax.random.PRNGKey(0),
+                               InputType.recurrent(8, 12))
+    x = jnp.asarray(rs.randn(3, 12, 8).astype("float32"))
+    y, _ = layer.apply(params, state, x)
+    assert y.shape == (3, 12, 16)
+    dense = MultiHeadAttention(n_out=16, n_heads=2, attention_impl="dense")
+    y2, _ = dense.apply(params, state, x)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(y2),
+                               rtol=2e-4, atol=2e-4)

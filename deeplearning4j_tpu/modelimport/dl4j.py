@@ -215,6 +215,57 @@ def _loss_to(name: str) -> dict:
     return {"@class": "org.nd4j.linalg.lossfunctions.impl." + _LOSS_TO[name]}
 
 
+def _layer_updater(body: dict):
+    """Updater from a layer JSON body — modern `iUpdater` object, or the
+    pre-0.9 legacy form (`"updater": "ADAM"` enum plus flat learningRate/
+    momentum/rho/rmsDecay/adamMeanDecay/adamVarDecay fields), which the
+    reference migrates in BaseNetConfigDeserializer.java
+    handleUpdaterBackwardCompatibility. Returns None when neither is
+    present."""
+    iupd = body.get("iUpdater")
+    if iupd is not None:
+        return _updater_from(iupd)
+    name = body.get("updater")
+    if not isinstance(name, str):
+        return None
+    raw_lr = body.get("learningRate")
+    # None-only fallback: an explicit 0.0 (deliberate no-step) must survive
+    lr = 1e-1 if raw_lr is None else float(raw_lr)
+    eps = body.get("epsilon")
+
+    def _eps(default):
+        return default if eps is None else float(eps)
+
+    name = name.upper()
+    if name == "SGD":
+        return upd.Sgd(lr)
+    if name in ("ADAM", "ADAMAX", "NADAM"):
+        cls = {"ADAM": upd.Adam, "ADAMAX": upd.AdaMax,
+               "NADAM": upd.Nadam}[name]
+        return cls(lr, beta1=float(body.get("adamMeanDecay", 0.9)),
+                   beta2=float(body.get("adamVarDecay", 0.999)),
+                   epsilon=_eps(1e-8))
+    if name == "NESTEROVS":
+        return upd.Nesterovs(lr, momentum=float(body.get("momentum", 0.9)))
+    if name == "ADAGRAD":
+        return upd.AdaGrad(lr, epsilon=_eps(1e-6))
+    if name == "RMSPROP":
+        return upd.RmsProp(lr, decay=float(body.get("rmsDecay", 0.95)),
+                           epsilon=_eps(1e-8))
+    if name == "ADADELTA":
+        return upd.AdaDelta(rho=float(body.get("rho", 0.95)),
+                            epsilon=_eps(1e-6))
+    if name == "NONE":
+        return upd.NoOp()
+    # reference handleUpdaterBackwardCompatibility leaves unmappable
+    # legacy updaters null and still loads the model — match that
+    import logging
+    logging.getLogger("deeplearning4j_tpu").warning(
+        "unmappable legacy updater enum %r; importing with the default "
+        "updater (parameters are unaffected)", name)
+    return None
+
+
 def _updater_from(d: Any) -> upd.Updater:
     """iUpdater {"@class": "org.nd4j.linalg.learning.config.X", ...}."""
     if d is None:
@@ -308,7 +359,13 @@ def _apply_common(layers, d: dict):
     layer that carries the parameters — silently dropping it would resume
     training under different regularization than the artifact was trained
     with."""
-    drop = _dropout_from(d.get("iDropout"))
+    if d.get("iDropout") is not None:
+        drop = _dropout_from(d["iDropout"])
+    else:
+        # pre-0.9 legacy flat field: dropOut = RETAIN probability (0 =
+        # dropout off, matching the reference's legacy migration)
+        legacy = float(d.get("dropOut", 0.0) or 0.0)
+        drop = 1.0 - legacy if legacy > 0.0 else 0.0
     l1 = float(d.get("l1", 0.0) or 0.0)
     l2 = float(d.get("l2", 0.0) or 0.0)
     if drop or l1 or l2:
@@ -401,6 +458,23 @@ def _parse_layer(kind: str, d: dict):
                          d.get("gateActivationFn"), "sigmoid"),
                      forget_gate_bias_init=float(
                          d.get("forgetGateBiasInit", 1.0)))]
+    if kind == "Bidirectional":
+        from deeplearning4j_tpu.nn.layers import Bidirectional
+        fwd_wrap = d.get("fwd")
+        if not fwd_wrap:
+            raise UnsupportedLayerError("Bidirectional JSON missing 'fwd'")
+        (ikind, ibody), = fwd_wrap.items()
+        inner = _apply_common(_parse_layer(ikind, ibody), ibody)
+        if len(inner) != 1:
+            raise UnsupportedLayerError(
+                "Bidirectional wrapping a multi-layer expansion is not "
+                "importable")
+        mode = {"CONCAT": "concat", "ADD": "add", "MUL": "mul",
+                "AVERAGE": "ave"}.get((d.get("mode") or "CONCAT").upper())
+        if mode is None:
+            raise UnsupportedLayerError(
+                f"unknown Bidirectional mode {d.get('mode')!r}")
+        return [Bidirectional(name=name, layer=inner[0], mode=mode)]
     if kind == "gravesLSTM":
         raise UnsupportedLayerError(
             "GravesLSTM peephole parameters are not transferable: the "
@@ -461,6 +535,8 @@ def _layer_num_params(layer, in_type: InputType) -> int:
         nin = layer.n_in or in_type.features
         H = layer.n_out
         return nin * 4 * H + H * 4 * H + 4 * H
+    if cls == "Bidirectional":
+        return 2 * _layer_num_params(layer.layer, in_type)
     return 0
 
 
@@ -520,6 +596,13 @@ def _decode_layer_params(layer, in_type: InputType, seg: np.ndarray,
         return {"W": _ifog_to_ifgo(W, H, 1),
                 "R": _ifog_to_ifgo(R, H, 1),
                 "b": _ifog_to_ifgo(b, H, 0)}, {}
+    if cls == "Bidirectional":
+        # BidirectionalParamInitializer.java:92-93 — [fwd flat | bwd flat]
+        n = _layer_num_params(layer.layer, in_type)
+        fwd, _ = _decode_layer_params(layer.layer, in_type, seg[:n], raw_in)
+        bwd, _ = _decode_layer_params(layer.layer, in_type, seg[n:2 * n],
+                                      raw_in)
+        return {"fwd": fwd, "bwd": bwd}, {}
     return {}, {}
 
 
@@ -528,6 +611,15 @@ def _encode_layer_params(layer, in_type: InputType, params: dict,
                          raw_in: Optional[InputType] = None) -> np.ndarray:
     """This framework's per-layer params -> the reference flat segment."""
     cls = type(layer).__name__
+    if cls == "Bidirectional":
+        # nested fwd/bwd subtrees (BidirectionalParamInitializer.java:92-93
+        # layout [fwd flat | bwd flat]); must recurse before the flat
+        # leaf conversion below
+        return np.concatenate([
+            _encode_layer_params(layer.layer, in_type, params["fwd"], {},
+                                 raw_in),
+            _encode_layer_params(layer.layer, in_type, params["bwd"], {},
+                                 raw_in)])
     P = {k: np.asarray(v, np.float32) for k, v in (params or {}).items()}
     S = {k: np.asarray(v, np.float32) for k, v in (state or {}).items()}
     if cls in ("DenseLayer", "OutputLayer", "RnnOutputLayer", "EmbeddingLayer"):
@@ -588,9 +680,8 @@ def parse_dl4j_conf(conf_json: str):
     for conf in d["confs"]:
         seed = int(conf.get("seed", seed) or 0)
         (kind, body), = conf["layer"].items()
-        iupd = body.get("iUpdater")
-        if updater is None and iupd is not None:
-            updater = _updater_from(iupd)
+        if updater is None:
+            updater = _layer_updater(body)
         expansion = _apply_common(_parse_layer(kind, body), body)
         our_layers.extend(expansion)
         owner.append(len(our_layers) - 1)
@@ -697,17 +788,24 @@ def _load_flat(net, owner, flat: np.ndarray) -> None:
                          f"{offset} of {flat.size} values")
 
 
-def _graft(net, our_i: int, params: dict, state: dict) -> None:
+def _graft_tree(dst: dict, src: dict) -> None:
+    """Recursively overlay decoded arrays onto a (possibly nested) param
+    subtree — Bidirectional wraps its inner layer's params under
+    fwd/bwd."""
     import jax.numpy as jnp
+    for k, v in src.items():
+        if isinstance(v, dict):
+            _graft_tree(dst[k], v)
+        else:
+            tmpl = dst[k]
+            dst[k] = jnp.asarray(
+                np.asarray(v, np.float32).reshape(tmpl.shape), tmpl.dtype)
+
+
+def _graft(net, our_i, params: dict, state: dict) -> None:
     key = str(our_i)
-    for k, v in params.items():
-        tmpl = net.params[key][k]
-        net.params[key][k] = jnp.asarray(
-            np.asarray(v, np.float32).reshape(tmpl.shape), tmpl.dtype)
-    for k, v in state.items():
-        tmpl = net.state[key][k]
-        net.state[key][k] = jnp.asarray(
-            np.asarray(v, np.float32).reshape(tmpl.shape), tmpl.dtype)
+    _graft_tree(net.params[key], params)
+    _graft_tree(net.state[key], state)
 
 
 def _updater_state_slots(u: upd.Updater) -> int:
@@ -741,6 +839,21 @@ def _graft_updater_state(net, segments, flat: np.ndarray) -> None:
         return
 
     # decode each slot with the SAME per-layer layout conversion as params
+    def _shape_like(src: dict, tmpl: dict) -> dict:
+        """Recursively align decoded arrays to the param template — nested
+        for wrapper layers (Bidirectional fwd/bwd); drops keys the template
+        lacks (BN mean/var are not optax-tracked here)."""
+        out = {}
+        for k, v in src.items():
+            if k not in tmpl:
+                continue
+            if isinstance(v, dict):
+                out[k] = _shape_like(v, tmpl[k])
+            else:
+                out[k] = jnp.asarray(np.asarray(v, np.float32).reshape(
+                    np.asarray(tmpl[k]).shape))
+        return out
+
     def decode_slot(slot_flat):
         tree = {}
         offset = 0
@@ -748,24 +861,26 @@ def _graft_updater_state(net, segments, flat: np.ndarray) -> None:
             params, state = _decode_layer_params(
                 layer, in_type, slot_flat[offset:offset + size], raw_in)
             merged = dict(params)
-            merged.update(state)        # BN mean/var not in optax state; drop below
-            tree[key] = {
-                k: jnp.asarray(np.asarray(v, np.float32).reshape(
-                    np.asarray(net.params[key][k]).shape))
-                for k, v in merged.items() if k in net.params[key]}
+            merged.update(state)
+            tree[key] = _shape_like(merged, net.params[key])
             offset += size
         return tree
 
     slot_trees = [decode_slot(flat[i * n:(i + 1) * n]) for i in range(slots)]
+
+    def _overlay(dst: dict, src: dict) -> None:
+        for k, v in src.items():
+            if isinstance(v, dict):
+                _overlay(dst[k], v)
+            else:
+                dst[k] = v
 
     def fill(template_tree, slot_tree):
         """Overlay slot values onto a params-shaped pytree, keeping leaves
         that the reference does not carry (e.g. BN has no updater state for
         mean/var on our side because they are not trainable here)."""
         out = jax.tree_util.tree_map(lambda x: x, template_tree)
-        for lk, lv in slot_tree.items():
-            for pk, pv in lv.items():
-                out[lk][pk] = pv
+        _overlay(out, slot_tree)
         return out
 
     name = type(u).__name__
@@ -811,6 +926,7 @@ def _load_updater_state(net, owner, flat: np.ndarray) -> None:
 # ======================================================================
 
 _KIND_TO = {"DenseLayer": "dense", "OutputLayer": "output",
+            "Bidirectional": "Bidirectional",
             "ElementWiseMultiplicationLayer": "ElementWiseMult",
             "RnnOutputLayer": "rnnoutput", "LossLayer": "loss",
             "EmbeddingLayer": "embedding", "ActivationLayer": "activation",
@@ -828,6 +944,12 @@ def _layer_to_dl4j_json(layer, in_type: InputType) -> Tuple[str, dict]:
             f"{cls} has no DL4J JSON mapping; export supports the shared "
             f"layer subset: {sorted(_KIND_TO)}")
     kind = _KIND_TO[cls]
+    if cls == "Bidirectional":
+        ikind, ibody = _layer_to_dl4j_json(layer.layer, in_type)
+        mode = {"concat": "CONCAT", "add": "ADD", "mul": "MUL",
+                "ave": "AVERAGE"}[layer.mode]
+        return kind, {"layerName": layer.name, "mode": mode,
+                      "fwd": {ikind: ibody}, "bwd": {ikind: dict(ibody)}}
     body: Dict[str, Any] = {"layerName": layer.name}
     if isinstance(layer.dropout, (int, float)) and layer.dropout > 0:
         body["iDropout"] = {
@@ -860,6 +982,13 @@ def _layer_to_dl4j_json(layer, in_type: InputType) -> Tuple[str, dict]:
         else:
             body["poolingType"] = layer.pooling_type.upper()
             body["pnorm"] = layer.pnorm
+    if cls == "GlobalPoolingLayer":
+        body["poolingType"] = layer.pooling_type.upper()
+        body["pnorm"] = layer.pnorm
+    if cls == "ZeroPaddingLayer":
+        body["padding"] = list(layer.padding)     # [top,bottom,left,right]
+    if cls == "Upsampling2D":
+        body["size"] = list(layer.size)
     if cls == "BatchNormalization":
         body.update(eps=layer.epsilon, decay=layer.decay,
                     gamma=layer.gamma_init, beta=layer.beta_init,
@@ -1043,9 +1172,8 @@ def parse_dl4j_graph_conf(conf_json: str, input_types=None):
             nnconf = vd.get("layerConf") or {}
             seed = int(nnconf.get("seed", seed) or 0)
             (lkind, lbody), = nnconf["layer"].items()
-            iupd = lbody.get("iUpdater")
-            if updater is None and iupd is not None:
-                updater = _updater_from(iupd)
+            if updater is None:
+                updater = _layer_updater(lbody)
             expansion = _apply_common(_parse_layer(lkind, lbody), lbody)
             prev = ins
             for i, lay in enumerate(expansion):

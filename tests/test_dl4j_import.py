@@ -45,7 +45,8 @@ def _adam(lr=1e-3):
 def _conf_json(layer_entries, **top):
     confs = []
     for kind, body in layer_entries:
-        body.setdefault("iUpdater", _adam())
+        if "updater" not in body:          # legacy bodies carry the enum
+            body.setdefault("iUpdater", _adam())
         confs.append({"layer": {kind: body}, "seed": 12345,
                       "optimizationAlgo": "STOCHASTIC_GRADIENT_DESCENT",
                       "miniBatch": True, "minimize": True})
@@ -737,3 +738,230 @@ def test_normalizer_minmax_fitlabel_consumed_and_warned(caplog):
     np.testing.assert_allclose(norm.feature_max, [3.0, 4.0])
     assert buf.read() == b""                       # fully consumed
     assert any("fitLabel" in r.message for r in caplog.records)
+
+
+def test_legacy_pre09_config_import():
+    """Pre-0.9 release zips: layer carries "updater": "ADAM" (enum) with
+    flat learningRate/adamMeanDecay/adamVarDecay fields, a legacy
+    "dropOut" retain-probability double, and "activationFunction" as a
+    plain string — the formats the reference's own RegressionTest050/060
+    suites deserialize (migrated by BaseNetConfigDeserializer)."""
+    rs = np.random.RandomState(40)
+    W1 = rs.randn(4, 5).astype(np.float32)
+    b1 = rs.randn(5).astype(np.float32)
+    W2 = rs.randn(5, 3).astype(np.float32)
+    b2 = rs.randn(3).astype(np.float32)
+    flat = np.concatenate([W1.ravel(order="F"), b1,
+                           W2.ravel(order="F"), b2])
+    cj = _conf_json([
+        ("dense", {"activationFunction": "relu", "nin": 4, "nout": 5,
+                   "updater": "ADAM", "learningRate": 0.005,
+                   "adamMeanDecay": 0.9, "adamVarDecay": 0.999,
+                   "epsilon": 1e-8, "rho": 0.0,
+                   "dropOut": 0.75, "l2": 5e-4}),
+        ("output", {"activationFunction": "softmax", "nin": 5, "nout": 3,
+                    "updater": "ADAM", "learningRate": 0.005,
+                    "adamMeanDecay": 0.9, "adamVarDecay": 0.999,
+                    "lossFunction": "MCXENT"}),
+    ])
+    net = restore_multilayer_network(_zip_bytes(cj, flat))
+    from deeplearning4j_tpu.nn.updaters import Adam
+    assert isinstance(net.conf.updater, Adam)
+    assert net.conf.updater.learning_rate == pytest.approx(0.005)
+    d0 = net.layers[0]
+    assert d0.dropout == pytest.approx(0.25)    # 1 - retain(0.75)
+    assert d0.l2 == pytest.approx(5e-4)
+    x = rs.randn(3, 4).astype(np.float32)
+    oracle = _softmax(np.maximum(x @ W1 + b1, 0) @ W2 + b2)
+    np.testing.assert_allclose(np.asarray(net.output(x)), oracle,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_legacy_nesterovs_enum():
+    rs = np.random.RandomState(41)
+    flat = np.concatenate([rs.randn(6).astype(np.float32),
+                           rs.randn(2).astype(np.float32)])
+    cj = _conf_json([
+        ("output", {"activationFunction": "softmax", "nin": 3, "nout": 2,
+                    "updater": "NESTEROVS", "learningRate": 0.02,
+                    "momentum": 0.85,
+                    "lossFunction": "MCXENT"}),
+    ])
+    net = restore_multilayer_network(_zip_bytes(cj, flat))
+    from deeplearning4j_tpu.nn.updaters import Nesterovs
+    assert isinstance(net.conf.updater, Nesterovs)
+    assert net.conf.updater.momentum == pytest.approx(0.85)
+
+
+def test_bidirectional_lstm_import():
+    """Bidirectional(LSTM) — BidirectionalParamInitializer layout
+    [fwd flat | bwd flat]; the backward half runs on the time-reversed
+    sequence and is flipped back (CONCAT mode)."""
+    rs = np.random.RandomState(50)
+    nin, H, T, B = 3, 4, 5, 2
+
+    def lstm_params():
+        return (rs.randn(nin, 4 * H).astype(np.float32),
+                rs.randn(H, 4 * H).astype(np.float32),
+                rs.randn(4 * H).astype(np.float32))
+
+    Wf, Rf, bf = lstm_params()
+    Wb, Rb, bb = lstm_params()
+    Wo = rs.randn(2 * H, 2).astype(np.float32)
+    bo = rs.randn(2).astype(np.float32)
+    inner = lambda W, R, b: np.concatenate(
+        [W.ravel(order="F"), R.ravel(order="F"), b])
+    flat = np.concatenate([inner(Wf, Rf, bf), inner(Wb, Rb, bb),
+                           Wo.ravel(order="F"), bo])
+    lstm_body = {"activationFn": _act("TanH"), "nin": nin, "nout": H,
+                 "gateActivationFn": _act("Sigmoid"),
+                 "forgetGateBiasInit": 1.0}
+    cj = _conf_json([
+        ("Bidirectional", {"mode": "CONCAT",
+                           "fwd": {"LSTM": dict(lstm_body)},
+                           "bwd": {"LSTM": dict(lstm_body)}}),
+        ("rnnoutput", {"activationFn": _act("Softmax"), "nin": 2 * H,
+                       "nout": 2,
+                       "lossFn": {"@class":
+                                  "org.nd4j.linalg.lossfunctions.impl.LossMCXENT"}}),
+    ])
+    net = restore_multilayer_network(
+        _zip_bytes(cj, flat), input_type=InputType.recurrent(nin, T))
+    x = rs.randn(B, T, nin).astype(np.float32)
+    hf = _lstm_oracle_ifog(x, Wf, Rf, bf, H)
+    hb = _lstm_oracle_ifog(x[:, ::-1], Wb, Rb, bb, H)[:, ::-1]
+    hs = np.concatenate([hf, hb], -1)
+    oracle = _softmax(hs @ Wo + bo)
+    np.testing.assert_allclose(np.asarray(net.output(x)), oracle,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_bidirectional_updater_state_grafts():
+    rs = np.random.RandomState(51)
+    nin, H = 2, 3
+    inner_n = nin * 4 * H + H * 4 * H + 4 * H
+    n = 2 * inner_n + (2 * H) * 2 + 2
+    flat = rs.randn(n).astype(np.float32)
+    m = rs.randn(n).astype(np.float32)
+    v = np.abs(rs.randn(n)).astype(np.float32)
+    lstm_body = {"activationFn": _act("TanH"), "nin": nin, "nout": H,
+                 "gateActivationFn": _act("Sigmoid")}
+    cj = _conf_json([
+        ("Bidirectional", {"mode": "CONCAT",
+                           "fwd": {"LSTM": dict(lstm_body)},
+                           "bwd": {"LSTM": dict(lstm_body)}}),
+        ("rnnoutput", {"activationFn": _act("Softmax"), "nin": 2 * H,
+                       "nout": 2,
+                       "lossFn": {"@class":
+                                  "org.nd4j.linalg.lossfunctions.impl.LossMCXENT"}}),
+    ])
+    net = restore_multilayer_network(
+        _zip_bytes(cj, flat, updater=np.concatenate([m, v])),
+        input_type=InputType.recurrent(nin, 4))
+    import optax
+    adam = [s for s in net.opt_state
+            if isinstance(s, optax.ScaleByAdamState)][0]
+    # fwd W occupies the first nin*4H slots of m (f-order, ifog->ifgo)
+    from deeplearning4j_tpu.modelimport.dl4j import _ifog_to_ifgo
+    exp = _ifog_to_ifgo(m[:nin * 4 * H].reshape((nin, 4 * H), order="F"),
+                        H, 1)
+    np.testing.assert_allclose(np.asarray(adam.mu["0"]["fwd"]["W"]), exp,
+                               rtol=1e-6)
+    # bwd b is the tail of the first bidirectional block
+    exp_b = _ifog_to_ifgo(m[2 * inner_n - 4 * H:2 * inner_n], H, 0)
+    np.testing.assert_allclose(np.asarray(adam.mu["0"]["bwd"]["b"]),
+                               exp_b, rtol=1e-6)
+
+
+def test_bidirectional_export_roundtrip(tmp_path):
+    from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import (
+        Bidirectional, LSTM, RnnOutputLayer,
+    )
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.updaters import Adam
+
+    conf = (NeuralNetConfiguration.Builder().seed(9).updater(Adam(1e-3))
+            .list()
+            .layer(Bidirectional(layer=LSTM(n_out=4, activation="tanh"),
+                                 mode="concat"))
+            .layer(RnnOutputLayer(n_out=2))
+            .set_input_type(InputType.recurrent(3, 5))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    p = tmp_path / "bidi.zip"
+    save_dl4j_model(net, p, save_updater=True)
+    net2 = restore_multilayer_network(
+        p, input_type=InputType.recurrent(3, 5))
+    rs = np.random.RandomState(0)
+    x = rs.randn(2, 5, 3).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(net.output(x)),
+                               np.asarray(net2.output(x)),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_legacy_lr_zero_and_unknown_enum():
+    rs = np.random.RandomState(60)
+    flat = np.concatenate([rs.randn(6).astype(np.float32),
+                           rs.randn(2).astype(np.float32)])
+    cj = _conf_json([
+        ("output", {"activationFunction": "softmax", "nin": 3, "nout": 2,
+                    "updater": "SGD", "learningRate": 0.0,
+                    "lossFunction": "MCXENT"}),
+    ])
+    net = restore_multilayer_network(_zip_bytes(cj, flat))
+    assert net.conf.updater.learning_rate == 0.0       # explicit 0 survives
+    # unknown enum: warn + default updater, model still loads
+    cj2 = _conf_json([
+        ("output", {"activationFunction": "softmax", "nin": 3, "nout": 2,
+                    "updater": "CUSTOM", "lossFunction": "MCXENT"}),
+    ])
+    net2 = restore_multilayer_network(_zip_bytes(cj2, flat))
+    x = rs.randn(2, 3).astype(np.float32)
+    assert np.asarray(net2.output(x)).shape == (2, 2)
+
+
+def test_bidirectional_average_mode_maps():
+    from deeplearning4j_tpu.modelimport.dl4j import _parse_layer
+    out = _parse_layer("Bidirectional", {
+        "mode": "AVERAGE",
+        "fwd": {"LSTM": {"activationFn": _act("TanH"), "nin": 2,
+                         "nout": 3}}})
+    assert out[0].mode == "ave"
+
+
+def test_export_preserves_parameter_free_layer_config(tmp_path):
+    """GlobalPooling/ZeroPadding/Upsampling config must survive export ->
+    import (a trained avg-pooling net must not come back max-pooling)."""
+    import dataclasses as _dc
+
+    from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import (
+        ConvolutionLayer, GlobalPoolingLayer, OutputLayer, Upsampling2D,
+        ZeroPaddingLayer,
+    )
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    conf = (NeuralNetConfiguration.Builder().seed(4).list()
+            .layer(ZeroPaddingLayer(padding=(1, 2, 1, 2)))
+            .layer(ConvolutionLayer(n_out=2, kernel=(3, 3),
+                                    convolution_mode="same"))
+            .layer(Upsampling2D(size=(2, 2)))
+            .layer(GlobalPoolingLayer(pooling_type="avg"))
+            .layer(OutputLayer(n_out=2))
+            .set_input_type(InputType.convolutional(5, 5, 1))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    p = tmp_path / "pfree.zip"
+    save_dl4j_model(net, p, save_updater=False)
+    net2 = restore_multilayer_network(
+        p, input_type=InputType.convolutional(5, 5, 1))
+    by_type = {type(l).__name__: l for l in net2.layers}
+    assert by_type["GlobalPoolingLayer"].pooling_type == "avg"
+    assert by_type["ZeroPaddingLayer"].padding == (1, 2, 1, 2)
+    assert by_type["Upsampling2D"].size == (2, 2)
+    rs = np.random.RandomState(0)
+    x = rs.rand(2, 5, 5, 1).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(net.output(x)),
+                               np.asarray(net2.output(x)),
+                               rtol=1e-5, atol=1e-6)

@@ -34,7 +34,9 @@ from typing import Iterable, Iterator, List
 import numpy as np
 
 from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
-from deeplearning4j_tpu.data.iterator import DataSetIterator
+from deeplearning4j_tpu.data.iterator import (   # noqa: F401 — re-export:
+    BenchmarkDataSetIterator, DataSetIterator,   # Benchmark* belongs to the
+)                                                # utility-iterator surface
 
 
 class EarlyTerminationDataSetIterator(DataSetIterator):

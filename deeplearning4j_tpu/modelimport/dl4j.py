@@ -458,6 +458,19 @@ def _parse_layer(kind: str, d: dict):
                          d.get("gateActivationFn"), "sigmoid"),
                      forget_gate_bias_init=float(
                          d.get("forgetGateBiasInit", 1.0)))]
+    if kind == "localResponseNormalization":
+        from deeplearning4j_tpu.nn.layers import LocalResponseNormalization
+        return [LocalResponseNormalization(
+            name=name, k=float(d.get("k", 2.0)), n=int(d.get("n", 5)),
+            alpha=float(d.get("alpha", 1e-4)),
+            beta=float(d.get("beta", 0.75)))]
+    if kind == "CenterLossOutputLayer":
+        from deeplearning4j_tpu.nn.layers import CenterLossOutputLayer
+        return [CenterLossOutputLayer(
+            name=name, n_in=nin or None, n_out=nout,
+            activation=head_act,
+            loss=_loss_from(d.get("lossFn", d.get("lossFunction"))),
+            center_lambda=float(d.get("lambda", 0.5)))]
     if kind == "Bidirectional":
         from deeplearning4j_tpu.nn.layers import Bidirectional
         fwd_wrap = d.get("fwd")
@@ -537,6 +550,10 @@ def _layer_num_params(layer, in_type: InputType) -> int:
         return nin * 4 * H + H * 4 * H + 4 * H
     if cls == "Bidirectional":
         return 2 * _layer_num_params(layer.layer, in_type)
+    if cls == "CenterLossOutputLayer":
+        nin = layer.n_in or in_type.features
+        # CenterLossParamInitializer: W + b + centers (nOut x nIn)
+        return nin * layer.n_out + layer.n_out + layer.n_out * nin
     return 0
 
 
@@ -596,6 +613,13 @@ def _decode_layer_params(layer, in_type: InputType, seg: np.ndarray,
         return {"W": _ifog_to_ifgo(W, H, 1),
                 "R": _ifog_to_ifgo(R, H, 1),
                 "b": _ifog_to_ifgo(b, H, 0)}, {}
+    if cls == "CenterLossOutputLayer":
+        nin = layer.n_in or in_type.features
+        nout = layer.n_out
+        W = seg[:nin * nout].reshape((nin, nout), order="F")
+        b = seg[nin * nout:nin * nout + nout]
+        centers = seg[nin * nout + nout:].reshape((nout, nin), order="C")
+        return {"W": W, "b": b, "cL": centers}, {}
     if cls == "Bidirectional":
         # BidirectionalParamInitializer.java:92-93 — [fwd flat | bwd flat]
         n = _layer_num_params(layer.layer, in_type)
@@ -648,6 +672,9 @@ def _encode_layer_params(layer, in_type: InputType, params: dict,
             out += [P["gamma"].ravel(), P["beta"].ravel()]
         out += [S["mean"].ravel(), S["var"].ravel()]
         return np.concatenate(out)
+    if cls == "CenterLossOutputLayer":
+        return np.concatenate([P["W"].ravel(order="F"), P["b"].ravel(),
+                               P["cL"].ravel(order="C")])
     if cls == "LSTM":
         H = layer.n_out
         # inverse of IFOG->IFGO is IFGO->IFOG: swap blocks back

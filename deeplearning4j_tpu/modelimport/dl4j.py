@@ -470,7 +470,8 @@ def _parse_layer(kind: str, d: dict):
             name=name, n_in=nin or None, n_out=nout,
             activation=head_act,
             loss=_loss_from(d.get("lossFn", d.get("lossFunction"))),
-            center_lambda=float(d.get("lambda", 0.5)))]
+            alpha=float(d.get("alpha", 0.05)),
+            lambda_=float(d.get("lambda", 2e-4)))]
     if kind == "Bidirectional":
         from deeplearning4j_tpu.nn.layers import Bidirectional
         fwd_wrap = d.get("fwd")

@@ -422,6 +422,39 @@ def test_elementwise_mult_import():
                                rtol=1e-5, atol=1e-6)
 
 
+def test_center_loss_import():
+    """CenterLossParamInitializer order [W | b | centers(nOut x nIn, c)];
+    lambda/alpha come through, forward parity vs a numpy oracle."""
+    rs = np.random.RandomState(11)
+    nin, nout = 5, 3
+    W1 = rs.randn(4, nin).astype(np.float32)
+    b1 = rs.randn(nin).astype(np.float32)
+    Wo = rs.randn(nin, nout).astype(np.float32)
+    bo = rs.randn(nout).astype(np.float32)
+    centers = rs.randn(nout, nin).astype(np.float32)
+    flat = np.concatenate([W1.ravel(order="F"), b1,
+                           Wo.ravel(order="F"), bo,
+                           centers.ravel(order="C")])
+    cj = _conf_json([
+        ("dense", {"activationFn": _act_relu(), "nin": 4, "nout": nin,
+                   "hasBias": True}),
+        ("CenterLossOutputLayer", {
+            "activationFn": _act("Softmax"), "nin": nin, "nout": nout,
+            "hasBias": True, "alpha": 0.1, "lambda": 0.25,
+            "lossFn": {"@class":
+                       "org.nd4j.linalg.lossfunctions.impl.LossMCXENT"}}),
+    ])
+    net = restore_multilayer_network(_zip_bytes(cj, flat))
+    head = net.conf.layers[-1]
+    assert head.lambda_ == 0.25 and head.alpha == 0.1
+    np.testing.assert_allclose(np.asarray(net.params["1"]["cL"]), centers,
+                               rtol=1e-6)
+    x = rs.randn(3, 4).astype(np.float32)
+    oracle = _softmax(np.maximum(x @ W1 + b1, 0) @ Wo + bo)
+    np.testing.assert_allclose(np.asarray(net.output(x)), oracle,
+                               rtol=1e-5, atol=1e-6)
+
+
 def test_dropout_l1_l2_import_mapping():
     """DL4J iDropout p is the RETAIN probability; l1/l2 must land on the
     param-carrying layer, not be silently dropped."""

@@ -38,6 +38,12 @@ while true; do
       [ "$src" = "0" ] && touch /tmp/flash_smoke_done
       # nonzero rc still counts as contact if it printed results;
       # leave undone so a later healthy window can retry
+    elif [ ! -f /tmp/trace_done ]; then
+      echo "TPU UP — capturing profiler trace $(date -u +%FT%TZ)" >> "$LOG"
+      (cd /root/repo && timeout 2400 python tools/profile_capture.py > /tmp/trace_capture.log 2>&1)
+      trc=$?
+      echo "trace rc=$trc $(date -u +%FT%TZ)" >> "$LOG"
+      [ "$trc" = "0" ] && touch /tmp/trace_done
     else
       sleep 420   # all jobs done; stay armed for manual reruns
     fi

@@ -35,12 +35,13 @@ numbers down with the process. Hence the r5 architecture:
   /tmp/jaxcache) is shared across the subprocesses, so the per-config
   re-compiles are cache hits after the first run of each program.
 
-Sweep contents (unchanged from round 4): batch {128, 256} x
-{per-call, scanK, fit-pipelined(scan_steps=K)} ResNet-50 at 224x224
-bf16, best-of-N (default 3) per config, MFU from XLA's own
-cost_analysis() flops against the chip's bf16 peak; plus char-LSTM
-(tBPTT), Word2Vec skip-gram, and dense-vs-Pallas-flash attention
-micro-benches (BASELINE.md configs 3/4 and the fused-kernel evidence).
+Sweep contents: batch {128, 256} x {per-call, scanK,
+fit-pipelined(scan_steps=K)} ResNet-50 at 224x224 bf16, best-of-N
+(default 3) per config, MFU from XLA's own cost_analysis() flops
+against the chip's bf16 peak; plus char-LSTM (tBPTT), Word2Vec
+skip-gram, and LeNet-MNIST entries — all 4 of BASELINE.md's benchable
+configs in one run — and the dense-vs-Pallas-flash attention micro
+(the fused-kernel evidence).
 """
 from __future__ import annotations
 
@@ -239,6 +240,39 @@ def _run_resnet(cfg):
     return out
 
 
+def _run_lenet(cfg):
+    # LeNet MNIST micro-bench (BASELINE.md config 1: zoo LeNet.java:83-95
+    # MultiLayerNetwork.fit). Jitted fit over MNIST-shape batches ->
+    # imgs/sec; completes the 4th of BASELINE.md's benchable configs.
+    import numpy as np
+
+    from deeplearning4j_tpu.models import LeNet
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.data.iterator import ArrayDataSetIterator
+
+    on_tpu, best_of = _bench_env()
+    bl = 512 if on_tpu else 64
+    steps = 20 if on_tpu else 3
+    net = MultiLayerNetwork(LeNet().conf()).init()
+    rs = np.random.RandomState(4)
+    X = rs.rand(bl * steps, 28, 28, 1).astype("float32")
+    Y = np.eye(10, dtype="float32")[rs.randint(0, 10, bl * steps)]
+    it = ArrayDataSetIterator(X, Y, batch_size=bl)
+    # scan_steps pinned so the DL4J_TPU_SCAN_STEPS env default can't
+    # silently change which program this config measures
+    net.fit(it, scan_steps=1)                # compile + warm
+
+    def run():
+        t0 = time.perf_counter()
+        net.fit(it, scan_steps=1)
+        float(net.score())
+        return time.perf_counter() - t0
+
+    return {"mode": "lenet-mnist", "batch": bl,
+            "lenet_imgs_sec": round(bl * steps / _timed_best(run, best_of),
+                                    1)}
+
+
 def _run_char_lstm(cfg):
     # char-LSTM micro-bench (BASELINE.json config 3: GravesLSTM char-RNN,
     # CudnnLSTMHelper + tBPTT analog). 2x200-unit LSTM over one-hot chars,
@@ -279,11 +313,12 @@ def _run_char_lstm(cfg):
     Xrep = np.concatenate([Xl] * steps_l)
     Yrep = np.concatenate([Yl] * steps_l)
     itl = ArrayDataSetIterator(Xrep, Yrep, batch_size=bl)
-    lnet.fit(itl)                            # compile + warm
+    lnet.fit(itl, scan_steps=1)              # pin vs DL4J_TPU_SCAN_STEPS
+    # (compile + warm)
 
     def run():
         t0 = time.perf_counter()
-        lnet.fit(itl)
+        lnet.fit(itl, scan_steps=1)
         float(lnet.score())
         return time.perf_counter() - t0
 
@@ -375,8 +410,9 @@ def _run_attention(cfg):
             "flash_speedup": round(dense_s / max(flash_s, 1e-9), 3)}
 
 
-_KIND_RUNNERS = {"resnet": _run_resnet, "char-lstm": _run_char_lstm,
-                 "word2vec": _run_word2vec, "attention": _run_attention}
+_KIND_RUNNERS = {"resnet": _run_resnet, "lenet": _run_lenet,
+                 "char-lstm": _run_char_lstm, "word2vec": _run_word2vec,
+                 "attention": _run_attention}
 
 
 def run_one(cfg):
@@ -447,6 +483,8 @@ def _configs(on_tpu):
         cfgs.append({"kind": "char-lstm"})
     if os.environ.get("DL4J_TPU_BENCH_W2V", "1") == "1":
         cfgs.append({"kind": "word2vec"})
+    if os.environ.get("DL4J_TPU_BENCH_LENET", "1") == "1":
+        cfgs.append({"kind": "lenet"})
     return cfgs
 
 

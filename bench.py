@@ -253,7 +253,11 @@ def _run_lenet(cfg):
     on_tpu, best_of = _bench_env()
     bl = 512 if on_tpu else 64
     steps = 20 if on_tpu else 3
-    net = MultiLayerNetwork(LeNet().conf()).init()
+    conf = LeNet().conf()
+    if on_tpu:
+        import dataclasses
+        conf = dataclasses.replace(conf, compute_dtype="bfloat16")
+    net = MultiLayerNetwork(conf).init()
     rs = np.random.RandomState(4)
     X = rs.rand(bl * steps, 28, 28, 1).astype("float32")
     Y = np.eye(10, dtype="float32")[rs.randint(0, 10, bl * steps)]

@@ -133,6 +133,17 @@ def _required_kind(layer: LayerConf) -> Optional[Kind]:
 def _as_jnp(a, dtype=None):
     if a is None:
         return None
+    # 16-bit compute dtypes (bfloat16 training): cast float32 host arrays
+    # BEFORE the device transfer — ml_dtypes' round-to-nearest-even
+    # matches XLA's device cast bit-for-bit, and the H2D copy ships half
+    # the bytes. f64 is excluded: its old path double-rounds via f32
+    # (x64 disabled), so a direct host cast would not be bit-identical.
+    # DL4J_TPU_HOST_CAST=0 restores the transfer-then-cast path.
+    if (dtype is not None and isinstance(a, np.ndarray)
+            and a.dtype == np.float32
+            and np.dtype(dtype).itemsize == 2
+            and os.environ.get("DL4J_TPU_HOST_CAST", "1") == "1"):
+        a = a.astype(dtype)
     arr = jnp.asarray(a)
     if dtype is not None and jnp.issubdtype(arr.dtype, jnp.floating):
         arr = arr.astype(dtype)

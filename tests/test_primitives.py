@@ -160,3 +160,31 @@ class TestFlatParams:
                   "1": {"a": jnp.array([1.0])}}
         flat = params_to_flat(params)
         np.testing.assert_allclose(flat, [1.0, 2.0, 10.0])
+
+
+class TestHostCast:
+    """_as_jnp host-side 16-bit cast: halves H2D bytes for bf16 compute
+    and must be bit-identical to the transfer-then-device-cast path."""
+
+    def test_bf16_host_cast_bitwise_matches_device_cast(self):
+        from deeplearning4j_tpu.nn.multilayer import _as_jnp
+        rs = np.random.RandomState(0)
+        a = (rs.randn(64, 17) * 100).astype(np.float32)
+        host = _as_jnp(a, jnp.dtype(jnp.bfloat16))
+        dev = jnp.asarray(a).astype(jnp.bfloat16)
+        assert host.dtype == jnp.bfloat16
+        np.testing.assert_array_equal(
+            np.asarray(host).view(np.uint16),
+            np.asarray(dev).view(np.uint16))
+
+    def test_kill_switch_and_non_16bit_paths(self, monkeypatch):
+        from deeplearning4j_tpu.nn.multilayer import _as_jnp
+        a = np.ones((3, 3), np.float32)
+        # f32 compute: no host cast, dtype preserved
+        out = _as_jnp(a, jnp.dtype(jnp.float32))
+        assert out.dtype == jnp.float32
+        # masks (dtype=None): untouched
+        assert _as_jnp(a).dtype == jnp.float32
+        monkeypatch.setenv("DL4J_TPU_HOST_CAST", "0")
+        out = _as_jnp(a, jnp.dtype(jnp.bfloat16))
+        assert out.dtype == jnp.bfloat16   # still cast, just on device

@@ -13,8 +13,8 @@ Markers (README "Running the tests"):
 - `distributed`: tests that spawn real extra OS processes.
 
 A persistent XLA compilation cache (JAX_TEST_CACHE_DIR, default
-/tmp/dl4jtpu-jax-test-cache) makes repeat runs compile-free: the first run
-pays the jit cost, later runs reload compiled programs from disk.
+$TMPDIR/dl4jtpu-jax-cache-<uid>) makes repeat runs compile-free: the first
+run pays the jit cost, later runs reload compiled programs from disk.
 """
 import os
 import sys
@@ -38,9 +38,14 @@ jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", False)
 
 # Persistent compile cache: repeat suite runs skip XLA compilation.
+# Per-user path (shared with __graft_entry__): /tmp is world-writable, so
+# a fixed name would collide across users and invite cache poisoning.
+import tempfile  # noqa: E402
+
+_default_cache = os.path.join(tempfile.gettempdir(),
+                              f"dl4jtpu-jax-cache-{os.getuid()}")
 jax.config.update("jax_compilation_cache_dir",
-                  os.environ.get("JAX_TEST_CACHE_DIR",
-                                 "/tmp/dl4jtpu-jax-test-cache"))
+                  os.environ.get("JAX_TEST_CACHE_DIR", _default_cache))
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 

@@ -166,11 +166,29 @@ class TestHostCast:
     """_as_jnp host-side 16-bit cast: halves H2D bytes for bf16 compute
     and must be bit-identical to the transfer-then-device-cast path."""
 
-    def test_bf16_host_cast_bitwise_matches_device_cast(self):
+    @staticmethod
+    def _spy_transfer_dtype(monkeypatch):
+        """Record the dtype of whatever _as_jnp hands to jnp.asarray —
+        the observable that distinguishes host-cast from device-cast."""
+        import deeplearning4j_tpu.nn.multilayer as ml
+        seen = {}
+        real = ml.jnp.asarray
+
+        def spy(a, *args, **kwargs):
+            seen["dtype"] = getattr(a, "dtype", None)
+            return real(a, *args, **kwargs)
+
+        monkeypatch.setattr(ml.jnp, "asarray", spy)
+        return seen
+
+    def test_bf16_host_cast_bitwise_matches_device_cast(self, monkeypatch):
         from deeplearning4j_tpu.nn.multilayer import _as_jnp
+        monkeypatch.setenv("DL4J_TPU_HOST_CAST", "1")
+        seen = self._spy_transfer_dtype(monkeypatch)
         rs = np.random.RandomState(0)
         a = (rs.randn(64, 17) * 100).astype(np.float32)
         host = _as_jnp(a, jnp.dtype(jnp.bfloat16))
+        assert seen["dtype"] == jnp.bfloat16      # cast BEFORE transfer
         dev = jnp.asarray(a).astype(jnp.bfloat16)
         assert host.dtype == jnp.bfloat16
         np.testing.assert_array_equal(
@@ -179,12 +197,19 @@ class TestHostCast:
 
     def test_kill_switch_and_non_16bit_paths(self, monkeypatch):
         from deeplearning4j_tpu.nn.multilayer import _as_jnp
+        monkeypatch.setenv("DL4J_TPU_HOST_CAST", "1")
+        seen = self._spy_transfer_dtype(monkeypatch)
         a = np.ones((3, 3), np.float32)
         # f32 compute: no host cast, dtype preserved
         out = _as_jnp(a, jnp.dtype(jnp.float32))
         assert out.dtype == jnp.float32
+        assert seen["dtype"] == np.float32
         # masks (dtype=None): untouched
         assert _as_jnp(a).dtype == jnp.float32
+        # f64 sources must NOT host-cast (double-rounding via f32 differs)
+        _as_jnp(np.ones((2, 2), np.float64), jnp.dtype(jnp.bfloat16))
+        assert seen["dtype"] == np.float64
         monkeypatch.setenv("DL4J_TPU_HOST_CAST", "0")
         out = _as_jnp(a, jnp.dtype(jnp.bfloat16))
-        assert out.dtype == jnp.bfloat16   # still cast, just on device
+        assert seen["dtype"] == np.float32        # transferred as f32...
+        assert out.dtype == jnp.bfloat16          # ...cast on device

@@ -38,14 +38,12 @@ jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", False)
 
 # Persistent compile cache: repeat suite runs skip XLA compilation.
-# Per-user path (shared with __graft_entry__): /tmp is world-writable, so
-# a fixed name would collide across users and invite cache poisoning.
-import tempfile  # noqa: E402
+# ONE path definition (bench.cache_dir) shared with bench.py and
+# __graft_entry__ so the caches can't silently split.
+from bench import cache_dir  # noqa: E402
 
-_default_cache = os.path.join(tempfile.gettempdir(),
-                              f"dl4jtpu-jax-cache-{os.getuid()}")
 jax.config.update("jax_compilation_cache_dir",
-                  os.environ.get("JAX_TEST_CACHE_DIR", _default_cache))
+                  os.environ.get("JAX_TEST_CACHE_DIR", cache_dir()))
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 

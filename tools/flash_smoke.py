@@ -143,6 +143,8 @@ check("blockq256 t2048 bf16", 4, 2048, 8, 64, jnp.bfloat16, True, False,
 check_bwd("b4 t2048 h8 d64 bf16 causal", 4, 2048, 8, 64, jnp.bfloat16, True)
 check_bwd("b2 t1024 h8 d64 f32 full", 2, 1024, 8, 64, jnp.float32, False)
 check_bwd("b1 t4096 h8 d64 bf16 causal", 1, 4096, 8, 64, jnp.bfloat16, True)
+check_bwd("b2 t300 h8 d64 bf16 causal pad", 2, 300, 8, 64, jnp.bfloat16,
+          True)   # t % 128 != 0 -> padding path through the backward too
 
 # return_lse path (the ring-flash composition residual)
 try:

@@ -208,7 +208,7 @@ def _run_resnet(cfg):
                 batch * n_steps / _timed_best(run, best_of), 2)
         else:
             @jax.jit
-            def scan_steps_fn(p, o, s, rng):
+            def scan_steps(p, o, s, rng):
                 def body(carry, k):
                     cp, co, cs, cr = carry
                     cr, sub = jax.random.split(cr)
@@ -218,13 +218,13 @@ def _run_resnet(cfg):
                     body, (p, o, s, rng), jnp.arange(scan_k))
                 return p, o, s, losses[-1]
 
-            p, o, s, loss = scan_steps_fn(p, o, s, rng)   # compile+run
+            p, o, s, loss = scan_steps(p, o, s, rng)   # compile+run
             float(loss)
 
             def run():
                 nonlocal p, o, s
                 t0 = time.perf_counter()
-                p, o, s, loss = scan_steps_fn(p, o, s, rng)
+                p, o, s, loss = scan_steps(p, o, s, rng)
                 float(loss)
                 return time.perf_counter() - t0
 

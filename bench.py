@@ -478,6 +478,25 @@ def _install_sigterm_handler():
     except (ValueError, OSError):
         pass
 
+def _headline(results):
+    """Pick the headline row: best ResNet imgs/sec. Micro-bench entries
+    (lenet_imgs_sec/chars_sec/pairs_sec) ride along in the sweep only."""
+    return max((r for r in results if "imgs_sec" in r),
+               key=lambda r: r["imgs_sec"], default=None)
+
+
+def _canon_mode(cfg, scan_k):
+    """Error/skip entries must carry the same mode label a successful
+    run reports (scan -> scanK, fit -> fit-pipelinedK) so downstream
+    grouping by mode can't split one config across two names."""
+    mode = cfg.get("mode")
+    if cfg.get("kind") == "resnet" and mode == "scan":
+        return {**cfg, "mode": f"scan{scan_k}"}
+    if cfg.get("kind") == "resnet" and mode == "fit":
+        return {**cfg, "mode": f"fit-pipelined{scan_k}"}
+    return cfg
+
+
 def _configs(on_tpu):
     batches = [int(b) for b in os.environ.get(
         "DL4J_TPU_BENCH_BATCHES",
@@ -523,15 +542,7 @@ def main():
     scan_k = 10 if tpu_up else 2
 
     def canon(cfg):
-        """Error/skip entries must carry the same mode label a successful
-        run reports (scan -> scanK, fit -> fit-pipelinedK) so downstream
-        grouping by mode can't split one config across two names."""
-        mode = cfg.get("mode")
-        if cfg.get("kind") == "resnet" and mode == "scan":
-            return {**cfg, "mode": f"scan{scan_k}"}
-        if cfg.get("kind") == "resnet" and mode == "fit":
-            return {**cfg, "mode": f"fit-pipelined{scan_k}"}
-        return cfg
+        return _canon_mode(cfg, scan_k)
 
     for cfg in _configs(tpu_up):
         label = json.dumps(cfg, sort_keys=True)
@@ -584,8 +595,7 @@ def main():
                         if r.get("device_kind")), None)
     hw = next((r["hw"] for r in results if r.get("hw")), None)
     peak = PEAK_FLOPS.get(device_kind)
-    best = max((r for r in results if "imgs_sec" in r),
-               key=lambda r: r["imgs_sec"], default=None)
+    best = _headline(results)
     # each row carries the best_of its subprocess actually used; report
     # that rather than re-deriving (the env/platform guess could disagree)
     best_of = next((r["best_of"] for r in results if r.get("best_of")),

@@ -1,0 +1,152 @@
+"""Unit tests for the bench orchestration (driver contract pieces that
+need no device): config ordering, mode-label canonicalization, cache
+path, and the headline-aggregation rule.
+
+bench.py's module level imports no jax, so these are instant.
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+import bench
+
+_KNOBS = ("DL4J_TPU_BENCH_BATCHES", "DL4J_TPU_BENCH_ATTENTION",
+          "DL4J_TPU_BENCH_LSTM", "DL4J_TPU_BENCH_W2V",
+          "DL4J_TPU_BENCH_LENET")
+
+
+@pytest.fixture
+def clean_knobs(monkeypatch):
+    """_configs() reads DL4J_TPU_BENCH_* — isolate from the caller's
+    shell so an exported knob can't flip these assertions."""
+    for k in _KNOBS:
+        monkeypatch.delenv(k, raising=False)
+
+
+class TestConfigs:
+    def test_tpu_order_banks_decisive_trio_first(self, clean_knobs):
+        cfgs = bench._configs(True)
+        kinds = [(c.get("kind"), c.get("mode", "")) for c in cfgs]
+        # the per-call/scan/fit trio at batch 128 must precede the Pallas
+        # attention micro (first-contact wedge risk) and batch 256
+        assert kinds[:3] == [("resnet", "per-call"), ("resnet", "scan"),
+                             ("resnet", "fit")]
+        assert kinds[3] == ("attention", "")
+        assert {c["batch"] for c in cfgs[:3]} == {128}
+        # full sweep carries all 4 BASELINE configs
+        assert {"char-lstm", "word2vec", "lenet"} <= {k for k, _ in kinds}
+
+    def test_cpu_order_single_batch(self, clean_knobs):
+        cfgs = bench._configs(False)
+        batches = {c.get("batch") for c in cfgs if "batch" in c
+                   and c["kind"] == "resnet"}
+        assert batches == {8}
+
+    def test_env_knobs_disable_entries(self, clean_knobs, monkeypatch):
+        monkeypatch.setenv("DL4J_TPU_BENCH_LSTM", "0")
+        monkeypatch.setenv("DL4J_TPU_BENCH_W2V", "0")
+        monkeypatch.setenv("DL4J_TPU_BENCH_LENET", "0")
+        monkeypatch.setenv("DL4J_TPU_BENCH_ATTENTION", "0")
+        kinds = {c["kind"] for c in bench._configs(True)}
+        assert kinds == {"resnet"}
+
+
+class TestCanonMode:
+    def test_scan_and_fit_get_k_suffix(self):
+        assert bench._canon_mode(
+            {"kind": "resnet", "mode": "scan"}, 10)["mode"] == "scan10"
+        assert bench._canon_mode(
+            {"kind": "resnet", "mode": "fit"}, 2)["mode"] == "fit-pipelined2"
+
+    def test_other_configs_untouched(self):
+        for cfg in ({"kind": "resnet", "mode": "per-call"},
+                    {"kind": "attention"}, {"kind": "char-lstm"}):
+            assert bench._canon_mode(dict(cfg), 10) == cfg
+
+
+class TestCacheDir:
+    def test_per_user_path(self):
+        d = bench.cache_dir()
+        assert str(os.getuid()) in os.path.basename(d)
+
+    def test_shared_with_graft_entry_and_conftest(self):
+        # conftest imports the same symbol; __graft_entry__ falls back to
+        # it too — one definition, so just assert it is importable from
+        # the repo root the way both callers do it
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "from bench import cache_dir; print(cache_dir())"],
+            capture_output=True, text=True, cwd=repo, timeout=60)
+        assert r.returncode == 0
+        assert r.stdout.strip() == bench.cache_dir()
+
+
+class TestHeadlineAggregation:
+    def test_best_is_max_imgs_sec_and_micro_entries_cannot_win(self):
+        results = [
+            {"batch": 128, "mode": "per-call", "imgs_sec": 2400.0},
+            {"batch": 128, "mode": "scan10", "imgs_sec": 3300.0},
+            {"mode": "lenet-mnist", "lenet_imgs_sec": 99999.0},
+            {"mode": "char-lstm", "chars_sec": 1e9},
+            {"batch": 256, "mode": "per-call",
+             "error": "watchdog: config exceeded 1800s"},
+        ]
+        best = bench._headline(results)
+        assert best["mode"] == "scan10"   # micro benches ride along only
+        assert bench._headline([{"mode": "x", "error": "e"}]) is None
+
+    @pytest.mark.slow
+    @pytest.mark.distributed
+    def test_sigterm_kills_inflight_child(self, tmp_path):
+        # orchestration-level contract: the --one child dies with the
+        # orchestrator (no orphan contending for the chip)
+        import signal
+        import time as _t
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   DL4J_TPU_BENCH_PARTIAL=str(tmp_path / "partial.jsonl"))
+        p = subprocess.Popen([sys.executable, "bench.py"], cwd=repo,
+                             env=env, stdout=subprocess.DEVNULL,
+                             stderr=subprocess.DEVNULL)
+        try:
+            child_pid = None
+            for _ in range(120):     # wait for the first --one child
+                _t.sleep(1)
+                r = subprocess.run(
+                    ["pgrep", "-f", "bench.py --one"],
+                    capture_output=True, text=True)
+                pids = [int(x) for x in r.stdout.split()
+                        if x.strip().isdigit() and int(x) != p.pid]
+                live = []
+                for pid in pids:
+                    try:
+                        with open(f"/proc/{pid}/stat") as f:
+                            ppid = int(f.read().split()[3])
+                        if ppid == p.pid:
+                            live.append(pid)
+                    except OSError:
+                        pass
+                if live:
+                    child_pid = live[0]
+                    break
+            assert child_pid is not None, "no --one child appeared"
+            p.send_signal(signal.SIGTERM)
+            p.wait(timeout=30)
+            for _ in range(20):
+                if not os.path.exists(f"/proc/{child_pid}"):
+                    break
+                _t.sleep(0.5)
+            # a zombie (not yet reaped) also counts as dead
+            alive = os.path.exists(f"/proc/{child_pid}")
+            if alive:
+                with open(f"/proc/{child_pid}/stat") as f:
+                    alive = f.read().split()[2] != "Z"
+            assert not alive, "config child survived orchestrator SIGTERM"
+        finally:
+            try:
+                p.kill()
+            except OSError:
+                pass

@@ -41,6 +41,10 @@ class MultiLayerConfiguration:
     compute_dtype: Optional[str] = None   # activation dtype (None = dtype)
     grad_clip_norm: Optional[float] = None
     grad_clip_value: Optional[float] = None
+    # rematerialize per-layer activations in the backward pass
+    # (jax.checkpoint): trades recompute FLOPs for HBM — the TPU lever
+    # for deep nets / long sequences that don't fit otherwise
+    gradient_checkpointing: bool = False
 
     # ---- serde ----------------------------------------------------------
     def to_dict(self) -> dict:
@@ -57,6 +61,7 @@ class MultiLayerConfiguration:
             "compute_dtype": self.compute_dtype,
             "grad_clip_norm": self.grad_clip_norm,
             "grad_clip_value": self.grad_clip_value,
+            "gradient_checkpointing": self.gradient_checkpointing,
         }
 
     def to_json(self) -> str:
@@ -88,6 +93,7 @@ class MultiLayerConfiguration:
             compute_dtype=d.get("compute_dtype"),
             grad_clip_norm=d.get("grad_clip_norm"),
             grad_clip_value=d.get("grad_clip_value"),
+            gradient_checkpointing=d.get("gradient_checkpointing", False),
         )
 
     @staticmethod
@@ -117,6 +123,7 @@ class NeuralNetConfiguration:
             self._compute_dtype: Optional[str] = None
             self._grad_clip_norm: Optional[float] = None
             self._grad_clip_value: Optional[float] = None
+            self._gradient_checkpointing = False
             self._weight_init: Optional[str] = None
             self._activation: Optional[str] = None
             self._dropout: Optional[float] = None
@@ -163,6 +170,10 @@ class NeuralNetConfiguration:
 
         def grad_clip_value(self, v: float):
             self._grad_clip_value = float(v)
+            return self
+
+        def gradient_checkpointing(self, on: bool = True):
+            self._gradient_checkpointing = bool(on)
             return self
 
         def list(self) -> "ListBuilder":
@@ -249,6 +260,7 @@ class ListBuilder:
             compute_dtype=p._compute_dtype,
             grad_clip_norm=p._grad_clip_norm,
             grad_clip_value=p._grad_clip_value,
+            gradient_checkpointing=p._gradient_checkpointing,
         )
 
 
@@ -277,6 +289,7 @@ class ComputationGraphConfiguration:
     compute_dtype: Optional[str] = None
     grad_clip_norm: Optional[float] = None
     grad_clip_value: Optional[float] = None
+    gradient_checkpointing: bool = False   # remat per-vertex activations
 
     def topological_order(self) -> List[str]:
         order: List[str] = []
@@ -313,6 +326,7 @@ class ComputationGraphConfiguration:
             "compute_dtype": self.compute_dtype,
             "grad_clip_norm": self.grad_clip_norm,
             "grad_clip_value": self.grad_clip_value,
+            "gradient_checkpointing": self.gradient_checkpointing,
         }
 
     def to_json(self) -> str:
@@ -348,6 +362,7 @@ class ComputationGraphConfiguration:
             compute_dtype=d.get("compute_dtype"),
             grad_clip_norm=d.get("grad_clip_norm"),
             grad_clip_value=d.get("grad_clip_value"),
+            gradient_checkpointing=d.get("gradient_checkpointing", False),
         )
 
     @staticmethod
@@ -411,4 +426,5 @@ class GraphBuilder:
             compute_dtype=p._compute_dtype,
             grad_clip_norm=p._grad_clip_norm,
             grad_clip_value=p._grad_clip_value,
+            gradient_checkpointing=p._gradient_checkpointing,
         )

@@ -210,7 +210,9 @@ class ComputationGraph:
         stored under '__pre__<name>' so score() sees features, not
         post-activation output (the analog of DL4J output layers keeping
         preOutput for computeScore)."""
-        from deeplearning4j_tpu.nn.multilayer import _is_stateful_recurrent
+        from deeplearning4j_tpu.nn.multilayer import (
+            _is_stateful_recurrent, _layer_call,
+        )
         if self._vertex_types is None:
             self._vertex_types = self._resolve_types()
         params = self._cast_params(params)
@@ -252,15 +254,22 @@ class ComputationGraph:
                 sub_rng, noise_rng = jax.random.split(sub_rng)
                 layer_params = apply_weight_noise(vd.vertex, layer_params,
                                                   train, noise_rng)
+            # per-vertex jax.checkpoint under gradient_checkpointing:
+            # backward recomputes this vertex's activations (HBM for
+            # FLOPs); inference forwards are untouched (train only)
+            remat = train and self.conf.gradient_checkpointing
             if carries is not None and _is_stateful_recurrent(vd.vertex):
-                y, carry = vd.vertex.apply_seq(
-                    layer_params, x, carries.get(name), train=train,
+                y, carry = _layer_call(
+                    vd.vertex, seq=True, train=train, remat=remat,
+                    params=layer_params, x=x, carry=carries.get(name),
                     rng=sub_rng, mask=m)
                 new_carries[name] = carry
                 new_state[name] = state.get(name, {})
             else:
-                y, s = vd.vertex.apply(layer_params, state.get(name, {}),
-                                       x, train=train, rng=sub_rng, mask=m)
+                y, s = _layer_call(
+                    vd.vertex, seq=False, train=train, remat=remat,
+                    params=layer_params, x=x, state=state.get(name, {}),
+                    rng=sub_rng, mask=m)
                 new_state[name] = s
             acts[name] = y
             masks[name] = (in_masks[0]

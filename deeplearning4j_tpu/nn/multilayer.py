@@ -130,6 +130,26 @@ def _required_kind(layer: LayerConf) -> Optional[Kind]:
     return _KIND_BY_CLASS.get(name)
 
 
+def _layer_call(layer, *, seq, train, remat, params, x, state=None,
+                carry=None, rng=None, mask=None):
+    """Invoke layer.apply (seq=False) or layer.apply_seq (seq=True), with
+    jax.checkpoint rematerialization when remat is on: every traced value
+    (params/state/carry/input/rng/mask) is a checkpoint ARGUMENT, only the
+    static layer conf and train flag are closed over. Shared by both
+    containers so the two forward passes can't drift."""
+    if seq:
+        def fn(lp, xx, cc, rr, mm, _l=layer):
+            return _l.apply_seq(lp, xx, cc, train=train, rng=rr, mask=mm)
+        args = (params, x, carry, rng, mask)
+    else:
+        def fn(lp, st, xx, rr, mm, _l=layer):
+            return _l.apply(lp, st, xx, train=train, rng=rr, mask=mm)
+        args = (params, state, x, rng, mask)
+    if remat:
+        fn = jax.checkpoint(fn)
+    return fn(*args)
+
+
 def _as_jnp(a, dtype=None):
     if a is None:
         return None
@@ -325,14 +345,23 @@ class MultiLayerNetwork:
                 sub_rng, noise_rng = jax.random.split(sub_rng)
                 layer_params = apply_weight_noise(layer, layer_params, train,
                                                   noise_rng)
+            # gradient checkpointing: rematerialize this layer's
+            # activations in the backward pass instead of storing them —
+            # HBM for recompute FLOPs (jax.checkpoint). Only the training
+            # forward pays for a backward, so inference is untouched.
+            remat = train and self.conf.gradient_checkpointing
             if carries is not None and _is_stateful_recurrent(layer):
-                y, carry = layer.apply_seq(layer_params, x, carries.get(key),
-                                           train=train, rng=sub_rng, mask=mask)
+                y, carry = _layer_call(
+                    layer, seq=True, train=train, remat=remat,
+                    params=layer_params, x=x, carry=carries.get(key),
+                    rng=sub_rng, mask=mask)
                 new_carries[key] = carry
                 new_state[key] = state[key]
             else:
-                y, s = layer.apply(layer_params, state[key], x, train=train,
-                                   rng=sub_rng, mask=mask)
+                y, s = _layer_call(
+                    layer, seq=False, train=train, remat=remat,
+                    params=layer_params, x=x, state=state[key],
+                    rng=sub_rng, mask=mask)
                 new_state[key] = s
             x = y
             cur_type = layer.output_type(cur_type)

@@ -1,0 +1,131 @@
+"""Gradient checkpointing (jax.checkpoint rematerialization).
+
+With gradient_checkpointing=True each layer/vertex recomputes its
+activations in the backward pass instead of storing them — the TPU HBM
+lever for deep nets and long sequences. Remat must not change the math:
+training with it on and off must produce (near-)identical parameters.
+"""
+import dataclasses
+
+import numpy as np
+
+from deeplearning4j_tpu.data.iterator import ArrayDataSetIterator
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.nn.layers import (
+    DenseLayer, LSTM, OutputLayer, RnnOutputLayer,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updaters import Adam
+
+
+def _blobs(n=96, nc=3, nf=6, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(size=(nc, nf)) * 4
+    X = np.concatenate([rng.normal(size=(n // nc, nf)) + c
+                        for c in centers]).astype(np.float32)
+    Y = np.eye(nc, dtype=np.float32)[
+        np.repeat(np.arange(nc), n // nc)]
+    return X, Y
+
+
+def _mlp_conf(remat):
+    b = (NeuralNetConfiguration.Builder().seed(7).updater(Adam(1e-2)))
+    if remat:
+        b = b.gradient_checkpointing()
+    return (b.list()
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=3, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.feed_forward(6))
+            .build())
+
+
+def _params_flat(net):
+    import jax
+    return np.concatenate([np.asarray(x).ravel()
+                           for x in jax.tree_util.tree_leaves(net.params)])
+
+
+class TestRematParity:
+    def test_mlp_training_identical_with_and_without(self):
+        X, Y = _blobs()
+        nets = []
+        for remat in (False, True):
+            net = MultiLayerNetwork(_mlp_conf(remat)).init()
+            net.fit(ArrayDataSetIterator(X, Y, batch_size=32), epochs=3)
+            nets.append(net)
+        base, remat = nets
+        np.testing.assert_allclose(_params_flat(base), _params_flat(remat),
+                                   rtol=1e-5, atol=1e-6)
+        # and it actually learns
+        ev = remat.evaluate(ArrayDataSetIterator(X, Y, batch_size=32))
+        assert ev.accuracy() > 0.8
+
+    def test_rnn_training_identical_with_and_without(self):
+        rs = np.random.RandomState(3)
+        T, F = 12, 5
+        X = rs.rand(24, T, F).astype(np.float32)
+        Y = np.eye(4, dtype=np.float32)[
+            rs.randint(0, 4, (24, T))]
+        nets = []
+        for remat in (False, True):
+            b = NeuralNetConfiguration.Builder().seed(5).updater(Adam(1e-2))
+            if remat:
+                b = b.gradient_checkpointing()
+            conf = (b.list()
+                    .layer(LSTM(n_out=8, activation="tanh"))
+                    .layer(RnnOutputLayer(n_out=4, activation="softmax",
+                                          loss="mcxent"))
+                    .set_input_type(InputType.recurrent(F, T))
+                    .build())
+            net = MultiLayerNetwork(conf).init()
+            net.fit(ArrayDataSetIterator(X, Y, batch_size=12), epochs=2)
+            nets.append(net)
+        np.testing.assert_allclose(_params_flat(nets[0]),
+                                   _params_flat(nets[1]),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_graph_training_identical_with_and_without(self):
+        X, Y = _blobs()
+        nets = []
+        for remat in (False, True):
+            b = NeuralNetConfiguration.Builder().seed(9).updater(Adam(1e-2))
+            gb = (b.graph_builder()
+                  .add_inputs("in")
+                  .add_layer("d1", DenseLayer(n_out=12, activation="relu"),
+                             "in")
+                  .add_layer("d2", DenseLayer(n_out=12, activation="relu"),
+                             "d1")
+                  .add_layer("out", OutputLayer(n_out=3,
+                                                activation="softmax",
+                                                loss="mcxent"), "d2")
+                  .set_outputs("out")
+                  .set_input_types(InputType.feed_forward(6)))
+            conf = gb.build()
+            if remat:
+                conf = dataclasses.replace(conf,
+                                           gradient_checkpointing=True)
+            net = ComputationGraph(conf).init()
+            net.fit(ArrayDataSetIterator(X, Y, batch_size=32), epochs=3)
+            nets.append(net)
+        np.testing.assert_allclose(_params_flat(nets[0]),
+                                   _params_flat(nets[1]),
+                                   rtol=1e-5, atol=1e-6)
+
+
+class TestRematSerde:
+    def test_flag_round_trips_json_and_builder(self):
+        conf = _mlp_conf(True)
+        assert conf.gradient_checkpointing is True
+        from deeplearning4j_tpu.nn.conf.network import (
+            MultiLayerConfiguration,
+        )
+        back = MultiLayerConfiguration.from_json(conf.to_json())
+        assert back.gradient_checkpointing is True
+        # default stays off and old JSON (no field) reads as off
+        d = conf.to_dict()
+        del d["gradient_checkpointing"]
+        assert MultiLayerConfiguration.from_dict(
+            d).gradient_checkpointing is False

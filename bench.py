@@ -284,7 +284,7 @@ def _run_lenet(cfg):
         float(net.score())
         return time.perf_counter() - t0
 
-    return {"mode": "lenet-mnist", "batch": bl,
+    return {"mode": "lenet-mnist", "batch": bl, "on_tpu": on_tpu,
             "lenet_imgs_sec": round(bl * steps / _timed_best(run, best_of),
                                     1)}
 
@@ -339,6 +339,7 @@ def _run_char_lstm(cfg):
         return time.perf_counter() - t0
 
     return {"mode": "char-lstm", "units": units, "tbptt": T, "batch": bl,
+            "on_tpu": on_tpu,
             "chars_sec": round(bl * T * steps_l / _timed_best(run, best_of),
                                1)}
 
@@ -380,7 +381,7 @@ def _run_word2vec(cfg):
         return time.perf_counter() - t0
 
     return {"mode": "word2vec-sgns", "vocab": vocab_w, "dim": dim_w,
-            "negative": neg,
+            "negative": neg, "on_tpu": on_tpu,
             "pairs_sec": round(pairs * steps_w / _timed_best(run, best_of),
                                0)}
 
@@ -421,6 +422,7 @@ def _run_attention(cfg):
     dense_s = time_attn(dense_fn)
     flash_s = time_attn(flash_fn)
     return {"mode": "attention-micro", "shape": [b_, t_, h_, d_],
+            "on_tpu": on_tpu,
             "dense_ms": round(dense_s * 1e3, 3),
             "flash_ms": round(flash_s * 1e3, 3),
             "flash_speedup": round(dense_s / max(flash_s, 1e-9), 3)}

@@ -19,7 +19,14 @@ from jax import lax
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-assert jax.devices()[0].platform != "cpu", "need TPU"
+# CPU run allowed only for smoke-testing the script itself (tiny batch);
+# the watcher always runs it on hardware
+if os.environ.get("DL4J_TPU_TRACE_ALLOW_CPU", "0") == "1":
+    # the axon plugin force-appends itself to jax_platforms at import —
+    # pin back to CPU or a wedged tunnel hangs the smoke in backend init
+    jax.config.update("jax_platforms", "cpu")
+else:
+    assert jax.devices()[0].platform != "cpu", "need TPU"
 
 import dataclasses
 

@@ -141,12 +141,22 @@ while true; do
       (cd /root/repo && timeout 2400 python tools/profile_capture.py > /tmp/trace_capture.log 2>&1)
       trc=$?
       echo "trace rc=$trc $(date -u +%FT%TZ)" >> "$LOG"
-      [ "$trc" = "0" ] && touch /tmp/trace_done
+      # the trace run also prints measured per-call/scan10 throughput —
+      # bank the log whenever those numbers landed
+      if grep -q 'imgs/s' /tmp/trace_capture.log 2>/dev/null; then
+        bank_windowed /tmp/trace_capture.log /tmp/trace_windowed.log \
+          TRACE_CAPTURE_r05.log \
+          "Bank profiler-trace capture log (rc=$trc)" \
+          && [ "$trc" = "0" ] && touch /tmp/trace_done
+      fi
     else
       sleep 420   # all jobs done; stay armed for manual reruns
     fi
     sleep 30
   else
-    sleep 170
+    # short sleep when down: a wedged probe already burns its 180s
+    # timeout, and observed healthy windows last only ~5-10 min — a
+    # ~4 min down-cycle can miss one entirely, a ~2.5 min one won't
+    sleep 50
   fi
 done

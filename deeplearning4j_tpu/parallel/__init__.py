@@ -34,6 +34,9 @@ from deeplearning4j_tpu.parallel.pipeline import PipelineParallelTrainer
 from deeplearning4j_tpu.parallel.shared import (
     LoopbackTransport, SharedGradientsTrainer,
 )
+from deeplearning4j_tpu.parallel.zero import (
+    sharded_fraction, zero_place, zero_spec,
+)
 
 __all__ = [
     "MeshConfig", "build_mesh", "data_sharding", "replicated_sharding",
@@ -47,4 +50,5 @@ __all__ = [
     "ring_self_attention", "make_ring_attention", "blockwise_attention",
     "ContextParallelTrainer", "PipelineParallelTrainer",
     "SharedGradientsTrainer", "LoopbackTransport",
+    "zero_place", "zero_spec", "sharded_fraction",
 ]

@@ -23,6 +23,12 @@ compiled modes:
 Thread-per-GPU worker zoos, round-robin feeding, and the FancyBlockingQueue
 (`DefaultTrainer.java:243-330`) have no analog here: SPMD replaces threads,
 and the async host-side prefetch is `AsyncDataSetIterator`.
+
+Beyond the reference: `zero_stage` (1 or 3) layers ZeRO/FSDP memory
+sharding onto SYNC_GRADIENTS — optimizer state (and at stage 3 the
+parameters) live dim-0-sharded over the "data" axis during training, with
+the reduce-scatter/all-gather schedule derived by XLA from sharding
+constraints. See `parallel/zero.py`.
 """
 from __future__ import annotations
 
@@ -37,6 +43,7 @@ import numpy as np
 import optax
 
 from deeplearning4j_tpu.data.iterator import DataSetIterator
+from deeplearning4j_tpu.parallel import zero
 from deeplearning4j_tpu.parallel.mesh import (
     DATA_AXIS, build_mesh, MeshConfig, stacked_sharding,
 )
@@ -75,12 +82,23 @@ class ParallelWrapper:
                  mode: TrainingMode = TrainingMode.SYNC_GRADIENTS,
                  averaging_frequency: int = 5,
                  average_updaters: bool = True,
-                 report_score_after_averaging: bool = False):
+                 report_score_after_averaging: bool = False,
+                 zero_stage: int = 0):
         if model.params is None:
             model.init()
         self.model = model
         self.mesh = mesh if mesh is not None else build_mesh(MeshConfig())
         self.mode = TrainingMode(mode)
+        if zero_stage not in zero.VALID_STAGES:
+            raise ValueError(
+                f"zero_stage must be one of {zero.VALID_STAGES} "
+                f"(got {zero_stage}); stage 2 is subsumed by stage 1 — "
+                "the reduce-scattered gradient never materializes whole")
+        if zero_stage and self.mode != TrainingMode.SYNC_GRADIENTS:
+            raise ValueError("zero_stage requires SYNC_GRADIENTS mode "
+                             "(AVERAGING keeps per-worker full copies by "
+                             "definition)")
+        self.zero_stage = zero_stage
         self.averaging_frequency = max(1, int(averaging_frequency))
         self.average_updaters = average_updaters
         self.report_score_after_averaging = report_score_after_averaging
@@ -127,6 +145,53 @@ class ParallelWrapper:
                                     fmask, lmask, rng)
 
         return jax.jit(step, donate_argnums=(0, 1, 2))
+
+    def _build_zero_step(self):
+        # Same math as the sync step; the only additions are sharding
+        # constraints pinning grads/updates/opt-state to the ZeRO layout
+        # (dim 0 split over "data") and params to their stage's layout.
+        # XLA derives the schedule: reduce-scatter grads -> sharded
+        # optimizer math -> all-gather (updates at stage 1, params at the
+        # next forward's use sites at stage 3). See parallel/zero.py.
+        mesh = self.mesh
+        stage3 = self.zero_stage == 3
+
+        def step(params, opt_state, state, x, y, fmask, lmask, rng):
+            def lf(p):
+                return self._loss_fn(p, state, x, y, fmask, lmask, rng)
+            (loss, new_state), grads = \
+                jax.value_and_grad(lf, has_aux=True)(params)
+            grads = zero.zero_constraint(grads, mesh)
+            updates, new_opt = self.model._tx.update(grads, opt_state,
+                                                     params)
+            updates = zero.zero_constraint(updates, mesh)
+            new_opt = zero.zero_constraint(new_opt, mesh)
+            new_params = optax.apply_updates(params, updates)
+            new_params = zero.zero_constraint(new_params, mesh) if stage3 \
+                else zero.replicated_constraint(new_params, mesh)
+            return new_params, new_opt, new_state, loss
+
+        return jax.jit(step, donate_argnums=(0, 1, 2))
+
+    def _zero_place(self):
+        """Place the wrapped net's params/opt-state in the ZeRO layout for
+        this stage (idempotent; called at fit start)."""
+        net = self.model
+        net.opt_state = zero.zero_place(net.opt_state, self.mesh)
+        if self.zero_stage == 3:
+            net.params = zero.zero_place(net.params, self.mesh)
+        else:
+            net.params = zero.replicate_place(net.params, self.mesh)
+
+    def _zero_gather(self):
+        """Restore DL4J post-fit semantics — "after fit() the wrapped
+        network holds the trained parameters": params come back replicated
+        so eval/serialization see whole arrays. Opt state stays sharded
+        (the next wrapper.fit re-uses it in place; a plain net.fit would
+        re-materialize it anyway)."""
+        if self.zero_stage == 3:
+            self.model.params = zero.replicate_place(self.model.params,
+                                                     self.mesh)
 
     def _build_avg_step(self):
         vstep = jax.vmap(self._local_step)
@@ -193,7 +258,10 @@ class ParallelWrapper:
         mesh = self.mesh
         shard = NamedSharding(mesh, P(DATA_AXIS))
         if self._step_fn is None:
-            self._step_fn = self._build_sync_step()
+            self._step_fn = self._build_zero_step() if self.zero_stage \
+                else self._build_sync_step()
+        if self.zero_stage:
+            self._zero_place()
         rng = jax.random.PRNGKey(net.conf.seed + 65537)
         for _ in range(epochs):
             for lst in net.listeners:
@@ -225,6 +293,8 @@ class ParallelWrapper:
                 lst.on_epoch_end(net, net.epoch_count)
             net.epoch_count += 1
             self._reset(source)
+        if self.zero_stage:
+            self._zero_gather()
         # note: the wrapped net's own compiled-step caches are kept — jit
         # re-lowers automatically if the params' sharding changed, so
         # dropping them only forced needless recompiles on later fits

@@ -1,0 +1,157 @@
+"""ZeRO / FSDP sharding (`parallel/zero.py` + ParallelWrapper zero_stage).
+
+No DL4J analog (reference DP always keeps full per-worker copies —
+ParallelWrapper.java:467-579); this is TPU-native capability. Semantics
+contract: ZeRO is a memory layout, not an algorithm change — stage 1 and
+stage 3 must produce the same trained parameters as plain SYNC_GRADIENTS
+up to reduction-order epsilon, while the optimizer state (and at stage 3
+the parameters) live dim-0-sharded over the "data" axis during training.
+"""
+import jax
+import numpy as np
+import pytest
+
+from jax.sharding import PartitionSpec as P
+
+from deeplearning4j_tpu.data.iterator import ArrayDataSetIterator
+from deeplearning4j_tpu.nn.conf.base import InputType
+from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updaters import Adam
+from deeplearning4j_tpu.parallel import (
+    ParallelWrapper, TrainingMode, build_mesh, MeshConfig, sharded_fraction,
+)
+from deeplearning4j_tpu.parallel.zero import zero_spec
+
+
+def _blob_data(n=256, k=4, d=8, seed=0):
+    rs = np.random.RandomState(seed)
+    X = np.vstack([rs.randn(n // k, d) * 0.35 + i for i in range(k)]
+                  ).astype("float32")
+    Y = np.eye(k, dtype="float32")[np.repeat(np.arange(k), n // k)]
+    perm = rs.permutation(n)
+    return X[perm], Y[perm]
+
+
+def _mlp(seed=7, lr=5e-2, width=16):
+    return (NeuralNetConfiguration.Builder()
+            .seed(seed).updater(Adam(lr))
+            .list()
+            .layer(DenseLayer(n_out=width, activation="relu"))
+            .layer(OutputLayer(n_out=4, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(8))
+            .build())
+
+
+def _fit(zero_stage, epochs=3, seed=3, lr=1e-2):
+    X, Y = _blob_data()
+    net = MultiLayerNetwork(_mlp(seed=seed, lr=lr)).init()
+    w = ParallelWrapper(net, mode=TrainingMode.SYNC_GRADIENTS,
+                        zero_stage=zero_stage)
+    w.fit(ArrayDataSetIterator(X, Y, batch_size=64), epochs=epochs)
+    return net, w, (X, Y)
+
+
+def test_zero_spec_divisibility():
+    a = np.zeros((16, 3))
+    b = np.zeros((6, 3))     # 6 % 8 != 0 -> replicated
+    c = np.zeros(())
+    assert zero_spec(a, 8) == P("data")
+    assert zero_spec(b, 8) == P()
+    assert zero_spec(c, 8) == P()
+
+
+def test_zero_stage_validation():
+    net = MultiLayerNetwork(_mlp()).init()
+    with pytest.raises(ValueError):
+        ParallelWrapper(net, zero_stage=2)
+    with pytest.raises(ValueError):
+        ParallelWrapper(net, mode=TrainingMode.AVERAGING, zero_stage=1)
+
+
+def test_zero1_matches_plain_sync():
+    """Stage 1 is the same algorithm as SYNC_GRADIENTS — trained params
+    must match to reduction-order epsilon."""
+    net_ref, _, _ = _fit(zero_stage=0)
+    net_z1, _, _ = _fit(zero_stage=1)
+    np.testing.assert_allclose(np.asarray(net_ref.params_flat()),
+                               np.asarray(net_z1.params_flat()),
+                               atol=2e-5, rtol=1e-4)
+
+
+def test_zero3_matches_plain_sync_and_trains():
+    net_ref, _, _ = _fit(zero_stage=0)
+    net_z3, _, _ = _fit(zero_stage=3)
+    np.testing.assert_allclose(np.asarray(net_ref.params_flat()),
+                               np.asarray(net_z3.params_flat()),
+                               atol=2e-5, rtol=1e-4)
+    # convergence on its own terms (enough epochs to separate the blobs)
+    net, _, data = _fit(zero_stage=3, epochs=8, seed=7, lr=5e-2)
+    acc = net.evaluate(data).accuracy()
+    assert acc > 0.9, acc
+
+
+def test_zero1_opt_state_is_sharded_in_training():
+    """During (and after) fit, divisible optimizer-state leaves live split
+    8 ways over the data axis: each device holds 1/8 of dim 0."""
+    net, w, _ = _fit(zero_stage=1, epochs=1)
+    mesh = w.mesh
+    n = mesh.shape["data"]
+    checked = 0
+    for leaf in jax.tree_util.tree_leaves(net.opt_state):
+        if zero_spec(leaf, n) == P("data"):
+            shard = leaf.addressable_shards[0].data
+            assert shard.shape[0] == leaf.shape[0] // n, \
+                (leaf.shape, shard.shape)
+            checked += 1
+    assert checked >= 2   # Adam mu+nu for at least the kernel
+    # params stay replicated at stage 1
+    for leaf in jax.tree_util.tree_leaves(net.params):
+        assert leaf.addressable_shards[0].data.shape == leaf.shape
+
+
+def test_zero3_params_sharded_in_training_gathered_after():
+    """Stage 3: params live sharded inside the fit loop (checked via the
+    wrapper's placement hook), and come back whole after fit so
+    eval/serialization see full arrays."""
+    X, Y = _blob_data()
+    net = MultiLayerNetwork(_mlp(seed=3, lr=1e-2)).init()
+    w = ParallelWrapper(net, zero_stage=3)
+    w._zero_place()
+    n = w.mesh.shape["data"]
+    sharded = [leaf for leaf in jax.tree_util.tree_leaves(net.params)
+               if zero_spec(leaf, n) == P("data")]
+    assert sharded, "no divisible param leaf found"
+    for leaf in sharded:
+        assert leaf.addressable_shards[0].data.shape[0] \
+            == leaf.shape[0] // n
+    w.fit(ArrayDataSetIterator(X, Y, batch_size=64), epochs=1)
+    for leaf in jax.tree_util.tree_leaves(net.params):
+        assert leaf.addressable_shards[0].data.shape == leaf.shape
+
+
+def test_sharded_fraction_diagnostic():
+    net, w, _ = _fit(zero_stage=1, epochs=1)
+    frac = sharded_fraction(net.opt_state, w.mesh)
+    # Adam on an 8->16->4 MLP: every kernel and bias has dim0 % 8 == 0
+    # except the 4-wide output bias; the bulk of the bytes shard.
+    assert frac > 0.5, frac
+
+
+def test_zero_on_computation_graph():
+    from deeplearning4j_tpu.nn.conf.network import GraphBuilder
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    X, Y = _blob_data()
+    g = (GraphBuilder(NeuralNetConfiguration.Builder().seed(11)
+                      .updater(Adam(5e-2)))
+         .add_inputs("in").set_input_types(InputType.feed_forward(8)))
+    g.add_layer("h", DenseLayer(n_out=16, activation="relu"), "in")
+    g.add_layer("out", OutputLayer(n_out=4, activation="softmax",
+                                   loss="mcxent"), "h")
+    g.set_outputs("out")
+    net = ComputationGraph(g.build()).init()
+    w = ParallelWrapper(net, zero_stage=3)
+    w.fit(ArrayDataSetIterator(X, Y, batch_size=64), epochs=16)
+    acc = net.evaluate((X, Y)).accuracy()
+    assert acc > 0.9, acc

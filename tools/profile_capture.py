@@ -93,7 +93,17 @@ with jax.profiler.trace(TRACE_DIR):
     t_scan = time.perf_counter() - t1
 
 print(f"trace saved to {TRACE_DIR}", flush=True)
-print(f"per-call: {10 * BATCH / t_per_call:.1f} imgs/s "
-      f"({t_per_call * 100:.1f} ms/step); "
-      f"scan10: {10 * BATCH / t_scan:.1f} imgs/s "
-      f"({t_scan * 100:.1f} ms/step)", flush=True)
+# platform stamp on the throughput line, and no "imgs/s" text at all on a
+# CPU run: the watcher banks this log on `grep imgs/s`, so a
+# DL4J_TPU_TRACE_ALLOW_CPU smoke run must never look like a hardware
+# measurement (mirrors bench.py's per-row on_tpu guard)
+_plat = jax.devices()[0].device_kind
+if jax.devices()[0].platform == "cpu":
+    print(f"[{_plat}] CPU smoke only — throughput suppressed "
+          f"(per-call {t_per_call * 100:.1f} ms/step, "
+          f"scan10 {t_scan * 100:.1f} ms/step)", flush=True)
+else:
+    print(f"[{_plat}] per-call: {10 * BATCH / t_per_call:.1f} imgs/s "
+          f"({t_per_call * 100:.1f} ms/step); "
+          f"scan10: {10 * BATCH / t_scan:.1f} imgs/s "
+          f"({t_scan * 100:.1f} ms/step)", flush=True)

@@ -3,7 +3,9 @@ from deeplearning4j_tpu.data.iterator import (
     DataSetIterator, ArrayDataSetIterator, ExistingDataSetIterator,
     BenchmarkDataSetIterator,
 )
-from deeplearning4j_tpu.data.async_iterator import AsyncDataSetIterator
+from deeplearning4j_tpu.data.async_iterator import (
+    AsyncDataSetIterator, host_cast, prefetch_iterable,
+)
 from deeplearning4j_tpu.data.utility_iterators import (
     AbstractDataSetIterator, AsyncMultiDataSetIterator,
     AsyncShieldDataSetIterator, CombinedMultiDataSetPreProcessor,

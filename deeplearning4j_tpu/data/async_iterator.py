@@ -11,10 +11,12 @@ buffering plays for CUDA.
 """
 from __future__ import annotations
 
+import os
 import queue
 import threading
 
 import jax
+import numpy as np
 
 from deeplearning4j_tpu.data.dataset import DataSet
 from deeplearning4j_tpu.data.iterator import DataSetIterator
@@ -22,15 +24,88 @@ from deeplearning4j_tpu.data.iterator import DataSetIterator
 _SENTINEL = object()
 
 
+def host_cast(a, dtype):
+    """Cast a float32 host array to a 16-bit compute dtype BEFORE the
+    device transfer: ml_dtypes' round-to-nearest-even matches XLA's device
+    cast bit-for-bit, and the H2D copy ships half the bytes (the single
+    shared implementation of the rule — nn/multilayer._as_jnp and the
+    prefetch workers both route through here). DL4J_TPU_HOST_CAST=0
+    restores the transfer-then-cast path."""
+    if (dtype is not None and isinstance(a, np.ndarray)
+            and a.dtype == np.float32
+            and np.dtype(dtype).itemsize == 2
+            and os.environ.get("DL4J_TPU_HOST_CAST", "1") == "1"):
+        return a.astype(dtype)
+    return a
+
+
+def prefetch_iterable(source, transform=None, queue_size: int = 2):
+    """Generic bounded background-thread pump: pull items from `source`,
+    apply `transform` on the worker thread (host cast + async device_put
+    live there), yield in order. The device-side analog of DL4J's
+    prefetch buffer for arbitrary item types (the graph container's
+    MultiDataSet stream uses this; DataSet streams use
+    AsyncDataSetIterator)."""
+    q: "queue.Queue" = queue.Queue(maxsize=int(queue_size))
+    stop = threading.Event()
+
+    def put(item) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def worker():
+        try:
+            for item in source:
+                if stop.is_set():
+                    return
+                if transform is not None:
+                    item = transform(item)
+                if not put(item):
+                    return
+        except BaseException as e:    # surface worker errors to the consumer
+            put(e)
+            return
+        put(_SENTINEL)
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is _SENTINEL:
+                break
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+    finally:
+        stop.set()
+        try:
+            while True:
+                q.get_nowait()
+        except queue.Empty:
+            pass
+        t.join(timeout=5)
+
+
 class AsyncDataSetIterator(DataSetIterator):
     def __init__(self, source: DataSetIterator, queue_size: int = 4,
-                 device_put: bool = True, device=None, callback=None):
+                 device_put: bool = True, device=None, callback=None,
+                 cast_dtype=None):
         """`callback` is a DataSetCallback (data/utility_iterators.py)
         applied to each batch on the prefetch thread AFTER the default
         device_put — the reference's DataSetCallback seam
         (AsyncDataSetIterator.java callback ctor arg); pass
         InterleavedDataSetCallback to round-robin batches over devices
-        (set device_put=False so the callback owns placement)."""
+        (set device_put=False so the callback owns placement).
+
+        `cast_dtype`: 16-bit compute dtype to host-cast float32
+        features/labels to on the worker thread before the transfer
+        (see `host_cast`; masks keep their dtype)."""
         if getattr(source, "async_supported", True) is False:
             # AsyncShieldDataSetIterator semantics: pass through unwrapped
             self._passthrough = source
@@ -41,6 +116,7 @@ class AsyncDataSetIterator(DataSetIterator):
         self._device_put = device_put
         self._device = device
         self._callback = callback
+        self._cast_dtype = cast_dtype
 
     def reset(self):
         self._source.reset()
@@ -53,38 +129,28 @@ class AsyncDataSetIterator(DataSetIterator):
         self._source.set_pre_processor(pre_processor)
         return self
 
-    def _put(self, q: "queue.Queue", stop: "threading.Event", item) -> bool:
-        """Bounded put that aborts when the consumer has gone away."""
-        while not stop.is_set():
-            try:
-                q.put(item, timeout=0.1)
-                return True
-            except queue.Full:
-                continue
-        return False
-
-    def _worker(self, q, stop):
-        try:
-            for ds in self._source:
-                if stop.is_set():
-                    return
-                if self._device_put:
-                    dev = self._device or jax.local_devices()[0]
-                    ds = DataSet(
-                        jax.device_put(ds.features, dev),
-                        None if ds.labels is None else jax.device_put(ds.labels, dev),
-                        None if ds.features_mask is None else jax.device_put(ds.features_mask, dev),
-                        None if ds.labels_mask is None else jax.device_put(ds.labels_mask, dev),
-                    )
-                if self._callback is not None:
-                    out = self._callback.call(ds)
-                    ds = ds if out is None else out
-                if not self._put(q, stop, ds):
-                    return
-        except BaseException as e:      # surface worker errors to the consumer
-            self._put(q, stop, e)
-            return
-        self._put(q, stop, _SENTINEL)
+    def _stage(self, ds: DataSet) -> DataSet:
+        """Per-batch worker-thread transform: 16-bit host cast, async H2D
+        transfer, then the DataSetCallback seam."""
+        if self._cast_dtype is not None:
+            ds = DataSet(
+                host_cast(ds.features, self._cast_dtype),
+                None if ds.labels is None
+                else host_cast(ds.labels, self._cast_dtype),
+                ds.features_mask, ds.labels_mask,
+            )
+        if self._device_put:
+            dev = self._device or jax.local_devices()[0]
+            ds = DataSet(
+                jax.device_put(ds.features, dev),
+                None if ds.labels is None else jax.device_put(ds.labels, dev),
+                None if ds.features_mask is None else jax.device_put(ds.features_mask, dev),
+                None if ds.labels_mask is None else jax.device_put(ds.labels_mask, dev),
+            )
+        if self._callback is not None:
+            out = self._callback.call(ds)
+            ds = ds if out is None else out
+        return ds
 
     def __iter__(self):
         if self._passthrough is not None:
@@ -101,25 +167,7 @@ class AsyncDataSetIterator(DataSetIterator):
             yield ds if out is None else out
 
     def _iter_async(self):
-        q: "queue.Queue" = queue.Queue(maxsize=self._queue_size)
-        stop = threading.Event()
-        t = threading.Thread(target=self._worker, args=(q, stop), daemon=True)
-        t.start()
-        try:
-            while True:
-                item = q.get()
-                if item is _SENTINEL:
-                    break
-                if isinstance(item, BaseException):
-                    raise item
-                yield item
-        finally:
-            # Consumer done or abandoned iteration: release the worker even
-            # if it is blocked on a full queue (no leaked thread / HBM batch).
-            stop.set()
-            try:
-                while True:
-                    q.get_nowait()
-            except queue.Empty:
-                pass
-            t.join(timeout=5)
+        # the one shared thread pump (bounded queue, sentinel, exception
+        # smuggling, drain-and-join teardown) lives in prefetch_iterable
+        yield from prefetch_iterable(self._source, self._stage,
+                                     self._queue_size)

@@ -385,9 +385,38 @@ class ComputationGraph:
                 data.reset()
         return self
 
+    def _mds_stream(self, data):
+        """MultiDataSet stream for one epoch: a prefetch worker thread
+        overlaps host ETL + the bf16 host cast + the H2D transfer with
+        device compute (the reference wraps every fit in an async iterator
+        by default — MultiLayerNetwork.java:1272-1274, same contract for
+        graphs at ComputationGraph.java:1015). DL4J_TPU_FIT_PREFETCH=0
+        disables."""
+        if os.environ.get("DL4J_TPU_FIT_PREFETCH", "1") != "1" \
+                or getattr(data, "async_supported", True) is False:
+            return self._iter_data(data)
+        from deeplearning4j_tpu.data.async_iterator import (
+            host_cast, prefetch_iterable,
+        )
+        cast = self._compute_dtype \
+            if np.dtype(self._compute_dtype).itemsize == 2 else None
+        dev = jax.local_devices()[0]
+
+        def stage(mds):
+            put = lambda a: None if a is None else jax.device_put(a, dev)
+            return MultiDataSet(
+                tuple(put(host_cast(f, cast)) for f in mds.features),
+                tuple(put(host_cast(l, cast)) for l in mds.labels),
+                None if mds.features_masks is None
+                else tuple(put(m) for m in mds.features_masks),
+                None if mds.labels_masks is None
+                else tuple(put(m) for m in mds.labels_masks))
+
+        return prefetch_iterable(self._iter_data(data), stage)
+
     def _fit_epoch_per_call(self, data, rng, tbptt):
         etl_start = time.perf_counter()
-        for mds in self._iter_data(data):
+        for mds in self._mds_stream(data):
             etl_ms = (time.perf_counter() - etl_start) * 1e3
             inputs = tuple(_as_jnp(f, self._compute_dtype) for f in mds.features)
             labels = tuple(_as_jnp(l, self._compute_dtype) for l in mds.labels)

@@ -29,6 +29,9 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from deeplearning4j_tpu.data.async_iterator import (
+    AsyncDataSetIterator, host_cast,
+)
 from deeplearning4j_tpu.data.dataset import DataSet
 from deeplearning4j_tpu.data.iterator import ArrayDataSetIterator, DataSetIterator
 from deeplearning4j_tpu.nn.conf.base import (
@@ -154,16 +157,11 @@ def _as_jnp(a, dtype=None):
     if a is None:
         return None
     # 16-bit compute dtypes (bfloat16 training): cast float32 host arrays
-    # BEFORE the device transfer — ml_dtypes' round-to-nearest-even
-    # matches XLA's device cast bit-for-bit, and the H2D copy ships half
-    # the bytes. f64 is excluded: its old path double-rounds via f32
-    # (x64 disabled), so a direct host cast would not be bit-identical.
-    # DL4J_TPU_HOST_CAST=0 restores the transfer-then-cast path.
-    if (dtype is not None and isinstance(a, np.ndarray)
-            and a.dtype == np.float32
-            and np.dtype(dtype).itemsize == 2
-            and os.environ.get("DL4J_TPU_HOST_CAST", "1") == "1"):
-        a = a.astype(dtype)
+    # BEFORE the device transfer (bit-identical to the device cast; f64 is
+    # excluded — its old path double-rounds via f32 with x64 disabled).
+    # Shared rule: data/async_iterator.host_cast (DL4J_TPU_HOST_CAST=0
+    # restores transfer-then-cast).
+    a = host_cast(a, dtype)
     arr = jnp.asarray(a)
     if dtype is not None and jnp.issubdtype(arr.dtype, jnp.floating):
         arr = arr.astype(dtype)
@@ -466,7 +464,8 @@ class MultiLayerNetwork:
         return self._train_step[sig]
 
     def fit(self, data, epochs: int = 1, batch_size: int = 32,
-            scan_steps: Optional[int] = None):
+            scan_steps: Optional[int] = None,
+            prefetch: Optional[bool] = None):
         """Train (DL4J fit(DataSetIterator), :1268). Accepts a DataSetIterator,
         a DataSet, or (features, labels) arrays.
 
@@ -480,12 +479,30 @@ class MultiLayerNetwork:
 
         Intended for dispatch-bound TPU loops. Caveat (PERF.md "mechanism
         check"): XLA:CPU pessimizes convolutions inside scan, so conv nets
-        on CPU should keep scan_steps=1."""
+        on CPU should keep scan_steps=1.
+
+        `prefetch` (default on, kill switch DL4J_TPU_FIT_PREFETCH=0):
+        wrap plain sources in AsyncDataSetIterator, like the reference
+        wraps every fit in an async iterator by default
+        (MultiLayerNetwork.java:1272-1274) — a worker thread overlaps host
+        ETL, the bf16 host cast, and the H2D transfer with device compute.
+        Already-async and async_supported=False sources pass through."""
         if self.params is None:
             self.init()
         if scan_steps is None:
             scan_steps = int(os.environ.get("DL4J_TPU_SCAN_STEPS", "1"))
         iterator = self._as_iterator(data, batch_size)
+        if prefetch is None:
+            prefetch = os.environ.get("DL4J_TPU_FIT_PREFETCH", "1") == "1"
+        if prefetch and not isinstance(iterator, AsyncDataSetIterator) \
+                and getattr(iterator, "async_supported", True):
+            # scan-fit stacks K host batches before ONE transfer, so the
+            # worker must not device_put per batch there (a device array
+            # would round-trip back through the host for the stack)
+            iterator = AsyncDataSetIterator(
+                iterator, device_put=(scan_steps <= 1),
+                cast_dtype=self._compute_dtype
+                if np.dtype(self._compute_dtype).itemsize == 2 else None)
         for _ in range(epochs):
             for lst in self.listeners:
                 lst.on_epoch_start(self, self.epoch_count)

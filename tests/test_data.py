@@ -716,3 +716,98 @@ def test_reset_mode_short_source_cycles_all_batches():
     shorts = [v for v in out if v < 10]
     assert shorts == [1.0, 2.0, 1.0, 2.0, 1.0]      # cycles, not 1,2,1,1,1
     assert [v for v in out if v >= 10] == [10.0, 20.0, 30.0, 40.0, 50.0]
+
+
+class TestFitPrefetch:
+    """fit() auto-wraps plain sources in an async device-prefetch
+    (reference default-wrap parity, MultiLayerNetwork.java:1272-1274).
+    The wrap must be a pure pipelining change: identical trained params."""
+
+    @staticmethod
+    def _net(seed=19):
+        from deeplearning4j_tpu.nn.conf.base import InputType
+        from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.nn.updaters import Adam
+        conf = (NeuralNetConfiguration.Builder().seed(seed).updater(Adam(1e-2))
+                .list()
+                .layer(DenseLayer(n_out=12, activation="relu"))
+                .layer(OutputLayer(n_out=3, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(5)).build())
+        return MultiLayerNetwork(conf).init()
+
+    @staticmethod
+    def _data(n=96):
+        rs = np.random.RandomState(3)
+        X = rs.randn(n, 5).astype("float32")
+        Y = np.eye(3, dtype="float32")[rs.randint(0, 3, n)]
+        return X, Y
+
+    def test_prefetch_is_bit_identical_to_plain(self):
+        X, Y = self._data()
+        net_a, net_b = self._net(), self._net()
+        net_a.fit((X, Y), epochs=2, batch_size=32, prefetch=False)
+        net_b.fit((X, Y), epochs=2, batch_size=32, prefetch=True)
+        np.testing.assert_array_equal(np.asarray(net_a.params_flat()),
+                                      np.asarray(net_b.params_flat()))
+
+    def test_prefetch_env_kill_switch(self, monkeypatch):
+        monkeypatch.setenv("DL4J_TPU_FIT_PREFETCH", "0")
+        X, Y = self._data()
+        net = self._net()
+        net.fit((X, Y), epochs=1, batch_size=32)    # just must not wrap/crash
+        assert np.isfinite(net.score())
+
+    def test_prefetch_scan_path_still_stacks(self):
+        # scan-fit stacks host-side; the auto-wrap must keep batches on host
+        X, Y = self._data()
+        net_a, net_b = self._net(), self._net()
+        net_a.fit((X, Y), epochs=2, batch_size=32, scan_steps=2,
+                  prefetch=False)
+        net_b.fit((X, Y), epochs=2, batch_size=32, scan_steps=2,
+                  prefetch=True)
+        np.testing.assert_array_equal(np.asarray(net_a.params_flat()),
+                                      np.asarray(net_b.params_flat()))
+
+    def test_prefetch_graph_stream_identical(self, monkeypatch):
+        from deeplearning4j_tpu.nn.conf.base import InputType
+        from deeplearning4j_tpu.nn.conf.network import (
+            GraphBuilder, NeuralNetConfiguration,
+        )
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.nn.updaters import Adam
+        from deeplearning4j_tpu.data.iterator import ArrayDataSetIterator
+        X, Y = self._data()
+
+        def build():
+            g = (GraphBuilder(NeuralNetConfiguration.Builder().seed(23)
+                              .updater(Adam(1e-2)))
+                 .add_inputs("in").set_input_types(InputType.feed_forward(5)))
+            g.add_layer("d", DenseLayer(n_out=12, activation="relu"), "in")
+            g.add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                           loss="mcxent"), "d")
+            g.set_outputs("out")
+            return ComputationGraph(g.build()).init()
+
+        it = ArrayDataSetIterator(X, Y, batch_size=32)
+        net_a, net_b = build(), build()
+        monkeypatch.setenv("DL4J_TPU_FIT_PREFETCH", "0")
+        net_a.fit(it, epochs=2)
+        monkeypatch.setenv("DL4J_TPU_FIT_PREFETCH", "1")
+        it.reset()
+        net_b.fit(it, epochs=2)
+        np.testing.assert_array_equal(np.asarray(net_a.params_flat()),
+                                      np.asarray(net_b.params_flat()))
+
+    def test_async_host_cast_halves_bytes(self):
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.data.async_iterator import host_cast
+        a = np.ones((4, 8), "float32")
+        out = host_cast(a, jnp.bfloat16)
+        assert out.dtype == jnp.bfloat16 and out.nbytes == a.nbytes // 2
+        # f64 and non-16-bit targets pass through untouched
+        assert host_cast(a, np.float64) is a
+        assert host_cast(a, None) is a

@@ -4,8 +4,13 @@ Checks numeric parity vs the dense XLA path at several shapes/dtypes,
 including the masked + non-causal + return_lse variants the framework
 uses, and times fwd and fwd+bwd. Exits nonzero on any parity failure.
 """
+import os
 import sys
 import time
+import traceback
+
+# keep jax-internal frames: Mosaic/BlockSpec root causes live there
+os.environ.setdefault("JAX_TRACEBACK_FILTERING", "off")
 
 import jax
 import jax.numpy as jnp
@@ -18,6 +23,19 @@ assert jax.devices()[0].platform != "cpu", "need TPU"
 print("device:", jax.devices()[0], flush=True)
 
 failures = []
+_tb_dumped = [False]
+
+
+def _dump_tb_once():
+    """Full (trimmed) traceback for the FIRST failure — the bench error
+    row only carries the last stderr lines, which for Mosaic/BlockSpec
+    errors is just the docs link; the root cause is mid-traceback."""
+    if not _tb_dumped[0]:
+        _tb_dumped[0] = True
+        tb = traceback.format_exc()
+        print("---- first failure traceback (trimmed) ----", flush=True)
+        print(tb[-4000:], flush=True)
+        print("-------------------------------------------", flush=True)
 
 
 def check(name, b, t, h, d, dtype, causal, masked, bq=None, bk=None):
@@ -68,6 +86,7 @@ def check(name, b, t, h, d, dtype, causal, masked, bq=None, bk=None):
     except Exception as e:
         print(f"FWD {name}: EXC {type(e).__name__}: {str(e)[:300]}",
               flush=True)
+        _dump_tb_once()
         failures.append(name)
 
 
@@ -121,6 +140,7 @@ def check_bwd(name, b, t, h, d, dtype, causal):
     except Exception as e:
         print(f"BWD {name}: EXC {type(e).__name__}: {str(e)[:300]}",
               flush=True)
+        _dump_tb_once()
         failures.append(name)
 
 
@@ -172,6 +192,7 @@ try:
         failures.append("lse-merge")
 except Exception as e:
     print(f"LSE-merge: EXC {type(e).__name__}: {str(e)[:300]}", flush=True)
+    _dump_tb_once()
     failures.append("lse-merge")
 
 print("FAILURES:", failures, flush=True)

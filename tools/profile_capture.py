@@ -35,14 +35,17 @@ from deeplearning4j_tpu.nn.graph import ComputationGraph
 
 TRACE_DIR = os.environ.get("DL4J_TPU_TRACE_DIR", "/tmp/dl4jtpu_trace")
 BATCH = int(os.environ.get("DL4J_TPU_TRACE_BATCH", "128"))
+# input size knob so the ALLOW_CPU smoke can shrink the model (a 224x224
+# ResNet-50 compile on CPU runs minutes; 64x64 is seconds)
+HW = int(os.environ.get("DL4J_TPU_TRACE_HW", "224"))
 
-model = ResNet50(num_classes=1000, input_shape=(224, 224, 3))
+model = ResNet50(num_classes=1000, input_shape=(HW, HW, 3))
 conf = dataclasses.replace(model.conf(), compute_dtype="bfloat16")
 net = ComputationGraph(conf).init()
 tx = net._tx
 
 rs = np.random.RandomState(0)
-X = jnp.asarray(rs.rand(BATCH, 224, 224, 3).astype("float32"))
+X = jnp.asarray(rs.rand(BATCH, HW, HW, 3).astype("float32"))
 Y = jnp.asarray(np.eye(1000, dtype="float32")[rs.randint(0, 1000, BATCH)])
 
 

@@ -67,6 +67,42 @@ bank_windowed() {
   bank "$2" "$3" "$4" && echo "$sum" > "$2.lastsum"
 }
 
+# run_sweep <out-json> <done-flag> <extra-grep> <label>: run the full
+# bench sweep; bank a fully-measured result (rc=0 + tpu_unavailable:false
+# + extra-grep, e.g. a config the first wedged window cut off) into
+# BENCH_TPU_MEASURED_r05.json, else bank any on_tpu partial rows. The
+# ONE implementation both sweep stages share.
+run_sweep() {
+  local out="$1" flag="$2" extra="$3" label="$4"
+  # fresh partial file per attempt; rows already banked in-repo from
+  # earlier windows are preserved there (bank_windowed)
+  : > "$DL4J_TPU_BENCH_PARTIAL"
+  # outer timeout > worst case (configs x watchdog + probes); bench.py
+  # kills its in-flight config subprocess on SIGTERM
+  (cd /root/repo && timeout -k 60 18000 python bench.py > "$out" 2>"${out%.json}.err")
+  local rc=$?
+  echo "$label rc=$rc $(date -u +%FT%TZ)" >> "$LOG"
+  # done only if the sweep produced a real TPU number — a CPU-fallback
+  # run also prints a numeric value but with tpu_unavailable: true.
+  # done-flag only AFTER a successful bank — a stranded /tmp artifact
+  # must keep this branch live for the next window to rebank
+  if [ "$rc" = "0" ] && grep -q '"value": [0-9]' "$out" \
+     && grep -q '"tpu_unavailable": false' "$out" \
+     && { [ -z "$extra" ] || grep -q "$extra" "$out"; }; then
+    bank "$out" BENCH_TPU_MEASURED_r05.json \
+      "Bank measured TPU bench sweep ($label $(date -u +%FT%TZ))" \
+      && touch "$flag"
+  elif grep -q '"on_tpu": true' "$DL4J_TPU_BENCH_PARTIAL" 2>/dev/null; then
+    # sweep didn't fully land but some configs DID measure ON TPU — bank
+    # those rows. Guard is per-row: every bench runner stamps its result
+    # with the platform it executed on, so a CPU row can never be banked
+    grep '"on_tpu": true' "$DL4J_TPU_BENCH_PARTIAL" > /tmp/bench_tpu_rows.jsonl
+    bank_windowed /tmp/bench_tpu_rows.jsonl /tmp/bench_windowed.jsonl \
+      BENCH_TPU_PARTIAL_r05.jsonl \
+      "Bank partial TPU bench rows ($label window $(date -u +%FT%TZ))"
+  fi
+}
+
 echo "watcher start $(date -u +%FT%TZ)" >> "$LOG"
 while true; do
   timeout 180 python -c "$PROBE" >/dev/null 2>&1
@@ -88,36 +124,7 @@ while true; do
         continue
       fi
       echo "TPU UP — running bench $(date -u +%FT%TZ)" >> "$LOG"
-      # fresh partial file per attempt; rows already banked in-repo from
-      # earlier windows are preserved there (bank_windowed)
-      : > "$DL4J_TPU_BENCH_PARTIAL"
-      # outer timeout > worst case (9 configs x watchdog + probes);
-      # bench.py kills its in-flight config subprocess on SIGTERM
-      (cd /root/repo && timeout -k 60 18000 python bench.py > /tmp/bench_tpu.json 2>/tmp/bench_tpu.err)
-      brc=$?
-      echo "bench rc=$brc $(date -u +%FT%TZ)" >> "$LOG"
-      # done only if the sweep produced a real TPU number — a CPU-fallback
-      # run also prints a numeric value but with tpu_unavailable: true
-      if [ "$brc" = "0" ] && grep -q '"value": [0-9]' /tmp/bench_tpu.json \
-         && grep -q '"tpu_unavailable": false' /tmp/bench_tpu.json; then
-        # bank the measured number in-repo immediately: the end-of-round
-        # driver run may hit a wedged tunnel, but this result survives.
-        # done-flag only AFTER a successful bank — a stranded /tmp artifact
-        # must keep the bench branch live for the next window to rebank
-        bank /tmp/bench_tpu.json BENCH_TPU_MEASURED_r05.json \
-          "Bank measured TPU bench sweep (watcher window $(date -u +%FT%TZ))" \
-          && touch /tmp/bench_tpu_done
-      elif grep -q '"on_tpu": true' "$DL4J_TPU_BENCH_PARTIAL" 2>/dev/null
-      then
-        # sweep didn't fully land but some configs DID measure ON TPU —
-        # bank those rows too. Guard is per-row: every bench runner stamps
-        # its result row with the platform it actually executed on
-        # (bench.py on_tpu), so a CPU-fallback row can never be banked
-        grep '"on_tpu": true' "$DL4J_TPU_BENCH_PARTIAL" > /tmp/bench_tpu_rows.jsonl
-        bank_windowed /tmp/bench_tpu_rows.jsonl /tmp/bench_windowed.jsonl \
-          BENCH_TPU_PARTIAL_r05.jsonl \
-          "Bank partial TPU bench rows (watcher window $(date -u +%FT%TZ))"
-      fi
+      run_sweep /tmp/bench_tpu.json /tmp/bench_tpu_done "" "bench"
     elif [ ! -f /tmp/flash_smoke_done ]; then
       echo "TPU UP — running flash smoke $(date -u +%FT%TZ)" >> "$LOG"
       (cd /root/repo && timeout 3600 python tools/flash_smoke.py > /tmp/flash_smoke.log 2>&1)
@@ -149,6 +156,26 @@ while true; do
           "Bank profiler-trace capture log (rc=$trc)" \
           && [ "$trc" = "0" ] && touch /tmp/trace_done
       fi
+    elif [ ! -f /tmp/mfu_probe_done ]; then
+      echo "TPU UP — running mfu probe $(date -u +%FT%TZ)" >> "$LOG"
+      (cd /root/repo && timeout 1800 python tools/mfu_probe.py \
+        > /tmp/mfu_probe.log 2>/tmp/mfu_probe.err)
+      mrc=$?
+      echo "mfu probe rc=$mrc $(date -u +%FT%TZ)" >> "$LOG"
+      # per-row on_tpu stamps guard against CPU rows, as in the bench
+      if grep -q '"on_tpu": true' /tmp/mfu_probe.log 2>/dev/null; then
+        bank_windowed /tmp/mfu_probe.log /tmp/mfu_windowed.jsonl \
+          MFU_PROBE_r05.jsonl \
+          "Bank MFU calibration probe (matmul peak + step segments, rc=$mrc)" \
+          && [ "$mrc" = "0" ] && touch /tmp/mfu_probe_done
+      fi
+    elif [ ! -f /tmp/bench2_done ]; then
+      # second full sweep: the 01:28Z wedge cut off the char-lstm /
+      # word2vec / lenet configs (resnet programs are compile-cache hits,
+      # so a complete pass fits one ~15 min window); the char-lstm grep
+      # gates the done-flag on the cut-off configs actually landing
+      echo "TPU UP — bench sweep 2 (full config set) $(date -u +%FT%TZ)" >> "$LOG"
+      run_sweep /tmp/bench_tpu2.json /tmp/bench2_done "char-lstm" "bench2"
     else
       sleep 420   # all jobs done; stay armed for manual reruns
     fi

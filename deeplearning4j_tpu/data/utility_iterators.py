@@ -27,8 +27,6 @@ helper), `FileSplitParallelDataSetIterator` (compose
 """
 from __future__ import annotations
 
-import queue
-import threading
 from typing import Iterable, Iterator, List
 
 import numpy as np
@@ -169,58 +167,17 @@ class IteratorDataSetIterator(DataSetIterator):
 
 class AsyncMultiDataSetIterator:
     """Background-thread prefetch over MultiDataSets — the multi-input twin
-    of AsyncDataSetIterator (AsyncMultiDataSetIterator)."""
-
-    _END = object()
+    of AsyncDataSetIterator (AsyncMultiDataSetIterator). Rides the shared
+    thread pump (`data/async_iterator.prefetch_iterable`) — bounded queue,
+    worker-error smuggling, drain-and-join teardown all live there."""
 
     def __init__(self, source, queue_size: int = 4):
         self.source = source
         self.queue_size = max(1, queue_size)
 
     def __iter__(self):
-        q: "queue.Queue" = queue.Queue(self.queue_size)
-        stop = threading.Event()
-        err: List[BaseException] = []
-
-        def worker():
-            try:
-                for item in self.source:
-                    # bounded put so an abandoned consumer (early break)
-                    # can't park this thread forever on a full queue
-                    while not stop.is_set():
-                        try:
-                            q.put(item, timeout=0.2)
-                            break
-                        except queue.Full:
-                            continue
-                    if stop.is_set():
-                        return
-            except BaseException as e:      # surface in the consumer
-                err.append(e)
-            finally:
-                # the END sentinel must not be dropped on a momentarily
-                # full queue (the consumer would then block forever on
-                # q.get) — retry until delivered or the consumer is gone
-                while not stop.is_set():
-                    try:
-                        q.put(self._END, timeout=0.2)
-                        break
-                    except queue.Full:
-                        continue
-        t = threading.Thread(target=worker, daemon=True,
-                             name="AsyncMultiDataSetIterator")
-        t.start()
-        try:
-            while True:
-                item = q.get()
-                if item is self._END:
-                    break
-                yield item
-        finally:                            # also runs on abandonment
-            stop.set()
-            t.join(timeout=5)
-        if err:
-            raise err[0]
+        from deeplearning4j_tpu.data.async_iterator import prefetch_iterable
+        return prefetch_iterable(self.source, None, self.queue_size)
 
     def reset(self):
         if hasattr(self.source, "reset"):

@@ -75,7 +75,7 @@ def _attn_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, m_scr,
 
     @pl.when(live)
     def _step():
-        s = _masked_scores(q_ref[0], k_ref[0], mask_ref[0], qi, kj,
+        s = _masked_scores(q_ref[0], k_ref[0], mask_ref[0, 0], qi, kj,
                            causal=causal, block_q=block_q,
                            block_k=block_k, scale=scale)
         v = v_ref[0].astype(jnp.float32)
@@ -102,7 +102,7 @@ def _attn_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, m_scr,
         # log-sum-exp per q row, the backward residual; +NEG-> +inf for
         # fully-masked rows so exp(s - lse) vanishes there in the bwd
         lse = m + jnp.log(jnp.maximum(l, 1e-30))
-        lse_ref[0] = jnp.where(m <= NEG / 2, -NEG, lse)
+        lse_ref[0, 0] = jnp.where(m <= NEG / 2, -NEG, lse)
 
 
 def _flash_call(q, k, v, mask, causal: bool, block_q: int, block_k: int,
@@ -116,7 +116,12 @@ def _flash_call(q, k, v, mask, causal: bool, block_q: int, block_k: int,
     vh = v.transpose(0, 2, 1, 3).reshape(b * h, tk, d)
     if mask is None:
         mask = jnp.ones((b, tk), jnp.float32)
-    mask = mask.astype(jnp.float32)
+    # rank-2 operands carry a singleton MIDDLE dim: the Mosaic lowering
+    # requires the last TWO block dims to divide (8, 128) or equal the
+    # array dims, so a (1, block) block on a (b, t) array is rejected
+    # (second-to-last = 1 != b); as (b, 1, t) with (1, 1, block) blocks
+    # the trailing pair is (1==1, block%128==0) — valid, same bytes
+    mask = mask.astype(jnp.float32).reshape(b, 1, tk)
 
     kernel = functools.partial(_attn_kernel, causal=causal,
                                block_q=block_q, block_k=block_k,
@@ -128,16 +133,16 @@ def _flash_call(q, k, v, mask, causal: bool, block_q: int, block_k: int,
             pl.BlockSpec((1, block_q, d), lambda bh, qi, kj: (bh, qi, 0)),
             pl.BlockSpec((1, block_k, d), lambda bh, qi, kj: (bh, kj, 0)),
             pl.BlockSpec((1, block_k, d), lambda bh, qi, kj: (bh, kj, 0)),
-            pl.BlockSpec((1, block_k),
-                         lambda bh, qi, kj, _h=h: (bh // _h, kj)),
+            pl.BlockSpec((1, 1, block_k),
+                         lambda bh, qi, kj, _h=h: (bh // _h, 0, kj)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, qi, kj: (bh, qi, 0)),
-            pl.BlockSpec((1, block_q), lambda bh, qi, kj: (bh, qi)),
+            pl.BlockSpec((1, 1, block_q), lambda bh, qi, kj: (bh, 0, qi)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
-            jax.ShapeDtypeStruct((b * h, tq), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, 1, tq), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q,), jnp.float32),
@@ -146,7 +151,8 @@ def _flash_call(q, k, v, mask, causal: bool, block_q: int, block_k: int,
         ],
         interpret=interpret,
     )(qh, kh, vh, mask)
-    return out.reshape(b, h, tq, d).transpose(0, 2, 1, 3), lse
+    return (out.reshape(b, h, tq, d).transpose(0, 2, 1, 3),
+            lse.reshape(b * h, tq))
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
@@ -164,7 +170,7 @@ def _bwd_scores(q_ref, k_ref, mask_ref, lse_row, qi, kj, *, causal,
                 block_q, block_k, scale):
     """Recompute the softmax probabilities p = exp(s - lse) for one
     (q block, k block) tile via the shared masked-scores helper."""
-    s = _masked_scores(q_ref[0], k_ref[0], mask_ref[0], qi, kj,
+    s = _masked_scores(q_ref[0], k_ref[0], mask_ref[0, 0], qi, kj,
                        causal=causal, block_q=block_q, block_k=block_k,
                        scale=scale)
     p = jnp.exp(s - lse_row[:, None])
@@ -186,14 +192,14 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(live)
     def _step():
-        p = _bwd_scores(q_ref, k_ref, mask_ref, lse_ref[0], qi, kj,
+        p = _bwd_scores(q_ref, k_ref, mask_ref, lse_ref[0, 0], qi, kj,
                         causal=causal, block_q=block_q, block_k=block_k,
                         scale=scale)
         do = do_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0][:, None])
+        ds = p * (dp - delta_ref[0, 0][:, None])
         k = k_ref[0].astype(jnp.float32)
         dq_scr[...] += scale * jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
@@ -220,7 +226,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(live)
     def _step():
-        p = _bwd_scores(q_ref, k_ref, mask_ref, lse_ref[0], qi, kj,
+        p = _bwd_scores(q_ref, k_ref, mask_ref, lse_ref[0, 0], qi, kj,
                         causal=causal, block_q=block_q, block_k=block_k,
                         scale=scale)
         do = do_ref[0].astype(jnp.float32)
@@ -230,7 +236,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         v = v_ref[0].astype(jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0][:, None])
+        ds = p * (dp - delta_ref[0, 0][:, None])
         q = q_ref[0].astype(jnp.float32)
         dk_scr[...] += scale * jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
@@ -265,9 +271,13 @@ def _flash_bwd(causal, block_q, block_k, interpret, res, g):
     qh = q.transpose(0, 2, 1, 3).reshape(b * h, tq, d)
     kh = k.transpose(0, 2, 1, 3).reshape(b * h, tk, d)
     vh = v.transpose(0, 2, 1, 3).reshape(b * h, tk, d)
-    dh = delta.transpose(0, 2, 1).reshape(b * h, tq)
+    # singleton middle dims on the rank-2 operands (lse/delta/mask) — see
+    # the forward call: (1, 1, block) trailing pairs satisfy the Mosaic
+    # (8, 128)-or-equal block constraint where (1, block) cannot
+    dh = delta.transpose(0, 2, 1).reshape(b * h, 1, tq)
+    lse3 = lse.reshape(b * h, 1, tq)
     m_in = (jnp.ones((b, tk), jnp.float32) if mask is None
-            else mask.astype(jnp.float32))
+            else mask.astype(jnp.float32)).reshape(b, 1, tk)
 
     common = dict(causal=causal, block_q=block_q, block_k=block_k,
                   scale=scale)
@@ -279,17 +289,17 @@ def _flash_bwd(causal, block_q, block_k, interpret, res, g):
             pl.BlockSpec((1, block_k, d), lambda bh, qi, kj: (bh, kj, 0)),
             pl.BlockSpec((1, block_k, d), lambda bh, qi, kj: (bh, kj, 0)),
             pl.BlockSpec((1, block_q, d), lambda bh, qi, kj: (bh, qi, 0)),
-            pl.BlockSpec((1, block_q), lambda bh, qi, kj: (bh, qi)),
-            pl.BlockSpec((1, block_q), lambda bh, qi, kj: (bh, qi)),
-            pl.BlockSpec((1, block_k),
-                         lambda bh, qi, kj, _h=h: (bh // _h, kj)),
+            pl.BlockSpec((1, 1, block_q), lambda bh, qi, kj: (bh, 0, qi)),
+            pl.BlockSpec((1, 1, block_q), lambda bh, qi, kj: (bh, 0, qi)),
+            pl.BlockSpec((1, 1, block_k),
+                         lambda bh, qi, kj, _h=h: (bh // _h, 0, kj)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d),
                                lambda bh, qi, kj: (bh, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
-    )(qh, kh, vh, gh, lse, dh, m_in)
+    )(qh, kh, vh, gh, lse3, dh, m_in)
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, **common),
@@ -299,10 +309,10 @@ def _flash_bwd(causal, block_q, block_k, interpret, res, g):
             pl.BlockSpec((1, block_k, d), lambda bh, kj, qi: (bh, kj, 0)),
             pl.BlockSpec((1, block_k, d), lambda bh, kj, qi: (bh, kj, 0)),
             pl.BlockSpec((1, block_q, d), lambda bh, kj, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, block_q), lambda bh, kj, qi: (bh, qi)),
-            pl.BlockSpec((1, block_q), lambda bh, kj, qi: (bh, qi)),
-            pl.BlockSpec((1, block_k),
-                         lambda bh, kj, qi, _h=h: (bh // _h, kj)),
+            pl.BlockSpec((1, 1, block_q), lambda bh, kj, qi: (bh, 0, qi)),
+            pl.BlockSpec((1, 1, block_q), lambda bh, kj, qi: (bh, 0, qi)),
+            pl.BlockSpec((1, 1, block_k),
+                         lambda bh, kj, qi, _h=h: (bh // _h, 0, kj)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda bh, kj, qi: (bh, kj, 0)),
@@ -315,7 +325,7 @@ def _flash_bwd(causal, block_q, block_k, interpret, res, g):
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
         interpret=interpret,
-    )(qh, kh, vh, gh, lse, dh, m_in)
+    )(qh, kh, vh, gh, lse3, dh, m_in)
 
     reshape = lambda a, t: a.reshape(b, h, t, d).transpose(0, 2, 1, 3)
     return reshape(dq, tq), reshape(dk, tk), reshape(dv, tk), None

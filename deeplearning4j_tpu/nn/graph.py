@@ -333,11 +333,10 @@ class ComputationGraph:
 
     def _make_train_step(self):
         from deeplearning4j_tpu.nn.regularization import (
-            apply_constraints, has_constraints,
+            apply_constraints, constraint_map, has_constraints,
         )
         tx = self._tx
-        layer_map = {name: vd.vertex for name, vd in self.conf.vertices.items()
-                     if isinstance(vd.vertex, LayerConf)}
+        layer_map = constraint_map(self)
         constrained = has_constraints(layer_map.values())
 
         def step(params, opt_state, state, inputs, labels, fmasks, lmasks,
@@ -445,11 +444,10 @@ class ComputationGraph:
 
     def _make_scan_step(self):
         from deeplearning4j_tpu.nn.regularization import (
-            apply_constraints, has_constraints,
+            apply_constraints, constraint_map, has_constraints,
         )
         tx = self._tx
-        layer_map = {name: vd.vertex for name, vd in self.conf.vertices.items()
-                     if isinstance(vd.vertex, LayerConf)}
+        layer_map = constraint_map(self)
         constrained = has_constraints(layer_map.values())
 
         def kstep(params, opt_state, state, inputs, labels, fmasks, lmasks,

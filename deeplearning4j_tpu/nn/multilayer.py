@@ -427,11 +427,11 @@ class MultiLayerNetwork:
     def _make_train_step(self, with_fmask, with_lmask, with_carries,
                          with_stats=False):
         from deeplearning4j_tpu.nn.regularization import (
-            apply_constraints, has_constraints,
+            apply_constraints, constraint_map, has_constraints,
         )
         tx = self._tx
         constrained = has_constraints(self.layers)
-        layer_map = {str(i): l for i, l in enumerate(self.layers)}
+        layer_map = constraint_map(self)
 
         def step(params, opt_state, state, x, y, fmask, lmask, rng, carries):
             def loss_fn(p):
@@ -619,11 +619,11 @@ class MultiLayerNetwork:
         _make_train_step applied K times; returns the K per-step losses as a
         device array so the host never syncs inside the chunk."""
         from deeplearning4j_tpu.nn.regularization import (
-            apply_constraints, has_constraints,
+            apply_constraints, constraint_map, has_constraints,
         )
         tx = self._tx
         constrained = has_constraints(self.layers)
-        layer_map = {str(i): l for i, l in enumerate(self.layers)}
+        layer_map = constraint_map(self)
 
         def kstep(params, opt_state, state, xs, ys, fms, lms, subs):
             def body(carry, batch):

@@ -269,3 +269,18 @@ def apply_constraints(layer_map, params):
 
 def has_constraints(layers) -> bool:
     return any(getattr(l, "constraints", ()) for l in layers)
+
+
+def constraint_map(model) -> dict:
+    """{param-dict key: LayerConf} for `apply_constraints`, for either
+    container — the ONE construction every trainer (container train
+    steps, ParallelWrapper, context/pipeline trainers) shares. Graph keys
+    are vertex names; MultiLayerNetwork keys are layer indices as
+    strings, matching the params pytree layout."""
+    from deeplearning4j_tpu.nn.conf.base import LayerConf
+    conf = getattr(model, "conf", None)
+    vertices = getattr(conf, "vertices", None)
+    if vertices is not None:
+        return {name: vd.vertex for name, vd in vertices.items()
+                if isinstance(vd.vertex, LayerConf)}
+    return {str(i): l for i, l in enumerate(model.layers)}

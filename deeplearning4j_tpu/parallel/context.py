@@ -101,19 +101,13 @@ class ContextParallelTrainer:
 
     # ---------------------------------------------------------------- build
     def _build_step(self, with_fmask, with_lmask):
-        from deeplearning4j_tpu.nn.conf.base import LayerConf
         from deeplearning4j_tpu.nn.regularization import (
-            apply_constraints, has_constraints,
+            apply_constraints, constraint_map, has_constraints,
         )
         net = self.model
         tx = net._tx
         mesh = self.mesh
-        if self._is_graph:
-            layer_map = {name: vd.vertex
-                         for name, vd in net.conf.vertices.items()
-                         if isinstance(vd.vertex, LayerConf)}
-        else:
-            layer_map = {str(i): l for i, l in enumerate(net.layers)}
+        layer_map = constraint_map(net)
         constrained = has_constraints(layer_map.values())
 
         def local_step(params, opt_state, state, x, y, fmask, lmask, rng):

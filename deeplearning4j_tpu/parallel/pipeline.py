@@ -198,9 +198,9 @@ class PipelineParallelTrainer:
             return lambda stacked, hm, fm: inner(stacked, hm)
 
         from deeplearning4j_tpu.nn.regularization import (
-            apply_constraints, has_constraints,
+            apply_constraints, constraint_map, has_constraints,
         )
-        layer_map = {str(i): l for i, l in enumerate(net.layers)}
+        layer_map = constraint_map(net)
         constrained = has_constraints(net.layers)
 
         def loss_fn(params, state_nn, x, y, fmask, lmask, rng):

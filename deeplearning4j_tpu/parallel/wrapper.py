@@ -128,11 +128,19 @@ class ParallelWrapper:
         return loss, new_state
 
     def _local_step(self, params, opt_state, state, x, y, fmask, lmask, rng):
+        # post-update projection (DL4J applyConstraints runs in EVERY
+        # trainer, ParallelWrapper included)
+        from deeplearning4j_tpu.nn.regularization import (
+            apply_constraints, constraint_map, has_constraints,
+        )
         def lf(p):
             return self._loss_fn(p, state, x, y, fmask, lmask, rng)
         (loss, new_state), grads = jax.value_and_grad(lf, has_aux=True)(params)
         updates, new_opt = self.model._tx.update(grads, opt_state, params)
         new_params = optax.apply_updates(params, updates)
+        layer_map = constraint_map(self.model)
+        if has_constraints(layer_map.values()):
+            new_params = apply_constraints(layer_map, new_params)
         return new_params, new_opt, new_state, loss
 
     # --------------------------------------------------------- compiled fns
@@ -153,8 +161,13 @@ class ParallelWrapper:
         # XLA derives the schedule: reduce-scatter grads -> sharded
         # optimizer math -> all-gather (updates at stage 1, params at the
         # next forward's use sites at stage 3). See parallel/zero.py.
+        from deeplearning4j_tpu.nn.regularization import (
+            apply_constraints, constraint_map, has_constraints,
+        )
         mesh = self.mesh
         stage3 = self.zero_stage == 3
+        layer_map = constraint_map(self.model)
+        constrained = has_constraints(layer_map.values())
 
         def step(params, opt_state, state, x, y, fmask, lmask, rng):
             def lf(p):
@@ -167,6 +180,8 @@ class ParallelWrapper:
             updates = zero.zero_constraint(updates, mesh)
             new_opt = zero.zero_constraint(new_opt, mesh)
             new_params = optax.apply_updates(params, updates)
+            if constrained:   # post-update projection (DL4J applyConstraints)
+                new_params = apply_constraints(layer_map, new_params)
             new_params = zero.zero_constraint(new_params, mesh) if stage3 \
                 else zero.replicated_constraint(new_params, mesh)
             return new_params, new_opt, new_state, loss

@@ -88,3 +88,7 @@ def test_long_context_ring():
 def test_dl4j_artifact_migration(tmp_path):
     assert _load("15_dl4j_artifact_migration.py").main(
         tmpdir=str(tmp_path)) > 0.9
+
+
+def test_zero_fsdp_training():
+    assert _load("16_zero_fsdp_training.py").main(epochs=8) > 0.9

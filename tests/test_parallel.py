@@ -507,3 +507,32 @@ def test_averaging_listener_deferred_fetch_scores_in_order():
     assert all(np.isfinite(c[2]) for c in cap.calls)
     epochs_seen = [c[1] for c in cap.calls]
     assert epochs_seen == [0, 0, 1, 1]  # flushed before epoch rollover
+
+
+def test_wrapper_applies_constraints():
+    """ParallelWrapper training must apply post-update parameter
+    constraints (DL4J applyConstraints runs in every trainer) — sync,
+    averaging, and zero-sharded paths all project after the update."""
+    from deeplearning4j_tpu.nn.regularization import MaxNormConstraint
+
+    def conf():
+        return (NeuralNetConfiguration.Builder().seed(5).updater(Adam(5e-2))
+                .list()
+                .layer(DenseLayer(n_out=16, activation="tanh",
+                                  constraints=(MaxNormConstraint(
+                                      max_norm=0.5),)))
+                .layer(OutputLayer(n_out=4, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(8)).build())
+
+    X, Y = _blob_data(n=128)
+    for kwargs in ({"mode": TrainingMode.SYNC_GRADIENTS},
+                   {"mode": TrainingMode.SYNC_GRADIENTS, "zero_stage": 3},
+                   {"mode": TrainingMode.AVERAGING,
+                    "averaging_frequency": 2}):
+        net = MultiLayerNetwork(conf()).init()
+        ParallelWrapper(net, **kwargs).fit(
+            ArrayDataSetIterator(X, Y, batch_size=64), epochs=4)
+        W = np.asarray(net.params["0"]["W"])
+        norms = np.linalg.norm(W, axis=0)
+        assert (norms <= 0.5 + 1e-4).all(), (kwargs, norms.max())

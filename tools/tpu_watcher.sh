@@ -79,7 +79,11 @@ try:
 except Exception:
     sys.exit(1)
 rows = d.get("sweep", [])
-ok = any(r.get("kind") == sys.argv[2] and r.get("on_tpu")
+# measured rows are the runner's own dict and label themselves via
+# "mode" (e.g. {"mode": "char-lstm", "chars_sec": ...}); only the
+# error/skipped paths spread the config and carry "kind"
+ok = any(sys.argv[2] in (r.get("kind"), r.get("mode"))
+         and r.get("on_tpu")
          and "error" not in r and "skipped" not in r for r in rows)
 sys.exit(0 if ok else 1)
 PYEOF

@@ -61,11 +61,22 @@ PEAK_FLOPS = {"TPU v5 lite": 197e12}   # bf16 peak per chip
 def cache_dir() -> str:
     """Default persistent XLA compile-cache dir, shared by the bench, the
     test suite (tests/conftest.py) and the driver hooks (__graft_entry__)
-    — ONE definition so the caches can't silently split. Per-user because
-    TMPDIR may be world-writable and JAX deserializes cached executables."""
-    import tempfile
-    uid = os.getuid() if hasattr(os, "getuid") else "u"
-    return os.path.join(tempfile.gettempdir(), f"dl4jtpu-jax-cache-{uid}")
+    — ONE definition so the caches can't silently split. Lives INSIDE the
+    repo (gitignored): /tmp is wiped between builder sessions, and losing
+    the cached TPU programs costs ~10 min of a healthy tunnel window on
+    recompiles (the r5 sweeps measured compile ~3 min/program through the
+    tunnel). Repo-local also means not world-writable (JAX deserializes
+    cached executables). Falls back to a per-user tempdir if the repo
+    checkout is read-only."""
+    repo = os.path.dirname(os.path.abspath(__file__))
+    d = os.path.join(repo, ".jaxcache")
+    try:
+        os.makedirs(d, exist_ok=True)
+        return d
+    except OSError:
+        import tempfile
+        uid = os.getuid() if hasattr(os, "getuid") else "u"
+        return os.path.join(tempfile.gettempdir(), f"dl4jtpu-jax-cache-{uid}")
 
 
 def probe_tpu(attempts: int = None, probe_timeout: int = None,

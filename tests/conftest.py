@@ -13,7 +13,7 @@ Markers (README "Running the tests"):
 - `distributed`: tests that spawn real extra OS processes.
 
 A persistent XLA compilation cache (JAX_TEST_CACHE_DIR, default
-$TMPDIR/dl4jtpu-jax-cache-<uid>) makes repeat runs compile-free: the first
+<repo>/.jaxcache, gitignored) makes repeat runs compile-free: the first
 run pays the jit cost, later runs reload compiled programs from disk.
 """
 import os

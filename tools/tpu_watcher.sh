@@ -2,14 +2,29 @@
 # Background TPU watcher: probe the axon tunnel every ~3 min; on every
 # healthy answer, run the next queued hardware job (bench sweep first,
 # then the Pallas flash first-contact smoke, then reruns) so no healthy
-# hardware minute is wasted. Log to /tmp/tpu_watch.log.
+# hardware minute is wasted. Log: /root/repo/.watcher/watch.log.
 #
 # The bench itself (bench.py, round-5 architecture) is wedge-tolerant:
 # each config runs in a subprocess with a watchdog, results stream to
 # $DL4J_TPU_BENCH_PARTIAL, and a mid-sweep wedge yields a partial JSON
 # instead of a hang — so even an unlucky window produces numbers.
 PROBE='import jax,sys; ds=jax.devices(); sys.exit(0 if ds and ds[0].platform!="cpu" else 3)'
-LOG=/tmp/tpu_watch.log
+# Stage done-flags, window accumulators and in-flight outputs live in a
+# REPO-LOCAL state dir (gitignored): /tmp is wiped between builder
+# sessions, and losing the flags made a fresh session re-run stages whose
+# results were already banked at HEAD (overwriting analyzed artifacts).
+STATE=/root/repo/.watcher
+mkdir -p "$STATE"
+LOG="$STATE/watch.log"
+# derive stage-1 done from the repo itself: if a fully-measured sweep is
+# already banked at HEAD, never re-run stage 1 (it would overwrite the
+# artifact PERF.md's analysis quotes)
+if [ ! -f "$STATE/bench_tpu_done" ] \
+   && grep -q '"tpu_unavailable": false' /root/repo/BENCH_TPU_MEASURED_r05.json 2>/dev/null \
+   && grep -q '"value": [0-9]' /root/repo/BENCH_TPU_MEASURED_r05.json 2>/dev/null; then
+  touch "$STATE/bench_tpu_done"
+  echo "stage-1 done derived from banked BENCH_TPU_MEASURED_r05.json $(date -u +%FT%TZ)" >> "$LOG"
+fi
 # headline per-call program is a disk-cache hit after first contact, so a
 # healthy config needs ~2 min; 600 s cuts wedge recovery from 30 min to 10
 export DL4J_TPU_BENCH_CONFIG_TIMEOUT="${DL4J_TPU_BENCH_CONFIG_TIMEOUT:-600}"
@@ -120,7 +135,7 @@ run_sweep() {
     # those rows. Guard is per-row: every bench runner stamps its result
     # with the platform it executed on, so a CPU row can never be banked
     grep '"on_tpu": true' "$DL4J_TPU_BENCH_PARTIAL" > /tmp/bench_tpu_rows.jsonl
-    bank_windowed /tmp/bench_tpu_rows.jsonl /tmp/bench_windowed.jsonl \
+    bank_windowed /tmp/bench_tpu_rows.jsonl $STATE/bench_windowed.jsonl \
       BENCH_TPU_PARTIAL_r05.jsonl \
       "Bank partial TPU bench rows ($label window $(date -u +%FT%TZ))"
   fi
@@ -133,23 +148,23 @@ while true; do
   echo "probe rc=$rc $(date -u +%FT%TZ)" >> "$LOG"
   if [ "$rc" = "0" ]; then
     touch /tmp/tpu_up
-    if [ ! -f /tmp/bench_tpu_done ]; then
+    if [ ! -f $STATE/bench_tpu_done ]; then
       # a measured sweep stranded in /tmp by a failed bank (index-lock
       # exhaustion) must be rebanked BEFORE the rerun truncates it
-      if [ -f /tmp/bench_tpu.json ] \
-         && grep -q '"value": [0-9]' /tmp/bench_tpu.json \
-         && grep -q '"tpu_unavailable": false' /tmp/bench_tpu.json; then
-        bank /tmp/bench_tpu.json BENCH_TPU_MEASURED_r05.json \
+      if [ -f $STATE/bench_tpu.json ] \
+         && grep -q '"value": [0-9]' $STATE/bench_tpu.json \
+         && grep -q '"tpu_unavailable": false' $STATE/bench_tpu.json; then
+        bank $STATE/bench_tpu.json BENCH_TPU_MEASURED_r05.json \
           "Bank measured TPU bench sweep (recovered stranded result)" \
-          && touch /tmp/bench_tpu_done
+          && touch $STATE/bench_tpu_done
         # whether or not the bank landed, never fall through to a rerun
         # this window — the rerun's truncation is the loss this guards
         continue
       fi
       echo "TPU UP — running bench $(date -u +%FT%TZ)" >> "$LOG"
-      run_sweep /tmp/bench_tpu.json /tmp/bench_tpu_done "" "bench" \
+      run_sweep $STATE/bench_tpu.json $STATE/bench_tpu_done "" "bench" \
         BENCH_TPU_MEASURED_r05.json
-    elif [ ! -f /tmp/flash_smoke_done ]; then
+    elif [ ! -f $STATE/flash_smoke_done ]; then
       echo "TPU UP — running flash smoke $(date -u +%FT%TZ)" >> "$LOG"
       (cd /root/repo && timeout 3600 python tools/flash_smoke.py > /tmp/flash_smoke.log 2>&1)
       src=$?
@@ -162,12 +177,12 @@ while true; do
         # ': err=' matches only genuine kernel-result lines — an
         # all-exception log (every kernel raising on first contact)
         # prints 'FWD x: EXC ...' lines and is not banked
-        bank_windowed /tmp/flash_smoke.log /tmp/flash_smoke_windowed.log \
+        bank_windowed /tmp/flash_smoke.log $STATE/flash_smoke_windowed.log \
           FLASH_SMOKE_r05.log \
           "Bank Pallas flash first-contact smoke log (rc=$src)" \
-          && [ "$src" = "0" ] && touch /tmp/flash_smoke_done
+          && [ "$src" = "0" ] && touch $STATE/flash_smoke_done
       fi
-    elif [ ! -f /tmp/trace_done ]; then
+    elif [ ! -f $STATE/trace_done ]; then
       echo "TPU UP — capturing profiler trace $(date -u +%FT%TZ)" >> "$LOG"
       (cd /root/repo && timeout 2400 python tools/profile_capture.py > /tmp/trace_capture.log 2>&1)
       trc=$?
@@ -175,12 +190,12 @@ while true; do
       # the trace run also prints measured per-call/scan10 throughput —
       # bank the log whenever those numbers landed
       if grep -q 'imgs/s' /tmp/trace_capture.log 2>/dev/null; then
-        bank_windowed /tmp/trace_capture.log /tmp/trace_windowed.log \
+        bank_windowed /tmp/trace_capture.log $STATE/trace_windowed.log \
           TRACE_CAPTURE_r05.log \
           "Bank profiler-trace capture log (rc=$trc)" \
-          && [ "$trc" = "0" ] && touch /tmp/trace_done
+          && [ "$trc" = "0" ] && touch $STATE/trace_done
       fi
-    elif [ ! -f /tmp/bench2_done ]; then
+    elif [ ! -f $STATE/bench2_done ]; then
       # second full sweep BEFORE the mfu probe: it completes BASELINE.md's
       # config coverage (the 01:28Z wedge cut off char-lstm / word2vec /
       # lenet; resnet programs are compile-cache hits so a complete pass
@@ -189,9 +204,9 @@ while true; do
       # Banked to a distinct artifact so the r05 JSON PERF.md quotes
       # stays byte-stable at HEAD.
       echo "TPU UP — bench sweep 2 (full config set) $(date -u +%FT%TZ)" >> "$LOG"
-      run_sweep /tmp/bench_tpu2.json /tmp/bench2_done "char-lstm" "bench2" \
+      run_sweep $STATE/bench_tpu2.json $STATE/bench2_done "char-lstm" "bench2" \
         BENCH_TPU_MEASURED_r05b.json
-    elif [ ! -f /tmp/mfu_probe_done ]; then
+    elif [ ! -f $STATE/mfu_probe_done ]; then
       # 5400s: fwd-only and fwd+bwd are cold compiles through the tunnel;
       # only the full-step program shares the bench's compile cache
       echo "TPU UP — running mfu probe $(date -u +%FT%TZ)" >> "$LOG"
@@ -201,10 +216,10 @@ while true; do
       echo "mfu probe rc=$mrc $(date -u +%FT%TZ)" >> "$LOG"
       # per-row on_tpu stamps guard against CPU rows, as in the bench
       if grep -q '"on_tpu": true' /tmp/mfu_probe.log 2>/dev/null; then
-        bank_windowed /tmp/mfu_probe.log /tmp/mfu_windowed.jsonl \
+        bank_windowed /tmp/mfu_probe.log $STATE/mfu_windowed.jsonl \
           MFU_PROBE_r05.jsonl \
           "Bank MFU calibration probe (matmul peak + step segments, rc=$mrc)" \
-          && [ "$mrc" = "0" ] && touch /tmp/mfu_probe_done
+          && [ "$mrc" = "0" ] && touch $STATE/mfu_probe_done
       fi
     else
       sleep 420   # all jobs done; stay armed for manual reruns

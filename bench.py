@@ -72,11 +72,13 @@ def cache_dir() -> str:
     d = os.path.join(repo, ".jaxcache")
     try:
         os.makedirs(d, exist_ok=True)
-        return d
+        if os.access(d, os.W_OK):   # existing dir on a read-only mount
+            return d                # raises nothing from makedirs
     except OSError:
-        import tempfile
-        uid = os.getuid() if hasattr(os, "getuid") else "u"
-        return os.path.join(tempfile.gettempdir(), f"dl4jtpu-jax-cache-{uid}")
+        pass
+    import tempfile
+    uid = os.getuid() if hasattr(os, "getuid") else "u"
+    return os.path.join(tempfile.gettempdir(), f"dl4jtpu-jax-cache-{uid}")
 
 
 def probe_tpu(attempts: int = None, probe_timeout: int = None,
@@ -243,16 +245,29 @@ def _run_resnet(cfg):
             out["imgs_sec"] = round(
                 batch * scan_k / _timed_best(run, best_of), 2)
     elif mode == "fit":
-        # the REAL production loop: fit(scan_steps=K) with host-side
-        # batch staging and deferred loss fetch. Should approach scanK.
+        # the REAL production loop: fit(scan_steps=K) over the canonical
+        # image pipeline — uint8 pixels + ImagePreProcessingScaler, so
+        # the device-norm seam engages and RAW bytes cross the host->HBM
+        # link (4x fewer than float32). r05 measured this mode at 103
+        # imgs/s vs 2376 for the resident-data scan: it is LINK-bound
+        # through the tunnel (see the h2d micro), not compute-bound.
         from deeplearning4j_tpu.data.dataset import DataSet
+        from deeplearning4j_tpu.data.iterator import ExistingDataSetIterator
+        from deeplearning4j_tpu.data.normalization import (
+            ImagePreProcessingScaler)
+        X8 = (Xnp * 255).astype("uint8")
         # two chunks of K so the deferred-fetch overlap actually engages
-        fit_batches = [DataSet(Xnp, Ynp) for _ in range(2 * scan_k)]
-        net.fit(iter(fit_batches), scan_steps=scan_k)  # compile+run
+        fit_batches = [DataSet(X8, Ynp) for _ in range(2 * scan_k)]
+
+        def make_it():
+            return ExistingDataSetIterator(fit_batches).set_pre_processor(
+                ImagePreProcessingScaler())
+
+        net.fit(make_it(), scan_steps=scan_k)  # compile+run
 
         def run():
             t0 = time.perf_counter()
-            net.fit(iter(fit_batches), scan_steps=scan_k)
+            net.fit(make_it(), scan_steps=scan_k)
             return time.perf_counter() - t0
 
         out["mode"] = f"fit-pipelined{scan_k}"
@@ -439,9 +454,44 @@ def _run_attention(cfg):
             "flash_speedup": round(dense_s / max(flash_s, 1e-9), 3)}
 
 
+def _run_h2d(cfg):
+    # host->HBM transfer bandwidth micro: attributes the fit-pipelined
+    # number (through the axon tunnel the link, not the chip, is the
+    # bottleneck — r05 measured ~31 MB/s effective vs PCIe-class GB/s on
+    # a co-located host). One fp32 and one uint8 payload so the
+    # device-norm byte savings are directly readable from the row.
+    import numpy as np
+    import jax
+
+    on_tpu, best_of = _bench_env()
+    mb = 64
+    rows = {}
+    # random payloads: an all-zeros buffer maps to the CoW zero page
+    # (cache-resident host reads) and compresses on any smart transport,
+    # overstating the bandwidth real image batches see
+    rng = np.random.default_rng(0)
+    for name, arr in (("f32",
+                       rng.standard_normal(mb * 1024 * 256,
+                                           dtype=np.float32)),
+                      ("u8",
+                       rng.integers(0, 256, mb * 1024 * 1024,
+                                    dtype=np.uint8))):
+        d = jax.device_put(arr)        # warm path/allocator
+        np.asarray(d[:1])
+
+        def run():
+            t0 = time.perf_counter()
+            dd = jax.device_put(arr)
+            np.asarray(dd[:1])         # host fetch = hard barrier
+            return time.perf_counter() - t0
+
+        rows[f"h2d_{name}_mbytes_sec"] = round(mb / _timed_best(run, best_of), 1)
+    return {"mode": "h2d-micro", "payload_mb": mb, "on_tpu": on_tpu, **rows}
+
+
 _KIND_RUNNERS = {"resnet": _run_resnet, "lenet": _run_lenet,
                  "char-lstm": _run_char_lstm, "word2vec": _run_word2vec,
-                 "attention": _run_attention}
+                 "attention": _run_attention, "h2d": _run_h2d}
 
 
 def run_one(cfg):
@@ -522,6 +572,8 @@ def _configs(on_tpu):
     cfgs = [{"kind": "resnet", "batch": b0, "mode": "per-call"},
             {"kind": "resnet", "batch": b0, "mode": "scan"},
             {"kind": "resnet", "batch": b0, "mode": "fit"}]
+    if os.environ.get("DL4J_TPU_BENCH_H2D", "1") == "1":
+        cfgs.append({"kind": "h2d"})   # cheap; attributes the fit number
     if os.environ.get("DL4J_TPU_BENCH_ATTENTION",
                       "1" if on_tpu else "0") == "1":
         cfgs.append({"kind": "attention"})
@@ -626,6 +678,26 @@ def main():
         "tunnel_wedged_mid_sweep": wedged,
         "sweep": results,
     }
+    if not on_tpu:
+        # the axon tunnel answers only intermittently; when this run never
+        # saw the chip, point at the most recent MEASURED sweep the
+        # background watcher banked at HEAD so a CPU-fallback JSON is
+        # never mistaken for "no TPU number exists"
+        here = os.path.dirname(os.path.abspath(__file__))
+        for name in ("BENCH_TPU_MEASURED_r05b.json",
+                     "BENCH_TPU_MEASURED_r05.json"):
+            p = os.path.join(here, name)
+            if os.path.exists(p):
+                try:
+                    with open(p) as f:
+                        m = json.load(f)
+                except (OSError, ValueError):
+                    continue
+                if m.get("value") and not m.get("tpu_unavailable", True):
+                    base["measured_tpu_artifact"] = name
+                    base["measured_tpu_value"] = m["value"]
+                    base["measured_tpu_unit"] = m.get("unit")
+                    break
     if best is None:            # every config errored — still emit JSON
         print(json.dumps({**base, "value": None, "unit": "imgs/sec",
                           "vs_baseline": None}))

@@ -95,7 +95,7 @@ def prefetch_iterable(source, transform=None, queue_size: int = 2):
 class AsyncDataSetIterator(DataSetIterator):
     def __init__(self, source: DataSetIterator, queue_size: int = 4,
                  device_put: bool = True, device=None, callback=None,
-                 cast_dtype=None):
+                 cast_dtype=None, cast_features: bool = True):
         """`callback` is a DataSetCallback (data/utility_iterators.py)
         applied to each batch on the prefetch thread AFTER the default
         device_put — the reference's DataSetCallback seam
@@ -105,7 +105,11 @@ class AsyncDataSetIterator(DataSetIterator):
 
         `cast_dtype`: 16-bit compute dtype to host-cast float32
         features/labels to on the worker thread before the transfer
-        (see `host_cast`; masks keep their dtype)."""
+        (see `host_cast`; masks keep their dtype). `cast_features=False`
+        restricts the cast to labels — fit() uses it when device-side
+        normalization is engaged, where RAW features must reach the
+        device uncast (normalize-then-cast preserves the f32 signal a
+        premature bf16 cast would quantize away)."""
         if getattr(source, "async_supported", True) is False:
             # AsyncShieldDataSetIterator semantics: pass through unwrapped
             self._passthrough = source
@@ -117,6 +121,7 @@ class AsyncDataSetIterator(DataSetIterator):
         self._device = device
         self._callback = callback
         self._cast_dtype = cast_dtype
+        self._cast_features = cast_features
 
     def reset(self):
         self._source.reset()
@@ -134,7 +139,8 @@ class AsyncDataSetIterator(DataSetIterator):
         transfer, then the DataSetCallback seam."""
         if self._cast_dtype is not None:
             ds = DataSet(
-                host_cast(ds.features, self._cast_dtype),
+                host_cast(ds.features, self._cast_dtype)
+                if self._cast_features else ds.features,
                 None if ds.labels is None
                 else host_cast(ds.labels, self._cast_dtype),
                 ds.features_mask, ds.labels_mask,

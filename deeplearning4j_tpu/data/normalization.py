@@ -29,7 +29,61 @@ class DataSetPreProcessor:
     def preprocess(self, ds: DataSet) -> DataSet:
         raise NotImplementedError
 
+    def device_affine(self):
+        """(shift, scale) float32 arrays such that
+        `features.astype(f32) * scale + shift` reproduces this
+        normalizer's FEATURE transform, or None when the transform is not
+        a per-feature affine map (or also touches labels).
+
+        TPU-first seam: when an iterator's pre-processor advertises an
+        affine, fit() ships the RAW features over the host->HBM link
+        (uint8 pixels stay uint8 — 4x fewer bytes than float32) and
+        applies the normalization on device, where the multiply is free
+        next to the matmuls. The reference normalizes on host in float
+        (ND4J ImagePreProcessingScaler.preProcess) because its CPU path
+        is where ETL lives; on TPU the link is the scarce resource."""
+        return None
+
     __call__ = preprocess
+
+
+def make_affine_fn(compute_dtype):
+    """The ONE jitted device-norm rule shared by both containers and
+    ParallelWrapper: accumulate in (at least) f32, then cast to the
+    compute dtype. Takes (x, shift, scale) so one compiled program
+    serves any affine values of the same shapes."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def affine(x, shift, scale):
+        acc = jnp.promote_types(jnp.float32, compute_dtype)
+        return (x.astype(acc) * scale + shift).astype(compute_dtype)
+
+    return affine
+
+
+def engage_device_affine(iterator):
+    """Walk an iterator wrapper chain (AsyncDataSetIterator etc. hold the
+    backing iterator as `_source`) for an attached pre-processor that
+    advertises `device_affine()`. If found, DETACH it — host application
+    is skipped for the duration of a fit — and return
+    `(owner, pre_processor, (shift, scale))` so the caller can restore
+    `owner.pre_processor` in a finally block. `(None, None, None)` when
+    no pre-processor is attached or it is not affine-representable."""
+    seen = set()
+    it = iterator
+    while it is not None and id(it) not in seen:
+        seen.add(id(it))
+        pp = getattr(it, "pre_processor", None)
+        if pp is not None:
+            aff = getattr(pp, "device_affine", lambda: None)()
+            if aff is None:
+                return None, None, None
+            it.pre_processor = None
+            return it, pp, aff
+        it = getattr(it, "_source", None)
+    return None, None, None
 
 
 class _Welford:
@@ -128,6 +182,15 @@ class NormalizerStandardize(DataSetPreProcessor):
             raise RuntimeError("NormalizerStandardize is not fitted — "
                                "call fit(iterator) first")
 
+    def device_affine(self):
+        # label standardization has no device-side analog (labels go
+        # through the loss, not the input head) — host path keeps it
+        if self.feature_mean is None or self.label_mean is not None:
+            return None
+        scale = (1.0 / self.feature_std).astype(np.float32)
+        shift = (-self.feature_mean * scale).astype(np.float32)
+        return shift, scale
+
     # ------------------------------------------------- serde (serializer)
     def save(self, path: str):
         self._check_fit()
@@ -182,6 +245,14 @@ class NormalizerMinMaxScaler(DataSetPreProcessor):
         return DataSet(self.transform(ds.features), ds.labels,
                        ds.features_mask, ds.labels_mask)
 
+    def device_affine(self):
+        if self.feature_min is None:
+            return None
+        rng = np.maximum(self.feature_max - self.feature_min, 1e-8)
+        scale = ((self.hi - self.lo) / rng).astype(np.float32)
+        shift = (self.lo - self.feature_min * scale).astype(np.float32)
+        return shift, scale
+
     def save(self, path: str):
         _save_stats(path, type(self).__name__, {
             "feature_min": self.feature_min, "feature_max": self.feature_max,
@@ -213,6 +284,10 @@ class ImagePreProcessingScaler(DataSetPreProcessor):
         return DataSet(self.transform(ds.features), ds.labels,
                        ds.features_mask, ds.labels_mask)
 
+    def device_affine(self):
+        scale = np.float32((self.hi - self.lo) / self.max_pixel)
+        return np.float32(self.lo), scale
+
 
 class VGG16ImagePreProcessor(DataSetPreProcessor):
     """Subtract the ImageNet channel means (ND4J VGG16ImagePreProcessor);
@@ -226,6 +301,9 @@ class VGG16ImagePreProcessor(DataSetPreProcessor):
     def preprocess(self, ds: DataSet) -> DataSet:
         return DataSet(self.transform(ds.features), ds.labels,
                        ds.features_mask, ds.labels_mask)
+
+    def device_affine(self):
+        return -self.MEANS, np.float32(1.0)
 
 
 class MultiNormalizerStandardize:

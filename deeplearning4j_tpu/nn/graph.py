@@ -56,10 +56,16 @@ class ComputationGraph:
         self._train_step = None
         self._scan_step: Dict[Any, Any] = {}
         self._output_fn = None
+        self._input_affine = None   # (shift, scale) during device-norm fit
+        self._affine_fn = None
 
     def set_listeners(self, *listeners):
         self.listeners = list(listeners)
         return self
+
+    def _stage_x(self, a):
+        from deeplearning4j_tpu.nn.multilayer import _stage_with_affine
+        return _stage_with_affine(self, a)
 
     # ----------------------------------------------------------- init/types
     def _resolve_types(self) -> Dict[str, InputType]:
@@ -370,18 +376,34 @@ class ComputationGraph:
             scan_steps = int(os.environ.get("DL4J_TPU_SCAN_STEPS", "1"))
         rng = jax.random.PRNGKey(self.conf.seed + 331 * (self.epoch_count + 1))
         tbptt = self.conf.backprop_type == "tbptt"
-        for _ in range(epochs):
-            for lst in self.listeners:
-                lst.on_epoch_start(self, self.epoch_count)
-            if not tbptt and scan_steps > 1:
-                rng = self._fit_epoch_scan(data, rng, scan_steps)
-            else:
-                rng = self._fit_epoch_per_call(data, rng, tbptt)
-            for lst in self.listeners:
-                lst.on_epoch_end(self, self.epoch_count)
-            self.epoch_count += 1
-            if hasattr(data, "reset"):
-                data.reset()
+        # device-side normalization (see MultiLayerNetwork.fit): an
+        # affine pre-processor is detached for the fit and applied on
+        # device, so raw (uint8) features ship over the link
+        aff_owner = aff_pp = None
+        if os.environ.get("DL4J_TPU_DEVICE_NORM", "1") == "1":
+            from deeplearning4j_tpu.data.normalization import (
+                engage_device_affine)
+            aff_owner, aff_pp, aff = engage_device_affine(data)
+            if aff is not None:
+                self._input_affine = (jnp.asarray(aff[0]),
+                                      jnp.asarray(aff[1]))
+        try:
+            for _ in range(epochs):
+                for lst in self.listeners:
+                    lst.on_epoch_start(self, self.epoch_count)
+                if not tbptt and scan_steps > 1:
+                    rng = self._fit_epoch_scan(data, rng, scan_steps)
+                else:
+                    rng = self._fit_epoch_per_call(data, rng, tbptt)
+                for lst in self.listeners:
+                    lst.on_epoch_end(self, self.epoch_count)
+                self.epoch_count += 1
+                if hasattr(data, "reset"):
+                    data.reset()
+        finally:
+            if aff_owner is not None:
+                aff_owner.pre_processor = aff_pp
+            self._input_affine = None
         return self
 
     def _mds_stream(self, data):
@@ -399,12 +421,16 @@ class ComputationGraph:
         )
         cast = self._compute_dtype \
             if np.dtype(self._compute_dtype).itemsize == 2 else None
+        # device-norm engaged: features reach the device UNCAST so the
+        # affine normalizes the full-precision values (normalize-then-
+        # cast); labels still ship 16-bit
+        fcast = None if self._input_affine is not None else cast
         dev = jax.local_devices()[0]
 
         def stage(mds):
             put = lambda a: None if a is None else jax.device_put(a, dev)
             return MultiDataSet(
-                tuple(put(host_cast(f, cast)) for f in mds.features),
+                tuple(put(host_cast(f, fcast)) for f in mds.features),
                 tuple(put(host_cast(l, cast)) for l in mds.labels),
                 None if mds.features_masks is None
                 else tuple(put(m) for m in mds.features_masks),
@@ -417,7 +443,7 @@ class ComputationGraph:
         etl_start = time.perf_counter()
         for mds in self._mds_stream(data):
             etl_ms = (time.perf_counter() - etl_start) * 1e3
-            inputs = tuple(_as_jnp(f, self._compute_dtype) for f in mds.features)
+            inputs = tuple(self._stage_x(f) for f in mds.features)
             labels = tuple(_as_jnp(l, self._compute_dtype) for l in mds.labels)
             fmasks = None if mds.features_masks is None else tuple(
                 _as_jnp(m) for m in mds.features_masks)
@@ -493,7 +519,7 @@ class ComputationGraph:
                 etl_ms = 0.0
 
         def to_dev(mds):
-            return (tuple(_as_jnp(f, self._compute_dtype) for f in mds.features),
+            return (tuple(self._stage_x(f) for f in mds.features),
                     tuple(_as_jnp(l, self._compute_dtype) for l in mds.labels),
                     None if mds.features_masks is None else tuple(
                         _as_jnp(m) for m in mds.features_masks),

@@ -153,6 +153,22 @@ def _layer_call(layer, *, seq, train, remat, params, x, state=None,
     return fn(*args)
 
 
+def _stage_with_affine(net, a):
+    """Features -> device, shared by MultiLayerNetwork._stage_x and
+    ComputationGraph._stage_x. With a device affine engaged (fit through
+    a `device_affine()` pre-processor), RAW features ship over the
+    host->HBM link (uint8 pixels stay uint8: 4x fewer bytes than
+    float32, 2x fewer than the bf16 host cast) and the normalization
+    runs on device in one fused jit; otherwise plain _as_jnp."""
+    if net._input_affine is None:
+        return _as_jnp(a, net._compute_dtype)
+    if net._affine_fn is None:
+        from deeplearning4j_tpu.data.normalization import make_affine_fn
+        net._affine_fn = make_affine_fn(net._compute_dtype)
+    shift, scale = net._input_affine
+    return net._affine_fn(jnp.asarray(a), shift, scale)
+
+
 def _as_jnp(a, dtype=None):
     if a is None:
         return None
@@ -220,8 +236,13 @@ class MultiLayerNetwork:
         self._train_step = None
         self._scan_step: Dict[Any, Any] = {}
         self._output_fn = None
+        self._input_affine = None   # (shift, scale) during device-norm fit
+        self._affine_fn = None
 
     # ------------------------------------------------------------ plumbing
+    def _stage_x(self, a):
+        return _stage_with_affine(self, a)
+
     def set_listeners(self, *listeners):
         self.listeners = list(listeners)
         return self
@@ -494,6 +515,23 @@ class MultiLayerNetwork:
         iterator = self._as_iterator(data, batch_size)
         if prefetch is None:
             prefetch = os.environ.get("DL4J_TPU_FIT_PREFETCH", "1") == "1"
+        # device-side normalization (kill switch DL4J_TPU_DEVICE_NORM=0):
+        # an affine-representable pre-processor is detached from the
+        # iterator for the duration of the fit and applied on device
+        # instead (_stage_x) — raw uint8 pixels ship over the link.
+        # Engaged BEFORE the async wrap so the wrap can skip the 16-bit
+        # host cast: casting RAW features to bf16 before normalization
+        # would quantize away the signal (x=1000.3 standardized to 0.3
+        # needs the f32 bits); normalize-then-cast keeps the host-norm
+        # numerics, uint8 features never cast host-side either way
+        aff_owner = aff_pp = None
+        if os.environ.get("DL4J_TPU_DEVICE_NORM", "1") == "1":
+            from deeplearning4j_tpu.data.normalization import (
+                engage_device_affine)
+            aff_owner, aff_pp, aff = engage_device_affine(iterator)
+            if aff is not None:
+                self._input_affine = (jnp.asarray(aff[0]),
+                                      jnp.asarray(aff[1]))
         if prefetch and not isinstance(iterator, AsyncDataSetIterator) \
                 and getattr(iterator, "async_supported", True):
             # scan-fit stacks K host batches before ONE transfer, so the
@@ -502,20 +540,26 @@ class MultiLayerNetwork:
             iterator = AsyncDataSetIterator(
                 iterator, device_put=(scan_steps <= 1),
                 cast_dtype=self._compute_dtype
-                if np.dtype(self._compute_dtype).itemsize == 2 else None)
-        for _ in range(epochs):
-            for lst in self.listeners:
-                lst.on_epoch_start(self, self.epoch_count)
-            if self.conf.backprop_type == "tbptt":
-                self._fit_epoch_tbptt(iterator)
-            elif scan_steps > 1:
-                self._fit_epoch_scan(iterator, scan_steps)
-            else:
-                self._fit_epoch(iterator)
-            for lst in self.listeners:
-                lst.on_epoch_end(self, self.epoch_count)
-            self.epoch_count += 1
-            iterator.reset()
+                if np.dtype(self._compute_dtype).itemsize == 2 else None,
+                cast_features=self._input_affine is None)
+        try:
+            for _ in range(epochs):
+                for lst in self.listeners:
+                    lst.on_epoch_start(self, self.epoch_count)
+                if self.conf.backprop_type == "tbptt":
+                    self._fit_epoch_tbptt(iterator)
+                elif scan_steps > 1:
+                    self._fit_epoch_scan(iterator, scan_steps)
+                else:
+                    self._fit_epoch(iterator)
+                for lst in self.listeners:
+                    lst.on_epoch_end(self, self.epoch_count)
+                self.epoch_count += 1
+                iterator.reset()
+        finally:
+            if aff_owner is not None:
+                aff_owner.pre_processor = aff_pp
+            self._input_affine = None
         return self
 
     def fit_pretrain(self, data, epochs: int = 1, batch_size: int = 32):
@@ -594,7 +638,7 @@ class MultiLayerNetwork:
                                         None, with_stats=bool(capture))
             out = step(
                 self.params, self.opt_state, self.state,
-                _as_jnp(ds.features, self._compute_dtype),
+                self._stage_x(ds.features),
                 _as_jnp(ds.labels, self._compute_dtype),
                 _as_jnp(ds.features_mask), _as_jnp(ds.labels_mask), sub, None)
             grads = updates = None
@@ -689,7 +733,7 @@ class MultiLayerNetwork:
                 losses = []
                 for ds, sub in zip(group, subs):
                     out = step(self.params, self.opt_state, self.state,
-                               _as_jnp(ds.features, self._compute_dtype),
+                               self._stage_x(ds.features),
                                _as_jnp(ds.labels, self._compute_dtype),
                                _as_jnp(ds.features_mask),
                                _as_jnp(ds.labels_mask), sub, None)
@@ -701,7 +745,8 @@ class MultiLayerNetwork:
                     None if get(ds0) is None else
                     _as_jnp(np.stack([np.asarray(get(d)) for d in group]),
                             dt))
-                xs = stack(lambda d: d.features, self._compute_dtype)
+                xs = None if ds0.features is None else self._stage_x(
+                    np.stack([np.asarray(d.features) for d in group]))
                 ys = stack(lambda d: d.labels, self._compute_dtype)
                 fms = stack(lambda d: d.features_mask)
                 lms = stack(lambda d: d.labels_mask)
@@ -738,7 +783,7 @@ class MultiLayerNetwork:
                 step = self._get_train_step(fm, lm, carries)
                 self.params, self.opt_state, self.state, loss, new_carries = step(
                     self.params, self.opt_state, self.state,
-                    _as_jnp(x, self._compute_dtype),
+                    self._stage_x(x),
                     _as_jnp(y, self._compute_dtype),
                     _as_jnp(fm), _as_jnp(lm), sub, carries)
                 # stop gradient across chunk boundary

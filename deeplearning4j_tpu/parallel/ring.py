@@ -178,7 +178,8 @@ def blockwise_attention(q, k, v, *, block_size: int = 512,
 
 def ring_flash_self_attention(q, k, v, *, axis_name: str = SEQ_AXIS,
                               causal: bool = True, mask=None,
-                              block_q: int = 128, block_k: int = 128):
+                              block_q: int = 128, block_k: int = 128,
+                              interpret=None):
     """Ring attention with the FUSED Pallas flash kernel per shard pair
     (ops/flash_attention.py), composed across ring steps with the exact
     LSE merge rule. Per-pair causality never needs position offsets
@@ -211,7 +212,8 @@ def ring_flash_self_attention(q, k, v, *, axis_name: str = SEQ_AXIS,
         o_s, l_s = flash_attention(
             q, k_cur, v_cur, mask=mask_cur,
             causal=(causal and s == 0),     # diagonal pair only
-            block_q=block_q, block_k=block_k, return_lse=True)
+            block_q=block_q, block_k=block_k, return_lse=True,
+            interpret=interpret)
         l_s = l_s.astype(jnp.float32)
         if causal and s > 0:
             # ring step s>0 holds shard `src`; it is entirely in the past

@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import enum
 import logging
+import os
 import time
 from typing import Optional
 
@@ -107,6 +108,7 @@ class ParallelWrapper:
         self._avg_fn = None
         self._stacked = None      # (params, opt_state, state) in AVERAGING mode
         self._local_steps = 0
+        self._input_affine = None  # jitted device-norm fn during fit
         self._warned_ragged = False
 
     # ------------------------------------------------------------- plumbing
@@ -245,10 +247,30 @@ class ParallelWrapper:
         else:
             source = self.model._as_iterator(data, batch_size) \
                 if not isinstance(data, DataSetIterator) else data
-        if self.mode == TrainingMode.AVERAGING:
-            self._fit_averaging(source, epochs)
-        else:
-            self._fit_sync(source, epochs)
+        # device-side normalization (see MultiLayerNetwork.fit): raw
+        # (uint8) features ship to HBM sharded, the affine runs on
+        # device per shard — the per-replica H2D feed is the scaling
+        # bottleneck the reference's workspaces attack host-side
+        aff_owner = aff_pp = None
+        if os.environ.get("DL4J_TPU_DEVICE_NORM", "1") == "1":
+            from deeplearning4j_tpu.data.normalization import (
+                engage_device_affine)
+            aff_owner, aff_pp, aff = engage_device_affine(source)
+            if aff is not None:
+                from deeplearning4j_tpu.data.normalization import (
+                    make_affine_fn)
+                fn = make_affine_fn(self.model._compute_dtype)
+                shift, scale = jnp.asarray(aff[0]), jnp.asarray(aff[1])
+                self._input_affine = lambda x: fn(x, shift, scale)
+        try:
+            if self.mode == TrainingMode.AVERAGING:
+                self._fit_averaging(source, epochs)
+            else:
+                self._fit_sync(source, epochs)
+        finally:
+            if aff_owner is not None:
+                aff_owner.pre_processor = aff_pp
+            self._input_affine = None
         return self.model
 
     def _batches(self, source):
@@ -380,17 +402,17 @@ class ParallelWrapper:
                     if net.listeners:
                         flush_pending()
                     # blocking loss fetches only where someone reads the
-                    # value; with listeners the fetch rides the deferred
-                    # flush
-                    if self.report_score_after_averaging:
-                        if at_avg:
-                            net._score = float(jnp.mean(losses))
-                    elif not net.listeners and at_avg:
+                    # value; with listeners EVERY fetch (including the
+                    # report-after-averaging barrier fetch) rides the
+                    # deferred flush, so the dispatch pipeline never
+                    # serializes on a device->host sync
+                    if at_avg and not net.listeners:
                         net._score = float(jnp.mean(losses))
                     if net.listeners:
                         pending = (
-                            None if self.report_score_after_averaging
-                            else losses, net.iteration_count, bs)
+                            losses if (at_avg or
+                                       not self.report_score_after_averaging)
+                            else None, net.iteration_count, bs)
                     net.iteration_count += 1
                 flush_pending()
                 for lst in net.listeners:
@@ -496,7 +518,13 @@ class ParallelWrapper:
         def put(a):
             return jax.device_put(jnp.asarray(a), shard)
 
-        return (self._map_entry(x, put), self._map_entry(y, put),
+        def put_x(a):
+            a = put(a)
+            # device-norm affine on the already-sharded features (jit
+            # propagates the sharding; elementwise, no resharding)
+            return a if self._input_affine is None else self._input_affine(a)
+
+        return (self._map_entry(x, put_x), self._map_entry(y, put),
                 self._map_entry(fm, put), self._map_entry(lm, put))
 
     def _split_batch(self, x, y, fm, lm):
@@ -512,7 +540,11 @@ class ParallelWrapper:
                 jnp.asarray(a.reshape(n, a.shape[0] // n, *a.shape[1:])),
                 stacked)
 
-        return (self._map_entry(x, split), self._map_entry(y, split),
+        def split_x(a):
+            a = split(a)
+            return a if self._input_affine is None else self._input_affine(a)
+
+        return (self._map_entry(x, split_x), self._map_entry(y, split),
                 self._map_entry(fm, split), self._map_entry(lm, split))
 
     @staticmethod

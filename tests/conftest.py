@@ -53,6 +53,10 @@ jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 # zoo forwards, multi-process distributed runs) — names without any
 # parametrize suffix, so every variant of a listed test is marked
 _SLOW = {
+    "tests/test_tpu_lowering.py::TestFlashKernelLowering::test_backward_kernels_with_lse_cotangent",
+    "tests/test_tpu_lowering.py::TestFlashKernelLowering::test_cross_attention_shapes",
+    "tests/test_tpu_lowering.py::TestRingFlashLowering::test_ring_flash_over_seq_mesh",
+    "tests/test_tpu_lowering.py::TestFlagshipLowering::test_graft_entry_forward_lowers_for_tpu",
     "tests/test_attention.py::test_context_parallel_dp_sp_mesh_trains",
     "tests/test_attention.py::test_context_parallel_graph_matches_single_device",
     "tests/test_attention.py::test_context_parallel_honors_label_mask",

@@ -33,7 +33,10 @@ class TestConfigs:
         # attention micro (first-contact wedge risk) and batch 256
         assert kinds[:3] == [("resnet", "per-call"), ("resnet", "scan"),
                              ("resnet", "fit")]
-        assert kinds[3] == ("attention", "")
+        # the cheap h2d bandwidth micro (attributes the fit number) rides
+        # right behind the trio, before the wedge-risky attention micro
+        assert kinds[3] == ("h2d", "")
+        assert kinds[4] == ("attention", "")
         assert {c["batch"] for c in cfgs[:3]} == {128}
         # full sweep carries all 4 BASELINE configs
         assert {"char-lstm", "word2vec", "lenet"} <= {k for k, _ in kinds}
@@ -49,6 +52,7 @@ class TestConfigs:
         monkeypatch.setenv("DL4J_TPU_BENCH_W2V", "0")
         monkeypatch.setenv("DL4J_TPU_BENCH_LENET", "0")
         monkeypatch.setenv("DL4J_TPU_BENCH_ATTENTION", "0")
+        monkeypatch.setenv("DL4J_TPU_BENCH_H2D", "0")
         kinds = {c["kind"] for c in bench._configs(True)}
         assert kinds == {"resnet"}
 

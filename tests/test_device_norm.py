@@ -1,0 +1,243 @@
+"""Device-side normalization (device_affine seam).
+
+TPU-first data path: when an iterator's pre-processor is an affine map,
+fit() ships RAW features over the host->HBM link (uint8 pixels stay
+uint8 — 4x fewer bytes than float32) and normalizes on device inside a
+jit, instead of the reference's host-side float preprocessing
+(ND4J ImagePreProcessingScaler.preProcess / NormalizerStandardize).
+"""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.data.iterator import ArrayDataSetIterator
+from deeplearning4j_tpu.data.normalization import (
+    ImagePreProcessingScaler, NormalizerMinMaxScaler, NormalizerStandardize,
+    VGG16ImagePreProcessor, engage_device_affine,
+)
+
+
+def _affine_matches_transform(pp, x):
+    shift, scale = pp.device_affine()
+    np.testing.assert_allclose(pp.transform(x),
+                               x.astype(np.float32) * scale + shift,
+                               rtol=1e-5, atol=1e-5)
+
+
+class TestDeviceAffine:
+    def test_image_scaler(self):
+        x = np.random.RandomState(0).randint(
+            0, 256, (4, 8, 8, 3)).astype(np.uint8)
+        _affine_matches_transform(ImagePreProcessingScaler(), x)
+        _affine_matches_transform(ImagePreProcessingScaler(-1, 1), x)
+
+    def test_vgg16(self):
+        x = np.random.RandomState(1).randint(
+            0, 256, (2, 8, 8, 3)).astype(np.uint8)
+        _affine_matches_transform(VGG16ImagePreProcessor(), x)
+
+    def test_minmax_fitted(self):
+        rs = np.random.RandomState(2)
+        x = rs.rand(32, 5).astype(np.float32) * 7 - 3
+        pp = NormalizerMinMaxScaler(0, 1)
+        assert pp.device_affine() is None     # unfitted
+        pp.fit(DataSet(x, x[:, :1]))
+        _affine_matches_transform(pp, x)
+
+    def test_standardize_features_only(self):
+        rs = np.random.RandomState(3)
+        x = rs.randn(64, 4).astype(np.float32) * 3 + 1
+        pp = NormalizerStandardize()
+        pp.fit(DataSet(x, x[:, :1]))
+        _affine_matches_transform(pp, x)
+
+    def test_standardize_with_labels_has_no_affine(self):
+        rs = np.random.RandomState(4)
+        x = rs.randn(16, 4).astype(np.float32)
+        pp = NormalizerStandardize(fit_labels=True)
+        pp.fit(DataSet(x, x[:, :2]))
+        assert pp.device_affine() is None
+
+    def test_engage_detaches_and_walks_wrapper_chain(self):
+        from deeplearning4j_tpu.data.async_iterator import (
+            AsyncDataSetIterator)
+        x = np.zeros((8, 4), np.uint8)
+        y = np.eye(2, dtype=np.float32)[np.zeros(8, int)]
+        it = ArrayDataSetIterator(x, y, batch_size=4)
+        it.set_pre_processor(ImagePreProcessingScaler())
+        wrapped = AsyncDataSetIterator(it, device_put=False)
+        owner, pp, aff = engage_device_affine(wrapped)
+        try:
+            assert owner is it and isinstance(pp, ImagePreProcessingScaler)
+            assert aff is not None
+            # host application skipped: raw uint8 flows out
+            ds = next(iter(it))
+            assert ds.features.dtype == np.uint8
+        finally:
+            owner.pre_processor = pp
+        ds = next(iter(it))
+        assert ds.features.dtype == np.float32   # restored
+
+    def test_engage_none_for_plain_iterator(self):
+        it = ArrayDataSetIterator(np.zeros((4, 2), np.float32),
+                                  np.zeros((4, 2), np.float32),
+                                  batch_size=2)
+        assert engage_device_affine(it) == (None, None, None)
+
+
+def _make_net(seed=11):
+    from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.updaters import Adam
+    conf = (NeuralNetConfiguration.Builder().seed(seed).updater(Adam(1e-2))
+            .list()
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(6)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _uint8_data(n=48):
+    rs = np.random.RandomState(7)
+    x = rs.randint(0, 256, (n, 6)).astype(np.uint8)
+    y = np.eye(3, dtype=np.float32)[rs.randint(0, 3, n)]
+    return x, y
+
+
+class TestFitWithDeviceNorm:
+    @pytest.mark.parametrize("scan_steps", [1, 2])
+    def test_matches_host_normalization(self, monkeypatch, scan_steps):
+        x, y = _uint8_data()
+
+        def run(device_norm):
+            monkeypatch.setenv("DL4J_TPU_DEVICE_NORM", device_norm)
+            it = ArrayDataSetIterator(x, y, batch_size=12)
+            it.set_pre_processor(ImagePreProcessingScaler())
+            net = _make_net()
+            net.fit(it, epochs=2, scan_steps=scan_steps)
+            assert it.pre_processor is not None    # restored after fit
+            return net
+
+        a = run("1")
+        b = run("0")
+        import jax
+        for la, lb in zip(jax.tree_util.tree_leaves(a.params),
+                          jax.tree_util.tree_leaves(b.params)):
+            np.testing.assert_allclose(np.asarray(la), np.asarray(lb),
+                                       rtol=2e-4, atol=2e-5)
+
+    def test_graph_fit_device_norm_matches(self, monkeypatch):
+        from deeplearning4j_tpu.nn.conf import (
+            InputType, NeuralNetConfiguration)
+        from deeplearning4j_tpu.nn.conf.network import GraphBuilder
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.nn.updaters import Adam
+        x, y = _uint8_data()
+
+        def run(device_norm):
+            monkeypatch.setenv("DL4J_TPU_DEVICE_NORM", device_norm)
+            g = (GraphBuilder(NeuralNetConfiguration.Builder().seed(5)
+                              .updater(Adam(1e-2)))
+                 .add_inputs("in")
+                 .set_input_types(InputType.feed_forward(6)))
+            g.add_layer("d", DenseLayer(n_out=16, activation="relu"), "in")
+            g.add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                           loss="mcxent"), "d")
+            g.set_outputs("out")
+            net = ComputationGraph(g.build()).init()
+            it = ArrayDataSetIterator(x, y, batch_size=12)
+            it.set_pre_processor(ImagePreProcessingScaler())
+            net.fit(it, epochs=2)
+            assert it.pre_processor is not None
+            return net
+
+        import jax
+        a, b = run("1"), run("0")
+        for la, lb in zip(jax.tree_util.tree_leaves(a.params),
+                          jax.tree_util.tree_leaves(b.params)):
+            np.testing.assert_allclose(np.asarray(la), np.asarray(lb),
+                                       rtol=2e-4, atol=2e-5)
+
+    @pytest.mark.parametrize("mode", ["averaging", "sync"])
+    def test_parallel_wrapper_device_norm_matches(self, monkeypatch, mode):
+        from deeplearning4j_tpu.parallel import (
+            MeshConfig, ParallelWrapper, TrainingMode, build_mesh)
+        x, y = _uint8_data()
+        tm = (TrainingMode.AVERAGING if mode == "averaging"
+              else TrainingMode.SYNC_GRADIENTS)
+
+        def run(device_norm):
+            monkeypatch.setenv("DL4J_TPU_DEVICE_NORM", device_norm)
+            it = ArrayDataSetIterator(x, y, batch_size=24)
+            pp = ImagePreProcessingScaler()
+            it.set_pre_processor(pp)
+            net = _make_net()
+            w = ParallelWrapper(net, mesh=build_mesh(MeshConfig()),
+                                mode=tm, averaging_frequency=2)
+            w.fit(it, epochs=2)
+            assert it.pre_processor is pp       # restored after fit
+            return net
+
+        import jax
+        a, b = run("1"), run("0")
+        for la, lb in zip(jax.tree_util.tree_leaves(a.params),
+                          jax.tree_util.tree_leaves(b.params)):
+            np.testing.assert_allclose(np.asarray(la), np.asarray(lb),
+                                       rtol=2e-4, atol=2e-5)
+
+    def test_bf16_compute_normalizes_before_cast(self, monkeypatch):
+        # features ~ N(1000, 1): the standardized signal lives in the
+        # f32 bits a premature bf16 cast (ulp ~4 at 1000) would destroy.
+        # Guards the normalize-then-cast ordering: the async wrap must
+        # not host-cast RAW features when the device affine is engaged.
+        monkeypatch.setenv("DL4J_TPU_DEVICE_NORM", "1")
+        from deeplearning4j_tpu.nn.conf import (
+            InputType, NeuralNetConfiguration)
+        from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.nn.updaters import Adam
+        rs = np.random.RandomState(9)
+        x = (1000.0 + rs.randn(96, 4)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[(x.sum(1) > 4000.0).astype(int)]
+        pp = NormalizerStandardize()
+        pp.fit(DataSet(x, y))
+        conf = (NeuralNetConfiguration.Builder().seed(2)
+                .updater(Adam(5e-2)).compute_dtype("bfloat16").list()
+                .layer(DenseLayer(n_out=16, activation="relu"))
+                .layer(OutputLayer(n_out=2, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(4)).build())
+        net = MultiLayerNetwork(conf).init()
+        it = ArrayDataSetIterator(x, y, batch_size=32)
+        it.set_pre_processor(pp)
+        net.fit(it, epochs=40)
+        acc = net.evaluate(it).accuracy()
+        # with the cast-before-normalize bug the standardized features
+        # collapse to a few quantized values and this stays near chance
+        assert acc > 0.9, acc
+
+    def test_pre_processor_restored_on_error(self, monkeypatch):
+        monkeypatch.setenv("DL4J_TPU_DEVICE_NORM", "1")
+        x, y = _uint8_data(12)
+        it = ArrayDataSetIterator(x, y, batch_size=12)
+        pp = ImagePreProcessingScaler()
+        it.set_pre_processor(pp)
+        net = _make_net()
+
+        class Boom(Exception):
+            pass
+
+        class BoomListener:
+            def on_epoch_start(self, *a):
+                raise Boom()
+
+            def __getattr__(self, name):
+                return lambda *a, **k: None
+
+        net.set_listeners(BoomListener())
+        with pytest.raises(Boom):
+            net.fit(it, epochs=1)
+        assert it.pre_processor is pp
+        assert net._input_affine is None

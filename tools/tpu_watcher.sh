@@ -18,8 +18,13 @@ mkdir -p "$STATE"
 LOG="$STATE/watch.log"
 # derive stage-1 done from the repo itself: if a fully-measured sweep is
 # already banked at HEAD, never re-run stage 1 (it would overwrite the
-# artifact PERF.md's analysis quotes)
+# artifact PERF.md's analysis quotes). COMMITTED at HEAD, not just in
+# the worktree — a stranded copy left by a failed bank() must keep the
+# stage live so a later window rebanks it.
 if [ ! -f "$STATE/bench_tpu_done" ] \
+   && (cd /root/repo \
+       && git ls-files --error-unmatch -- BENCH_TPU_MEASURED_r05.json >/dev/null 2>&1 \
+       && git diff --quiet HEAD -- BENCH_TPU_MEASURED_r05.json) \
    && grep -q '"tpu_unavailable": false' /root/repo/BENCH_TPU_MEASURED_r05.json 2>/dev/null \
    && grep -q '"value": [0-9]' /root/repo/BENCH_TPU_MEASURED_r05.json 2>/dev/null; then
   touch "$STATE/bench_tpu_done"

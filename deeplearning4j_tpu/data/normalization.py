@@ -15,7 +15,9 @@ the reference's preprocessors do; revert/revert_features undo it.
 """
 from __future__ import annotations
 
+import contextlib
 import json
+import os
 from typing import Optional
 
 import numpy as np
@@ -84,6 +86,50 @@ def engage_device_affine(iterator):
             return it, pp, aff
         it = getattr(it, "_source", None)
     return None, None, None
+
+
+@contextlib.contextmanager
+def engaged_device_affine(iterator, listeners=()):
+    """THE device-norm engagement seam, shared by MultiLayerNetwork.fit,
+    ComputationGraph.fit and ParallelWrapper.fit: yields `(shift, scale)`
+    when device-side normalization is engaged for the `with` body, else
+    None. Single-sources every invariant:
+
+    - env gate: DL4J_TPU_DEVICE_NORM=0 disables;
+    - listener gate: a `reads_model` listener (Evaluative/Checkpoint/...)
+      may evaluate THROUGH the same iterator mid-fit — with the
+      pre-processor detached it would see raw features, so engagement is
+      skipped entirely for such fits;
+    - detach the pre-processor (host application off) + restore in
+      finally, even on error;
+    - pause the 16-bit FEATURE host cast on any AsyncDataSetIterator
+      already in the chain (a user-constructed wrap with cast_dtype set
+      would otherwise bf16-quantize RAW features before the device
+      affine — the cast-before-normalize bug) + restore in finally."""
+    if os.environ.get("DL4J_TPU_DEVICE_NORM", "1") != "1" \
+            or any(getattr(lst, "reads_model", False) for lst in listeners):
+        yield None
+        return
+    owner, pp, aff = engage_device_affine(iterator)
+    if aff is None:
+        yield None
+        return
+    paused = []
+    seen = set()
+    it = iterator
+    while it is not None and id(it) not in seen:
+        seen.add(id(it))
+        if getattr(it, "_cast_dtype", None) is not None \
+                and getattr(it, "_cast_features", False):
+            it._cast_features = False
+            paused.append(it)
+        it = getattr(it, "_source", None)
+    try:
+        yield aff
+    finally:
+        owner.pre_processor = pp
+        for a in paused:
+            a._cast_features = True
 
 
 class _Welford:

@@ -367,43 +367,42 @@ class ComputationGraph:
         scan_steps > 1 fuses that many optimizer steps into one jit via
         lax.scan with a one-chunk-deferred loss fetch (input-pipelined fit;
         see MultiLayerNetwork.fit) — bit-identical math/RNG to the per-call
-        path. Default from $DL4J_TPU_SCAN_STEPS or 1."""
+        path. Default: 10 on TPU, 1 on CPU (measured, PERF.md);
+        $DL4J_TPU_SCAN_STEPS overrides."""
         if self.params is None:
             self.init()
         if self._train_step is None:
             self._train_step = self._make_train_step()
         if scan_steps is None:
-            scan_steps = int(os.environ.get("DL4J_TPU_SCAN_STEPS", "1"))
+            from deeplearning4j_tpu.nn.multilayer import _default_scan_steps
+            scan_steps = _default_scan_steps()
         rng = jax.random.PRNGKey(self.conf.seed + 331 * (self.epoch_count + 1))
         tbptt = self.conf.backprop_type == "tbptt"
-        # device-side normalization (see MultiLayerNetwork.fit): an
-        # affine pre-processor is detached for the fit and applied on
-        # device, so raw (uint8) features ship over the link
-        aff_owner = aff_pp = None
-        if os.environ.get("DL4J_TPU_DEVICE_NORM", "1") == "1":
-            from deeplearning4j_tpu.data.normalization import (
-                engage_device_affine)
-            aff_owner, aff_pp, aff = engage_device_affine(data)
+        # device-side normalization (data/normalization.py
+        # engaged_device_affine; see MultiLayerNetwork.fit): the affine
+        # pre-processor is applied on device, raw (uint8) features ship
+        # over the link
+        from deeplearning4j_tpu.data.normalization import (
+            engaged_device_affine)
+        with engaged_device_affine(data, self.listeners) as aff:
             if aff is not None:
                 self._input_affine = (jnp.asarray(aff[0]),
                                       jnp.asarray(aff[1]))
-        try:
-            for _ in range(epochs):
-                for lst in self.listeners:
-                    lst.on_epoch_start(self, self.epoch_count)
-                if not tbptt and scan_steps > 1:
-                    rng = self._fit_epoch_scan(data, rng, scan_steps)
-                else:
-                    rng = self._fit_epoch_per_call(data, rng, tbptt)
-                for lst in self.listeners:
-                    lst.on_epoch_end(self, self.epoch_count)
-                self.epoch_count += 1
-                if hasattr(data, "reset"):
-                    data.reset()
-        finally:
-            if aff_owner is not None:
-                aff_owner.pre_processor = aff_pp
-            self._input_affine = None
+            try:
+                for _ in range(epochs):
+                    for lst in self.listeners:
+                        lst.on_epoch_start(self, self.epoch_count)
+                    if not tbptt and scan_steps > 1:
+                        rng = self._fit_epoch_scan(data, rng, scan_steps)
+                    else:
+                        rng = self._fit_epoch_per_call(data, rng, tbptt)
+                    for lst in self.listeners:
+                        lst.on_epoch_end(self, self.epoch_count)
+                    self.epoch_count += 1
+                    if hasattr(data, "reset"):
+                        data.reset()
+            finally:
+                self._input_affine = None
         return self
 
     def _mds_stream(self, data):

@@ -153,6 +153,23 @@ def _layer_call(layer, *, seq, train, remat, params, x, state=None,
     return fn(*args)
 
 
+def _default_scan_steps() -> int:
+    """Production fit() pipelining default, decided from the round-5
+    hardware measurement (PERF.md): on the TPU v5e the scan-of-10 fused
+    step measured +6.5% over per-call (2377 vs 2231 imgs/s, ResNet-50
+    bf16 batch 128) and removes all per-step dispatch; on CPU XLA
+    pessimizes convolutions inside scan (10.9x slower, PERF.md
+    "mechanism check"), so per-call stays the CPU default.
+    DL4J_TPU_SCAN_STEPS overrides either way."""
+    env = os.environ.get("DL4J_TPU_SCAN_STEPS")
+    if env:
+        return int(env)
+    try:
+        return 1 if jax.default_backend() == "cpu" else 10
+    except Exception:
+        return 1
+
+
 def _stage_with_affine(net, a):
     """Features -> device, shared by MultiLayerNetwork._stage_x and
     ComputationGraph._stage_x. With a device affine engaged (fit through
@@ -496,7 +513,8 @@ class MultiLayerNetwork:
         deferred one chunk, so the dispatch pipeline never blocks on a
         device→host sync. The RNG stream, update math and listener calls are
         identical to the per-call path (bit-for-bit, tested) — only the
-        host/device overlap changes. Default from $DL4J_TPU_SCAN_STEPS or 1.
+        host/device overlap changes. Default: 10 on TPU (measured +6.5%
+        over per-call, PERF.md), 1 on CPU; $DL4J_TPU_SCAN_STEPS overrides.
 
         Intended for dispatch-bound TPU loops. Caveat (PERF.md "mechanism
         check"): XLA:CPU pessimizes convolutions inside scan, so conv nets
@@ -511,55 +529,56 @@ class MultiLayerNetwork:
         if self.params is None:
             self.init()
         if scan_steps is None:
-            scan_steps = int(os.environ.get("DL4J_TPU_SCAN_STEPS", "1"))
+            scan_steps = _default_scan_steps()
         iterator = self._as_iterator(data, batch_size)
         if prefetch is None:
             prefetch = os.environ.get("DL4J_TPU_FIT_PREFETCH", "1") == "1"
-        # device-side normalization (kill switch DL4J_TPU_DEVICE_NORM=0):
-        # an affine-representable pre-processor is detached from the
-        # iterator for the duration of the fit and applied on device
-        # instead (_stage_x) — raw uint8 pixels ship over the link.
-        # Engaged BEFORE the async wrap so the wrap can skip the 16-bit
-        # host cast: casting RAW features to bf16 before normalization
-        # would quantize away the signal (x=1000.3 standardized to 0.3
-        # needs the f32 bits); normalize-then-cast keeps the host-norm
-        # numerics, uint8 features never cast host-side either way
-        aff_owner = aff_pp = None
-        if os.environ.get("DL4J_TPU_DEVICE_NORM", "1") == "1":
-            from deeplearning4j_tpu.data.normalization import (
-                engage_device_affine)
-            aff_owner, aff_pp, aff = engage_device_affine(iterator)
+        # device-side normalization (data/normalization.py
+        # engaged_device_affine — env gate, listener gate, detach/restore,
+        # feature-cast pause): an affine-representable pre-processor is
+        # applied on device instead of host (_stage_x), so raw uint8
+        # pixels ship over the link. Engaged BEFORE the async wrap so
+        # the wrap skips the 16-bit FEATURE host cast — normalize-then-
+        # cast preserves the f32 signal a premature bf16 cast would
+        # quantize away (labels still ship 16-bit).
+        from deeplearning4j_tpu.data.normalization import (
+            engaged_device_affine)
+        with engaged_device_affine(iterator, self.listeners) as aff:
             if aff is not None:
                 self._input_affine = (jnp.asarray(aff[0]),
                                       jnp.asarray(aff[1]))
-        if prefetch and not isinstance(iterator, AsyncDataSetIterator) \
-                and getattr(iterator, "async_supported", True):
-            # scan-fit stacks K host batches before ONE transfer, so the
-            # worker must not device_put per batch there (a device array
-            # would round-trip back through the host for the stack)
-            iterator = AsyncDataSetIterator(
-                iterator, device_put=(scan_steps <= 1),
-                cast_dtype=self._compute_dtype
-                if np.dtype(self._compute_dtype).itemsize == 2 else None,
-                cast_features=self._input_affine is None)
-        try:
-            for _ in range(epochs):
-                for lst in self.listeners:
-                    lst.on_epoch_start(self, self.epoch_count)
-                if self.conf.backprop_type == "tbptt":
-                    self._fit_epoch_tbptt(iterator)
-                elif scan_steps > 1:
-                    self._fit_epoch_scan(iterator, scan_steps)
-                else:
-                    self._fit_epoch(iterator)
-                for lst in self.listeners:
-                    lst.on_epoch_end(self, self.epoch_count)
-                self.epoch_count += 1
-                iterator.reset()
-        finally:
-            if aff_owner is not None:
-                aff_owner.pre_processor = aff_pp
-            self._input_affine = None
+            # the scan path falls back to per-call under model-reading
+            # listeners — the wrap's device_put choice must match the
+            # path that will actually run
+            eff_scan = 1 if _scan_incompatible_listeners(self.listeners) \
+                else scan_steps
+            if prefetch and not isinstance(iterator, AsyncDataSetIterator) \
+                    and getattr(iterator, "async_supported", True):
+                # scan-fit stacks K host batches before ONE transfer, so
+                # the worker must not device_put per batch there (a device
+                # array would round-trip back through the host)
+                iterator = AsyncDataSetIterator(
+                    iterator, device_put=(eff_scan <= 1),
+                    cast_dtype=self._compute_dtype
+                    if np.dtype(self._compute_dtype).itemsize == 2
+                    else None,
+                    cast_features=self._input_affine is None)
+            try:
+                for _ in range(epochs):
+                    for lst in self.listeners:
+                        lst.on_epoch_start(self, self.epoch_count)
+                    if self.conf.backprop_type == "tbptt":
+                        self._fit_epoch_tbptt(iterator)
+                    elif scan_steps > 1:
+                        self._fit_epoch_scan(iterator, scan_steps)
+                    else:
+                        self._fit_epoch(iterator)
+                    for lst in self.listeners:
+                        lst.on_epoch_end(self, self.epoch_count)
+                    self.epoch_count += 1
+                    iterator.reset()
+            finally:
+                self._input_affine = None
         return self
 
     def fit_pretrain(self, data, epochs: int = 1, batch_size: int = 32):

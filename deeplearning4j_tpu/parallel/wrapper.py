@@ -34,7 +34,6 @@ from __future__ import annotations
 
 import enum
 import logging
-import os
 import time
 from typing import Optional
 
@@ -108,7 +107,8 @@ class ParallelWrapper:
         self._avg_fn = None
         self._stacked = None      # (params, opt_state, state) in AVERAGING mode
         self._local_steps = 0
-        self._input_affine = None  # jitted device-norm fn during fit
+        self._input_affine = None  # (shift, scale) during device-norm fit
+        self._affine_fn = None     # cached jitted affine (shared rule)
         self._warned_ragged = False
 
     # ------------------------------------------------------------- plumbing
@@ -247,30 +247,27 @@ class ParallelWrapper:
         else:
             source = self.model._as_iterator(data, batch_size) \
                 if not isinstance(data, DataSetIterator) else data
-        # device-side normalization (see MultiLayerNetwork.fit): raw
-        # (uint8) features ship to HBM sharded, the affine runs on
-        # device per shard — the per-replica H2D feed is the scaling
-        # bottleneck the reference's workspaces attack host-side
-        aff_owner = aff_pp = None
-        if os.environ.get("DL4J_TPU_DEVICE_NORM", "1") == "1":
-            from deeplearning4j_tpu.data.normalization import (
-                engage_device_affine)
-            aff_owner, aff_pp, aff = engage_device_affine(source)
+        # device-side normalization (data/normalization.py
+        # engaged_device_affine; see MultiLayerNetwork.fit): raw (uint8)
+        # features ship to HBM sharded, the affine runs on device per
+        # shard — the per-replica H2D feed is the scaling bottleneck the
+        # reference's workspaces attack host-side
+        from deeplearning4j_tpu.data.normalization import (
+            engaged_device_affine, make_affine_fn)
+        with engaged_device_affine(source, self.model.listeners) as aff:
             if aff is not None:
-                from deeplearning4j_tpu.data.normalization import (
-                    make_affine_fn)
-                fn = make_affine_fn(self.model._compute_dtype)
-                shift, scale = jnp.asarray(aff[0]), jnp.asarray(aff[1])
-                self._input_affine = lambda x: fn(x, shift, scale)
-        try:
-            if self.mode == TrainingMode.AVERAGING:
-                self._fit_averaging(source, epochs)
-            else:
-                self._fit_sync(source, epochs)
-        finally:
-            if aff_owner is not None:
-                aff_owner.pre_processor = aff_pp
-            self._input_affine = None
+                if self._affine_fn is None:    # cached across fit() calls
+                    self._affine_fn = make_affine_fn(
+                        self.model._compute_dtype)
+                self._input_affine = (jnp.asarray(aff[0]),
+                                      jnp.asarray(aff[1]))
+            try:
+                if self.mode == TrainingMode.AVERAGING:
+                    self._fit_averaging(source, epochs)
+                else:
+                    self._fit_sync(source, epochs)
+            finally:
+                self._input_affine = None
         return self.model
 
     def _batches(self, source):
@@ -522,7 +519,9 @@ class ParallelWrapper:
             a = put(a)
             # device-norm affine on the already-sharded features (jit
             # propagates the sharding; elementwise, no resharding)
-            return a if self._input_affine is None else self._input_affine(a)
+            if self._input_affine is None:
+                return a
+            return self._affine_fn(a, *self._input_affine)
 
         return (self._map_entry(x, put_x), self._map_entry(y, put),
                 self._map_entry(fm, put), self._map_entry(lm, put))
@@ -542,7 +541,9 @@ class ParallelWrapper:
 
         def split_x(a):
             a = split(a)
-            return a if self._input_affine is None else self._input_affine(a)
+            if self._input_affine is None:
+                return a
+            return self._affine_fn(a, *self._input_affine)
 
         return (self._map_entry(x, split_x), self._map_entry(y, split),
                 self._map_entry(fm, split), self._map_entry(lm, split))

@@ -84,6 +84,48 @@ class TestDeviceAffine:
                                   batch_size=2)
         assert engage_device_affine(it) == (None, None, None)
 
+    def test_context_skips_for_model_reading_listener(self):
+        # an EvaluativeListener-style listener evaluates THROUGH the same
+        # iterator mid-fit: with the pre-processor detached it would see
+        # raw features, so engagement must be skipped entirely
+        from deeplearning4j_tpu.data.normalization import (
+            engaged_device_affine)
+        it = ArrayDataSetIterator(np.zeros((4, 2), np.uint8),
+                                  np.zeros((4, 2), np.float32),
+                                  batch_size=2)
+        pp = ImagePreProcessingScaler()
+        it.set_pre_processor(pp)
+
+        class Reader:
+            reads_model = True
+
+        with engaged_device_affine(it, [Reader()]) as aff:
+            assert aff is None
+            assert it.pre_processor is pp      # never detached
+
+    def test_context_pauses_user_async_feature_cast(self):
+        # a user-constructed AsyncDataSetIterator(cast_dtype=bf16) would
+        # bf16-quantize RAW features before the device affine; the
+        # engagement pauses its feature cast and restores it after
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.data.async_iterator import (
+            AsyncDataSetIterator)
+        from deeplearning4j_tpu.data.normalization import (
+            engaged_device_affine)
+        inner = ArrayDataSetIterator(np.zeros((4, 2), np.float32),
+                                     np.zeros((4, 2), np.float32),
+                                     batch_size=2)
+        inner.set_pre_processor(ImagePreProcessingScaler())
+        wrapped = AsyncDataSetIterator(inner, device_put=False,
+                                       cast_dtype=jnp.bfloat16)
+        assert wrapped._cast_features
+        with engaged_device_affine(wrapped) as aff:
+            assert aff is not None
+            assert wrapped._cast_features is False
+            assert inner.pre_processor is None
+        assert wrapped._cast_features is True
+        assert inner.pre_processor is not None
+
 
 def _make_net(seed=11):
     from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration

@@ -368,3 +368,23 @@ class TestScanFit:
         # per-call fallback: checkpoints reflect the exact iteration params
         assert len(ckpt._saved) >= 1
         assert net.iteration_count == 3   # 126 samples, drop_last batching
+
+
+class TestScanStepsDefault:
+    def test_cpu_default_is_per_call(self, monkeypatch):
+        from deeplearning4j_tpu.nn.multilayer import _default_scan_steps
+        monkeypatch.delenv("DL4J_TPU_SCAN_STEPS", raising=False)
+        # conftest pins the cpu backend; per-call is the measured CPU
+        # winner (PERF.md: conv-in-scan 10.9x slower on XLA:CPU)
+        assert _default_scan_steps() == 1
+
+    def test_env_override_wins(self, monkeypatch):
+        from deeplearning4j_tpu.nn.multilayer import _default_scan_steps
+        monkeypatch.setenv("DL4J_TPU_SCAN_STEPS", "7")
+        assert _default_scan_steps() == 7
+
+    def test_tpu_default_is_scan10(self, monkeypatch):
+        import deeplearning4j_tpu.nn.multilayer as ml
+        monkeypatch.delenv("DL4J_TPU_SCAN_STEPS", raising=False)
+        monkeypatch.setattr(ml.jax, "default_backend", lambda: "tpu")
+        assert ml._default_scan_steps() == 10

@@ -29,7 +29,7 @@ from deeplearning4j_tpu.nn.conf.base import (
 from deeplearning4j_tpu.nn.conf.graph_vertices import GraphVertexConf
 from deeplearning4j_tpu.nn.conf.network import ComputationGraphConfiguration
 from deeplearning4j_tpu.nn.multilayer import (
-    _as_jnp, _required_kind, _run_scan_pipeline,
+    _as_jnp, _default_scan_steps, _required_kind, _run_scan_pipeline,
     _scan_incompatible_listeners,
 )
 from deeplearning4j_tpu.nn.updaters import NoOp, build_optimizer
@@ -374,7 +374,6 @@ class ComputationGraph:
         if self._train_step is None:
             self._train_step = self._make_train_step()
         if scan_steps is None:
-            from deeplearning4j_tpu.nn.multilayer import _default_scan_steps
             scan_steps = _default_scan_steps()
         rng = jax.random.PRNGKey(self.conf.seed + 331 * (self.epoch_count + 1))
         tbptt = self.conf.backprop_type == "tbptt"

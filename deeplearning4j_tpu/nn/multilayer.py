@@ -165,7 +165,10 @@ def _default_scan_steps() -> int:
     if env:
         return int(env)
     try:
-        return 1 if jax.default_backend() == "cpu" else 10
+        # TPU only ("axon" is the tunneled-TPU PJRT platform name) —
+        # GPU/other backends are unmeasured, and the CPU mechanism check
+        # shows conv-in-scan can regress badly off-TPU
+        return 10 if jax.default_backend() in ("tpu", "axon") else 1
     except Exception:
         return 1
 
@@ -548,9 +551,10 @@ class MultiLayerNetwork:
                 self._input_affine = (jnp.asarray(aff[0]),
                                       jnp.asarray(aff[1]))
             # the scan path falls back to per-call under model-reading
-            # listeners — the wrap's device_put choice must match the
-            # path that will actually run
-            eff_scan = 1 if _scan_incompatible_listeners(self.listeners) \
+            # listeners, and tbptt never scans — the wrap's device_put
+            # choice must match the path that will actually run
+            eff_scan = 1 if (self.conf.backprop_type == "tbptt"
+                             or _scan_incompatible_listeners(self.listeners)) \
                 else scan_steps
             if prefetch and not isinstance(iterator, AsyncDataSetIterator) \
                     and getattr(iterator, "async_supported", True):

@@ -92,3 +92,7 @@ def test_dl4j_artifact_migration(tmp_path):
 
 def test_zero_fsdp_training():
     assert _load("16_zero_fsdp_training.py").main(epochs=8) > 0.9
+
+
+def test_device_norm_image_pipeline():
+    assert _load("17_device_norm_image_pipeline.py").main(epochs=10) > 0.9

@@ -26,6 +26,7 @@ from deeplearning4j_tpu.nn.conf.base import (
     InputType, Kind, LayerConf, register_layer,
 )
 from deeplearning4j_tpu.nn.initializers import get_initializer
+from deeplearning4j_tpu.util.platform import is_tpu_backend
 
 # --- context-parallel mode -------------------------------------------------
 # When the sequence axis is sharded over the mesh (ContextParallelTrainer,
@@ -236,7 +237,7 @@ class MultiHeadAttention(LayerConf):
         import os
         use_flash = (self.attention_impl in ("flash", "blockwise")
                      and drop == 0.0
-                     and jax.default_backend() == "tpu"
+                     and is_tpu_backend()
                      and os.environ.get("DL4J_TPU_FLASH", "1") != "0")
         if _CONTEXT_PARALLEL_AXIS is not None:
             if use_flash:

@@ -40,6 +40,7 @@ from deeplearning4j_tpu.nn.conf.base import (
 from deeplearning4j_tpu.nn.conf.network import MultiLayerConfiguration
 from deeplearning4j_tpu.nn.updaters import build_optimizer, NoOp
 from deeplearning4j_tpu.util import params as param_util
+from deeplearning4j_tpu.util.platform import is_tpu_backend
 
 log = logging.getLogger("deeplearning4j_tpu")
 
@@ -164,13 +165,9 @@ def _default_scan_steps() -> int:
     env = os.environ.get("DL4J_TPU_SCAN_STEPS")
     if env:
         return int(env)
-    try:
-        # TPU only ("axon" is the tunneled-TPU PJRT platform name) —
-        # GPU/other backends are unmeasured, and the CPU mechanism check
-        # shows conv-in-scan can regress badly off-TPU
-        return 10 if jax.default_backend() in ("tpu", "axon") else 1
-    except Exception:
-        return 1
+    # TPU only — GPU/other backends are unmeasured, and the CPU
+    # mechanism check shows conv-in-scan can regress badly off-TPU
+    return 10 if is_tpu_backend() else 1
 
 
 def _stage_with_affine(net, a):

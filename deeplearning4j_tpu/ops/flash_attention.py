@@ -31,6 +31,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from deeplearning4j_tpu.util.platform import is_tpu_backend
+
 NEG = -1e30
 
 
@@ -356,7 +358,7 @@ def flash_attention(q, k, v, *, mask=None, causal: bool = False,
     b, tq, h, d = q.shape
     tk = k.shape[1]
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        interpret = not is_tpu_backend()
     # block sizes: DL4J_TPU_FLASH_BLOCK_Q/K take PRECEDENCE over caller
     # arguments — they are the first-contact VMEM/tiling recovery knobs
     # (PERF.md) and must work even for layers that pass explicit sizes

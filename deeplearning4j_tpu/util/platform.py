@@ -1,0 +1,27 @@
+"""Backend/platform detection helpers.
+
+One predicate for "are we on a real TPU", shared by every fused-kernel
+eligibility check. The subtlety: through a PJRT plugin tunnel the
+platform name is the PLUGIN's (e.g. "axon"), not "tpu" — a bare
+`jax.default_backend() == "tpu"` silently disables the Pallas kernels
+on exactly the hardware they exist for. The device_kind still names the
+chip ("TPU v5 lite"), so fall back to that.
+"""
+from __future__ import annotations
+
+import jax
+
+
+def is_tpu_backend() -> bool:
+    """True when the default JAX backend is a real TPU, including
+    tunneled PJRT plugins whose platform name differs but whose
+    device_kind names the TPU generation."""
+    try:
+        if jax.default_backend() == "tpu":
+            return True
+        d = jax.devices()[0]
+        if d.platform == "cpu":
+            return False
+        return "tpu" in (getattr(d, "device_kind", "") or "").lower()
+    except Exception:
+        return False

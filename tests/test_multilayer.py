@@ -388,3 +388,23 @@ class TestScanStepsDefault:
         monkeypatch.delenv("DL4J_TPU_SCAN_STEPS", raising=False)
         monkeypatch.setattr(ml.jax, "default_backend", lambda: "tpu")
         assert ml._default_scan_steps() == 10
+
+    def test_axon_tunnel_counts_as_tpu(self, monkeypatch):
+        # the tunneled chip registers platform "axon" with device_kind
+        # "TPU v5 lite" — flash/scan gating must recognize it as TPU
+        import deeplearning4j_tpu.util.platform as plat
+
+        class _Dev:
+            platform = "axon"
+            device_kind = "TPU v5 lite"
+
+        monkeypatch.setattr(plat.jax, "default_backend", lambda: "axon")
+        monkeypatch.setattr(plat.jax, "devices", lambda: [_Dev()])
+        assert plat.is_tpu_backend() is True
+        import deeplearning4j_tpu.nn.multilayer as ml
+        monkeypatch.delenv("DL4J_TPU_SCAN_STEPS", raising=False)
+        assert ml._default_scan_steps() == 10
+
+    def test_cpu_is_not_tpu(self):
+        import deeplearning4j_tpu.util.platform as plat
+        assert plat.is_tpu_backend() is False   # conftest pins cpu

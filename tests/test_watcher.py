@@ -1,0 +1,149 @@
+"""Unit tests for tools/tpu_watcher.sh's banking/derive logic.
+
+Two earlier sessions lost measurement artifacts to exactly these
+functions (a bank racing a concurrent commit; a /tmp wipe re-running a
+banked stage and overwriting the analyzed artifact) — the script is
+ops-critical, so its pure functions are tested hermetically against a
+throwaway git repo via `source` + DL4J_TPU_WATCHER_REPO.
+"""
+import json
+import os
+import subprocess
+from pathlib import Path
+
+import pytest
+
+SCRIPT = str(Path(__file__).resolve().parent.parent / "tools" /
+             "tpu_watcher.sh")
+
+
+def _sh(repo, body):
+    """Source the watcher in `repo` then run `body` in the same shell."""
+    return subprocess.run(
+        ["bash", "-c", f'source "{SCRIPT}" && {body}'],
+        env={**os.environ, "DL4J_TPU_WATCHER_REPO": str(repo)},
+        capture_output=True, text=True, timeout=120)
+
+
+@pytest.fixture()
+def repo(tmp_path):
+    r = tmp_path / "repo"
+    r.mkdir()
+    for cmd in (["git", "init", "-q"],
+                ["git", "config", "user.email", "t@t"],
+                ["git", "config", "user.name", "t"],
+                ["git", "commit", "-q", "--allow-empty", "-m", "root"]):
+        subprocess.run(cmd, cwd=r, check=True)
+    return r
+
+
+def _head_paths(repo):
+    out = subprocess.run(["git", "show", "--name-only", "--format=",
+                          "HEAD"], cwd=repo, capture_output=True,
+                         text=True, check=True)
+    return out.stdout.split()
+
+
+class TestBank:
+    def test_commits_only_the_artifact(self, repo, tmp_path):
+        (repo / "unrelated.txt").write_text("staged by someone else")
+        subprocess.run(["git", "add", "unrelated.txt"], cwd=repo,
+                       check=True)
+        src = tmp_path / "result.json"
+        src.write_text('{"value": 1}')
+        r = _sh(repo, f'bank "{src}" ART.json "bank it"')
+        assert r.returncode == 0, r.stderr
+        assert _head_paths(repo) == ["ART.json"]
+        # the concurrent session's staged file is still staged, uncommitted
+        st = subprocess.run(["git", "status", "--porcelain"], cwd=repo,
+                            capture_output=True, text=True).stdout
+        assert "A  unrelated.txt" in st
+
+    def test_idempotent_when_content_at_head(self, repo, tmp_path):
+        src = tmp_path / "result.json"
+        src.write_text('{"value": 2}')
+        assert _sh(repo, f'bank "{src}" ART.json "first"').returncode == 0
+        n1 = subprocess.run(["git", "rev-list", "--count", "HEAD"],
+                            cwd=repo, capture_output=True,
+                            text=True).stdout.strip()
+        assert _sh(repo, f'bank "{src}" ART.json "second"').returncode == 0
+        n2 = subprocess.run(["git", "rev-list", "--count", "HEAD"],
+                            cwd=repo, capture_output=True,
+                            text=True).stdout.strip()
+        assert n1 == n2              # no new commit for identical content
+
+
+class TestMeasuredRow:
+    def _sweep(self, tmp_path, rows):
+        p = tmp_path / "sweep.json"
+        p.write_text(json.dumps({"sweep": rows}))
+        return p
+
+    def test_measured_row_true_for_on_tpu_result(self, repo, tmp_path):
+        p = self._sweep(tmp_path, [
+            {"mode": "char-lstm", "on_tpu": True, "chars_sec": 1e6}])
+        assert _sh(repo, f'measured_row "{p}" char-lstm').returncode == 0
+
+    def test_error_and_skipped_rows_do_not_count(self, repo, tmp_path):
+        p = self._sweep(tmp_path, [
+            {"kind": "char-lstm", "on_tpu": True, "error": "rc=1"},
+            {"kind": "char-lstm", "skipped": "tunnel wedged"},
+            {"mode": "char-lstm", "on_tpu": False, "chars_sec": 5.0}])
+        assert _sh(repo, f'measured_row "{p}" char-lstm').returncode != 0
+
+
+class TestStageOneDerive:
+    ART = "BENCH_TPU_MEASURED_r05.json"
+    GOOD = json.dumps({"value": 123.0, "tpu_unavailable": False})
+
+    def test_committed_artifact_marks_done(self, repo):
+        (repo / self.ART).write_text(self.GOOD)
+        subprocess.run(["git", "add", self.ART], cwd=repo, check=True)
+        subprocess.run(["git", "commit", "-q", "-m", "bank"], cwd=repo,
+                       check=True)
+        assert _sh(repo, "true").returncode == 0
+        assert (repo / ".watcher" / "bench_tpu_done").exists()
+
+    def test_uncommitted_stranded_copy_keeps_stage_live(self, repo):
+        (repo / self.ART).write_text(self.GOOD)   # stranded, not committed
+        assert _sh(repo, "true").returncode == 0
+        assert not (repo / ".watcher" / "bench_tpu_done").exists()
+
+    def test_cpu_fallback_artifact_keeps_stage_live(self, repo):
+        (repo / self.ART).write_text(
+            json.dumps({"value": 2.8, "tpu_unavailable": True}))
+        subprocess.run(["git", "add", self.ART], cwd=repo, check=True)
+        subprocess.run(["git", "commit", "-q", "-m", "cpu"], cwd=repo,
+                       check=True)
+        assert _sh(repo, "true").returncode == 0
+        assert not (repo / ".watcher" / "bench_tpu_done").exists()
+
+
+class TestBankWindowed:
+    def test_dedupes_identical_payload_and_seeds_from_repo(self, repo,
+                                                           tmp_path):
+        src = tmp_path / "rows.jsonl"
+        src.write_text('{"on_tpu": true, "x": 1}\n')
+        acc = tmp_path / "acc.jsonl"
+        body = f'bank_windowed "{src}" "{acc}" WIN.jsonl "w1"'
+        assert _sh(repo, body).returncode == 0
+        n1 = subprocess.run(["git", "rev-list", "--count", "HEAD"],
+                            cwd=repo, capture_output=True,
+                            text=True).stdout.strip()
+        # identical payload again: no append, no new commit
+        assert _sh(repo, body).returncode == 0
+        n2 = subprocess.run(["git", "rev-list", "--count", "HEAD"],
+                            cwd=repo, capture_output=True,
+                            text=True).stdout.strip()
+        assert n1 == n2
+        banked = (repo / "WIN.jsonl").read_text()
+        assert banked.count('"x": 1') == 1
+        # fresh shell with an EMPTY accumulator (simulated /tmp wipe) and a
+        # NEW payload: seeds from the repo copy so the old row survives
+        src.write_text('{"on_tpu": true, "x": 2}\n')
+        acc2 = tmp_path / "acc2.jsonl"
+        assert _sh(repo,
+                   f'bank_windowed "{src}" "{acc2}" WIN.jsonl "w2"'
+                   ).returncode == 0
+        banked = (repo / "WIN.jsonl").read_text()
+        assert '"x": 1' in banked and '"x": 2' in banked

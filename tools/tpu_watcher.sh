@@ -2,7 +2,7 @@
 # Background TPU watcher: probe the axon tunnel every ~3 min; on every
 # healthy answer, run the next queued hardware job (bench sweep first,
 # then the Pallas flash first-contact smoke, then reruns) so no healthy
-# hardware minute is wasted. Log: /root/repo/.watcher/watch.log.
+# hardware minute is wasted. Log: $REPO/.watcher/watch.log.
 #
 # The bench itself (bench.py, round-5 architecture) is wedge-tolerant:
 # each config runs in a subprocess with a watchdog, results stream to
@@ -13,7 +13,9 @@ PROBE='import jax,sys; ds=jax.devices(); sys.exit(0 if ds and ds[0].platform!="c
 # REPO-LOCAL state dir (gitignored): /tmp is wiped between builder
 # sessions, and losing the flags made a fresh session re-run stages whose
 # results were already banked at HEAD (overwriting analyzed artifacts).
-STATE=/root/repo/.watcher
+# REPO override is for the unit tests (tests/test_watcher.py)
+REPO="${DL4J_TPU_WATCHER_REPO:-/root/repo}"
+STATE="$REPO/.watcher"
 mkdir -p "$STATE"
 LOG="$STATE/watch.log"
 # derive stage-1 done from the repo itself: if a fully-measured sweep is
@@ -22,11 +24,11 @@ LOG="$STATE/watch.log"
 # the worktree — a stranded copy left by a failed bank() must keep the
 # stage live so a later window rebanks it.
 if [ ! -f "$STATE/bench_tpu_done" ] \
-   && (cd /root/repo \
+   && (cd "$REPO" \
        && git ls-files --error-unmatch -- BENCH_TPU_MEASURED_r05.json >/dev/null 2>&1 \
        && git diff --quiet HEAD -- BENCH_TPU_MEASURED_r05.json) \
-   && grep -q '"tpu_unavailable": false' /root/repo/BENCH_TPU_MEASURED_r05.json 2>/dev/null \
-   && grep -q '"value": [0-9]' /root/repo/BENCH_TPU_MEASURED_r05.json 2>/dev/null; then
+   && grep -q '"tpu_unavailable": false' "$REPO/BENCH_TPU_MEASURED_r05.json" 2>/dev/null \
+   && grep -q '"value": [0-9]' "$REPO/BENCH_TPU_MEASURED_r05.json" 2>/dev/null; then
   touch "$STATE/bench_tpu_done"
   echo "stage-1 done derived from banked BENCH_TPU_MEASURED_r05.json $(date -u +%FT%TZ)" >> "$LOG"
 fi
@@ -43,17 +45,17 @@ export DL4J_TPU_BENCH_PARTIAL="${DL4J_TPU_BENCH_PARTIAL:-/tmp/bench_partial.json
 # neither swept into this commit nor lost. Idempotent: identical content
 # already at HEAD counts as banked (no retry burn, no false alarm).
 bank() {
-  if ! cp "$1" "/root/repo/$2"; then
+  if ! cp "$1" "$REPO/$2"; then
     echo "bank FAILED for $2: cp $1 failed $(date -u +%FT%TZ)" >> "$LOG"
     return 1
   fi
-  if (cd /root/repo && git ls-files --error-unmatch -- "$2" >/dev/null 2>&1 \
+  if (cd "$REPO" && git ls-files --error-unmatch -- "$2" >/dev/null 2>&1 \
       && git diff --quiet HEAD -- "$2"); then
     echo "bank: $2 already at HEAD $(date -u +%FT%TZ)" >> "$LOG"
     return 0
   fi
   for i in 1 2 3 4 5; do
-    if (cd /root/repo && git add -- "$2" \
+    if (cd "$REPO" && git add -- "$2" \
         && git commit -q -m "$3" \
             -m "No-Verification-Needed: measurement artifact, no code change" \
             -- "$2"); then
@@ -64,7 +66,7 @@ bank() {
   done
   # unstage so a concurrent session's plain `git commit` can't sweep the
   # artifact into an unrelated commit
-  (cd /root/repo && git reset -q -- "$2") || true
+  (cd "$REPO" && git reset -q -- "$2") || true
   echo "bank FAILED for $2 (index lock?) $(date -u +%FT%TZ)" >> "$LOG"
   return 1
 }
@@ -77,7 +79,7 @@ bank() {
 # the previous window's (a deterministic repeating failure must not grow
 # the artifact or mint a commit per probe).
 bank_windowed() {
-  [ -s "$2" ] || { [ -f "/root/repo/$3" ] && cp "/root/repo/$3" "$2"; }
+  [ -s "$2" ] || { [ -f "$REPO/$3" ] && cp "$REPO/$3" "$2"; }
   local sum; sum=$(md5sum < "$1" | cut -d' ' -f1)
   if [ -f "$2.lastsum" ] && [ "$(cat "$2.lastsum")" = "$sum" ]; then
     echo "bank_windowed: $3 payload unchanged, skipping $(date -u +%FT%TZ)" >> "$LOG"
@@ -122,7 +124,7 @@ run_sweep() {
   : > "$DL4J_TPU_BENCH_PARTIAL"
   # outer timeout > worst case (configs x watchdog + probes); bench.py
   # kills its in-flight config subprocess on SIGTERM
-  (cd /root/repo && timeout -k 60 18000 python bench.py > "$out" 2>"${out%.json}.err")
+  (cd "$REPO" && timeout -k 60 18000 python bench.py > "$out" 2>"${out%.json}.err")
   local rc=$?
   echo "$label rc=$rc $(date -u +%FT%TZ)" >> "$LOG"
   # done only if the sweep produced a real TPU number — a CPU-fallback
@@ -145,6 +147,12 @@ run_sweep() {
       "Bank partial TPU bench rows ($label window $(date -u +%FT%TZ))"
   fi
 }
+
+# sourced (tests/test_watcher.py): expose the functions + the stage-1
+# derive above, skip the probe loop
+if [ "${BASH_SOURCE[0]}" != "$0" ]; then
+  return 0 2>/dev/null || exit 0
+fi
 
 echo "watcher start $(date -u +%FT%TZ)" >> "$LOG"
 while true; do
@@ -171,7 +179,7 @@ while true; do
         BENCH_TPU_MEASURED_r05.json
     elif [ ! -f $STATE/flash_smoke_done ]; then
       echo "TPU UP — running flash smoke $(date -u +%FT%TZ)" >> "$LOG"
-      (cd /root/repo && timeout 3600 python tools/flash_smoke.py > /tmp/flash_smoke.log 2>&1)
+      (cd "$REPO" && timeout 3600 python tools/flash_smoke.py > /tmp/flash_smoke.log 2>&1)
       src=$?
       echo "flash smoke rc=$src $(date -u +%FT%TZ)" >> "$LOG"
       # bank only logs that carry real kernel results (FWD/BWD/LSE lines,
@@ -189,7 +197,7 @@ while true; do
       fi
     elif [ ! -f $STATE/trace_done ]; then
       echo "TPU UP — capturing profiler trace $(date -u +%FT%TZ)" >> "$LOG"
-      (cd /root/repo && timeout 2400 python tools/profile_capture.py > /tmp/trace_capture.log 2>&1)
+      (cd "$REPO" && timeout 2400 python tools/profile_capture.py > /tmp/trace_capture.log 2>&1)
       trc=$?
       echo "trace rc=$trc $(date -u +%FT%TZ)" >> "$LOG"
       # the trace run also prints measured per-call/scan10 throughput —
@@ -215,7 +223,7 @@ while true; do
       # 5400s: fwd-only and fwd+bwd are cold compiles through the tunnel;
       # only the full-step program shares the bench's compile cache
       echo "TPU UP — running mfu probe $(date -u +%FT%TZ)" >> "$LOG"
-      (cd /root/repo && timeout 5400 python tools/mfu_probe.py \
+      (cd "$REPO" && timeout 5400 python tools/mfu_probe.py \
         > /tmp/mfu_probe.log 2>/tmp/mfu_probe.err)
       mrc=$?
       echo "mfu probe rc=$mrc $(date -u +%FT%TZ)" >> "$LOG"

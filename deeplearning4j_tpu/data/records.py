@@ -185,6 +185,12 @@ class RecordReaderDataSetIterator(DataSetIterator):
         self.reader.reset()
 
     def __iter__(self):
+        # every batch flows through the attached pre-processor (the
+        # setPreProcessor contract every DataSetIterator honors —
+        # device-norm fit detaches it and normalizes on device instead)
+        return (self._pp(ds) for ds in self._iter_raw())
+
+    def _iter_raw(self):
         if getattr(self.reader, "is_image", False):
             yield from self._iter_image_batches()
             return
@@ -216,7 +222,9 @@ class RecordReaderDataSetIterator(DataSetIterator):
             yield self._image_dataset(imgs, labels)
 
     def _image_dataset(self, imgs, labels) -> DataSet:
-        feats = np.stack(imgs).astype("float32")        # (B, H, W, C)
+        feats = np.stack(imgs)                          # (B, H, W, C)
+        if feats.dtype != np.uint8:     # raw bytes stay raw (device norm)
+            feats = feats.astype("float32")
         if self.label_index is None:    # unlabeled, as the tabular path
             return DataSet(feats)
         if self.regression:
@@ -271,6 +279,11 @@ class SequenceRecordReaderDataSetIterator(DataSetIterator):
             self.labels_reader.reset()
 
     def __iter__(self):
+        # honor the setPreProcessor contract (see
+        # RecordReaderDataSetIterator.__iter__)
+        return (self._pp(ds) for ds in self._iter_raw())
+
+    def _iter_raw(self):
         if self.labels_reader is None:
             seqs = ((s, None) for s in self.reader.sequences())
         else:
@@ -392,7 +405,8 @@ class RecordReaderMultiDataSetIterator(DataSetIterator):
                 if k is not None:
                     a = np.eye(k, dtype="float32")[a[:, 0].astype(int)]
                 labs.append(a)
-            yield MultiDataSet(feats, tuple(labs))
+            # setPreProcessor contract (MultiDataSetPreProcessor here)
+            yield self._pp(MultiDataSet(feats, tuple(labs)))
 
     @staticmethod
     def _slice(a, lo, hi):
@@ -404,7 +418,17 @@ class RecordReaderMultiDataSetIterator(DataSetIterator):
 class ImageRecordReader(RecordReader):
     """Images-from-directories reader (DataVec ImageRecordReader +
     ParentPathLabelGenerator): label = parent directory name, images
-    resized to (height, width) and scaled to [0, 1] float32 NHWC.
+    resized to (height, width), RAW 0-255 uint8 NHWC — scaling is the
+    attached normalizer's job, exactly as in the reference (DataVec's
+    reader loads raw pixel values; the canonical quickstarts then do
+    `iterator.setPreProcessor(new ImagePreProcessingScaler(0, 1))`).
+    Keeping the batches uint8 also engages the device-side
+    normalization seam: raw bytes cross the host->HBM link at 1/4 the
+    float32 size and the scaler's affine runs on device during fit.
+
+    normalize=True restores the pre-round-5 behavior of this class
+    (float32 [0, 1] batches, no normalizer needed) for pipelines that
+    relied on it.
 
     Usage (the canonical DL4J image-pipeline quickstart):
         rr = ImageRecordReader(32, 32, 3)
@@ -412,17 +436,20 @@ class ImageRecordReader(RecordReader):
         it = RecordReaderDataSetIterator(rr, batch_size=64,
                                          label_index=-1,
                                          num_classes=rr.num_labels())
+        it.set_pre_processor(ImagePreProcessingScaler())
     """
 
     IMAGE_EXTENSIONS = (".png", ".jpg", ".jpeg", ".bmp", ".gif")
 
     def __init__(self, height: int, width: int, channels: int = 3,
-                 shuffle: bool = False, seed: int = 0):
+                 shuffle: bool = False, seed: int = 0,
+                 normalize: bool = False):
         self.height = int(height)
         self.width = int(width)
         self.channels = int(channels)
         self.shuffle = shuffle
         self.seed = seed
+        self.normalize = normalize
         self._files: List[Tuple[str, int]] = []
         self._labels: List[str] = []
 
@@ -454,14 +481,18 @@ class ImageRecordReader(RecordReader):
         img = Image.open(path)
         img = img.convert("L" if self.channels == 1 else "RGB")
         img = img.resize((self.width, self.height))
-        arr = np.asarray(img, np.float32) / 255.0
+        if self.normalize:
+            arr = np.asarray(img, np.float32) / 255.0
+        else:
+            arr = np.asarray(img, np.uint8)
         if arr.ndim == 2:
             arr = arr[..., None]
         return arr
 
     def records(self):
-        """Yields (image (H, W, C) float32, label_idx) pairs; the bridge
-        iterator recognizes the image shape and builds NHWC batches."""
+        """Yields (image (H, W, C) uint8 — float32 [0,1] with
+        normalize=True, label_idx) pairs; the bridge iterator recognizes
+        the image shape and builds NHWC batches."""
         if not self._files:
             raise RuntimeError("call initialize(root_dir) first")
         for path, label in self._files:

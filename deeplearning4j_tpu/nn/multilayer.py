@@ -196,7 +196,12 @@ def _as_jnp(a, dtype=None):
     # restores transfer-then-cast).
     a = host_cast(a, dtype)
     arr = jnp.asarray(a)
-    if dtype is not None and jnp.issubdtype(arr.dtype, jnp.floating):
+    # floats cast to the compute dtype; so do raw uint8 image bytes
+    # (ImageRecordReader reference parity) used WITHOUT a normalizer.
+    # Wider int dtypes stay integer — they are embedding/sparse-label
+    # token ids, not pixels.
+    if dtype is not None and (jnp.issubdtype(arr.dtype, jnp.floating)
+                              or arr.dtype == jnp.uint8):
         arr = arr.astype(dtype)
     return arr
 

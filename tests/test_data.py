@@ -502,7 +502,22 @@ def test_image_record_reader_end_to_end(tmp_path):
     assert len(batches) == 4
     assert batches[0].features.shape == (6, 8, 8, 3)
     assert batches[0].labels.shape == (6, 2)
-    assert 0.0 <= batches[0].features.min() <= batches[0].features.max() <= 1.0
+    # reference parity: the reader yields RAW 0-255 bytes; scaling is the
+    # attached normalizer's job (and raw uint8 engages device-norm)
+    assert batches[0].features.dtype == np.uint8
+    assert batches[0].features.max() > 1
+    # normalize=True restores the float32 [0,1] convenience mode
+    rrn = ImageRecordReader(8, 8, 3, normalize=True).initialize(
+        str(tmp_path / "train"))
+    bn = next(iter(RecordReaderDataSetIterator(rrn, batch_size=6,
+                                               label_index=-1,
+                                               num_classes=2)))
+    assert bn.features.dtype == np.float32
+    assert 0.0 <= bn.features.min() <= bn.features.max() <= 1.0
+    # the canonical DL4J flow: scaler attached to the iterator
+    from deeplearning4j_tpu.data.normalization import (
+        ImagePreProcessingScaler)
+    it.set_pre_processor(ImagePreProcessingScaler())
 
     # trains end to end
     from deeplearning4j_tpu.nn.conf import (
@@ -531,6 +546,7 @@ def test_image_record_reader_end_to_end(tmp_path):
                                               label_index=-1,
                                               num_classes=2)))
     assert b.features.shape == (4, 8, 8, 1)
+    assert b.features.dtype == np.uint8
 
 
 # ------------------------------------------------ round-5 iterator tail
@@ -811,3 +827,46 @@ class TestFitPrefetch:
         # f64 and non-16-bit targets pass through untouched
         assert host_cast(a, np.float64) is a
         assert host_cast(a, None) is a
+
+
+def test_record_iterators_honor_set_pre_processor():
+    """setPreProcessor contract on all three record-reader bridges —
+    the attached pre-processor transforms every emitted batch (DL4J
+    DataSetIterator/MultiDataSetIterator contract)."""
+    from deeplearning4j_tpu.data.records import (
+        CollectionRecordReader, CollectionSequenceRecordReader,
+        RecordReaderDataSetIterator, RecordReaderMultiDataSetIterator,
+        SequenceRecordReaderDataSetIterator,
+    )
+
+    class Doubler:
+        def preprocess(self, ds):
+            if hasattr(ds, "features_masks") or isinstance(
+                    ds.features, tuple):   # MultiDataSet
+                return type(ds)(tuple(f * 2 for f in ds.features),
+                                ds.labels)
+            return type(ds)(ds.features * 2, ds.labels,
+                            ds.features_mask, ds.labels_mask)
+
+    rr = CollectionRecordReader([[1.0, 2.0, 0.0], [3.0, 4.0, 1.0]])
+    it = RecordReaderDataSetIterator(rr, batch_size=2, label_index=2,
+                                     num_classes=2)
+    it.set_pre_processor(Doubler())
+    ds = next(iter(it))
+    np.testing.assert_allclose(ds.features, [[2.0, 4.0], [6.0, 8.0]])
+
+    srr = CollectionSequenceRecordReader(
+        [[[1.0, 0.0], [2.0, 0.0]], [[3.0, 1.0], [4.0, 1.0]]])
+    sit = SequenceRecordReaderDataSetIterator(
+        srr, batch_size=2, label_index=1, num_classes=2)
+    sit.set_pre_processor(Doubler())
+    sds = next(iter(sit))
+    assert float(sds.features.max()) == 8.0
+
+    m = RecordReaderMultiDataSetIterator(batch_size=2)
+    m.add_reader("a", CollectionRecordReader([[1.0, 5.0], [2.0, 6.0]]))
+    m.add_input("a", 0, 0)
+    m.add_output("a", 1, 1)
+    m.set_pre_processor(Doubler())
+    mds = next(iter(m))
+    np.testing.assert_allclose(mds.features[0], [[2.0], [4.0]])

@@ -162,8 +162,16 @@ def _run_resnet(cfg):
     n_steps = 10 if on_tpu else 3
     scan_k = 10 if on_tpu else 2
 
-    model = ResNet50(num_classes=1000, input_shape=(hw, hw, 3))
+    # DL4J_TPU_BENCH_S2D=1: MLPerf-style space-to-depth stem (exactly
+    # equivalent model, MXU-friendlier head conv) for hardware A/B
+    s2d = os.environ.get("DL4J_TPU_BENCH_S2D", "0") == "1"
+    model = ResNet50(num_classes=1000, input_shape=(hw, hw, 3),
+                     space_to_depth_stem=s2d)
     conf = model.conf()
+    if s2d:
+        out_extra = {"s2d_stem": True}
+    else:
+        out_extra = {}
     if on_tpu:
         conf = dataclasses.replace(conf, compute_dtype="bfloat16")
     net = ComputationGraph(conf).init()
@@ -174,7 +182,7 @@ def _run_resnet(cfg):
     Ynp = np.eye(1000, dtype="float32")[rs.randint(0, 1000, batch)]
     out = {"batch": batch, "mode": mode,
            "device_kind": devices[0].device_kind, "hw": hw,
-           "on_tpu": on_tpu, "best_of": best_of}
+           "on_tpu": on_tpu, "best_of": best_of, **out_extra}
 
     if mode in ("per-call", "scan"):
         X, Y = jnp.asarray(Xnp), jnp.asarray(Ynp)

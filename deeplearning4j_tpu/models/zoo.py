@@ -28,8 +28,8 @@ from deeplearning4j_tpu.nn.conf.graph_vertices import (
 from deeplearning4j_tpu.nn.layers import (
     ActivationLayer, BatchNormalization, ConvolutionLayer, DenseLayer,
     DropoutLayer, GlobalPoolingLayer, LocalResponseNormalization, LSTM,
-    OutputLayer, RnnOutputLayer, SubsamplingLayer, Upsampling2D,
-    ZeroPaddingLayer,
+    OutputLayer, RnnOutputLayer, SpaceToDepthLayer, SubsamplingLayer,
+    Upsampling2D, ZeroPaddingLayer,
 )
 from deeplearning4j_tpu.nn.graph import ComputationGraph
 from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
@@ -229,6 +229,13 @@ class ResNet50(ZooModel):
     num_classes: int = 1000
     input_shape: Tuple[int, int, int] = (224, 224, 3)
     seed: int = 123
+    # MLPerf-style TPU stem: rearrange the input 2x2 space-to-depth and
+    # replace the 7x7/s2 head conv (stride-2 convs underfill the MXU,
+    # and C=3 wastes 125 of 128 input lanes) with a dense 4x4/s1 conv on
+    # (112, 112, 12). EXACTLY equivalent to the standard stem under the
+    # s2d_stem_weights() mapping (tested); opt-in because checkpoints
+    # trained with one stem need that mapping to move to the other.
+    space_to_depth_stem: bool = False
 
     def _conv_bn(self, g, name, n_out, kernel, stride, inp, pad="same",
                  relu=True):
@@ -267,9 +274,26 @@ class ResNet50(ZooModel):
                           .l2(1e-4))
              .add_inputs("input")
              .set_input_types(InputType.convolutional(*self.input_shape)))
-        g.add_layer("stem_pad", ZeroPaddingLayer(padding=(3, 3, 3, 3)), "input")
-        x = self._conv_bn(g, "stem", 64, (7, 7), (2, 2), "stem_pad",
-                          pad="truncate")
+        if self.space_to_depth_stem:
+            h, w = self.input_shape[:2]
+            if h % 2 or w % 2:
+                raise ValueError(
+                    f"space_to_depth_stem needs even input H/W (the 2x2 "
+                    f"rearrange + exact 7x7-stem equivalence both require "
+                    f"it); got {self.input_shape} — use the standard stem")
+            g.add_layer("stem_s2d", SpaceToDepthLayer(block_size=2),
+                        "input")
+            # pad (2 left, 1 right): the 7x7+pad-3 receptive field spans
+            # s2d cells i-2..i+1 (see s2d_stem_weights)
+            g.add_layer("stem_pad", ZeroPaddingLayer(padding=(2, 1, 2, 1)),
+                        "stem_s2d")
+            x = self._conv_bn(g, "stem", 64, (4, 4), (1, 1), "stem_pad",
+                              pad="truncate")
+        else:
+            g.add_layer("stem_pad", ZeroPaddingLayer(padding=(3, 3, 3, 3)),
+                        "input")
+            x = self._conv_bn(g, "stem", 64, (7, 7), (2, 2), "stem_pad",
+                              pad="truncate")
         g.add_layer("stem_pool",
                     SubsamplingLayer(kernel=(3, 3), stride=(2, 2),
                                      convolution_mode="same"), x)
@@ -291,6 +315,27 @@ class ResNet50(ZooModel):
                                 loss="mcxent"), "avgpool")
         g.set_outputs("output")
         return g.build()
+
+
+def s2d_stem_weights(w7):
+    """Map the standard ResNet stem's (7, 7, C, F) HWIO conv weights onto
+    the space-to-depth stem's (4, 4, 4*C, F) weights, EXACTLY:
+
+    standard: out(i,j) = sum_{p,q<7} x_pad3[2i+p, 2j+q] . w7[p, q]
+    s2d stem: the 4x4/s1 conv over pad-(2,1) s2d cells reads rows
+    2i-4..2i+3; pad w7 to 8x8 with a zero leading row/col (k8[1:,1:] =
+    w7) so that span contributes identically, then regroup
+    w4[a, b, (dr*2+dc)*C + ch, f] = k8[2a+dr, 2b+dc, ch, f]
+    matching SpaceToDepthLayer's (dr, dc, ch) depth order."""
+    import numpy as np
+    w7 = np.asarray(w7)
+    kh, kw, c, f = w7.shape
+    assert (kh, kw) == (7, 7), "stem mapping is for the 7x7 head conv"
+    k8 = np.zeros((8, 8, c, f), w7.dtype)
+    k8[1:, 1:] = w7
+    # (8, 8, C, F) -> (4, dr, 4, dc, C, F) -> (4, 4, dr, dc, C, F)
+    w4 = k8.reshape(4, 2, 4, 2, c, f).transpose(0, 2, 1, 3, 4, 5)
+    return w4.reshape(4, 4, 4 * c, f)
 
 
 # ----------------------------------------------------------------- GoogLeNet

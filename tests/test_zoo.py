@@ -150,3 +150,53 @@ class TestPretrainedFixtures:
         from deeplearning4j_tpu.models.zoo import LeNet
         with pytest.raises(FileNotFoundError, match="pretrained"):
             LeNet().init_pretrained(cache_dir=str(tmp_path))
+
+
+def test_resnet50_space_to_depth_stem_exact():
+    """The MLPerf-style s2d stem is EXACTLY the standard stem under the
+    s2d_stem_weights mapping — same conv output for the same input."""
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.models.zoo import s2d_stem_weights
+    from deeplearning4j_tpu.nn.layers import (
+        ConvolutionLayer, SpaceToDepthLayer, ZeroPaddingLayer,
+    )
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(2, 64, 64, 3).astype("float32"))
+    w7 = rs.randn(7, 7, 3, 16).astype("float32") * 0.1
+
+    # standard: pad 3, 7x7 stride 2
+    pad = ZeroPaddingLayer(padding=(3, 3, 3, 3))
+    conv7 = ConvolutionLayer(n_out=16, kernel=(7, 7), stride=(2, 2),
+                             convolution_mode="truncate", has_bias=False)
+    xp, _ = pad.apply({}, {}, x)
+    ref, _ = conv7.apply({"W": jnp.asarray(w7)}, {}, xp)
+
+    # s2d: block-2, pad (2,1), 4x4 stride 1, mapped weights
+    s2d = SpaceToDepthLayer(block_size=2)
+    pad2 = ZeroPaddingLayer(padding=(2, 1, 2, 1))
+    conv4 = ConvolutionLayer(n_out=16, kernel=(4, 4), stride=(1, 1),
+                             convolution_mode="truncate", has_bias=False)
+    xs, _ = s2d.apply({}, {}, x)
+    xs, _ = pad2.apply({}, {}, xs)
+    out, _ = conv4.apply({"W": jnp.asarray(s2d_stem_weights(w7))}, {}, xs)
+
+    assert ref.shape == out.shape == (2, 32, 32, 16)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_resnet50_space_to_depth_model_trains():
+    m = ResNet50(num_classes=10, input_shape=(64, 64, 3),
+                 space_to_depth_stem=True)
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    net = ComputationGraph(m.conf()).init()
+    rs = np.random.RandomState(1)
+    X = rs.rand(4, 64, 64, 3).astype("float32")
+    Y = np.eye(10, dtype="float32")[rs.randint(0, 10, 4)]
+    net.fit((X, Y), epochs=1)
+    assert np.isfinite(net._score)
+    # same downstream trunk: parameter count differs only by the stem
+    # conv (7*7*3 -> 4*4*12 rows = 192 vs 147 per filter)
+    base = ComputationGraph(ResNet50(num_classes=10,
+                                     input_shape=(64, 64, 3)).conf()).init()
+    assert net.num_params() - base.num_params() == (192 - 147) * 64

@@ -234,6 +234,16 @@ while true; do
           "Bank MFU calibration probe (matmul peak + step segments, rc=$mrc)" \
           && [ "$mrc" = "0" ] && touch $STATE/mfu_probe_done
       fi
+    elif [ ! -f $STATE/s2d_done ]; then
+      # space-to-depth stem A/B: resnet configs only (exactly-equivalent
+      # model, MXU-friendlier head conv — models/zoo.py
+      # s2d_stem_weights). Needs a MEASURED resnet row to retire.
+      echo "TPU UP — s2d stem A/B sweep $(date -u +%FT%TZ)" >> "$LOG"
+      DL4J_TPU_BENCH_S2D=1 DL4J_TPU_BENCH_LSTM=0 DL4J_TPU_BENCH_W2V=0 \
+      DL4J_TPU_BENCH_LENET=0 DL4J_TPU_BENCH_ATTENTION=0 \
+      DL4J_TPU_BENCH_H2D=0 DL4J_TPU_BENCH_BATCHES=128 \
+        run_sweep $STATE/bench_s2d.json $STATE/s2d_done "" "s2d" \
+          BENCH_TPU_S2D_r05.json
     else
       sleep 420   # all jobs done; stay armed for manual reruns
     fi

@@ -177,6 +177,19 @@ while true; do
       echo "TPU UP — running bench $(date -u +%FT%TZ)" >> "$LOG"
       run_sweep $STATE/bench_tpu.json $STATE/bench_tpu_done "" "bench" \
         BENCH_TPU_MEASURED_r05.json
+    elif [ ! -f $STATE/bench2_done ]; then
+      # second full sweep FIRST in the queue after the headline bank:
+      # it completes BASELINE.md's config coverage (the 01:28Z wedge
+      # cut off char-lstm / word2vec / lenet) AND runs the fixed
+      # attention micro — the first flash-vs-dense hardware timing —
+      # so it outranks the dedicated flash smoke now that Mosaic
+      # lowering is CI-proven (tests/test_tpu_lowering.py). resnet
+      # programs are compile-cache hits; done-gate requires a
+      # MEASURED char-lstm row. Distinct artifact keeps the r05 JSON
+      # PERF.md quotes byte-stable at HEAD.
+      echo "TPU UP — bench sweep 2 (full config set) $(date -u +%FT%TZ)" >> "$LOG"
+      run_sweep $STATE/bench_tpu2.json $STATE/bench2_done "char-lstm" "bench2" \
+        BENCH_TPU_MEASURED_r05b.json
     elif [ ! -f $STATE/flash_smoke_done ]; then
       echo "TPU UP — running flash smoke $(date -u +%FT%TZ)" >> "$LOG"
       (cd "$REPO" && timeout 3600 python tools/flash_smoke.py > /tmp/flash_smoke.log 2>&1)
@@ -208,17 +221,6 @@ while true; do
           "Bank profiler-trace capture log (rc=$trc)" \
           && [ "$trc" = "0" ] && touch $STATE/trace_done
       fi
-    elif [ ! -f $STATE/bench2_done ]; then
-      # second full sweep BEFORE the mfu probe: it completes BASELINE.md's
-      # config coverage (the 01:28Z wedge cut off char-lstm / word2vec /
-      # lenet; resnet programs are compile-cache hits so a complete pass
-      # fits one ~15 min window), and its done-gate requires a MEASURED
-      # char-lstm row (measured_row), not just the name in an error row.
-      # Banked to a distinct artifact so the r05 JSON PERF.md quotes
-      # stays byte-stable at HEAD.
-      echo "TPU UP — bench sweep 2 (full config set) $(date -u +%FT%TZ)" >> "$LOG"
-      run_sweep $STATE/bench_tpu2.json $STATE/bench2_done "char-lstm" "bench2" \
-        BENCH_TPU_MEASURED_r05b.json
     elif [ ! -f $STATE/mfu_probe_done ]; then
       # 5400s: fwd-only and fwd+bwd are cold compiles through the tunnel;
       # only the full-step program shares the bench's compile cache

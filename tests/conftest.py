@@ -57,6 +57,7 @@ _SLOW = {
     "tests/test_tpu_lowering.py::TestFlashKernelLowering::test_cross_attention_shapes",
     "tests/test_tpu_lowering.py::TestRingFlashLowering::test_ring_flash_over_seq_mesh",
     "tests/test_tpu_lowering.py::TestFlagshipLowering::test_graft_entry_forward_lowers_for_tpu",
+    "tests/test_tpu_lowering.py::TestFlagshipLowering::test_resnet_train_step_lowers_for_tpu",
     "tests/test_attention.py::test_context_parallel_dp_sp_mesh_trains",
     "tests/test_attention.py::test_context_parallel_graph_matches_single_device",
     "tests/test_attention.py::test_context_parallel_honors_label_mask",

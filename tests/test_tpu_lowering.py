@@ -21,6 +21,7 @@ validation either — this is the TPU-native improvement on that story.
 """
 import jax
 import jax.numpy as jnp
+import pytest
 
 
 def _export_tpu(fn, *args, expect_pallas: bool = True):
@@ -104,3 +105,38 @@ class TestFlagshipLowering:
         import __graft_entry__ as ge
         fn, args = ge.entry()
         _export_tpu(fn, *args, expect_pallas=False)
+
+    @pytest.mark.parametrize("s2d", [False, True])
+    def test_resnet_train_step_lowers_for_tpu(self, s2d):
+        # the bench's headline program at the REAL hardware spatial shape
+        # (224x224 bf16) — a regression in the stem/device-norm/zoo that
+        # only breaks TPU lowering must fail here, not in a tunnel window
+        import dataclasses
+
+        import optax
+
+        from deeplearning4j_tpu.models import ResNet50
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+        model = ResNet50(num_classes=1000, input_shape=(224, 224, 3),
+                         space_to_depth_stem=s2d)
+        conf = dataclasses.replace(model.conf(),
+                                   compute_dtype="bfloat16")
+        net = ComputationGraph(conf).init()
+        tx = net._tx
+        x = jnp.zeros((8, 224, 224, 3), jnp.bfloat16)
+        y = jnp.zeros((8, 1000), jnp.bfloat16)
+
+        def step(params, opt_state, state, x, y, rng):
+            def loss_fn(p):
+                loss, (new_state, _) = net._score_fn(
+                    p, state, (x,), (y,), None, None, True, rng)
+                return loss, new_state
+            (loss, new_state), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            updates, new_opt = tx.update(grads, opt_state, params)
+            return (optax.apply_updates(params, updates), new_opt,
+                    new_state, loss)
+
+        _export_tpu(step, net.params, net.opt_state, net.state, x, y,
+                    jax.random.PRNGKey(0), expect_pallas=False)

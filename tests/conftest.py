@@ -28,6 +28,13 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+# XLA's cpu_aot_loader logs an E-level "could lead to SIGILL" wall of
+# text for every compile-cache hit whose recorded machine-feature string
+# differs textually from the host's (the compile side records XLA tuning
+# pseudo-features like +prefer-no-scatter that host detection never
+# lists — same box, pure noise). Real failures surface as Python
+# exceptions, so silence C++ glog in tests unless the caller overrides.
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
 
 import jax  # noqa: E402
 import pytest  # noqa: E402

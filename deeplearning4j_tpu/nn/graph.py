@@ -360,7 +360,8 @@ class ComputationGraph:
 
         return jax.jit(step, donate_argnums=(0, 1, 2))
 
-    def fit(self, data, epochs: int = 1, scan_steps: Optional[int] = None):
+    def fit(self, data, epochs: int = 1, scan_steps: Optional[int] = None,
+            accumulate_steps: int = 1):
         """Train on a MultiDataSet / DataSet / iterator of either
         (ComputationGraph.fit, :1015).
 
@@ -368,11 +369,26 @@ class ComputationGraph:
         lax.scan with a one-chunk-deferred loss fetch (input-pipelined fit;
         see MultiLayerNetwork.fit) — bit-identical math/RNG to the per-call
         path. Default: 10 on TPU, 1 on CPU (measured, PERF.md);
-        $DL4J_TPU_SCAN_STEPS overrides."""
+        $DL4J_TPU_SCAN_STEPS overrides.
+
+        accumulate_steps > 1: gradient accumulation — K micro-batch
+        gradients averaged into ONE optimizer step inside one jit (see
+        MultiLayerNetwork.fit; mutually exclusive with scan_steps > 1,
+        not applicable to tbptt)."""
         if self.params is None:
             self.init()
         if self._train_step is None:
             self._train_step = self._make_train_step()
+        if accumulate_steps > 1:
+            if self.conf.backprop_type == "tbptt":
+                raise ValueError("accumulate_steps does not apply to "
+                                 "tbptt (chunked-time) training")
+            if scan_steps is not None and scan_steps > 1:
+                raise ValueError("accumulate_steps and scan_steps are "
+                                 "mutually exclusive (one fuses K "
+                                 "optimizer steps, the other folds K "
+                                 "micro-batches into one step)")
+            scan_steps = 1
         if scan_steps is None:
             scan_steps = _default_scan_steps()
         rng = jax.random.PRNGKey(self.conf.seed + 331 * (self.epoch_count + 1))
@@ -391,7 +407,10 @@ class ComputationGraph:
                 for _ in range(epochs):
                     for lst in self.listeners:
                         lst.on_epoch_start(self, self.epoch_count)
-                    if not tbptt and scan_steps > 1:
+                    if not tbptt and accumulate_steps > 1:
+                        rng = self._fit_epoch_accum(data, rng,
+                                                    accumulate_steps)
+                    elif not tbptt and scan_steps > 1:
                         rng = self._fit_epoch_scan(data, rng, scan_steps)
                     else:
                         rng = self._fit_epoch_per_call(data, rng, tbptt)
@@ -496,6 +515,104 @@ class ComputationGraph:
             return params, opt_state, state, losses
 
         return jax.jit(kstep, donate_argnums=(0, 1, 2))
+
+    def _mds_to_dev(self, mds):
+        """MultiDataSet -> device operand tuples; the ONE staging rule
+        the per-call, scan and accumulation fit paths share."""
+        return (tuple(self._stage_x(f) for f in mds.features),
+                tuple(_as_jnp(l, self._compute_dtype) for l in mds.labels),
+                None if mds.features_masks is None else tuple(
+                    _as_jnp(m) for m in mds.features_masks),
+                None if mds.labels_masks is None else tuple(
+                    _as_jnp(m) for m in mds.labels_masks))
+
+    @staticmethod
+    def _mds_sig(mds):
+        shapes = lambda t: None if t is None else tuple(
+            np.shape(a) for a in t)
+        return (shapes(mds.features), shapes(mds.labels),
+                shapes(mds.features_masks), shapes(mds.labels_masks))
+
+    def _make_accum_step(self):
+        """K micro-batch gradients averaged into ONE optimizer step (see
+        MultiLayerNetwork._make_accum_step)."""
+        from deeplearning4j_tpu.nn.regularization import (
+            apply_constraints, constraint_map, has_constraints,
+        )
+        tx = self._tx
+        layer_map = constraint_map(self)
+        constrained = has_constraints(layer_map.values())
+
+        def kaccum(params, opt_state, state, inputs, labels, fmasks,
+                   lmasks, subs):
+            k = subs.shape[0]
+
+            def body(carry, batch):
+                gsum, state = carry
+                cin, clab, cfm, clm, sub = batch
+                def loss_fn(p):
+                    return self._score_fn(p, state, cin, clab, cfm, clm,
+                                          True, sub, carries=None)
+                (loss, (new_state, _)), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(params)
+                gsum = jax.tree_util.tree_map(jnp.add, gsum, grads)
+                return (gsum, new_state), loss
+
+            zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+            (gsum, state), losses = jax.lax.scan(
+                body, (zeros, state), (inputs, labels, fmasks, lmasks,
+                                       subs))
+            grads = jax.tree_util.tree_map(lambda g: g / k, gsum)
+            updates, new_opt = tx.update(grads, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+            if constrained:
+                new_params = apply_constraints(layer_map, new_params)
+            return new_params, new_opt, state, jnp.mean(losses)
+
+        return jax.jit(kaccum, donate_argnums=(0, 1, 2))
+
+    def _fit_epoch_accum(self, data, rng, K):
+        """One optimizer step per K stacked micro-batches; chunking and
+        ragged-tail handling as in _fit_epoch_scan, lockstep listener
+        callbacks when a model-reading listener is attached."""
+
+        def process(p):
+            loss, bs, etl_ms = p
+            self._score = float(loss)
+            for lst in self.listeners:
+                lst.iteration_done(self, self.iteration_count,
+                                   self.epoch_count, self._score, etl_ms,
+                                   bs)
+            self.iteration_count += 1
+
+        def dispatch(group, etl_ms):
+            nonlocal rng
+            subs = []
+            for _ in group:
+                rng, sub = jax.random.split(rng)
+                subs.append(sub)
+            items = [self._mds_to_dev(m) for m in group]
+            inputs, labels, fmasks, lmasks = jax.tree_util.tree_map(
+                lambda *xs: jnp.stack(xs), *items)
+            sig = ("accum", fmasks is not None, lmasks is not None)
+            if sig not in self._scan_step:
+                self._scan_step[sig] = self._make_accum_step()
+            (self.params, self.opt_state, self.state,
+             loss) = self._scan_step[sig](
+                self.params, self.opt_state, self.state, inputs, labels,
+                fmasks, lmasks, jnp.stack(subs))
+            bs = int(np.shape(group[0].features[0])[0]) * len(group)
+            return (loss, bs, etl_ms)
+
+        # _iter_data, not _mds_stream: dispatch stacks K host batches
+        # into ONE transfer; the prefetch stream's per-batch device_put
+        # would round-trip each micro-batch through the host (same rule
+        # as _fit_epoch_scan)
+        _run_scan_pipeline(self._iter_data(data), self._mds_sig, dispatch,
+                           process, K,
+                           defer=not _scan_incompatible_listeners(
+                               self.listeners))
+        return rng
 
     def _fit_epoch_scan(self, data, rng, K):
         """Input-pipelined epoch over MultiDataSets: consecutive same-shape

@@ -91,9 +91,10 @@ def _scan_incompatible_listeners(listeners) -> bool:
                for lst in listeners)
 
 
-def _run_scan_pipeline(batches, sig_of, dispatch, process, K):
+def _run_scan_pipeline(batches, sig_of, dispatch, process, K, defer=True):
     """Shared chunking/deferral loop of the input-pipelined fit paths
-    (MultiLayerNetwork._fit_epoch_scan, ComputationGraph._fit_epoch_scan).
+    (MultiLayerNetwork._fit_epoch_scan/_fit_epoch_accum,
+    ComputationGraph._fit_epoch_scan).
 
     Groups consecutive batches with identical shape signature `sig_of(b)`
     into chunks of at most K, calls `dispatch(group, etl_ms)` for each
@@ -101,7 +102,9 @@ def _run_scan_pipeline(batches, sig_of, dispatch, process, K):
     futures), and calls `process(pending)` for chunk i only AFTER chunk
     i+1 has been dispatched — so the host-side stacking and dispatch of the
     next chunk overlaps the device compute of the current one, and the one
-    blocking loss fetch per chunk happens while the device is busy."""
+    blocking loss fetch per chunk happens while the device is busy.
+    defer=False processes each chunk in lockstep instead (model-reading
+    listeners must observe the params as of the step they're told about)."""
     pending = None
     group, gsig = [], None
     etl_start = time.perf_counter()
@@ -110,9 +113,12 @@ def _run_scan_pipeline(batches, sig_of, dispatch, process, K):
         nonlocal pending, group, etl_start
         etl_ms = (time.perf_counter() - etl_start) * 1e3
         fresh = dispatch(group, etl_ms)
-        if pending is not None:
-            process(pending)
-        pending = fresh
+        if not defer:
+            process(fresh)
+        else:
+            if pending is not None:
+                process(pending)
+            pending = fresh
         group, etl_start = [], time.perf_counter()
 
     for b in batches:
@@ -508,9 +514,16 @@ class MultiLayerNetwork:
 
     def fit(self, data, epochs: int = 1, batch_size: int = 32,
             scan_steps: Optional[int] = None,
-            prefetch: Optional[bool] = None):
+            prefetch: Optional[bool] = None,
+            accumulate_steps: int = 1):
         """Train (DL4J fit(DataSetIterator), :1268). Accepts a DataSetIterator,
         a DataSet, or (features, labels) arrays.
+
+        accumulate_steps > 1: gradient accumulation — K micro-batch
+        gradients averaged into ONE optimizer step inside one jit, for
+        effective batch sizes beyond what HBM fits in a single forward
+        (see _make_accum_step; mutually exclusive with scan_steps > 1,
+        not applicable to tbptt).
 
         scan_steps > 1 fuses that many optimizer steps into ONE jit call via
         lax.scan (input-pipelined fit): batches are stacked host-side while
@@ -533,6 +546,16 @@ class MultiLayerNetwork:
         Already-async and async_supported=False sources pass through."""
         if self.params is None:
             self.init()
+        if accumulate_steps > 1:
+            if self.conf.backprop_type == "tbptt":
+                raise ValueError("accumulate_steps does not apply to "
+                                 "tbptt (chunked-time) training")
+            if scan_steps is not None and scan_steps > 1:
+                raise ValueError("accumulate_steps and scan_steps are "
+                                 "mutually exclusive (one fuses K "
+                                 "optimizer steps, the other folds K "
+                                 "micro-batches into one step)")
+            scan_steps = 1
         if scan_steps is None:
             scan_steps = _default_scan_steps()
         iterator = self._as_iterator(data, batch_size)
@@ -552,19 +575,20 @@ class MultiLayerNetwork:
             if aff is not None:
                 self._input_affine = (jnp.asarray(aff[0]),
                                       jnp.asarray(aff[1]))
-            # the scan path falls back to per-call under model-reading
-            # listeners, and tbptt never scans — the wrap's device_put
-            # choice must match the path that will actually run
-            eff_scan = 1 if (self.conf.backprop_type == "tbptt"
-                             or _scan_incompatible_listeners(self.listeners)) \
-                else scan_steps
+            # scan-fit and accumulation STACK K host batches before one
+            # transfer — the wrap must not device_put per batch there (a
+            # device array would round-trip back through the host). The
+            # scan path falls back to per-call under model-reading
+            # listeners and tbptt never scans, so match the path that
+            # will actually run.
+            stacking = accumulate_steps > 1 or (
+                scan_steps > 1
+                and self.conf.backprop_type != "tbptt"
+                and not _scan_incompatible_listeners(self.listeners))
             if prefetch and not isinstance(iterator, AsyncDataSetIterator) \
                     and getattr(iterator, "async_supported", True):
-                # scan-fit stacks K host batches before ONE transfer, so
-                # the worker must not device_put per batch there (a device
-                # array would round-trip back through the host)
                 iterator = AsyncDataSetIterator(
-                    iterator, device_put=(eff_scan <= 1),
+                    iterator, device_put=not stacking,
                     cast_dtype=self._compute_dtype
                     if np.dtype(self._compute_dtype).itemsize == 2
                     else None,
@@ -575,6 +599,8 @@ class MultiLayerNetwork:
                         lst.on_epoch_start(self, self.epoch_count)
                     if self.conf.backprop_type == "tbptt":
                         self._fit_epoch_tbptt(iterator)
+                    elif accumulate_steps > 1:
+                        self._fit_epoch_accum(iterator, accumulate_steps)
                     elif scan_steps > 1:
                         self._fit_epoch_scan(iterator, scan_steps)
                     else:
@@ -714,6 +740,128 @@ class MultiLayerNetwork:
             return params, opt_state, state, losses
 
         return jax.jit(kstep, donate_argnums=(0, 1, 2))
+
+    def _make_accum_step(self, with_stats):
+        """Gradient accumulation: K micro-batch gradients averaged into
+        ONE optimizer step, all inside one jit (TPU-native big-effective-
+        batch training — the HBM cost is one extra gradient-sized
+        accumulator, not a K-times batch). For equal micro-batch sizes
+        and batch-independent layers the result is bit-comparable to one
+        big-batch step (mean of equal-size micro means == full-batch
+        mean; tested); BatchNormalization statistics remain per
+        micro-batch, the same semantics every framework's accumulation
+        has. with_stats additionally returns the averaged (grads,
+        updates) for on_gradients listeners. One jit serves every
+        chunk/mask shape (jax retraces per pytree structure)."""
+        from deeplearning4j_tpu.nn.regularization import (
+            apply_constraints, constraint_map, has_constraints,
+        )
+        tx = self._tx
+        constrained = has_constraints(self.layers)
+        layer_map = constraint_map(self)
+
+        def kaccum(params, opt_state, state, xs, ys, fms, lms, subs):
+            def body(carry, batch):
+                gsum, state = carry
+                x, y, fm, lm, sub = batch
+                def loss_fn(p):
+                    return self._score_fn(p, state, x, y, fm, lm, True,
+                                          sub, carries=None)
+                (loss, (new_state, _)), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(params)
+                gsum = jax.tree_util.tree_map(jnp.add, gsum, grads)
+                return (gsum, new_state), loss
+
+            zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+            (gsum, state), losses = jax.lax.scan(
+                body, (zeros, state), (xs, ys, fms, lms, subs))
+            grads = jax.tree_util.tree_map(
+                lambda g: g / subs.shape[0], gsum)
+            updates, new_opt = tx.update(grads, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+            if constrained:
+                new_params = apply_constraints(layer_map, new_params)
+            if with_stats:
+                return (new_params, new_opt, state, jnp.mean(losses),
+                        grads, updates)
+            return new_params, new_opt, state, jnp.mean(losses)
+
+        return jax.jit(kaccum, donate_argnums=(0, 1, 2))
+
+    def _get_accum_step(self, with_stats=False):
+        sig = ("accum", with_stats)
+        if sig not in self._scan_step:
+            self._scan_step[sig] = self._make_accum_step(with_stats)
+        return self._scan_step[sig]
+
+    def _fit_epoch_accum(self, iterator, K):
+        """One optimizer step per K micro-batches (gradient accumulation).
+        Iteration counting follows DL4J's meaning (one iteration = one
+        optimizer step); a ragged tail (< K same-shape batches) still
+        accumulates into one step with the correct 1/len mean. Gradient
+        listeners receive the AVERAGED per-step grads/updates (lockstep
+        — wants_gradients forces defer=False below, so iteration_count
+        at dispatch is the step being reported)."""
+        rng = jax.random.PRNGKey(self.conf.seed
+                                 + 7919 * (self.epoch_count + 1))
+        grad_listeners = [lst for lst in self.listeners
+                          if getattr(lst, "wants_gradients", False)]
+
+        def process(p):
+            loss, bs, etl_ms, capture, grads, updates = p
+            self._score = float(loss)
+            for lst in capture:
+                lst.on_gradients(self, self.iteration_count,
+                                 self.epoch_count, grads, updates)
+            for lst in self.listeners:
+                lst.iteration_done(self, self.iteration_count,
+                                   self.epoch_count, self._score, etl_ms,
+                                   bs)
+            self.iteration_count += 1
+
+        def dispatch(group, etl_ms):
+            nonlocal rng
+            subs = []
+            for _ in group:
+                rng, sub = jax.random.split(rng)
+                subs.append(sub)
+            ds0 = group[0]
+            stack = lambda get, dt=None: (
+                None if get(ds0) is None else
+                _as_jnp(np.stack([np.asarray(get(d)) for d in group]), dt))
+            xs = None if ds0.features is None else self._stage_x(
+                np.stack([np.asarray(d.features) for d in group]))
+            ys = stack(lambda d: d.labels, self._compute_dtype)
+            fms = stack(lambda d: d.features_mask)
+            lms = stack(lambda d: d.labels_mask)
+            capture = [lst for lst in grad_listeners
+                       if lst.should_capture(self.iteration_count)]
+            kstep = self._get_accum_step(with_stats=bool(capture))
+            out = kstep(self.params, self.opt_state, self.state, xs, ys,
+                        fms, lms, jnp.stack(subs))
+            grads = updates = None
+            if capture:
+                (self.params, self.opt_state, self.state, loss, grads,
+                 updates) = out
+            else:
+                self.params, self.opt_state, self.state, loss = out
+            bs = int(np.shape(ds0.features)[0]) * len(group)
+            return loss, bs, etl_ms, capture, grads, updates
+
+        def sig_of(ds):
+            return (np.shape(ds.features), np.shape(ds.labels),
+                    None if ds.features_mask is None
+                    else np.shape(ds.features_mask),
+                    None if ds.labels_mask is None
+                    else np.shape(ds.labels_mask))
+
+        # unlike scan-fit, accumulation cannot fall back to per-call for
+        # model-reading listeners (that would change the optimization) —
+        # it drops the one-chunk deferral instead so each callback sees
+        # the params of the step it reports
+        _run_scan_pipeline(iterator, sig_of, dispatch, process, K,
+                           defer=not _scan_incompatible_listeners(
+                               self.listeners))
 
     def _get_scan_step(self, fmask, lmask, K):
         sig = (fmask is not None, lmask is not None, K)

@@ -408,3 +408,135 @@ class TestScanStepsDefault:
     def test_cpu_is_not_tpu(self):
         import deeplearning4j_tpu.util.platform as plat
         assert plat.is_tpu_backend() is False   # conftest pins cpu
+
+
+class TestGradientAccumulation:
+    def _net(self, seed=21):
+        from deeplearning4j_tpu.nn.conf import (
+            InputType, NeuralNetConfiguration)
+        from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.nn.updaters import Sgd
+        conf = (NeuralNetConfiguration.Builder().seed(seed)
+                .updater(Sgd(1e-1)).list()
+                .layer(DenseLayer(n_out=16, activation="tanh"))
+                .layer(OutputLayer(n_out=3, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(5)).build())
+        return MultiLayerNetwork(conf).init()
+
+    def _data(self, n=64):
+        rs = np.random.RandomState(3)
+        X = rs.randn(n, 5).astype("float32")
+        Y = np.eye(3, dtype="float32")[rs.randint(0, 3, n)]
+        return X, Y
+
+    def test_accumulation_equals_big_batch(self):
+        # 4 micro-batches of 16 accumulated == one step on a batch of 64
+        # (equal-size micro means == full-batch mean; BN-free net)
+        X, Y = self._data(64)
+        a = self._net()
+        a.fit((X, Y), batch_size=16, accumulate_steps=4, epochs=2)
+        b = self._net()
+        b.fit((X, Y), batch_size=64, epochs=2)
+        assert a.iteration_count == b.iteration_count == 2
+        import jax
+        for la, lb in zip(jax.tree_util.tree_leaves(a.params),
+                          jax.tree_util.tree_leaves(b.params)):
+            np.testing.assert_allclose(np.asarray(la), np.asarray(lb),
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_ragged_tail_accumulates_with_correct_mean(self):
+        # 6 micro-batches, K=4 -> chunks of 4 and 2 -> 2 optimizer steps,
+        # equal to per-call steps on batches of 64 and 32
+        X, Y = self._data(96)
+        a = self._net()
+        a.fit((X, Y), batch_size=16, accumulate_steps=4)
+        assert a.iteration_count == 2
+        b = self._net()
+        b.fit((X[:64], Y[:64]), batch_size=64)
+        b.fit((X[64:], Y[64:]), batch_size=32)
+        import jax
+        for la, lb in zip(jax.tree_util.tree_leaves(a.params),
+                          jax.tree_util.tree_leaves(b.params)):
+            np.testing.assert_allclose(np.asarray(la), np.asarray(lb),
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_conflicting_modes_rejected(self):
+        import pytest
+        X, Y = self._data(32)
+        net = self._net()
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            net.fit((X, Y), batch_size=16, accumulate_steps=2,
+                    scan_steps=2)
+
+    def test_listener_sees_per_step_iterations(self):
+        from deeplearning4j_tpu.train.listeners import (
+            CollectScoresIterationListener)
+        X, Y = self._data(64)
+        net = self._net()
+        lst = CollectScoresIterationListener()
+        net.set_listeners(lst)
+        net.fit((X, Y), batch_size=16, accumulate_steps=4, epochs=3)
+        assert net.iteration_count == 3           # one step per chunk
+        assert len(lst.scores) == 3
+
+    def test_graph_accumulation_equals_big_batch(self):
+        from deeplearning4j_tpu.nn.conf import (
+            InputType, NeuralNetConfiguration)
+        from deeplearning4j_tpu.nn.conf.network import GraphBuilder
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.nn.updaters import Sgd
+        X, Y = self._data(64)
+
+        def net():
+            g = (GraphBuilder(NeuralNetConfiguration.Builder().seed(9)
+                              .updater(Sgd(1e-1)))
+                 .add_inputs("in")
+                 .set_input_types(InputType.feed_forward(5)))
+            g.add_layer("d", DenseLayer(n_out=16, activation="tanh"), "in")
+            g.add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                           loss="mcxent"), "d")
+            g.set_outputs("out")
+            return ComputationGraph(g.build()).init()
+
+        from deeplearning4j_tpu.data.iterator import ArrayDataSetIterator
+        a = net()
+        a.fit(ArrayDataSetIterator(X, Y, batch_size=16),
+              accumulate_steps=4, epochs=2)
+        b = net()
+        b.fit(ArrayDataSetIterator(X, Y, batch_size=64), epochs=2)
+        assert a.iteration_count == b.iteration_count == 2
+        import jax
+        for la, lb in zip(jax.tree_util.tree_leaves(a.params),
+                          jax.tree_util.tree_leaves(b.params)):
+            np.testing.assert_allclose(np.asarray(la), np.asarray(lb),
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_gradient_listener_gets_averaged_grads(self):
+        # wants_gradients listeners receive the AVERAGED per-step grads
+        # (lockstep callbacks — no one-chunk deferral on this path)
+        class GradSpy:
+            wants_gradients = True
+            reads_model = True
+
+            def __init__(self):
+                self.calls = []
+
+            def should_capture(self, it):
+                return True
+
+            def on_gradients(self, model, it, ep, grads, updates):
+                self.calls.append(
+                    (it, grads is not None and updates is not None))
+
+            def __getattr__(self, name):
+                return lambda *a, **k: None
+
+        X, Y = self._data(64)
+        net = self._net()
+        spy = GradSpy()
+        net.set_listeners(spy)
+        net.fit((X, Y), batch_size=16, accumulate_steps=4, epochs=2)
+        assert spy.calls == [(0, True), (1, True)]

@@ -377,6 +377,12 @@ class ComputationGraph:
         not applicable to tbptt)."""
         if self.params is None:
             self.init()
+        # donated-buffer safety: see util/params.owned_leaf (params from a
+        # checkpoint or import may alias numpy memory the donating step
+        # would otherwise free)
+        self.params = param_util.own_tree(self.params)
+        self.state = param_util.own_tree(self.state)
+        self.opt_state = param_util.own_tree(self.opt_state)
         if self._train_step is None:
             self._train_step = self._make_train_step()
         if accumulate_steps > 1:

@@ -546,6 +546,12 @@ class MultiLayerNetwork:
         Already-async and async_supported=False sources pass through."""
         if self.params is None:
             self.init()
+        # donated-buffer safety: params from ANY host source (checkpoint,
+        # keras/dl4j import, set_params_flat) may alias numpy memory that
+        # the donating train step must not free (util/params.owned_leaf)
+        self.params = param_util.own_tree(self.params)
+        self.state = param_util.own_tree(self.state)
+        self.opt_state = param_util.own_tree(self.opt_state)
         if accumulate_steps > 1:
             if self.conf.backprop_type == "tbptt":
                 raise ValueError("accumulate_steps does not apply to "

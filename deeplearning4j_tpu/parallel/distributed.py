@@ -10,7 +10,11 @@ TPU-native mapping: the whole role/shard/transport machinery collapses into
 coordinator plays the Spark-driver/TrainingMaster role, each host process is
 a worker, and gradient traffic rides compiled ICI/DCN collectives instead of
 Aeron UDP. Failure handling = checkpoint + restart (SURVEY.md §5.3: the
-reference has no better story either; we layer checkpoint/resume on top).
+reference has no better story either): that layer is
+`train/resilience.ResilientTrainer` — atomic manifest-tracked checkpoints
+with auto-resume, SIGTERM preemption handling, and a per-step fault
+policy. In a multi-process run only the coordinator (`is_coordinator()`)
+writes checkpoints; every process restores from them.
 """
 from __future__ import annotations
 

@@ -21,6 +21,7 @@ Wire format per message:
 from __future__ import annotations
 
 import queue
+import random
 import socket
 import struct
 import threading
@@ -77,6 +78,11 @@ class SocketTransport:
     SilentIntroductoryMessage).
     """
 
+    #: backoff shape for _connect's retry loop (floor doubles up to cap,
+    #: each sleep jittered into [0.5x, 1.5x])
+    CONNECT_BACKOFF_FLOOR = 0.02
+    CONNECT_BACKOFF_CAP = 1.0
+
     def __init__(self, rank: int, n_workers: int, base_port: int = 29610,
                  host: str = "127.0.0.1", connect_timeout: float = 30.0):
         self.rank = rank
@@ -86,14 +92,22 @@ class SocketTransport:
         self.connect_timeout = connect_timeout
         self.messages_sent = 0
         self.bytes_sent = 0
+        #: optional fault hook (util/faults.attach_transport_faults):
+        #: called with the peer rank per outbound message; False = drop
+        self.send_filter = None
         self._inbox: "queue.Queue[Tuple]" = queue.Queue()
         self._out: dict = {}
         self._lock = threading.Lock()
+        # deterministic backoff jitter stream, decorrelated across ranks
+        self._jitter = random.Random(0x5EED ^ rank)
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._listener.bind((host, base_port + rank))
         self._listener.listen(n_workers)
         self._closed = False
+        self._close_lock = threading.Lock()
+        self._inbound: set = set()
+        self._inbound_lock = threading.Lock()
         self._accept_thread = threading.Thread(target=self._accept_loop,
                                                daemon=True)
         self._accept_thread.start()
@@ -105,6 +119,11 @@ class SocketTransport:
                 conn, _ = self._listener.accept()
             except OSError:
                 return
+            with self._inbound_lock:
+                if self._closed:
+                    conn.close()
+                    return
+                self._inbound.add(conn)
             threading.Thread(target=self._reader, args=(conn,),
                              daemon=True).start()
 
@@ -115,7 +134,12 @@ class SocketTransport:
         except (ConnectionError, OSError, ValueError):
             pass
         finally:
-            conn.close()
+            with self._inbound_lock:
+                self._inbound.discard(conn)
+            try:
+                conn.close()
+            except OSError:
+                pass
 
     def recv(self, n_messages: int, timeout: float = 120.0) -> List[Tuple]:
         """Block until `n_messages` peer messages arrive (one iteration's
@@ -135,26 +159,49 @@ class SocketTransport:
 
     # ---------------------------------------------------------------- send
     def _connect(self, peer: int) -> socket.socket:
+        """Connect to a peer with jittered exponential backoff under a
+        bounded total deadline (`connect_timeout`). Start order between
+        workers doesn't matter (the Aeron-mesh introduction handshake
+        analog); an unreachable peer fails with an error naming exactly
+        who could not be reached."""
+        addr = (self.host, self.base_port + peer)
         deadline = time.monotonic() + self.connect_timeout
+        delay = self.CONNECT_BACKOFF_FLOOR
         last_err = None
-        while time.monotonic() < deadline:
+        attempts = 0
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise ConnectionError(
+                    f"rank {self.rank} could not reach peer {peer} at "
+                    f"{addr[0]}:{addr[1]} after {attempts} attempts over "
+                    f"{self.connect_timeout:.1f}s: {last_err}")
             try:
                 s = socket.create_connection(
-                    (self.host, self.base_port + peer), timeout=2.0)
+                    addr, timeout=min(2.0, max(remaining, 0.1)))
                 s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 return s
-            except OSError as e:       # peer not up yet — retry
+            except OSError as e:       # peer not up yet — back off, retry
                 last_err = e
-                time.sleep(0.05)
-        raise ConnectionError(
-            f"rank {self.rank} could not reach peer {peer}: {last_err}")
+                attempts += 1
+                sleep = min(delay * (0.5 + self._jitter.random()),
+                            max(deadline - time.monotonic(), 0.0))
+                if sleep > 0:
+                    time.sleep(sleep)
+                delay = min(delay * 2, self.CONNECT_BACKOFF_CAP)
 
     def broadcast(self, sender: int, message: Tuple):
+        if self._closed:
+            raise RuntimeError(
+                f"rank {self.rank}: broadcast on a closed transport")
         data = _encode_message(message)
         with self._lock:
             for peer in range(self.n_workers):
                 if peer == self.rank:
                     continue
+                if self.send_filter is not None \
+                        and not self.send_filter(peer):
+                    continue           # injected message drop (util/faults)
                 if peer not in self._out:
                     self._out[peer] = self._connect(peer)
                 self._out[peer].sendall(data)
@@ -162,11 +209,26 @@ class SocketTransport:
                 self.bytes_sent += len(data)
 
     def close(self):
-        self._closed = True
+        """Idempotent and safe to call concurrently with the accept/reader
+        threads (or a second close): the first caller flips `_closed` under
+        its own lock, later callers return immediately; closing the inbound
+        sockets unblocks any reader mid-recv."""
+        with self._close_lock:
+            if self._closed:
+                return
+            self._closed = True
         try:
             self._listener.close()
         except OSError:
             pass
+        with self._inbound_lock:
+            inbound = list(self._inbound)
+            self._inbound.clear()
+        for c in inbound:              # unblock readers stuck in recv
+            try:
+                c.close()
+            except OSError:
+                pass
         with self._lock:
             for s in self._out.values():
                 try:

@@ -242,6 +242,14 @@ class ParallelWrapper:
 
     # ------------------------------------------------------------------ fit
     def fit(self, data, epochs: int = 1, batch_size: int = 32):
+        # donated-buffer safety: see util/params.owned_leaf — the sync
+        # step donates the wrapped net's params, which must not alias
+        # numpy memory from a checkpoint/import
+        from deeplearning4j_tpu.util import params as param_util
+        net = self.model
+        net.params = param_util.own_tree(net.params)
+        net.state = param_util.own_tree(net.state)
+        net.opt_state = param_util.own_tree(net.opt_state)
         if self._is_graph:
             source = data
         else:

@@ -4,6 +4,10 @@ from deeplearning4j_tpu.train.listeners import (
     EvaluativeListener, CheckpointListener, ProfilerListener,
     DivergenceListener, TrainingDivergedError,
 )
+from deeplearning4j_tpu.train.resilience import (
+    CheckpointManager, FaultPolicy, FitReport, PreemptionGuard,
+    ResilientTrainer,
+)
 from deeplearning4j_tpu.train.solvers import (
     BackTrackLineSearch, ConjugateGradient, LBFGS, LineGradientDescent,
 )
@@ -13,6 +17,8 @@ __all__ = [
     "CollectScoresIterationListener", "TimeIterationListener",
     "EvaluativeListener", "CheckpointListener", "ProfilerListener",
     "DivergenceListener", "TrainingDivergedError",
+    "CheckpointManager", "FaultPolicy", "FitReport", "PreemptionGuard",
+    "ResilientTrainer",
     "BackTrackLineSearch", "LineGradientDescent", "ConjugateGradient",
     "LBFGS",
 ]

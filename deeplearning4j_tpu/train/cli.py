@@ -48,6 +48,19 @@ def build_parser() -> argparse.ArgumentParser:
                    help="substitute deterministic synthetic data when the "
                         "dataset cache is missing (pipeline testing only); "
                         "without this flag a missing cache is an error")
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="enable fault-tolerant training (ResilientTrainer): "
+                        "atomic manifest-tracked checkpoints in this "
+                        "directory, SIGTERM/SIGINT preemption handling, "
+                        "per-step fault policy (docs/FAULT_TOLERANCE.md)")
+    p.add_argument("--resume", action="store_true",
+                   help="auto-resume from the newest valid checkpoint in "
+                        "--checkpoint-dir (bitwise-identical continuation); "
+                        "--epochs is then the TOTAL epoch target")
+    p.add_argument("--save-every-iterations", type=int, default=50,
+                   help="checkpoint cadence for --checkpoint-dir runs")
+    p.add_argument("--keep-last", type=int, default=3,
+                   help="checkpoints retained by manifest pruning")
     return p
 
 
@@ -111,7 +124,36 @@ def main(argv=None) -> int:
         print(f"dashboard: {ui_server.url}", file=sys.stderr)
     net.set_listeners(*listeners)
 
-    if args.mode == "single":
+    if args.resume and not args.checkpoint_dir:
+        raise SystemExit("--resume requires --checkpoint-dir")
+    if args.checkpoint_dir:
+        # resilient path: atomic checkpoint/auto-resume + fault policy;
+        # wraps the plain net (single) or the sync-mode ParallelWrapper
+        from deeplearning4j_tpu.train.resilience import ResilientTrainer
+        target = net
+        if args.mode == "sync":
+            target = ParallelWrapper(net, mode=TrainingMode.SYNC_GRADIENTS)
+        elif args.mode == "averaging":
+            raise SystemExit("--checkpoint-dir supports --mode single|sync "
+                             "(AVERAGING replica state is not resumable)")
+        trainer = ResilientTrainer(
+            target, args.checkpoint_dir,
+            save_every_n_iterations=args.save_every_iterations,
+            keep_last=args.keep_last, resume=args.resume)
+        report = trainer.fit(iterator, epochs=args.epochs,
+                             batch_size=args.batch_size)
+        if report.preempted or report.diverged:
+            # incomplete run (preempted, or diverged and rolled back to an
+            # older checkpoint): no output model, no success JSON, distinct
+            # exit code so callers can't mistake it for a finished job
+            print(json.dumps({"preempted": report.preempted,
+                              "diverged": report.diverged,
+                              "iterations": net.iteration_count,
+                              "resume_with": "--resume"}), file=sys.stderr)
+            if ui_server is not None:
+                ui_server.stop()
+            return 3 if report.preempted else 4
+    elif args.mode == "single":
         net.fit(iterator, epochs=args.epochs)
     else:
         wrapper = ParallelWrapper(

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import logging
 import os
+import re
 import time
 from typing import Callable, List, Optional
 
@@ -168,7 +169,40 @@ class CheckpointListener(TrainingListener):
         self._pending: List = []
         os.makedirs(directory, exist_ok=True)
 
+    def _prune(self):
+        """keep_last retention by directory scan: only files matching the
+        tag kinds THIS listener writes (checkpoint_iter_* and/or
+        checkpoint_epoch_*) count and get deleted — foreign files in the
+        checkpoint directory (exports, notes, resilience manifests, a
+        sibling listener's other-kind checkpoints) are ignored. Scanning
+        (vs. an in-memory list) also retires leftovers from a previous
+        run of the same job."""
+        kinds = [k for k, on in (("iter", self.every_iter),
+                                 ("epoch", self.every_epoch)) if on]
+        if not kinds:
+            return
+        pat = re.compile(rf"^checkpoint_({'|'.join(kinds)})_(\d+)\.zip$")
+        try:
+            names = os.listdir(self.dir)
+        except OSError:
+            return
+        # order by the monotone counter in the filename, NOT mtime —
+        # coarse-granularity or copied-file mtimes would make ties
+        # arbitrary and could delete the newest checkpoint. Iteration and
+        # epoch counters are not comparable to each other, so retention
+        # applies per kind (keep_last of each).
+        for kind in kinds:
+            own = sorted((int(m.group(2)), n) for n in names
+                         for m in [pat.match(n)] if m and m.group(1) == kind)
+            while len(own) > self.keep_last:
+                try:
+                    os.remove(os.path.join(self.dir, own.pop(0)[1]))
+                except OSError:
+                    pass
+
     def _save(self, model, tag: str):
+        # save_model's default atomic mode (tmp + os.replace) means a kill
+        # mid-save can never leave a truncated checkpoint zip at `path`
         from deeplearning4j_tpu.util.serialization import save_model
         path = os.path.join(self.dir, f"checkpoint_{tag}.zip")
         if self.async_save:
@@ -199,19 +233,13 @@ class CheckpointListener(TrainingListener):
                 # retention runs AFTER the file lands; the single-worker
                 # executor serializes these mutations
                 self._saved.append(path)
-                while len(self._saved) > self.keep_last:
-                    old = self._saved.pop(0)
-                    if os.path.exists(old):
-                        os.remove(old)
+                self._prune()
 
             self._pending.append(self._executor.submit(job))
         else:
             save_model(model, path)
             self._saved.append(path)
-            while len(self._saved) > self.keep_last:
-                old = self._saved.pop(0)
-                if os.path.exists(old):
-                    os.remove(old)
+            self._prune()
 
     def _raise_pending_errors(self, block: bool):
         still = []

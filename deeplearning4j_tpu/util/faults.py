@@ -1,0 +1,170 @@
+"""Deterministic fault-injection harness for resilience testing.
+
+The production story (docs/FAULT_TOLERANCE.md) is only credible if the
+recovery paths are exercised: this module injects the three failure
+families a preemptible TPU fleet actually produces —
+
+- **divergence**: a NaN/Inf loss at a chosen step (optimizer state or
+  data corruption, bf16 overflow);
+- **transient errors**: a retryable exception raised at a chosen step
+  (DCN hiccup, preempted host, flaky storage);
+- **hard faults**: a simulated crash (kill -9 analog, raised as a
+  ``BaseException`` so no recovery layer can swallow it), a simulated
+  preemption notice (SIGTERM analog), and dropped transport messages.
+
+Everything is deterministic — schedules are explicit step sets (or an
+``every=n`` cadence), so tests and the chaos tool reproduce bit-for-bit.
+``FaultInjector.from_env()`` reads ``DL4J_TPU_FAULTS`` so any entry point
+(CLI, chaos tool, CI) can be run under faults without code changes:
+
+    DL4J_TPU_FAULTS="nan_at=3,4;transient_every=5;crash_at=11"
+
+`ResilientTrainer` (train/resilience.py) consults the injector at its
+step boundaries; `attach_transport_faults` wires the message-drop
+schedule into a `SocketTransport`.
+"""
+from __future__ import annotations
+
+import logging
+import os
+from typing import Iterable, Optional, Set, Tuple
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+
+class TransientFaultError(RuntimeError):
+    """A retryable failure (network hiccup / preempted peer / flaky IO).
+    The resilience layer's backoff-and-retry policy treats this class —
+    and real ConnectionError/TimeoutError/OSError — as transient."""
+
+
+class SimulatedCrash(BaseException):
+    """A hard kill (SIGKILL / machine loss analog). Derives from
+    BaseException ON PURPOSE: no except-Exception recovery path may
+    swallow it — exactly like a real kill, the process dies with
+    whatever checkpoints already landed on disk."""
+
+
+def _parse_steps(spec: str) -> Set[int]:
+    return {int(tok) for tok in spec.split(",") if tok.strip() != ""}
+
+
+class FaultInjector:
+    """Deterministic, schedule-driven fault source.
+
+    Step indices refer to the trainer's global *dispatch* counter (batches
+    consumed across the whole fit, starting at 0). Each scheduled fault
+    fires exactly once per step index — a retry of the same step does not
+    re-fire the fault, which is what makes transient-retry testable.
+
+    Parameters
+    ----------
+    nan_at:           steps whose loss is replaced with NaN (divergence).
+    transient_at:     steps that raise TransientFaultError before dispatch.
+    transient_every:  additionally raise every n-th step (n > 0).
+    crash_at:         step that raises SimulatedCrash (uncatchable by the
+                      retry layer; the test harness catches it).
+    preempt_at:       step at which `should_preempt` turns True (SIGTERM
+                      analog delivered through the trainer's flag).
+    drop_send_at:     0-based outbound message ordinals a wrapped
+                      SocketTransport silently drops.
+    """
+
+    def __init__(self, nan_at: Iterable[int] = (),
+                 transient_at: Iterable[int] = (),
+                 transient_every: Optional[int] = None,
+                 crash_at: Optional[int] = None,
+                 preempt_at: Optional[int] = None,
+                 drop_send_at: Iterable[int] = ()):
+        self.nan_at = set(nan_at)
+        self.transient_at = set(transient_at)
+        self.transient_every = transient_every
+        self.crash_at = crash_at
+        self.preempt_at = preempt_at
+        self.drop_send_at = set(drop_send_at)
+        self._fired: Set[Tuple[str, int]] = set()
+        self.nans_injected = 0
+        self.transients_injected = 0
+        self.sends_dropped = 0
+
+    # ------------------------------------------------------------- parsing
+    @classmethod
+    def from_env(cls, var: str = "DL4J_TPU_FAULTS") -> Optional["FaultInjector"]:
+        """Build an injector from ``nan_at=..;transient_every=..`` env
+        syntax; None when the variable is unset/empty."""
+        spec = os.environ.get(var, "").strip()
+        if not spec:
+            return None
+        kw: dict = {}
+        for part in spec.split(";"):
+            part = part.strip()
+            if not part:
+                continue
+            key, _, val = part.partition("=")
+            key = key.strip()
+            if key in ("nan_at", "transient_at", "drop_send_at"):
+                kw[key] = _parse_steps(val)
+            elif key in ("transient_every", "crash_at", "preempt_at"):
+                kw[key] = int(val)
+            else:
+                raise ValueError(f"{var}: unknown fault key {key!r}")
+        log.warning("fault injection ACTIVE from $%s: %s", var, spec)
+        return cls(**kw)
+
+    def _once(self, kind: str, step: int) -> bool:
+        if (kind, step) in self._fired:
+            return False
+        self._fired.add((kind, step))
+        return True
+
+    # ----------------------------------------------------------- injection
+    def before_step(self, step: int):
+        """Called before dispatching step `step`; may raise."""
+        if self.crash_at is not None and step == self.crash_at \
+                and self._once("crash", step):
+            log.warning("injecting simulated crash at step %d", step)
+            raise SimulatedCrash(f"injected crash at step {step}")
+        transient = step in self.transient_at or (
+            self.transient_every and step > 0
+            and step % self.transient_every == 0)
+        if transient and self._once("transient", step):
+            self.transients_injected += 1
+            log.warning("injecting transient fault at step %d", step)
+            raise TransientFaultError(f"injected transient fault at step {step}")
+
+    def corrupt_loss(self, step: int, loss: float) -> float:
+        """Replace the loss with NaN on scheduled steps (the observable
+        signature of a NaN gradient — the skip-step guard keys off it)."""
+        if step in self.nan_at:
+            self.nans_injected += 1
+            log.warning("injecting NaN loss at step %d", step)
+            return float("nan")
+        return loss
+
+    def should_preempt(self, step: int) -> bool:
+        return self.preempt_at is not None and step >= self.preempt_at
+
+    # ------------------------------------------------------------ transport
+    def send_filter(self, peer: int, ordinal: int) -> bool:
+        """False = drop this outbound message (ordinal counts every send
+        attempt on the transport, across peers, starting at 0)."""
+        if ordinal in self.drop_send_at:
+            self.sends_dropped += 1
+            log.warning("dropping transport message %d to peer %d",
+                        ordinal, peer)
+            return False
+        return True
+
+
+def attach_transport_faults(transport, injector: FaultInjector):
+    """Wire the injector's message-drop schedule into a SocketTransport
+    (its `broadcast` consults `send_filter` per outbound message)."""
+    ordinal = {"n": 0}
+
+    def fltr(peer: int) -> bool:
+        i = ordinal["n"]
+        ordinal["n"] += 1
+        return injector.send_filter(peer, i)
+
+    transport.send_filter = fltr
+    return transport

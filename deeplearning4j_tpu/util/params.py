@@ -40,6 +40,26 @@ def num_params(tree) -> int:
     return int(sum(np.prod(leaf.shape) for _, leaf in iter_leaves(tree)))
 
 
+def owned_leaf(a):
+    """Host/array leaf -> XLA-owned device buffer. jnp.asarray on a numpy
+    array can be ZERO-COPY on CPU backends: the jax array aliases
+    numpy-owned memory, and DONATING it into a jitted train step
+    (donate_argnums) frees/reuses memory XLA does not own — heap
+    corruption that surfaces as garbage params or a segfault at a random
+    later point (the historical serde-resume / keras-import crash
+    class). copy=True forces a buffer XLA owns outright."""
+    return jnp.array(a, copy=True)
+
+
+def own_tree(tree):
+    """owned_leaf over a whole pytree (params / optimizer state / layer
+    state). Called once at every fit() entry so that params assigned from
+    ANY host source (checkpoint restore, keras/dl4j import,
+    set_params_flat, user numpy) are safe to donate — one extra copy per
+    fit call, not per step."""
+    return jax.tree_util.tree_map(owned_leaf, tree)
+
+
 def params_to_flat(tree) -> jnp.ndarray:
     """Flatten a param pytree to one 1-D vector in canonical order."""
     leaves = [jnp.ravel(leaf) for _, leaf in iter_leaves(tree)]

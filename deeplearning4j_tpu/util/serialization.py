@@ -17,13 +17,16 @@ from __future__ import annotations
 
 import io
 import json
+import os
 import zipfile
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from deeplearning4j_tpu.util.params import iter_leaves
+# owned_leaf: the donated-buffer-safety copy (single-sourced in params)
+from deeplearning4j_tpu.util.params import iter_leaves, owned_leaf as _owned
 
 _FORMAT_VERSION = 1
 
@@ -37,6 +40,8 @@ def _tree_to_npz_bytes(tree) -> bytes:
     return buf.getvalue()
 
 
+
+
 def _npz_bytes_to_tree(data: bytes) -> dict:
     buf = io.BytesIO(data)
     loaded = np.load(buf)
@@ -46,7 +51,7 @@ def _npz_bytes_to_tree(data: bytes) -> dict:
         node = tree
         for p in parts[:-1]:
             node = node.setdefault(p, {})
-        node[parts[-1]] = jnp.asarray(loaded[key])
+        node[parts[-1]] = _owned(loaded[key])
     return tree
 
 
@@ -67,8 +72,16 @@ def _restore_like(template, loaded):
     return loaded if loaded is not None else template
 
 
-def save_model(model, path: str, save_updater: bool = True):
-    """Write a model checkpoint zip (ModelSerializer.writeModel)."""
+def save_model(model, path: str, save_updater: bool = True,
+               atomic: bool = True, extra_entries: Optional[dict] = None):
+    """Write a model checkpoint zip (ModelSerializer.writeModel).
+
+    `atomic` (default): the zip is written to a same-directory temp file
+    and `os.replace`d into place, so a kill mid-save can never leave a
+    truncated checkpoint at `path` — readers see either the old complete
+    file or the new complete file. `extra_entries` ({name: str|bytes})
+    adds caller entries to the archive (the resilience layer stores its
+    RNG key / normalizer stats this way)."""
     from deeplearning4j_tpu.nn.graph import ComputationGraph
     from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 
@@ -85,14 +98,31 @@ def save_model(model, path: str, save_updater: bool = True):
         "iteration_count": model.iteration_count,
         "epoch_count": model.epoch_count,
     }
-    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
-        zf.writestr("configuration.json", model.conf.to_json())
-        zf.writestr("coefficients.npz", _tree_to_npz_bytes(model.params))
-        zf.writestr("state.npz", _tree_to_npz_bytes(model.state))
-        zf.writestr("metadata.json", json.dumps(meta))
-        if save_updater and model.opt_state is not None:
-            from flax import serialization
-            zf.writestr("updaterState.bin", serialization.to_bytes(model.opt_state))
+    # atomic mode needs a real filesystem path (file-like targets — the
+    # estimator pickle path writes into a BytesIO — stream directly)
+    atomic = atomic and isinstance(path, (str, os.PathLike))
+    target = f"{path}.tmp.{os.getpid()}" if atomic else path
+    try:
+        with zipfile.ZipFile(target, "w", zipfile.ZIP_DEFLATED) as zf:
+            zf.writestr("configuration.json", model.conf.to_json())
+            zf.writestr("coefficients.npz", _tree_to_npz_bytes(model.params))
+            zf.writestr("state.npz", _tree_to_npz_bytes(model.state))
+            zf.writestr("metadata.json", json.dumps(meta))
+            if save_updater and model.opt_state is not None:
+                from flax import serialization
+                zf.writestr("updaterState.bin",
+                            serialization.to_bytes(model.opt_state))
+            for name, payload in (extra_entries or {}).items():
+                zf.writestr(name, payload)
+        if atomic:
+            os.replace(target, path)
+    except BaseException:
+        if atomic:
+            try:
+                os.remove(target)
+            except OSError:
+                pass
+        raise
     return path
 
 
@@ -125,8 +155,11 @@ def _restore(path: str, expect_type=None, load_updater: bool = True):
         model._build_optimizer()
         if load_updater and "updaterState.bin" in zf.namelist():
             from flax import serialization
-            model.opt_state = serialization.from_bytes(
-                model.opt_state, zf.read("updaterState.bin"))
+            # from_bytes yields numpy leaves — take owned copies so the
+            # first donated train step can't free numpy-owned memory
+            model.opt_state = jax.tree_util.tree_map(
+                _owned, serialization.from_bytes(
+                    model.opt_state, zf.read("updaterState.bin")))
     return model
 
 

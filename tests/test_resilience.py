@@ -1,0 +1,483 @@
+"""Fault-tolerant training: resilient fit loop, atomic checkpoint/resume,
+preemption, per-step fault policy — all driven through the deterministic
+fault-injection harness (util/faults.py). No sleep exceeds the backoff
+floor (FaultPolicy backoff_base is set to ~1ms throughout)."""
+import json
+import os
+import signal
+import threading
+import time
+import zipfile
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data.iterator import ArrayDataSetIterator
+from deeplearning4j_tpu.nn.conf.base import InputType
+from deeplearning4j_tpu.nn.conf.network import (
+    GraphBuilder, NeuralNetConfiguration,
+)
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updaters import Adam
+from deeplearning4j_tpu.train import (
+    CheckpointManager, FaultPolicy, ResilientTrainer, TrainingDivergedError,
+    TrainingListener,
+)
+from deeplearning4j_tpu.util.faults import (
+    FaultInjector, SimulatedCrash, TransientFaultError,
+    attach_transport_faults,
+)
+from deeplearning4j_tpu.util.serialization import load_model
+
+rs = np.random.RandomState(0)
+X = rs.randn(120, 6).astype("float32")
+Y = np.eye(3, dtype="float32")[rs.randint(0, 3, 120)]
+
+FAST = FaultPolicy(backoff_base=0.001, backoff_max=0.004)
+
+
+def _net(seed=7):
+    conf = (NeuralNetConfiguration.Builder().seed(seed).updater(Adam(1e-2))
+            .list()
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(6)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _data(shuffle=False):
+    return ArrayDataSetIterator(X, Y, batch_size=30, shuffle=shuffle, seed=5)
+
+
+def _flat(net):
+    return np.asarray(net.params_flat())
+
+
+def _reference_params(tmp_path, epochs=3):
+    """Params/score of an uninterrupted resilient fit (the parity target)."""
+    net = _net()
+    ResilientTrainer(net, str(tmp_path / "ref"), save_every_n_iterations=100,
+                     policy=FAST).fit(_data(), epochs=epochs)
+    return _flat(net), net.score(), net.iteration_count
+
+
+# --------------------------------------------------------------- resume parity
+def test_resume_parity_after_crash(tmp_path):
+    """Kill-at-k + auto-resume reaches bitwise-identical params, updater
+    state effects, RNG stream, and final score vs an uninterrupted run —
+    including an epoch-dependent shuffling iterator."""
+    ref = _net()
+    ResilientTrainer(ref, str(tmp_path / "a"), save_every_n_iterations=100,
+                     policy=FAST).fit(_data(shuffle=True), epochs=3)
+
+    crashed = _net()
+    with pytest.raises(SimulatedCrash):
+        ResilientTrainer(crashed, str(tmp_path / "b"),
+                         save_every_n_iterations=2, policy=FAST,
+                         injector=FaultInjector(crash_at=5)
+                         ).fit(_data(shuffle=True), epochs=3)
+
+    resumed = _net()
+    rep = ResilientTrainer(resumed, str(tmp_path / "b"),
+                           save_every_n_iterations=2, policy=FAST
+                           ).fit(_data(shuffle=True), epochs=3)
+    assert rep.resumed_from is not None
+    np.testing.assert_array_equal(_flat(ref), _flat(resumed))
+    assert ref.score() == resumed.score()
+    assert ref.iteration_count == resumed.iteration_count
+    assert ref.epoch_count == resumed.epoch_count
+
+
+def test_resume_parity_computation_graph(tmp_path):
+    def graph():
+        g = (GraphBuilder(NeuralNetConfiguration.Builder().seed(3)
+                          .updater(Adam(1e-2)))
+             .add_inputs("in").set_input_types(InputType.feed_forward(6)))
+        g.add_layer("d", DenseLayer(n_out=12), "in")
+        g.add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                       loss="mcxent"), "d")
+        g.set_outputs("out")
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        return ComputationGraph(g.build()).init()
+
+    ref = graph()
+    ResilientTrainer(ref, str(tmp_path / "a"), save_every_n_iterations=100,
+                     policy=FAST).fit(_data(), epochs=2)
+    crashed = graph()
+    with pytest.raises(SimulatedCrash):
+        ResilientTrainer(crashed, str(tmp_path / "b"),
+                         save_every_n_iterations=2, policy=FAST,
+                         injector=FaultInjector(crash_at=3)
+                         ).fit(_data(), epochs=2)
+    resumed = graph()
+    rep = ResilientTrainer(resumed, str(tmp_path / "b"),
+                           save_every_n_iterations=2, policy=FAST
+                           ).fit(_data(), epochs=2)
+    assert rep.resumed_from is not None
+    np.testing.assert_array_equal(_flat(ref), _flat(resumed))
+
+
+def test_resume_parity_parallel_wrapper(tmp_path):
+    from deeplearning4j_tpu.parallel import ParallelWrapper
+    ref = _net()
+    ResilientTrainer(ParallelWrapper(ref), str(tmp_path / "a"),
+                     save_every_n_iterations=100, policy=FAST
+                     ).fit(_data(), epochs=2)
+    crashed = _net()
+    with pytest.raises(SimulatedCrash):
+        ResilientTrainer(ParallelWrapper(crashed), str(tmp_path / "b"),
+                         save_every_n_iterations=2, policy=FAST,
+                         injector=FaultInjector(crash_at=3)
+                         ).fit(_data(), epochs=2)
+    resumed = _net()
+    rep = ResilientTrainer(ParallelWrapper(resumed), str(tmp_path / "b"),
+                           save_every_n_iterations=2, policy=FAST
+                           ).fit(_data(), epochs=2)
+    assert rep.resumed_from is not None
+    np.testing.assert_array_equal(_flat(ref), _flat(resumed))
+
+
+def test_completed_run_does_not_retrain_on_rerun(tmp_path):
+    net = _net()
+    t = ResilientTrainer(net, str(tmp_path), save_every_n_iterations=100,
+                         policy=FAST)
+    t.fit(_data(), epochs=2)
+    before = _flat(net)
+    ckpts_before = sorted(f for f in os.listdir(str(tmp_path))
+                          if f.startswith("ckpt_"))
+    rerun = _net()
+    rep = ResilientTrainer(rerun, str(tmp_path), policy=FAST
+                           ).fit(_data(), epochs=2)
+    assert rep.applied_steps == 0 and rep.resumed_from is not None
+    np.testing.assert_array_equal(before, _flat(rerun))
+    # a no-op rerun must not write duplicate final checkpoints (they would
+    # rotate real training history out of keep_last)
+    assert sorted(f for f in os.listdir(str(tmp_path))
+                  if f.startswith("ckpt_")) == ckpts_before
+
+
+# ---------------------------------------------------------------- fault policy
+def test_nan_steps_skipped_without_crashing(tmp_path):
+    net = _net()
+    rep = ResilientTrainer(net, str(tmp_path), save_every_n_iterations=100,
+                           policy=FAST,
+                           injector=FaultInjector(nan_at=(3, 7))
+                           ).fit(_data(), epochs=3)
+    assert rep.skipped_steps == 2
+    assert rep.applied_steps == 10          # 12 batches - 2 skipped
+    assert not rep.diverged
+    assert np.isfinite(_flat(net)).all()
+    assert np.isfinite(net.score())
+    # skipped batches don't count as optimizer steps (DL4J iteration
+    # semantics: one iteration = one applied update)
+    assert net.iteration_count == 10
+
+
+def test_consecutive_skip_threshold_restores_last_good_checkpoint(tmp_path):
+    net = _net()
+    rep = ResilientTrainer(
+        net, str(tmp_path), save_every_n_iterations=2,
+        policy=FaultPolicy(max_consecutive_skips=2, backoff_base=0.001),
+        injector=FaultInjector(nan_at=range(4, 50))).fit(_data(), epochs=3)
+    assert rep.diverged
+    assert rep.restored_checkpoint is not None
+    # graceful degradation: the model holds the checkpointed (good) params
+    ck = load_model(rep.restored_checkpoint)
+    np.testing.assert_array_equal(np.asarray(ck.params_flat()), _flat(net))
+    assert np.isfinite(_flat(net)).all()
+
+
+def test_unrecoverable_raise_mode(tmp_path):
+    net = _net()
+    with pytest.raises(TrainingDivergedError):
+        ResilientTrainer(
+            net, str(tmp_path), save_every_n_iterations=2,
+            policy=FaultPolicy(max_consecutive_skips=2, backoff_base=0.001,
+                               on_unrecoverable="raise"),
+            injector=FaultInjector(nan_at=range(4, 50))
+        ).fit(_data(), epochs=3)
+    assert np.isfinite(_flat(net)).all()    # restored before raising
+
+
+def test_transient_retry_is_transparent(tmp_path):
+    """A retried step is bitwise-identical to an unfaulted one (same RNG
+    sub-key, same batch, pre-step snapshot restored)."""
+    clean = _net()
+    ResilientTrainer(clean, str(tmp_path / "a"), save_every_n_iterations=100,
+                     policy=FAST).fit(_data(), epochs=3)
+    faulted = _net()
+    inj = FaultInjector(transient_at=(2, 5))
+    rep = ResilientTrainer(faulted, str(tmp_path / "b"),
+                           save_every_n_iterations=100, policy=FAST,
+                           injector=inj).fit(_data(), epochs=3)
+    assert rep.retries == 2 and inj.transients_injected == 2
+    np.testing.assert_array_equal(_flat(clean), _flat(faulted))
+
+
+def test_retry_exhaustion_checkpoints_then_raises(tmp_path):
+    net = _net()
+    trainer = ResilientTrainer(
+        net, str(tmp_path), save_every_n_iterations=100,
+        policy=FaultPolicy(max_retries=1, backoff_base=0.001,
+                           backoff_max=0.002),
+        # same step keeps faulting across retries: three distinct
+        # dispatch indices all scheduled
+        injector=_AlwaysTransient())
+    with pytest.raises(TransientFaultError):
+        trainer.fit(_data(), epochs=1)
+    # the pre-fault state was checkpointed for a later resume
+    assert trainer.ckpt.latest_valid() is not None
+
+
+class _AlwaysTransient(FaultInjector):
+    def before_step(self, step):
+        raise TransientFaultError(f"flaky forever at step {step}")
+
+
+class _StuckStep(FaultInjector):
+    """One step that fails on EVERY attempt (retry cannot save it)."""
+
+    def __init__(self, step):
+        super().__init__()
+        self._stuck = step
+
+    def before_step(self, step):
+        if step == self._stuck:
+            raise TransientFaultError(f"stuck at step {step}")
+
+
+def test_resume_parity_after_retry_exhaustion(tmp_path):
+    """The emergency checkpoint written when retries run out must rewind
+    the RNG carry to the failed step, so a resumed run re-derives the SAME
+    subkey for it — bitwise parity holds across the failure."""
+    ref_params, ref_score, _ = _reference_params(tmp_path)
+    faulted = _net()
+    with pytest.raises(TransientFaultError):
+        ResilientTrainer(faulted, str(tmp_path / "b"),
+                         save_every_n_iterations=100,
+                         policy=FaultPolicy(max_retries=1,
+                                            backoff_base=0.001,
+                                            backoff_max=0.002),
+                         injector=_StuckStep(5)).fit(_data(), epochs=3)
+    resumed = _net()
+    rep = ResilientTrainer(resumed, str(tmp_path / "b"),
+                           save_every_n_iterations=100, policy=FAST
+                           ).fit(_data(), epochs=3)
+    assert rep.resumed_from is not None
+    np.testing.assert_array_equal(ref_params, _flat(resumed))
+    assert ref_score == resumed.score()
+
+
+# ----------------------------------------------------------------- preemption
+def test_preemption_via_sigterm_checkpoints_and_resumes(tmp_path):
+    ref_params, ref_score, _ = _reference_params(tmp_path)
+
+    class Kick(TrainingListener):
+        def iteration_done(self, model, iteration, epoch, score,
+                           etl_ms=0.0, batch_size=0):
+            if iteration == 4:
+                os.kill(os.getpid(), signal.SIGTERM)
+
+    net = _net()
+    net.set_listeners(Kick())
+    rep = ResilientTrainer(net, str(tmp_path / "p"),
+                           save_every_n_iterations=100, policy=FAST
+                           ).fit(_data(), epochs=3)
+    assert rep.preempted
+    # resumable: a fresh run completes to parity with the uninterrupted one
+    resumed = _net()
+    rep2 = ResilientTrainer(resumed, str(tmp_path / "p"),
+                            save_every_n_iterations=100, policy=FAST
+                            ).fit(_data(), epochs=3)
+    assert rep2.resumed_from is not None and not rep2.preempted
+    np.testing.assert_array_equal(ref_params, _flat(resumed))
+    assert ref_score == resumed.score()
+
+
+def test_preemption_via_injector(tmp_path):
+    ref_params, _, _ = _reference_params(tmp_path)
+    net = _net()
+    rep = ResilientTrainer(net, str(tmp_path / "p"),
+                           save_every_n_iterations=100, policy=FAST,
+                           injector=FaultInjector(preempt_at=5)
+                           ).fit(_data(), epochs=3)
+    assert rep.preempted and rep.checkpoints_written >= 1
+    resumed = _net()
+    ResilientTrainer(resumed, str(tmp_path / "p"),
+                     save_every_n_iterations=100, policy=FAST
+                     ).fit(_data(), epochs=3)
+    np.testing.assert_array_equal(ref_params, _flat(resumed))
+
+
+# --------------------------------------------------------- checkpoint manager
+def test_corrupted_checkpoint_falls_back_to_previous(tmp_path):
+    net = _net()
+    trainer = ResilientTrainer(net, str(tmp_path), save_every_n_iterations=2,
+                               policy=FAST)
+    trainer.fit(_data(), epochs=1)
+    mgr = trainer.ckpt
+    entries = mgr._read_manifest()["checkpoints"]
+    assert len(entries) >= 2
+    newest = os.path.join(str(tmp_path), entries[-1]["file"])
+    with open(newest, "wb") as f:
+        f.write(b"truncated garbage")       # kill-mid-write simulation
+    best = mgr.latest_valid()
+    assert best is not None
+    assert best["file"] == entries[-2]["file"]
+    # resume still works from the fallback
+    resumed = _net()
+    rep = ResilientTrainer(resumed, str(tmp_path), policy=FAST
+                           ).fit(_data(), epochs=1)
+    assert rep.resumed_from.endswith(entries[-2]["file"])
+
+
+def test_manager_pruning_ignores_foreign_files(tmp_path):
+    foreign = tmp_path / "exported_model.zip"
+    foreign.write_bytes(b"user data, not ours")
+    notes = tmp_path / "NOTES.txt"
+    notes.write_text("keep me")
+    net = _net()
+    ResilientTrainer(net, str(tmp_path), save_every_n_iterations=1,
+                     keep_last=2, policy=FAST).fit(_data(), epochs=1)
+    assert foreign.exists() and notes.exists()
+    ckpts = [f for f in os.listdir(str(tmp_path)) if f.startswith("ckpt_")]
+    assert len(ckpts) == 2                  # keep_last enforced
+    # no temp residue from the atomic writes
+    assert not [f for f in os.listdir(str(tmp_path)) if ".tmp." in f]
+
+
+def test_checkpoint_zip_carries_rng_and_counters(tmp_path):
+    net = _net()
+    trainer = ResilientTrainer(net, str(tmp_path), save_every_n_iterations=3,
+                               policy=FAST)
+    trainer.fit(_data(), epochs=1)
+    entry = trainer.ckpt.latest_valid()
+    with zipfile.ZipFile(entry["path"]) as zf:
+        names = set(zf.namelist())
+        assert {"configuration.json", "coefficients.npz", "state.npz",
+                "updaterState.bin", "metadata.json",
+                "resilience.json"} <= names
+        extra = json.loads(zf.read("resilience.json"))
+    assert "rng" in extra and "step_in_epoch" in extra
+    assert entry["sha256"]
+
+
+def test_checkpoint_restores_normalizer(tmp_path):
+    from deeplearning4j_tpu.data.normalization import NormalizerStandardize
+    norm = NormalizerStandardize().fit(_data())
+    src = _data().set_pre_processor(norm)
+    net = _net()
+    ResilientTrainer(net, str(tmp_path), save_every_n_iterations=100,
+                     policy=FAST, normalizer=norm).fit(src, epochs=1)
+    t2 = ResilientTrainer(_net(), str(tmp_path), policy=FAST)
+    t2.fit(_data(), epochs=1)               # resume restores the normalizer
+    assert t2.normalizer is not None
+    np.testing.assert_allclose(t2.normalizer.feature_mean,
+                               norm.feature_mean)
+
+
+# --------------------------------------------------- CheckpointListener (sat.)
+def test_checkpoint_listener_atomic_and_foreign_tolerant(tmp_path):
+    from deeplearning4j_tpu.train import CheckpointListener
+    foreign = tmp_path / "precious_export.zip"
+    foreign.write_bytes(b"do not delete")
+    # a stale checkpoint from a previous run participates in retention
+    # (ordering is by the iteration number in the name — monotone across
+    # resumes — not by mtime)
+    stale = tmp_path / "checkpoint_iter_0.zip"
+    stale.write_bytes(b"old run")
+    net = _net()
+    lst = CheckpointListener(str(tmp_path), save_every_n_iterations=2,
+                             keep_last=2)
+    net.set_listeners(lst)
+    # 10 iterations -> saves at 2,4,6,8: enough for retention to engage
+    net.fit(ArrayDataSetIterator(X, Y, batch_size=12), epochs=1)
+    names = sorted(os.listdir(str(tmp_path)))
+    assert "precious_export.zip" in names       # foreign file untouched
+    assert not [n for n in names if ".tmp." in n]   # atomic: no residue
+    own = [n for n in names if n.startswith("checkpoint_")]
+    assert len(own) == 2                         # stale file pruned away
+    assert "checkpoint_iter_0.zip" not in own
+    assert own == ["checkpoint_iter_6.zip", "checkpoint_iter_8.zip"]
+    restored = load_model(os.path.join(str(tmp_path), own[-1]))
+    assert np.isfinite(np.asarray(restored.params_flat())).sum()
+
+
+# ----------------------------------------------------------- transport (sat.)
+def test_transport_connect_deadline_names_peer():
+    from deeplearning4j_tpu.parallel.transport import SocketTransport
+    tr = SocketTransport(0, 2, base_port=29750, connect_timeout=0.3)
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(ConnectionError) as ei:
+            tr.broadcast(0, (np.array([0], np.int32),
+                             np.array([0], np.int8), 0.0))
+        elapsed = time.monotonic() - t0
+        assert elapsed < 3.0                    # bounded, not 30s default
+        msg = str(ei.value)
+        assert "peer 1" in msg and "127.0.0.1:29751" in msg
+        assert "attempts" in msg
+    finally:
+        tr.close()
+
+
+def test_transport_close_idempotent_and_concurrent():
+    from deeplearning4j_tpu.parallel.transport import SocketTransport
+    a = SocketTransport(0, 2, base_port=29760, connect_timeout=5)
+    b = SocketTransport(1, 2, base_port=29760, connect_timeout=5)
+    msg = (np.array([1, 2], np.int32), np.array([1, -1], np.int8), 0.5)
+    a.broadcast(0, msg)
+    assert len(b.recv(1, timeout=10)) == 1
+    # close concurrently from several threads, twice each — no deadlock,
+    # no exception, reader threads unblocked
+    threads = [threading.Thread(target=t.close)
+               for t in (a, b) for _ in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=5)
+    assert not any(t.is_alive() for t in threads)
+    a.close(), b.close()                        # idempotent
+    with pytest.raises(RuntimeError):
+        a.broadcast(0, msg)
+
+
+def test_transport_fault_injected_message_drop():
+    from deeplearning4j_tpu.parallel.transport import SocketTransport
+    a = SocketTransport(0, 2, base_port=29770, connect_timeout=5)
+    b = SocketTransport(1, 2, base_port=29770, connect_timeout=5)
+    inj = FaultInjector(drop_send_at=(0,))
+    attach_transport_faults(a, inj)
+    msg = (np.array([1], np.int32), np.array([1], np.int8), 0.25)
+    try:
+        a.broadcast(0, msg)                     # dropped
+        with pytest.raises(TimeoutError):
+            b.recv(1, timeout=0.3)
+        a.broadcast(0, msg)                     # delivered
+        assert len(b.recv(1, timeout=10)) == 1
+        assert inj.sends_dropped == 1
+    finally:
+        a.close(), b.close()
+
+
+# ------------------------------------------------------------ faults harness
+def test_fault_injector_from_env(monkeypatch):
+    monkeypatch.setenv("DL4J_TPU_FAULTS",
+                       "nan_at=3,4; transient_every=5; crash_at=11")
+    inj = FaultInjector.from_env()
+    assert inj.nan_at == {3, 4}
+    assert inj.transient_every == 5 and inj.crash_at == 11
+    monkeypatch.setenv("DL4J_TPU_FAULTS", "")
+    assert FaultInjector.from_env() is None
+    monkeypatch.setenv("DL4J_TPU_FAULTS", "bogus_key=1")
+    with pytest.raises(ValueError):
+        FaultInjector.from_env()
+
+
+def test_fault_injector_fires_once_per_step():
+    inj = FaultInjector(transient_at=(2,))
+    with pytest.raises(TransientFaultError):
+        inj.before_step(2)
+    inj.before_step(2)          # retry of the same step passes
+    inj.before_step(3)

@@ -1,0 +1,153 @@
+#!/usr/bin/env python
+"""Chaos smoke: a short fit under randomized injected faults must still
+converge. Usable locally and from CI:
+
+    JAX_PLATFORMS=cpu python tools/chaos_fit.py --seed 3
+
+Builds a small classifier on deterministic synthetic blobs, derives a
+randomized-but-seeded fault schedule (NaN steps, transient errors, one
+mid-run crash, one preemption), runs it through ResilientTrainer in a
+crash/resume sequence, and asserts:
+
+- every run survives its faults (skips + retries, no unhandled error),
+- the killed-and-resumed sequence reaches bitwise-identical params to a
+  clean uninterrupted run,
+- the final loss improves on the initial loss (training actually worked).
+
+Exit code 0 on success, 1 on failure; prints a JSON summary either way.
+"""
+import argparse
+import json
+import os
+import random
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+
+import numpy as np  # noqa: E402
+
+
+def _blobs(n=240, d=8, k=3, seed=0):
+    rs = np.random.RandomState(seed)
+    centers = rs.randn(k, d) * 3
+    X = np.concatenate([centers[i] + rs.randn(n // k, d)
+                        for i in range(k)]).astype("float32")
+    Y = np.eye(k, dtype="float32")[np.repeat(np.arange(k), n // k)]
+    return X, Y
+
+
+def _net(seed):
+    from deeplearning4j_tpu.nn.conf.base import InputType
+    from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.updaters import Adam
+    conf = (NeuralNetConfiguration.Builder().seed(seed).updater(Adam(2e-2))
+            .list()
+            .layer(DenseLayer(n_out=24, activation="relu"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(8)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--seed", type=int, default=0,
+                   help="seeds the fault schedule AND the model")
+    p.add_argument("--epochs", type=int, default=4)
+    p.add_argument("--batch-size", type=int, default=24)
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="default: a fresh temp dir")
+    args = p.parse_args(argv)
+
+    from deeplearning4j_tpu.data.iterator import ArrayDataSetIterator
+    from deeplearning4j_tpu.train.resilience import (
+        FaultPolicy, ResilientTrainer,
+    )
+    from deeplearning4j_tpu.util.faults import FaultInjector, SimulatedCrash
+
+    X, Y = _blobs(seed=args.seed)
+    steps_per_epoch = len(X) // args.batch_size
+    total = steps_per_epoch * args.epochs
+    data = lambda: ArrayDataSetIterator(X, Y, batch_size=args.batch_size)
+    policy = FaultPolicy(backoff_base=0.001, backoff_max=0.01,
+                         max_consecutive_skips=4)
+
+    # randomized (seeded) schedule over the middle of the run: faults at
+    # the edges are covered by the unit tests; the smoke wants overlap
+    rng = random.Random(args.seed)
+    pool = list(range(1, total - 1))
+    rng.shuffle(pool)
+    nan_at = sorted(pool[:3])
+    transient_at = sorted(pool[3:6])
+    crash_at = pool[6]
+    summary = {"seed": args.seed, "total_steps": total, "nan_at": nan_at,
+               "transient_at": transient_at, "crash_at": crash_at}
+
+    ckdir = args.checkpoint_dir or tempfile.mkdtemp(prefix="chaos_fit_")
+    refdir = tempfile.mkdtemp(prefix="chaos_ref_")
+    failures = []
+    try:
+        from deeplearning4j_tpu.data.dataset import DataSet
+
+        # reference: same fault schedule minus the crash, uninterrupted
+        ref = _net(args.seed)
+        initial = float(ref.score(DataSet(X, Y)))
+        rep_ref = ResilientTrainer(
+            ref, refdir, save_every_n_iterations=10_000, policy=policy,
+            injector=FaultInjector(nan_at=nan_at, transient_at=transient_at)
+        ).fit(data(), epochs=args.epochs)
+        summary["ref"] = {"skipped": rep_ref.skipped_steps,
+                          "retries": rep_ref.retries,
+                          "score": rep_ref.final_score}
+
+        # chaos run: same schedule PLUS a hard crash, then auto-resume
+        net = _net(args.seed)
+        try:
+            ResilientTrainer(
+                net, ckdir, save_every_n_iterations=2, policy=policy,
+                injector=FaultInjector(nan_at=nan_at,
+                                       transient_at=transient_at,
+                                       crash_at=crash_at)
+            ).fit(data(), epochs=args.epochs)
+            failures.append("crash did not fire")
+        except SimulatedCrash:
+            pass
+        resumed = _net(args.seed)
+        rep = ResilientTrainer(
+            resumed, ckdir, save_every_n_iterations=2, policy=policy,
+            injector=FaultInjector(nan_at=nan_at, transient_at=transient_at)
+        ).fit(data(), epochs=args.epochs)
+        summary["resumed"] = {"resumed_from": rep.resumed_from,
+                              "skipped": rep.skipped_steps,
+                              "retries": rep.retries,
+                              "score": rep.final_score}
+
+        final = rep.final_score
+        if rep.resumed_from is None:
+            failures.append("resume did not engage")
+        if not np.array_equal(np.asarray(ref.params_flat()),
+                              np.asarray(resumed.params_flat())):
+            failures.append("crash+resume params != uninterrupted params")
+        if not np.isfinite(np.asarray(resumed.params_flat())).all():
+            failures.append("non-finite params after chaos run")
+        if not (final is not None and np.isfinite(final)
+                and final < initial):
+            failures.append(
+                f"did not converge: initial {initial} -> final {final}")
+        summary["initial_score"] = initial
+    except Exception as e:  # noqa: BLE001 - smoke must report, not die
+        failures.append(f"{type(e).__name__}: {e}")
+
+    summary["failures"] = failures
+    summary["ok"] = not failures
+    print(json.dumps(summary, indent=1))
+    return 0 if not failures else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

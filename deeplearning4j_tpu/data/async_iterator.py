@@ -14,6 +14,7 @@ from __future__ import annotations
 import os
 import queue
 import threading
+import time
 
 import jax
 import numpy as np
@@ -45,14 +46,31 @@ def prefetch_iterable(source, transform=None, queue_size: int = 2):
     live there), yield in order. The device-side analog of DL4J's
     prefetch buffer for arbitrary item types (the graph container's
     MultiDataSet stream uses this; DataSet streams use
-    AsyncDataSetIterator)."""
+    AsyncDataSetIterator).
+
+    Telemetry (monitor/): `etl_queue_depth` tracks the prefetch buffer
+    fill, `etl_fetch_wait_seconds` how long the consumer (the train
+    loop) blocked on it — a consistently empty queue + large waits means
+    the fit is ETL-bound, not compute-bound. Worker-side staging shows
+    up as `etl/stage` spans on the prefetch thread's trace track."""
+    from deeplearning4j_tpu import monitor
     q: "queue.Queue" = queue.Queue(maxsize=int(queue_size))
     stop = threading.Event()
+    m_depth = monitor.gauge("etl_queue_depth",
+                            "Prefetch queue fill (async ETL)")
+    m_wait = monitor.histogram("etl_fetch_wait_seconds",
+                               "Consumer wait on the prefetch queue")
+    m_batches = monitor.counter("etl_batches_prefetched_total",
+                                "Batches staged by prefetch workers")
+    m_stage = monitor.histogram("etl_stage_seconds",
+                                "Worker-side batch staging (cast + "
+                                "device_put + callback)")
 
     def put(item) -> bool:
         while not stop.is_set():
             try:
                 q.put(item, timeout=0.1)
+                m_depth.set(q.qsize())
                 return True
             except queue.Full:
                 continue
@@ -64,7 +82,11 @@ def prefetch_iterable(source, transform=None, queue_size: int = 2):
                 if stop.is_set():
                     return
                 if transform is not None:
-                    item = transform(item)
+                    t0 = time.perf_counter()
+                    with monitor.span("etl/stage"):
+                        item = transform(item)
+                    m_stage.observe(time.perf_counter() - t0)
+                m_batches.inc()
                 if not put(item):
                     return
         except BaseException as e:    # surface worker errors to the consumer
@@ -72,11 +94,15 @@ def prefetch_iterable(source, transform=None, queue_size: int = 2):
             return
         put(_SENTINEL)
 
-    t = threading.Thread(target=worker, daemon=True)
+    t = threading.Thread(target=worker, daemon=True,
+                         name="etl-prefetch")
     t.start()
     try:
         while True:
+            t0 = time.perf_counter()
             item = q.get()
+            m_wait.observe(time.perf_counter() - t0)
+            m_depth.set(q.qsize())
             if item is _SENTINEL:
                 break
             if isinstance(item, BaseException):

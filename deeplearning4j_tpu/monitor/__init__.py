@@ -1,0 +1,47 @@
+"""Unified telemetry: metrics registry + trace spans.
+
+The production observability layer the reference stack never had (its
+StatsListener feeds a dashboard; it cannot answer "which 1% of steps are
+slow and is it compute, ETL, or comms"). Two dependency-free halves:
+
+- **Metrics** (monitor/metrics.py): thread-safe labeled counters /
+  gauges / fixed-bucket histograms in a process-global registry,
+  exposed as Prometheus text at ``GET /metrics`` on UIServer and as
+  `dump()` / `summary()` dicts for tools and tests.
+- **Tracing** (monitor/trace.py): `span("name", **attrs)` context
+  manager — zero-cost while disabled — producing thread-aware Chrome
+  trace-event JSON loadable in Perfetto / chrome://tracing, with
+  optional mirroring into jax.profiler trace annotations.
+
+Everything in-tree records into the default registry: the fit loops
+(step wall time, host sync, examples/sec, score), the async ETL pipeline
+(queue depth, fetch wait), the socket transport (bytes, latency,
+reconnects, drops), ResilientTrainer (checkpoint IO, retries, NaN skips,
+resumes, preemptions), and ParallelInference (request latency, batch
+size, queue depth, timeouts). docs/OBSERVABILITY.md catalogs the metric
+names and walks through a trace capture.
+
+Quickstart:
+
+    from deeplearning4j_tpu import monitor
+    monitor.enable_tracing()
+    net.fit(data, epochs=1)                   # instrumented end to end
+    monitor.save_trace("/tmp/fit_trace.json") # load in ui.perfetto.dev
+    print(monitor.prometheus_text())          # or scrape UIServer /metrics
+"""
+from deeplearning4j_tpu.monitor.metrics import (
+    DEFAULT_BUCKETS, REGISTRY, Counter, Gauge, Histogram, MetricsRegistry,
+    counter, dump, gauge, histogram, prometheus_text, summary,
+)
+from deeplearning4j_tpu.monitor.trace import (
+    add_span, clear_trace, disable_tracing, enable_tracing, instant,
+    save_trace, span, trace_events, tracing_enabled,
+)
+
+__all__ = [
+    "DEFAULT_BUCKETS", "REGISTRY", "Counter", "Gauge", "Histogram",
+    "MetricsRegistry", "counter", "dump", "gauge", "histogram",
+    "prometheus_text", "summary",
+    "add_span", "clear_trace", "disable_tracing", "enable_tracing",
+    "instant", "save_trace", "span", "trace_events", "tracing_enabled",
+]

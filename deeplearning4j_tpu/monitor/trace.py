@@ -1,0 +1,188 @@
+"""Trace half of the telemetry subsystem (see monitor/__init__.py).
+
+`span("name", **attrs)` is a context manager that records one complete
+event per dynamic extent — thread-aware, nestable, exported as Chrome
+trace-event JSON that Perfetto / chrome://tracing load directly. Use it
+to see WHERE a training step's wall time goes: the fit loops bracket the
+compiled step and the loss host-sync, the prefetch worker brackets ETL,
+ResilientTrainer brackets checkpoint IO, ParallelInference brackets
+batches — all on their own thread tracks.
+
+Zero-cost-when-disabled is the hard requirement: tracing is off by
+default, `span()` then returns a shared no-op context manager (no
+allocation, no clock read, no lock), and `add_span()` returns
+immediately. Enabling costs two `perf_counter_ns` reads and one
+lock-guarded list append per span — still no device->host syncs, so the
+jitted fast path is untouched either way.
+
+Optionally (`enable_tracing(jax_annotations=True)`) each span also
+enters a `jax.profiler.TraceAnnotation`, so the same names show up
+inside an XLA device profile captured with `jax.profiler.trace` /
+ProfilerListener.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import List, Optional
+
+_lock = threading.Lock()
+_events: List[dict] = []
+_thread_names: dict = {}
+_enabled = False
+_jax_annotations = False
+_MAX_EVENTS = 1_000_000          # runaway-loop backstop (~hundreds of MB)
+
+
+def _now_us() -> float:
+    return time.perf_counter_ns() / 1e3
+
+
+class _NullSpan:
+    """Stateless reusable no-op: what span() hands out while disabled."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL = _NullSpan()
+
+
+class _Span:
+    __slots__ = ("name", "args", "t0", "_ann")
+
+    def __init__(self, name: str, args: dict):
+        self.name = name
+        self.args = args
+        self._ann = None
+
+    def __enter__(self):
+        if _jax_annotations:
+            try:
+                import jax
+                self._ann = jax.profiler.TraceAnnotation(self.name)
+                self._ann.__enter__()
+            except Exception:
+                self._ann = None
+        self.t0 = _now_us()
+        return self
+
+    def __exit__(self, *exc):
+        t1 = _now_us()
+        if self._ann is not None:
+            try:
+                self._ann.__exit__(*exc)
+            except Exception:
+                pass
+        _record(self.name, self.t0, t1, self.args)
+        return False
+
+
+def _record(name: str, t0_us: float, t1_us: float, args: dict):
+    tid = threading.get_ident()
+    ev = {"name": name, "ph": "X", "ts": t0_us,
+          "dur": max(t1_us - t0_us, 0.0), "pid": os.getpid(), "tid": tid}
+    if args:
+        ev["args"] = {k: _jsonable(v) for k, v in args.items()}
+    tname = threading.current_thread().name
+    with _lock:
+        if len(_events) >= _MAX_EVENTS:
+            return
+        _events.append(ev)
+        _thread_names[tid] = tname
+
+
+def _jsonable(v):
+    if isinstance(v, (str, int, float, bool)) or v is None:
+        return v
+    return str(v)
+
+
+def span(name: str, **attrs):
+    """Context manager timing one dynamic extent. No-op (shared null
+    object) while tracing is disabled."""
+    if not _enabled:
+        return _NULL
+    return _Span(name, attrs)
+
+
+def add_span(name: str, start_s: float, end_s: float, **attrs):
+    """Record a complete event from `time.perf_counter()` stamps already
+    taken — for loops that measure a phase anyway (ETL timers in the fit
+    loops) and shouldn't pay a second pair of clock reads."""
+    if not _enabled:
+        return
+    _record(name, start_s * 1e6, end_s * 1e6, attrs)
+
+
+def instant(name: str, **attrs):
+    """Record an instant event (a point mark: preemption, resume, skip)."""
+    if not _enabled:
+        return
+    tid = threading.get_ident()
+    ev = {"name": name, "ph": "i", "ts": _now_us(), "pid": os.getpid(),
+          "tid": tid, "s": "t"}
+    if attrs:
+        ev["args"] = {k: _jsonable(v) for k, v in attrs.items()}
+    with _lock:
+        if len(_events) < _MAX_EVENTS:
+            _events.append(ev)
+            _thread_names[tid] = threading.current_thread().name
+
+
+def enable_tracing(jax_annotations: bool = False):
+    """Start recording spans (idempotent). `jax_annotations=True`
+    additionally mirrors every span into jax.profiler.TraceAnnotation so
+    device profiles captured alongside carry the same names."""
+    global _enabled, _jax_annotations
+    _jax_annotations = bool(jax_annotations)
+    _enabled = True
+
+
+def disable_tracing():
+    global _enabled, _jax_annotations
+    _enabled = False
+    _jax_annotations = False
+
+
+def tracing_enabled() -> bool:
+    return _enabled
+
+
+def clear_trace():
+    with _lock:
+        _events.clear()
+        _thread_names.clear()
+
+
+def trace_events() -> List[dict]:
+    """Copy of the recorded events (Chrome trace-event dicts)."""
+    with _lock:
+        return list(_events)
+
+
+def save_trace(path: str, clear: bool = True) -> int:
+    """Write the recorded events as a Chrome trace-event JSON file
+    (object form, with thread-name metadata so Perfetto labels tracks).
+    Returns the number of events written; `clear` drops them after."""
+    with _lock:
+        events = list(_events)
+        names = dict(_thread_names)
+        if clear:
+            _events.clear()
+    meta = [{"name": "thread_name", "ph": "M", "pid": os.getpid(),
+             "tid": tid, "args": {"name": tname}}
+            for tid, tname in sorted(names.items())]
+    doc = {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+    os.replace(tmp, path)
+    return len(events)

@@ -29,8 +29,8 @@ from deeplearning4j_tpu.nn.conf.base import (
 from deeplearning4j_tpu.nn.conf.graph_vertices import GraphVertexConf
 from deeplearning4j_tpu.nn.conf.network import ComputationGraphConfiguration
 from deeplearning4j_tpu.nn.multilayer import (
-    _as_jnp, _default_scan_steps, _required_kind, _run_scan_pipeline,
-    _scan_incompatible_listeners,
+    _as_jnp, _default_scan_steps, _record_iteration, _required_kind,
+    _run_scan_pipeline, _scan_incompatible_listeners,
 )
 from deeplearning4j_tpu.nn.updaters import NoOp, build_optimizer
 from deeplearning4j_tpu.util import params as param_util
@@ -410,16 +410,19 @@ class ComputationGraph:
                 self._input_affine = (jnp.asarray(aff[0]),
                                       jnp.asarray(aff[1]))
             try:
+                from deeplearning4j_tpu import monitor
                 for _ in range(epochs):
                     for lst in self.listeners:
                         lst.on_epoch_start(self, self.epoch_count)
-                    if not tbptt and accumulate_steps > 1:
-                        rng = self._fit_epoch_accum(data, rng,
-                                                    accumulate_steps)
-                    elif not tbptt and scan_steps > 1:
-                        rng = self._fit_epoch_scan(data, rng, scan_steps)
-                    else:
-                        rng = self._fit_epoch_per_call(data, rng, tbptt)
+                    with monitor.span("train/epoch",
+                                      epoch=self.epoch_count):
+                        if not tbptt and accumulate_steps > 1:
+                            rng = self._fit_epoch_accum(data, rng,
+                                                        accumulate_steps)
+                        elif not tbptt and scan_steps > 1:
+                            rng = self._fit_epoch_scan(data, rng, scan_steps)
+                        else:
+                            rng = self._fit_epoch_per_call(data, rng, tbptt)
                     for lst in self.listeners:
                         lst.on_epoch_end(self, self.epoch_count)
                     self.epoch_count += 1
@@ -463,9 +466,13 @@ class ComputationGraph:
         return prefetch_iterable(self._iter_data(data), stage)
 
     def _fit_epoch_per_call(self, data, rng, tbptt):
+        from deeplearning4j_tpu import monitor
         etl_start = time.perf_counter()
         for mds in self._mds_stream(data):
-            etl_ms = (time.perf_counter() - etl_start) * 1e3
+            step_start = time.perf_counter()
+            etl_ms = (step_start - etl_start) * 1e3
+            monitor.add_span("train/etl", etl_start, step_start,
+                             iteration=self.iteration_count)
             inputs = tuple(self._stage_x(f) for f in mds.features)
             labels = tuple(_as_jnp(l, self._compute_dtype) for l in mds.labels)
             fmasks = None if mds.features_masks is None else tuple(
@@ -482,7 +489,16 @@ class ComputationGraph:
                  _) = self._train_step(
                     self.params, self.opt_state, self.state, inputs,
                     labels, fmasks, lmasks, sub, None)
+                sync_start = time.perf_counter()
                 self._score = float(loss)
+                step_end = time.perf_counter()
+                monitor.add_span("train/host_sync", sync_start, step_end)
+                monitor.add_span("train/step", step_start, step_end,
+                                 iteration=self.iteration_count,
+                                 score=self._score, batch_size=bs)
+                _record_iteration(self._score, bs,
+                                  step_seconds=step_end - step_start,
+                                  sync_seconds=step_end - sync_start)
                 for lst in self.listeners:
                     lst.iteration_done(self, self.iteration_count,
                                        self.epoch_count, self._score,
@@ -585,6 +601,7 @@ class ComputationGraph:
         def process(p):
             loss, bs, etl_ms = p
             self._score = float(loss)
+            _record_iteration(self._score, bs)
             for lst in self.listeners:
                 lst.iteration_done(self, self.iteration_count,
                                    self.epoch_count, self._score, etl_ms,
@@ -632,6 +649,7 @@ class ComputationGraph:
             losses, bs, etl_ms = p
             for loss in np.asarray(losses):
                 self._score = float(loss)
+                _record_iteration(self._score, bs)
                 for lst in self.listeners:
                     lst.iteration_done(self, self.iteration_count,
                                        self.epoch_count, self._score,
@@ -737,6 +755,7 @@ class ComputationGraph:
             carries = jax.tree_util.tree_map(jax.lax.stop_gradient,
                                              new_carries)
             self._score = float(loss)
+            _record_iteration(self._score, bs)
             for lst in self.listeners:
                 lst.iteration_done(self, self.iteration_count,
                                    self.epoch_count, self._score, etl_ms, bs)

@@ -91,6 +91,29 @@ def _scan_incompatible_listeners(listeners) -> bool:
                for lst in listeners)
 
 
+def _record_iteration(score: float, batch_size: int,
+                      step_seconds: Optional[float] = None,
+                      sync_seconds: Optional[float] = None):
+    """One optimizer step's worth of telemetry (monitor/metrics.py) —
+    shared by every fit path of both containers and the resilient
+    trainer, so `train_*` series mean the same thing everywhere. Only
+    host scalars are touched: no device sync is introduced."""
+    from deeplearning4j_tpu import monitor
+    monitor.counter("train_iterations_total",
+                    "Optimizer steps applied").inc()
+    monitor.counter("train_examples_total",
+                    "Training examples consumed").inc(batch_size)
+    monitor.gauge("train_score", "Last training loss/score").set(score)
+    if step_seconds is not None:
+        monitor.histogram("train_step_seconds",
+                          "Train step wall time (dispatch + host sync)"
+                          ).observe(step_seconds)
+    if sync_seconds is not None:
+        monitor.histogram("train_host_sync_seconds",
+                          "Blocking device->host loss fetch per step"
+                          ).observe(sync_seconds)
+
+
 def _run_scan_pipeline(batches, sig_of, dispatch, process, K, defer=True):
     """Shared chunking/deferral loop of the input-pipelined fit paths
     (MultiLayerNetwork._fit_epoch_scan/_fit_epoch_accum,
@@ -105,19 +128,28 @@ def _run_scan_pipeline(batches, sig_of, dispatch, process, K, defer=True):
     blocking loss fetch per chunk happens while the device is busy.
     defer=False processes each chunk in lockstep instead (model-reading
     listeners must observe the params as of the step they're told about)."""
+    from deeplearning4j_tpu import monitor
     pending = None
     group, gsig = [], None
     etl_start = time.perf_counter()
 
     def flush():
         nonlocal pending, group, etl_start
-        etl_ms = (time.perf_counter() - etl_start) * 1e3
-        fresh = dispatch(group, etl_ms)
+        etl_end = time.perf_counter()
+        etl_ms = (etl_end - etl_start) * 1e3
+        monitor.add_span("train/etl", etl_start, etl_end,
+                         batches=len(group))
+        monitor.counter("train_chunks_dispatched_total",
+                        "Scan/accum chunks dispatched to the device").inc()
+        with monitor.span("train/dispatch", batches=len(group)):
+            fresh = dispatch(group, etl_ms)
         if not defer:
-            process(fresh)
+            with monitor.span("train/chunk_sync"):
+                process(fresh)
         else:
             if pending is not None:
-                process(pending)
+                with monitor.span("train/chunk_sync"):
+                    process(pending)
             pending = fresh
         group, etl_start = [], time.perf_counter()
 
@@ -130,7 +162,8 @@ def _run_scan_pipeline(batches, sig_of, dispatch, process, K, defer=True):
     if group:
         flush()
     if pending is not None:
-        process(pending)
+        with monitor.span("train/chunk_sync"):
+            process(pending)
 
 
 def _required_kind(layer: LayerConf) -> Optional[Kind]:
@@ -600,17 +633,20 @@ class MultiLayerNetwork:
                     else None,
                     cast_features=self._input_affine is None)
             try:
+                from deeplearning4j_tpu import monitor
                 for _ in range(epochs):
                     for lst in self.listeners:
                         lst.on_epoch_start(self, self.epoch_count)
-                    if self.conf.backprop_type == "tbptt":
-                        self._fit_epoch_tbptt(iterator)
-                    elif accumulate_steps > 1:
-                        self._fit_epoch_accum(iterator, accumulate_steps)
-                    elif scan_steps > 1:
-                        self._fit_epoch_scan(iterator, scan_steps)
-                    else:
-                        self._fit_epoch(iterator)
+                    with monitor.span("train/epoch",
+                                      epoch=self.epoch_count):
+                        if self.conf.backprop_type == "tbptt":
+                            self._fit_epoch_tbptt(iterator)
+                        elif accumulate_steps > 1:
+                            self._fit_epoch_accum(iterator, accumulate_steps)
+                        elif scan_steps > 1:
+                            self._fit_epoch_scan(iterator, scan_steps)
+                        else:
+                            self._fit_epoch(iterator)
                     for lst in self.listeners:
                         lst.on_epoch_end(self, self.epoch_count)
                     self.epoch_count += 1
@@ -682,12 +718,16 @@ class MultiLayerNetwork:
         raise ValueError(f"Cannot interpret training data: {type(data)}")
 
     def _fit_epoch(self, iterator):
+        from deeplearning4j_tpu import monitor
         etl_start = time.perf_counter()
         rng = jax.random.PRNGKey(self.conf.seed + 7919 * (self.epoch_count + 1))
         grad_listeners = [lst for lst in self.listeners
                           if getattr(lst, "wants_gradients", False)]
         for ds in iterator:
-            etl_ms = (time.perf_counter() - etl_start) * 1e3
+            step_start = time.perf_counter()
+            etl_ms = (step_start - etl_start) * 1e3
+            monitor.add_span("train/etl", etl_start, step_start,
+                             iteration=self.iteration_count)
             rng, sub = jax.random.split(rng)
             capture = [lst for lst in grad_listeners
                        if lst.should_capture(self.iteration_count)]
@@ -704,8 +744,17 @@ class MultiLayerNetwork:
                  grads, updates) = out
             else:
                 self.params, self.opt_state, self.state, loss, _ = out
-            self._score = float(loss)
+            sync_start = time.perf_counter()
+            self._score = float(loss)     # the step's one blocking fetch
+            step_end = time.perf_counter()
             bs = int(np.shape(ds.features)[0])
+            monitor.add_span("train/host_sync", sync_start, step_end)
+            monitor.add_span("train/step", step_start, step_end,
+                             iteration=self.iteration_count,
+                             score=self._score, batch_size=bs)
+            _record_iteration(self._score, bs,
+                              step_seconds=step_end - step_start,
+                              sync_seconds=step_end - sync_start)
             for lst in capture:
                 lst.on_gradients(self, self.iteration_count, self.epoch_count,
                                  grads, updates)
@@ -816,6 +865,7 @@ class MultiLayerNetwork:
         def process(p):
             loss, bs, etl_ms, capture, grads, updates = p
             self._score = float(loss)
+            _record_iteration(self._score, bs)
             for lst in capture:
                 lst.on_gradients(self, self.iteration_count,
                                  self.epoch_count, grads, updates)
@@ -889,6 +939,7 @@ class MultiLayerNetwork:
             losses, bs, etl_ms = p
             for loss in np.asarray(losses):     # single blocking fetch/chunk
                 self._score = float(loss)
+                _record_iteration(self._score, bs)
                 for lst in self.listeners:
                     lst.iteration_done(self, self.iteration_count,
                                        self.epoch_count, self._score,
@@ -968,6 +1019,7 @@ class MultiLayerNetwork:
                 # stop gradient across chunk boundary
                 carries = jax.tree_util.tree_map(jax.lax.stop_gradient, new_carries)
                 self._score = float(loss)
+                _record_iteration(self._score, int(np.shape(x)[0]))
                 for lst in self.listeners:
                     lst.iteration_done(self, self.iteration_count,
                                        self.epoch_count, self._score, 0.0,

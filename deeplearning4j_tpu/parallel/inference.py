@@ -17,6 +17,7 @@ from __future__ import annotations
 import enum
 import queue
 import threading
+import time
 from typing import Optional
 
 import jax
@@ -24,6 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from deeplearning4j_tpu import monitor
 from deeplearning4j_tpu.parallel.mesh import DATA_AXIS, MeshConfig, build_mesh
 
 
@@ -114,17 +116,35 @@ class ParallelInference:
         """Synchronous inference; thread-safe. In BATCHED mode the call may
         be coalesced with concurrent callers (ParallelInference.java:173)."""
         x = np.asarray(x)
-        if self.mode == InferenceMode.SEQUENTIAL or self._worker is None:
-            return self._run_batch(x)
-        if self._stop.is_set() or not self._worker.is_alive():
-            raise RuntimeError("ParallelInference has been shut down")
-        req = _Request(x)
-        self._queue.put(req, timeout=timeout)
-        if not req.event.wait(timeout):
-            raise TimeoutError("inference request timed out")
-        if req.error is not None:
-            raise req.error
-        return req.result
+        t0 = time.perf_counter()
+        monitor.counter("inference_requests_total",
+                        "ParallelInference.output() calls").inc()
+        try:
+            with monitor.span("inference/request", n=int(x.shape[0])):
+                if self.mode == InferenceMode.SEQUENTIAL \
+                        or self._worker is None:
+                    return self._run_batch(x)
+                if self._stop.is_set() or not self._worker.is_alive():
+                    raise RuntimeError(
+                        "ParallelInference has been shut down")
+                req = _Request(x)
+                self._queue.put(req, timeout=timeout)
+                monitor.gauge("inference_queue_depth",
+                              "Pending inference requests").set(
+                    self._queue.qsize())
+                if not req.event.wait(timeout):
+                    monitor.counter("inference_timeouts_total",
+                                    "Requests that hit their deadline"
+                                    ).inc()
+                    raise TimeoutError("inference request timed out")
+                if req.error is not None:
+                    raise req.error
+                return req.result
+        finally:
+            monitor.histogram("inference_request_seconds",
+                              "End-to-end request latency (queueing + "
+                              "batching + device run)").observe(
+                time.perf_counter() - t0)
 
     def _serve_loop(self):
         pending = None      # request popped but deferred to the next batch
@@ -153,7 +173,18 @@ class ParallelInference:
                 total += nxt.x.shape[0]
             try:
                 batch = np.concatenate([r.x for r in reqs], axis=0)
-                out = self._run_batch(batch)
+                monitor.histogram(
+                    "inference_batch_size",
+                    "Coalesced device-batch sizes (examples)",
+                    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512)
+                ).observe(batch.shape[0])
+                monitor.gauge("inference_queue_depth",
+                              "Pending inference requests").set(
+                    self._queue.qsize())
+                with monitor.span("inference/batch",
+                                  n=int(batch.shape[0]),
+                                  requests=len(reqs)):
+                    out = self._run_batch(batch)
                 ofs = 0
                 for r in reqs:
                     r.result = out[ofs:ofs + r.x.shape[0]]

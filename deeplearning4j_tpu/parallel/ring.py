@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from deeplearning4j_tpu.parallel.mesh import SEQ_AXIS, compat_shard_map
@@ -128,11 +129,28 @@ def make_ring_attention(mesh: Mesh, *, causal: bool = True,
 
     f_masked = compat_shard_map(masked, mesh, (spec_qkv, spec_qkv, spec_qkv, spec_mask), spec_qkv)
     f_unmasked = compat_shard_map(unmasked, mesh, (spec_qkv, spec_qkv, spec_qkv), spec_qkv)
+    size = int(mesh.shape[axis_name])
 
     def attend(q, k, v, mask=None):
-        if mask is None:
-            return f_unmasked(q, k, v)
-        return f_masked(q, k, v, mask)
+        # host-side telemetry at the shard_map boundary: counts calls and
+        # the ICI traffic the ring schedules ((size-1) K/V rotations of
+        # one shard each, per device). Under an enclosing jit these fire
+        # at trace time only — the compiled path stays untouched.
+        from deeplearning4j_tpu import monitor
+        nbytes = lambda a: 0 if a is None else \
+            int(np.prod(np.shape(a))) * np.dtype(a.dtype).itemsize
+        monitor.counter("ring_attention_calls_total",
+                        "ring attention invocations (trace-time under "
+                        "jit)").inc()
+        monitor.counter("ring_bytes_rotated_total",
+                        "K/V (+mask) bytes scheduled over the ring per "
+                        "call (trace-time under jit: counts traced "
+                        "builds, not executed steps)").inc(
+            (size - 1) * (nbytes(k) + nbytes(v) + nbytes(mask)))
+        with monitor.span("parallel/ring_attention", seq_shards=size):
+            if mask is None:
+                return f_unmasked(q, k, v)
+            return f_masked(q, k, v, mask)
 
     return attend
 

@@ -30,6 +30,8 @@ from typing import List, Tuple
 
 import numpy as np
 
+from deeplearning4j_tpu import monitor
+
 _MAGIC = b"DTPU"
 _HEADER = struct.Struct("<4sIBf")
 
@@ -130,7 +132,17 @@ class SocketTransport:
     def _reader(self, conn: socket.socket):
         try:
             while not self._closed:
-                self._inbox.put(_decode_message(conn))
+                msg = _decode_message(conn)
+                monitor.counter("transport_messages_received_total",
+                                "Encoded-gradient messages received",
+                                labels=("rank",)).inc(rank=self.rank)
+                monitor.counter(
+                    "transport_bytes_received_total",
+                    "Wire bytes received (header + indices + payload)",
+                    labels=("rank",)).inc(
+                    _HEADER.size + msg[0].nbytes + msg[1].nbytes,
+                    rank=self.rank)
+                self._inbox.put(msg)
         except (ConnectionError, OSError, ValueError):
             pass
         finally:
@@ -145,16 +157,27 @@ class SocketTransport:
         """Block until `n_messages` peer messages arrive (one iteration's
         worth in lockstep training)."""
         out = []
+        t0 = time.perf_counter()
         deadline = time.monotonic() + timeout
-        while len(out) < n_messages:
-            remaining = deadline - time.monotonic()
-            if remaining <= 0:
-                raise TimeoutError(
-                    f"rank {self.rank}: got {len(out)}/{n_messages} messages")
-            try:
-                out.append(self._inbox.get(timeout=min(remaining, 1.0)))
-            except queue.Empty:
-                continue
+        with monitor.span("transport/recv", rank=self.rank,
+                          n_messages=n_messages):
+            while len(out) < n_messages:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    monitor.counter("transport_recv_timeouts_total",
+                                    "recv() deadline expiries",
+                                    labels=("rank",)).inc(rank=self.rank)
+                    raise TimeoutError(
+                        f"rank {self.rank}: got {len(out)}/{n_messages} "
+                        f"messages")
+                try:
+                    out.append(self._inbox.get(timeout=min(remaining, 1.0)))
+                except queue.Empty:
+                    continue
+        monitor.histogram("transport_recv_wait_seconds",
+                          "Blocking wait for one iteration's peer messages",
+                          labels=("rank",)).observe(
+            time.perf_counter() - t0, rank=self.rank)
         return out
 
     # ---------------------------------------------------------------- send
@@ -180,10 +203,17 @@ class SocketTransport:
                 s = socket.create_connection(
                     addr, timeout=min(2.0, max(remaining, 0.1)))
                 s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                monitor.counter("transport_connects_total",
+                                "Outbound peer connections established",
+                                labels=("rank",)).inc(rank=self.rank)
                 return s
             except OSError as e:       # peer not up yet — back off, retry
                 last_err = e
                 attempts += 1
+                monitor.counter("transport_connect_retries_total",
+                                "Failed connect attempts (peer not up yet "
+                                "/ unreachable)",
+                                labels=("rank",)).inc(rank=self.rank)
                 sleep = min(delay * (0.5 + self._jitter.random()),
                             max(deadline - time.monotonic(), 0.0))
                 if sleep > 0:
@@ -195,18 +225,36 @@ class SocketTransport:
             raise RuntimeError(
                 f"rank {self.rank}: broadcast on a closed transport")
         data = _encode_message(message)
-        with self._lock:
+        t0 = time.perf_counter()
+        with self._lock, monitor.span("transport/broadcast",
+                                      rank=self.rank, bytes=len(data)):
             for peer in range(self.n_workers):
                 if peer == self.rank:
                     continue
                 if self.send_filter is not None \
                         and not self.send_filter(peer):
-                    continue           # injected message drop (util/faults)
+                    # injected message drop (util/faults)
+                    monitor.counter("transport_messages_dropped_total",
+                                    "Outbound messages dropped by the "
+                                    "send filter (fault injection)",
+                                    labels=("rank",)).inc(rank=self.rank)
+                    continue
                 if peer not in self._out:
                     self._out[peer] = self._connect(peer)
                 self._out[peer].sendall(data)
                 self.messages_sent += 1
                 self.bytes_sent += len(data)
+                monitor.counter("transport_messages_sent_total",
+                                "Encoded-gradient messages sent",
+                                labels=("rank",)).inc(rank=self.rank)
+                monitor.counter("transport_bytes_sent_total",
+                                "Wire bytes sent",
+                                labels=("rank",)).inc(len(data),
+                                                      rank=self.rank)
+        monitor.histogram("transport_send_seconds",
+                          "broadcast() wall time (all peers, incl. lazy "
+                          "connect)", labels=("rank",)).observe(
+            time.perf_counter() - t0, rank=self.rank)
 
     def close(self):
         """Idempotent and safe to call concurrently with the accept/reader

@@ -61,6 +61,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="checkpoint cadence for --checkpoint-dir runs")
     p.add_argument("--keep-last", type=int, default=3,
                    help="checkpoints retained by manifest pruning")
+    p.add_argument("--metrics", action="store_true",
+                   help="print the final telemetry summary "
+                        "(monitor.summary()) as JSON to stderr; with "
+                        "--ui-port the live Prometheus exposition is "
+                        "also served at /metrics (docs/OBSERVABILITY.md)")
+    p.add_argument("--trace-out", default=None, metavar="PATH",
+                   help="record telemetry spans and write a Chrome "
+                        "trace-event JSON to PATH on exit (load in "
+                        "Perfetto / chrome://tracing)")
     return p
 
 
@@ -101,11 +110,31 @@ def main(argv=None) -> int:
         # the axon TPU plugin force-appends itself to jax_platforms at
         # import, overriding the env var — pin the user's choice back
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    from deeplearning4j_tpu import monitor
     from deeplearning4j_tpu.parallel import ParallelWrapper, TrainingMode
     from deeplearning4j_tpu.train.listeners import (
         PerformanceListener, ScoreIterationListener,
     )
     from deeplearning4j_tpu.util.serialization import load_model, save_model
+
+    if args.trace_out:
+        monitor.enable_tracing()
+
+    def emit_telemetry():
+        # runs in a finally: a bad --trace-out path (unwritable dir, full
+        # disk) must not fail an otherwise-successful run or mask the
+        # fit's real exception
+        if args.trace_out:
+            try:
+                n = monitor.save_trace(args.trace_out)
+                print(f"trace: {args.trace_out} ({n} events)",
+                      file=sys.stderr)
+            except OSError as e:
+                print(f"trace not written to {args.trace_out}: {e}",
+                      file=sys.stderr)
+        if args.metrics:
+            print(json.dumps({"metrics": monitor.summary()}),
+                  file=sys.stderr)
 
     net = load_model(args.model)
     iterator = _load_data(args.dataset, args.batch_size,
@@ -126,52 +155,62 @@ def main(argv=None) -> int:
 
     if args.resume and not args.checkpoint_dir:
         raise SystemExit("--resume requires --checkpoint-dir")
-    if args.checkpoint_dir:
-        # resilient path: atomic checkpoint/auto-resume + fault policy;
-        # wraps the plain net (single) or the sync-mode ParallelWrapper
-        from deeplearning4j_tpu.train.resilience import ResilientTrainer
-        target = net
-        if args.mode == "sync":
-            target = ParallelWrapper(net, mode=TrainingMode.SYNC_GRADIENTS)
-        elif args.mode == "averaging":
-            raise SystemExit("--checkpoint-dir supports --mode single|sync "
-                             "(AVERAGING replica state is not resumable)")
-        trainer = ResilientTrainer(
-            target, args.checkpoint_dir,
-            save_every_n_iterations=args.save_every_iterations,
-            keep_last=args.keep_last, resume=args.resume)
-        report = trainer.fit(iterator, epochs=args.epochs,
-                             batch_size=args.batch_size)
-        if report.preempted or report.diverged:
-            # incomplete run (preempted, or diverged and rolled back to an
-            # older checkpoint): no output model, no success JSON, distinct
-            # exit code so callers can't mistake it for a finished job
-            print(json.dumps({"preempted": report.preempted,
-                              "diverged": report.diverged,
-                              "iterations": net.iteration_count,
-                              "resume_with": "--resume"}), file=sys.stderr)
-            if ui_server is not None:
-                ui_server.stop()
-            return 3 if report.preempted else 4
-    elif args.mode == "single":
-        net.fit(iterator, epochs=args.epochs)
-    else:
-        wrapper = ParallelWrapper(
-            net,
-            mode=(TrainingMode.SYNC_GRADIENTS if args.mode == "sync"
-                  else TrainingMode.AVERAGING),
-            averaging_frequency=args.averaging_frequency,
-            average_updaters=not args.no_average_updaters)
-        wrapper.fit(iterator, epochs=args.epochs)
+    # telemetry emits in a finally: a fit that dies mid-run (bad data,
+    # retries exhausted, OOM) still leaves the trace/metrics record —
+    # the crash case is exactly when it is most needed
+    try:
+        if args.checkpoint_dir:
+            # resilient path: atomic checkpoint/auto-resume + fault policy;
+            # wraps the plain net (single) or the sync-mode ParallelWrapper
+            from deeplearning4j_tpu.train.resilience import ResilientTrainer
+            target = net
+            if args.mode == "sync":
+                target = ParallelWrapper(net,
+                                         mode=TrainingMode.SYNC_GRADIENTS)
+            elif args.mode == "averaging":
+                raise SystemExit("--checkpoint-dir supports --mode "
+                                 "single|sync (AVERAGING replica state is "
+                                 "not resumable)")
+            trainer = ResilientTrainer(
+                target, args.checkpoint_dir,
+                save_every_n_iterations=args.save_every_iterations,
+                keep_last=args.keep_last, resume=args.resume)
+            report = trainer.fit(iterator, epochs=args.epochs,
+                                 batch_size=args.batch_size)
+            if report.preempted or report.diverged:
+                # incomplete run (preempted, or diverged and rolled back
+                # to an older checkpoint): no output model, no success
+                # JSON, distinct exit code so callers can't mistake it
+                # for a finished job
+                print(json.dumps({"preempted": report.preempted,
+                                  "diverged": report.diverged,
+                                  "iterations": net.iteration_count,
+                                  "resume_with": "--resume"}),
+                      file=sys.stderr)
+                if ui_server is not None:
+                    ui_server.stop()
+                return 3 if report.preempted else 4
+        elif args.mode == "single":
+            net.fit(iterator, epochs=args.epochs)
+        else:
+            wrapper = ParallelWrapper(
+                net,
+                mode=(TrainingMode.SYNC_GRADIENTS if args.mode == "sync"
+                      else TrainingMode.AVERAGING),
+                averaging_frequency=args.averaging_frequency,
+                average_updaters=not args.no_average_updaters)
+            wrapper.fit(iterator, epochs=args.epochs)
 
-    save_model(net, args.output)
-    print(json.dumps({"output": args.output,
-                      "final_score": net.score(),
-                      "iterations": net.iteration_count,
-                      "epochs": net.epoch_count}))
-    if ui_server is not None:
-        ui_server.stop()
-    return 0
+        save_model(net, args.output)
+        print(json.dumps({"output": args.output,
+                          "final_score": net.score(),
+                          "iterations": net.iteration_count,
+                          "epochs": net.epoch_count}))
+        if ui_server is not None:
+            ui_server.stop()
+        return 0
+    finally:
+        emit_telemetry()
 
 
 if __name__ == "__main__":

@@ -54,7 +54,13 @@ class ScoreIterationListener(TrainingListener):
 
 
 class PerformanceListener(TrainingListener):
-    """Reports throughput per iteration (DL4J PerformanceListener.java:22-87)."""
+    """Reports throughput per iteration (DL4J PerformanceListener.java:22-87).
+
+    Every reported record carries the SAME four numbers in the history
+    dict, the log line, and the telemetry registry (monitor/metrics.py:
+    train_examples_per_sec / train_batches_per_sec gauges and the
+    train_etl_seconds histogram) — one source of truth for throughput,
+    whether you read logs, listener history, or a /metrics scrape."""
 
     def __init__(self, frequency: int = 1, report: bool = True):
         self.frequency = max(int(frequency), 1)
@@ -64,22 +70,37 @@ class PerformanceListener(TrainingListener):
 
     def iteration_done(self, model, iteration, epoch, score, etl_ms=0.0,
                        batch_size=0):
+        from deeplearning4j_tpu import monitor
         now = time.perf_counter()
         if self._last_time is not None and iteration % self.frequency == 0:
             dt = now - self._last_time
             rec = {
                 "iteration": iteration,
                 "batches_per_sec": 1.0 / dt if dt > 0 else float("inf"),
-                "samples_per_sec": batch_size / dt if dt > 0 else float("inf"),
+                "examples_per_sec": batch_size / dt if dt > 0 else float("inf"),
                 "etl_ms": etl_ms,
                 "iteration_ms": dt * 1e3,
             }
+            # historical key kept so existing consumers don't break
+            rec["samples_per_sec"] = rec["examples_per_sec"]
             self.history.append(rec)
+            if dt > 0:
+                monitor.gauge("train_examples_per_sec",
+                              "Training throughput, examples/sec "
+                              "(PerformanceListener)").set(
+                    rec["examples_per_sec"])
+                monitor.gauge("train_batches_per_sec",
+                              "Training throughput, batches/sec "
+                              "(PerformanceListener)").set(
+                    rec["batches_per_sec"])
+            monitor.histogram("train_etl_seconds",
+                              "Host ETL time per reported iteration "
+                              "(PerformanceListener)").observe(etl_ms / 1e3)
             if self.report:
                 log.info("ETL: %.0f ms; iteration %d; iteration time: %.1f ms; "
-                         "samples/sec: %.1f; batches/sec: %.2f",
+                         "examples/sec: %.1f; batches/sec: %.2f",
                          etl_ms, iteration, rec["iteration_ms"],
-                         rec["samples_per_sec"], rec["batches_per_sec"])
+                         rec["examples_per_sec"], rec["batches_per_sec"])
         self._last_time = now
 
 

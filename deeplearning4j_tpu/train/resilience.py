@@ -61,6 +61,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deeplearning4j_tpu import monitor
 from deeplearning4j_tpu.train.listeners import (
     DivergenceListener, TrainingDivergedError,
 )
@@ -613,7 +614,15 @@ class ResilientTrainer:
         nz = self._normalizer_extra()
         if nz is not None:
             extra["normalizer"] = nz
-        path = self.ckpt.save(self.net, extra)
+        t0 = time.perf_counter()
+        with monitor.span("resilience/checkpoint_save",
+                          iteration=self.net.iteration_count):
+            path = self.ckpt.save(self.net, extra)
+        monitor.histogram("resilience_checkpoint_save_seconds",
+                          "Checkpoint zip write + hash + manifest update"
+                          ).observe(time.perf_counter() - t0)
+        monitor.counter("resilience_checkpoints_written_total",
+                        "Checkpoints written by ResilientTrainer").inc()
         report.checkpoints_written += 1
         log.info("checkpoint written: %s (iteration %d, epoch %d, step %d)",
                  path, self.net.iteration_count, self.net.epoch_count,
@@ -629,14 +638,27 @@ class ResilientTrainer:
         snap = self._driver.snapshot() if policy.guards_steps else None
         attempt = 0
         while True:
+            # per-attempt clock: train_step_seconds and the train/step
+            # span must time ONLY the attempt that landed — backoff
+            # sleeps and failed attempts would otherwise make retried
+            # steps read as slow compute
+            attempt_start = time.perf_counter()
             try:
                 if self.injector is not None:
                     self.injector.before_step(step_idx)
                 loss, bs = self._driver.step(batch, sub)
                 loss_f = float(loss)
+                step_secs = time.perf_counter() - attempt_start
+                monitor.add_span("train/step", attempt_start,
+                                 attempt_start + step_secs, step=step_idx)
                 break
             except policy.transient_errors as e:
                 attempt += 1
+                monitor.counter("resilience_retries_total",
+                                "Transient-error step retries").inc()
+                monitor.add_span("resilience/step_retry", attempt_start,
+                                 time.perf_counter(), step=step_idx,
+                                 attempt=attempt, error=str(e))
                 if snap is not None:
                     self._driver.restore(snap)
                 if attempt > policy.max_retries:
@@ -659,6 +681,9 @@ class ResilientTrainer:
                 self._driver.restore(snap)
             self._consecutive_skips += 1
             report.skipped_steps += 1
+            monitor.counter("resilience_steps_skipped_total",
+                            "Steps skipped on non-finite loss").inc()
+            monitor.instant("resilience/nan_skip", step=step_idx)
             log.warning("non-finite loss %s at step %d: skipping batch "
                         "(%d consecutive skips, threshold %d)", loss_f,
                         step_idx, self._consecutive_skips,
@@ -670,6 +695,8 @@ class ResilientTrainer:
                     f"at step {step_idx}")
             return "skipped", loss_f, bs
         self._consecutive_skips = 0
+        from deeplearning4j_tpu.nn.multilayer import _record_iteration
+        _record_iteration(loss_f, bs, step_seconds=step_secs)
         return "applied", loss_f, bs
 
     # ------------------------------------------------------------------ fit
@@ -685,7 +712,15 @@ class ResilientTrainer:
         if self.resume:
             entry = self.ckpt.latest_valid()
             if entry is not None:
-                extra = self.ckpt.restore_into(net, entry["path"])
+                t0 = time.perf_counter()
+                with monitor.span("resilience/checkpoint_restore",
+                                  path=entry["path"]):
+                    extra = self.ckpt.restore_into(net, entry["path"])
+                monitor.histogram("resilience_checkpoint_restore_seconds",
+                                  "Checkpoint verify + load into the model"
+                                  ).observe(time.perf_counter() - t0)
+                monitor.counter("resilience_resumes_total",
+                                "Auto-resumes from a checkpoint").inc()
                 report.resumed_from = entry["path"]
                 step_in_epoch = int(extra.get("step_in_epoch", 0))
                 self._dispatch_idx = int(extra.get("dispatch_idx", 0))
@@ -726,7 +761,8 @@ class ResilientTrainer:
 
         steps_since_save = 0
         rng_at_step_start = None    # pre-split carry of the in-flight step
-        with PreemptionGuard() as guard:
+        with PreemptionGuard() as guard, \
+                monitor.span("resilience/fit", epochs=epochs):
             # the uninterrupted run resets the source once per completed
             # epoch — replay those resets so epoch-dependent shuffles match
             for _ in range(net.epoch_count):
@@ -750,18 +786,28 @@ class ResilientTrainer:
                             self._save(report, step_in_epoch)
                             report.preempted = True
                             report.final_score = net._score
+                            monitor.counter(
+                                "resilience_preemptions_total",
+                                "Preemption-triggered clean stops").inc()
+                            monitor.instant("resilience/preempted",
+                                            iteration=net.iteration_count)
                             log.warning("preempted: checkpointed at "
                                         "iteration %d; re-run to resume",
                                         net.iteration_count)
                             return report
+                        etl_start = time.perf_counter()
                         try:
                             batch = next(it)
                         except StopIteration:
                             break
+                        etl_end = time.perf_counter()
                         if consumed < step_in_epoch:    # resume fast-forward
                             consumed += 1
                             continue
                         consumed += 1
+                        etl_ms = (etl_end - etl_start) * 1e3
+                        monitor.add_span("train/etl", etl_start, etl_end,
+                                         step=self._dispatch_idx)
                         rng_at_step_start = self._rng
                         self._rng, sub = jax.random.split(self._rng)
                         step_idx = self._dispatch_idx
@@ -776,7 +822,7 @@ class ResilientTrainer:
                         report.applied_steps += 1
                         for lst in net.listeners:
                             lst.iteration_done(net, net.iteration_count,
-                                               epoch, loss_f, 0.0, bs)
+                                               epoch, loss_f, etl_ms, bs)
                         if div_guard is not None:
                             div_guard.iteration_done(net,
                                                      net.iteration_count,
@@ -832,6 +878,9 @@ class ResilientTrainer:
         """Graceful degradation: restore the newest good checkpoint so the
         model is left usable, then stop (or raise, per policy)."""
         report.diverged = True
+        monitor.counter("resilience_divergence_rollbacks_total",
+                        "Unrecoverable divergences rolled back to the "
+                        "last good checkpoint").inc()
         entry = self.ckpt.latest_valid()
         if entry is not None:
             self.ckpt.restore_into(self.net, entry["path"])

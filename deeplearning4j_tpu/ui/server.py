@@ -351,13 +351,41 @@ class _Handler(BaseHTTPRequestHandler):
         if url.path == "/train/sessions":
             self._json({"sessions": ui.session_ids()})
             return
+        if url.path == "/metrics":
+            # Prometheus text exposition of the process-global telemetry
+            # registry (monitor/metrics.py) — scrape target for ops
+            from deeplearning4j_tpu.monitor import prometheus_text
+            self._raw(prometheus_text().encode(),
+                      "text/plain; version=0.0.4; charset=utf-8")
+            return
         if url.path == "/train/data":
             q = parse_qs(url.query)
             sid = q.get("sid", [""])[0]
-            after = float(q.get("after", ["0"])[0])
+            try:
+                after = float(q.get("after", ["0"])[0])
+            except ValueError:
+                self._json({"error": "bad 'after' parameter: not a number"},
+                           code=400)
+                return
+            if sid not in ui.session_ids():
+                self._json({"error": f"unknown session id '{sid}'"},
+                           code=404)
+                return
             self._json(ui.session_data(sid, after))
             return
         self._json({"error": "not found"}, code=404)
+
+    def _post_body(self):
+        """Read and json-parse the POST body; raises ValueError on a
+        bad/abusive Content-Length or non-JSON payload (the caller maps
+        that to a clean 400, never a 500 traceback)."""
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+        except (TypeError, ValueError):
+            raise ValueError("bad Content-Length header")
+        if length < 0 or length > (64 << 20):
+            raise ValueError(f"unreasonable Content-Length {length}")
+        return json.loads(self.rfile.read(length) or b"{}")
 
     def do_POST(self):
         # TsneModule.java route parity: POST /tsne/post/<sid> with a JSON
@@ -368,11 +396,12 @@ class _Handler(BaseHTTPRequestHandler):
             # RemoteReceiverModule.java:60 parity: workers' remote stats
             # routers POST record batches here; they land in the storage
             # registered via UIServer.enable_remote_listener()
-            length = int(self.headers.get("Content-Length", "0"))
+            # AttributeError covers a well-formed-JSON body that is not an
+            # object (e.g. a bare list: .get would 500 with a traceback)
             try:
-                body = json.loads(self.rfile.read(length) or b"{}")
+                body = self._post_body()
                 n = ui.receive_remote(body.get("records", []))
-            except (ValueError, KeyError, TypeError) as e:
+            except (ValueError, KeyError, TypeError, AttributeError) as e:
                 self._json({"error": f"bad body: {e}"}, code=400)
                 return
             if n is None:
@@ -383,12 +412,12 @@ class _Handler(BaseHTTPRequestHandler):
             return
         if url.path.startswith("/tsne/post/"):
             sid = unquote(url.path.rsplit("/", 1)[-1])
-            length = int(self.headers.get("Content-Length", "0"))
             try:
-                body = json.loads(self.rfile.read(length) or b"{}")
+                body = self._post_body()
                 pts = body["points"]
                 ui.post_tsne(sid, pts)
-            except (ValueError, KeyError, TypeError, IndexError) as e:
+            except (ValueError, KeyError, TypeError, IndexError,
+                    AttributeError) as e:
                 self._json({"error": f"bad body: {e}"}, code=400)
                 return
             self._json({"ok": True, "n": len(pts)})

@@ -143,6 +143,10 @@ def main(argv=None) -> int:
     except Exception as e:  # noqa: BLE001 - smoke must report, not die
         failures.append(f"{type(e).__name__}: {e}")
 
+    # attributable CI record: the run's full telemetry (skips, retries,
+    # checkpoint IO, step timings) rides along in the summary JSON
+    from deeplearning4j_tpu import monitor
+    summary["metrics"] = monitor.summary()
     summary["failures"] = failures
     summary["ok"] = not failures
     print(json.dumps(summary, indent=1))

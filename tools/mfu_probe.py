@@ -48,6 +48,15 @@ BEST_OF = int(os.environ.get("DL4J_TPU_PROBE_BEST_OF", "3"))
 
 def emit(row):
     row.update({"device_kind": DEV.device_kind, "on_tpu": ON_TPU})
+    # mirror every numeric measurement into the telemetry registry so the
+    # final metrics-summary line (and any /metrics scrape of a harness
+    # embedding this probe) carries the same numbers as the log
+    from deeplearning4j_tpu import monitor
+    probe = str(row.get("segment") or row.get("kind") or "probe")
+    for k, v in row.items():
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            monitor.gauge(f"mfu_probe_{k}", "mfu_probe measurement",
+                          labels=("probe",)).set(v, probe=probe)
     print(json.dumps(row), flush=True)
 
 
@@ -227,3 +236,6 @@ if __name__ == "__main__":
         matmul_peak(n=512)
         conv_micro(batch=2)
         resnet_segments(batch=2, hw=64)
+    from deeplearning4j_tpu import monitor
+    print(json.dumps({"kind": "metrics-summary",
+                      "metrics": monitor.summary()}), flush=True)

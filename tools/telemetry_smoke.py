@@ -1,0 +1,193 @@
+#!/usr/bin/env python
+"""Telemetry smoke: a short instrumented fit must leave a scrapeable
+/metrics payload and a valid Perfetto-loadable trace. CI-friendly:
+
+    JAX_PLATFORMS=cpu python tools/telemetry_smoke.py --trace-out /tmp/t.json
+
+Exercises every instrumented subsystem on CPU in one process:
+
+- ResilientTrainer fit over an AsyncDataSetIterator (train + ETL +
+  resilience series; one injected NaN step ticks
+  resilience_steps_skipped_total),
+- ParallelInference BATCHED serving (inference series),
+- a two-rank SocketTransport exchange (transport series),
+
+then asserts:
+
+- GET /metrics on a live UIServer returns valid Prometheus text with
+  >= 12 distinct metric families spanning train/ETL/transport/
+  resilience/inference,
+- the Chrome trace JSON loads, spans nest (train/step inside
+  resilience/fit), and at least two distinct thread tracks appear.
+
+Exit code 0 on success, 1 on failure; prints a JSON summary either way.
+"""
+import argparse
+import json
+import os
+import sys
+import tempfile
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+
+import numpy as np  # noqa: E402
+
+GROUPS = {
+    "train": ("train_",),
+    "etl": ("etl_", "train_etl_"),
+    "transport": ("transport_",),
+    "resilience": ("resilience_",),
+    "inference": ("inference_",),
+}
+
+
+def _net(seed=0):
+    from deeplearning4j_tpu.nn.conf.base import InputType
+    from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.updaters import Adam
+    conf = (NeuralNetConfiguration.Builder().seed(seed).updater(Adam(1e-2))
+            .list()
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(6)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _transport_exchange(failures):
+    """One round-trip over the host-side DCN path (two in-process ranks)."""
+    from deeplearning4j_tpu.parallel.transport import SocketTransport
+    base = 30200 + (os.getpid() % 5000)
+    msg = (np.arange(4, dtype=np.int32), np.ones(4, np.float32), 0.5)
+    try:
+        with SocketTransport(0, 2, base_port=base) as t0, \
+                SocketTransport(1, 2, base_port=base) as t1:
+            t0.broadcast(0, msg)
+            t1.broadcast(1, msg)
+            t0.recv(1, timeout=20)
+            t1.recv(1, timeout=20)
+    except Exception as e:  # noqa: BLE001
+        failures.append(f"transport exchange failed: {type(e).__name__}: {e}")
+
+
+def _span_index(events):
+    return [e for e in events if e.get("ph") == "X"]
+
+
+def _nested(parent, child):
+    eps = 1.0  # µs
+    return (parent["tid"] == child["tid"]
+            and parent["ts"] - eps <= child["ts"]
+            and child["ts"] + child.get("dur", 0)
+            <= parent["ts"] + parent.get("dur", 0) + eps)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--trace-out", default=None,
+                   help="default: a fresh temp file")
+    p.add_argument("--epochs", type=int, default=2)
+    p.add_argument("--batch-size", type=int, default=16)
+    args = p.parse_args(argv)
+    trace_path = args.trace_out or os.path.join(
+        tempfile.mkdtemp(prefix="telemetry_smoke_"), "trace.json")
+
+    from deeplearning4j_tpu import monitor
+    from deeplearning4j_tpu.data.async_iterator import AsyncDataSetIterator
+    from deeplearning4j_tpu.data.iterator import ArrayDataSetIterator
+    from deeplearning4j_tpu.parallel.inference import (
+        InferenceMode, ParallelInference,
+    )
+    from deeplearning4j_tpu.train.listeners import PerformanceListener
+    from deeplearning4j_tpu.train.resilience import ResilientTrainer
+    from deeplearning4j_tpu.ui.server import UIServer
+    from deeplearning4j_tpu.util.faults import FaultInjector
+
+    monitor.enable_tracing()
+    failures = []
+    summary = {"trace_out": trace_path}
+
+    # ---- train + ETL + resilience -------------------------------------
+    rs = np.random.RandomState(0)
+    X = rs.randn(96, 6).astype("float32")
+    Y = np.eye(3, dtype="float32")[rs.randint(0, 3, 96)]
+    net = _net()
+    net.set_listeners(PerformanceListener(frequency=1, report=False))
+    ckdir = tempfile.mkdtemp(prefix="telemetry_ck_")
+    source = AsyncDataSetIterator(
+        ArrayDataSetIterator(X, Y, batch_size=args.batch_size))
+    report = ResilientTrainer(
+        net, ckdir, save_every_n_iterations=4,
+        injector=FaultInjector(nan_at=[3]),
+    ).fit(source, epochs=args.epochs, batch_size=args.batch_size)
+    summary["fit"] = {"applied": report.applied_steps,
+                      "skipped": report.skipped_steps,
+                      "checkpoints": report.checkpoints_written}
+    if report.skipped_steps < 1:
+        failures.append("injected NaN step was not skipped")
+
+    # ---- inference -----------------------------------------------------
+    with ParallelInference(net, mode=InferenceMode.BATCHED,
+                           max_batch_size=32) as pi:
+        out = pi.output(X[:8])
+    if out.shape != (8, 3):
+        failures.append(f"inference output shape {out.shape} != (8, 3)")
+
+    # ---- transport -----------------------------------------------------
+    _transport_exchange(failures)
+
+    # ---- /metrics scrape ----------------------------------------------
+    server = UIServer(port=0)
+    try:
+        body = urllib.request.urlopen(server.url + "metrics",
+                                      timeout=10).read().decode()
+    finally:
+        server.stop()
+    families = [ln.split()[2] for ln in body.splitlines()
+                if ln.startswith("# TYPE ")]
+    summary["metric_families"] = len(families)
+    if len(families) < 12:
+        failures.append(f"only {len(families)} metric families exposed "
+                        f"(need >= 12): {families}")
+    for group, prefixes in GROUPS.items():
+        if not any(f.startswith(pre) for f in families for pre in prefixes):
+            failures.append(f"no {group} metrics in /metrics exposition")
+    skip_ctr = monitor.REGISTRY.collect("resilience_steps_skipped_total")
+    if skip_ctr is None or skip_ctr.value() < 1:
+        failures.append("resilience_steps_skipped_total did not increment")
+
+    # ---- trace validity ------------------------------------------------
+    n_events = monitor.save_trace(trace_path)
+    summary["trace_events"] = n_events
+    try:
+        with open(trace_path) as f:
+            doc = json.load(f)
+        spans = _span_index(doc["traceEvents"])
+        fits = [e for e in spans if e["name"] == "resilience/fit"]
+        steps = [e for e in spans if e["name"] == "train/step"]
+        if not fits or not steps:
+            failures.append("missing resilience/fit or train/step spans")
+        elif not any(_nested(f, s) for f in fits for s in steps):
+            failures.append("train/step spans do not nest inside "
+                            "resilience/fit")
+        tids = {e["tid"] for e in spans}
+        summary["trace_threads"] = len(tids)
+        if len(tids) < 2:
+            failures.append("expected spans from >= 2 threads "
+                            "(train + prefetch/inference workers)")
+    except (OSError, ValueError, KeyError) as e:
+        failures.append(f"trace file invalid: {type(e).__name__}: {e}")
+
+    summary["failures"] = failures
+    summary["ok"] = not failures
+    print(json.dumps(summary, indent=1))
+    return 0 if not failures else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -72,8 +72,14 @@ def cache_dir() -> str:
     d = os.path.join(repo, ".jaxcache")
     try:
         os.makedirs(d, exist_ok=True)
-        if os.access(d, os.W_OK):   # existing dir on a read-only mount
-            return d                # raises nothing from makedirs
+        # real write probe, not os.access: access(W_OK) answers from
+        # permission bits, which say yes to root even on a read-only
+        # mount — only an actual create/remove proves writability
+        probe = os.path.join(d, f".wprobe.{os.getpid()}")
+        with open(probe, "wb"):
+            pass
+        os.remove(probe)
+        return d
     except OSError:
         pass
     import tempfile

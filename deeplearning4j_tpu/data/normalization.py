@@ -83,6 +83,10 @@ def engage_device_affine(iterator):
             if aff is None:
                 return None, None, None
             it.pre_processor = None
+            # marker for the raw-uint8 fit warning (data/records.py):
+            # normalization still happens, on device — a detached
+            # pre-processor must not read as "training unnormalized"
+            it._device_affine_active = True
             return it, pp, aff
         it = getattr(it, "_source", None)
     return None, None, None
@@ -106,7 +110,7 @@ def engaged_device_affine(iterator, listeners=()):
       already in the chain (a user-constructed wrap with cast_dtype set
       would otherwise bf16-quantize RAW features before the device
       affine — the cast-before-normalize bug) + restore in finally."""
-    if os.environ.get("DL4J_TPU_DEVICE_NORM", "1") != "1" \
+    if os.environ.get("DL4J_TPU_DEVICE_NORM", "1") == "0" \
             or any(getattr(lst, "reads_model", False) for lst in listeners):
         yield None
         return
@@ -128,6 +132,7 @@ def engaged_device_affine(iterator, listeners=()):
         yield aff
     finally:
         owner.pre_processor = pp
+        owner._device_affine_active = False
         for a in paused:
             a._cast_features = True
 

@@ -13,6 +13,7 @@ DL4J draws between DataVec and ND4J).
 from __future__ import annotations
 
 import csv
+import logging
 import os
 from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
@@ -20,6 +21,33 @@ import numpy as np
 
 from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
 from deeplearning4j_tpu.data.iterator import DataSetIterator
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+_warned_raw_uint8 = False
+
+
+def _maybe_warn_raw_uint8(it, ds):
+    """One-time guard against the silent 0-255 scale regression: raw uint8
+    image batches consumed with NO normalizer attached train on unscaled
+    pixels (4x-off inputs, degraded convergence) with no other runtime
+    signal. Skipped while a device-affine pre-processor is engaged — it is
+    detached from the iterator during such fits but normalization still
+    happens, on device (data/normalization.engaged_device_affine)."""
+    global _warned_raw_uint8
+    if (not _warned_raw_uint8
+            and ds.features is not None
+            and getattr(ds.features, "dtype", None) == np.uint8
+            and it.pre_processor is None
+            and not getattr(it, "_device_affine_active", False)):
+        _warned_raw_uint8 = True
+        log.warning(
+            "uint8 image batches are being consumed with no pre_processor "
+            "attached: the model sees raw 0-255 pixels. Attach "
+            "ImagePreProcessingScaler (set_pre_processor) or construct "
+            "ImageRecordReader(normalize=True) for float [0,1] batches. "
+            "(warned once; see docs/MIGRATION.md)")
+    return ds
 
 
 # -------------------------------------------------------------- record readers
@@ -188,7 +216,8 @@ class RecordReaderDataSetIterator(DataSetIterator):
         # every batch flows through the attached pre-processor (the
         # setPreProcessor contract every DataSetIterator honors —
         # device-norm fit detaches it and normalizes on device instead)
-        return (self._pp(ds) for ds in self._iter_raw())
+        return (self._pp(_maybe_warn_raw_uint8(self, ds))
+                for ds in self._iter_raw())
 
     def _iter_raw(self):
         if getattr(self.reader, "is_image", False):

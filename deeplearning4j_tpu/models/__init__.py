@@ -20,6 +20,8 @@ from deeplearning4j_tpu.models.zoo import (
     InceptionResNetV1,
     FaceNetNN4Small2,
     UNet,
+    model_by_name,
+    zoo_models,
 )
 from deeplearning4j_tpu.models.transformer import TransformerLM, TransformerLMMoE
 
@@ -27,5 +29,5 @@ __all__ = [
     "ZooModel", "LeNet", "SimpleCNN", "AlexNet", "VGG16", "VGG19",
     "ResNet50", "GoogLeNet", "Darknet19", "TinyYOLO", "YOLO2",
     "TextGenerationLSTM", "InceptionResNetV1", "FaceNetNN4Small2", "UNet",
-    "TransformerLM", "TransformerLMMoE",
+    "TransformerLM", "TransformerLMMoE", "model_by_name", "zoo_models",
 ]

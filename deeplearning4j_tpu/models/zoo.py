@@ -779,3 +779,28 @@ class UNet(ZooModel):
                     "head")
         g.set_outputs("loss")
         return g.build()
+
+
+# ------------------------------------------------------------ name registry
+def zoo_models() -> dict:
+    """Name -> ZooModel subclass map (every concrete arch in this module),
+    the resolver behind `zoo:<Name>` servable sources and CLI flags."""
+    out = {}
+    for obj in globals().values():
+        if isinstance(obj, type) and issubclass(obj, ZooModel) \
+                and obj is not ZooModel:
+            out[obj.__name__] = obj
+    return out
+
+
+def model_by_name(name: str, **overrides) -> ZooModel:
+    """Instantiate a zoo architecture by (case-insensitive) class name,
+    with dataclass field overrides (num_classes=, input_shape=, seed=).
+    Raises KeyError listing the known names for a typo'd arch."""
+    models = zoo_models()
+    by_lower = {k.lower(): v for k, v in models.items()}
+    cls = models.get(name) or by_lower.get(name.lower())
+    if cls is None:
+        raise KeyError(f"unknown zoo model {name!r}; available: "
+                       f"{', '.join(sorted(models))}")
+    return cls(**overrides)

@@ -556,7 +556,12 @@ class MultiLayerNetwork:
         gradients averaged into ONE optimizer step inside one jit, for
         effective batch sizes beyond what HBM fits in a single forward
         (see _make_accum_step; mutually exclusive with scan_steps > 1,
-        not applicable to tbptt).
+        not applicable to tbptt). Accumulation groups only CONSECUTIVE
+        same-shape micro-batches: a shape change (e.g. a non-drop-last
+        partial tail) cuts the group short, and the short group takes one
+        full-learning-rate step with the mean of however many gradients
+        it holds — use drop_last/padded iterators for uniform shapes if K
+        must be honored exactly (a warning fires once otherwise).
 
         scan_steps > 1 fuses that many optimizer steps into ONE jit call via
         lax.scan (input-pipelined fit): batches are stacked host-side while
@@ -861,6 +866,8 @@ class MultiLayerNetwork:
                                  + 7919 * (self.epoch_count + 1))
         grad_listeners = [lst for lst in self.listeners
                           if getattr(lst, "wants_gradients", False)]
+        sigs_seen = set()
+        warned_partial = [False]
 
         def process(p):
             loss, bs, etl_ms, capture, grads, updates = p
@@ -877,6 +884,24 @@ class MultiLayerNetwork:
 
         def dispatch(group, etl_ms):
             nonlocal rng
+            if len(group) < K and not warned_partial[0]:
+                # _run_scan_pipeline only groups CONSECUTIVE same-shape
+                # batches: a shape change (e.g. a non-drop-last partial
+                # tail) cuts the accumulation group short, and the short
+                # group still takes ONE full-learning-rate optimizer step
+                # with the mean of len(group) gradients — K is silently
+                # not honored for it. Surface that once.
+                warned_partial[0] = True
+                cause = ("the micro-batch shape changed mid-epoch (use "
+                         "drop_last or padded iterators for uniform "
+                         "shapes)" if len(sigs_seen) > 1
+                         else "the epoch ended mid-group")
+                log.warning(
+                    "fit(accumulate_steps=%d): dispatching an accumulation "
+                    "group of only %d micro-batch(es) because %s; the "
+                    "partial group takes one full-learning-rate step with "
+                    "the 1/%d gradient mean", K, len(group), cause,
+                    len(group))
             subs = []
             for _ in group:
                 rng, sub = jax.random.split(rng)
@@ -905,11 +930,13 @@ class MultiLayerNetwork:
             return loss, bs, etl_ms, capture, grads, updates
 
         def sig_of(ds):
-            return (np.shape(ds.features), np.shape(ds.labels),
-                    None if ds.features_mask is None
-                    else np.shape(ds.features_mask),
-                    None if ds.labels_mask is None
-                    else np.shape(ds.labels_mask))
+            s = (np.shape(ds.features), np.shape(ds.labels),
+                 None if ds.features_mask is None
+                 else np.shape(ds.features_mask),
+                 None if ds.labels_mask is None
+                 else np.shape(ds.labels_mask))
+            sigs_seen.add(s)
+            return s
 
         # unlike scan-fit, accumulation cannot fall back to per-call for
         # model-reading listeners (that would change the optimization) —

@@ -94,15 +94,21 @@ class ParallelInference:
         return forward
 
     def _run_batch(self, x):
-        """Pad to a multiple of the data-parallel degree, shard, run, slice."""
+        with self._swap_lock:   # (fn, params, state) read atomically vs swap
+            fn, params, state = self._fn, self.model.params, self.model.state
+        return self._run_with(fn, params, state, x)
+
+    def _run_with(self, fn, params, state, x):
+        """Pad to a multiple of the data-parallel degree, shard, run, slice.
+        Takes the (fn, params, state) triple explicitly so update_model can
+        warm a replacement model through the EXACT code path that will
+        serve it, before the atomic swap makes it live."""
         n = x.shape[0]
         pad_to = -(-max(n, 1) // self.n_devices) * self.n_devices
         if pad_to != n:
             pad = np.zeros((pad_to - n,) + x.shape[1:], x.dtype)
             x = np.concatenate([x, pad], axis=0)
         xd = jax.device_put(jnp.asarray(x), self._shard)
-        with self._swap_lock:   # (fn, params, state) read atomically vs swap
-            fn, params, state = self._fn, self.model.params, self.model.state
         # replicate weights over the mesh (no-op when already placed —
         # required when update_model swapped in a single-device model)
         rep = NamedSharding(self.mesh, P())
@@ -206,17 +212,26 @@ class ParallelInference:
             r.error = RuntimeError("ParallelInference has been shut down")
             r.event.set()
 
-    def update_model(self, model):
+    def update_model(self, model, warmup=None):
         """Hot-swap the served model (DL4J ParallelInference.updateModel).
 
         The jitted forward is re-created for the new model — the old one
         closed over the previous model's `_forward`. The (fn, model) pair is
         swapped atomically with respect to any batch in flight; batches
         already running finish on the old model. Only same-input-shape swaps
-        avoid recompilation, but any architecture is correct."""
+        avoid recompilation, but any architecture is correct.
+
+        `warmup`, when given, is called with a `run(x) -> np.ndarray`
+        closure over the NEW (fn, params, state) BEFORE the swap: live
+        traffic keeps hitting the old model while the replacement's XLA
+        programs compile, so the first post-swap request never pays compile
+        latency (the serving batcher warms its whole bucket ladder here)."""
         if model.params is None:
             raise RuntimeError("replacement model must be initialized")
         new_fn = jax.jit(self._make_forward(model))
+        if warmup is not None:
+            warmup(lambda x: self._run_with(new_fn, model.params,
+                                            model.state, x))
         with self._swap_lock:
             self.model = model
             self._fn = new_fn

@@ -1,0 +1,43 @@
+"""Production model serving — the L6/L7 layer over ParallelInference.
+
+Three parts (docs/SERVING.md):
+
+- **registry** — named, versioned servables loaded from checkpoint
+  manifests (SHA-256 verified), model zips, Keras imports, or zoo archs,
+  with zero-downtime hot-swap (warm-before-swap through
+  ParallelInference.update_model) and one-step rollback;
+- **batcher** — shape-bucketed dynamic batching: requests pad to a fixed
+  bucket ladder so the forward compiles at most once per bucket, AOT
+  warmup at load time keeps compiles off the request path, a coalescing
+  deadline bounds batching latency, and a bounded queue gives explicit
+  backpressure;
+- **server** — threaded stdlib HTTP front end (predict/swap/rollback/
+  healthz/readyz/metrics) with admission control (429/504, never a
+  traceback) and graceful SIGTERM drain.
+
+Quickstart:
+
+    from deeplearning4j_tpu.serving import ModelRegistry, ModelServer
+    registry = ModelRegistry()
+    registry.deploy("lenet", "zoo:LeNet")        # load + warm all buckets
+    server = ModelServer(registry, port=8500)    # live
+    # curl -d '{"inputs": [...]}' localhost:8500/v1/models/lenet/predict
+
+CLI: ``python -m deeplearning4j_tpu.serving --model lenet=zoo:LeNet``.
+"""
+from deeplearning4j_tpu.serving.batcher import (
+    DEFAULT_BUCKETS, DeadlineExceededError, ServerDrainingError,
+    ServerOverloadedError, ServingError, ShapeBucketedBatcher,
+)
+from deeplearning4j_tpu.serving.registry import (
+    ModelLoadError, ModelRegistry, ServedModel, ServableVersion,
+    load_servable,
+)
+from deeplearning4j_tpu.serving.server import ModelServer
+
+__all__ = [
+    "DEFAULT_BUCKETS", "DeadlineExceededError", "ModelLoadError",
+    "ModelRegistry", "ModelServer", "ServableVersion", "ServedModel",
+    "ServerDrainingError", "ServerOverloadedError", "ServingError",
+    "ShapeBucketedBatcher", "load_servable",
+]

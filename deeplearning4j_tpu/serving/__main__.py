@@ -1,0 +1,5 @@
+"""`python -m deeplearning4j_tpu.serving` — model-serving entrypoint
+(TensorFlow-Serving-style servable host; see serving/cli.py)."""
+from deeplearning4j_tpu.serving.cli import main
+
+raise SystemExit(main())

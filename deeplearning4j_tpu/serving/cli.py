@@ -1,0 +1,117 @@
+"""`python -m deeplearning4j_tpu.serving` — the serve CLI entrypoint.
+
+Stands up a ModelServer over one or more servables and runs until
+SIGTERM/SIGINT, then drains gracefully (stop admitting, flush in-flight,
+clean exit 0) — the deploy surface a process supervisor or container
+runtime manages.
+
+Usage:
+    python -m deeplearning4j_tpu.serving \
+        --model lenet=zoo:LeNet --port 8500 \
+        --buckets 1,8,32,128 --max-delay-ms 5 --deadline-s 30
+
+    # serve a training run's newest verified checkpoint:
+    python -m deeplearning4j_tpu.serving --model prod=/ckpts/run17
+
+See docs/SERVING.md for the API, bucket-ladder tuning, and the
+swap/rollback runbook.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import signal
+import sys
+import threading
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m deeplearning4j_tpu.serving",
+        description="Production model server: versioned registry, "
+                    "shape-bucketed AOT-warmed batching, admission "
+                    "control, zero-downtime hot-swap (docs/SERVING.md)")
+    p.add_argument("--model", action="append", required=True,
+                   metavar="NAME=SOURCE",
+                   help="servable to deploy; SOURCE is a checkpoint dir "
+                        "(manifest.json), a model zip, a Keras .h5, or "
+                        "zoo:<Arch>. Repeatable.")
+    p.add_argument("--host", default="127.0.0.1",
+                   help="bind address (0.0.0.0 behind a load balancer)")
+    p.add_argument("--port", type=int, default=8500)
+    p.add_argument("--buckets", default="1,8,32,128",
+                   help="batch-size bucket ladder (comma-separated)")
+    p.add_argument("--max-delay-ms", type=float, default=5.0,
+                   help="batching coalescing deadline")
+    p.add_argument("--queue-limit", type=int, default=256,
+                   help="admission-control queue bound (full -> 429)")
+    p.add_argument("--deadline-s", type=float, default=30.0,
+                   help="default per-request deadline (expired -> 504)")
+    p.add_argument("--drain-timeout-s", type=float, default=30.0,
+                   help="max time to flush in-flight work on SIGTERM")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    import os
+
+    import jax
+    if os.environ.get("JAX_PLATFORMS"):
+        # the axon TPU plugin force-appends itself to jax_platforms at
+        # import, overriding the env var — pin the user's choice back
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    from deeplearning4j_tpu.serving.registry import (
+        ModelLoadError, ModelRegistry,
+    )
+    from deeplearning4j_tpu.serving.server import ModelServer
+
+    try:
+        buckets = tuple(int(b) for b in args.buckets.split(",") if b)
+    except ValueError:
+        raise SystemExit(f"--buckets must be comma-separated ints, got "
+                         f"{args.buckets!r}")
+    specs = []
+    for spec in args.model:
+        name, sep, source = spec.partition("=")
+        if not sep or not name or not source:
+            raise SystemExit(f"--model expects NAME=SOURCE, got {spec!r}")
+        specs.append((name, source))
+
+    registry = ModelRegistry()
+    for name, source in specs:
+        try:
+            served = registry.deploy(name, source, buckets=buckets,
+                                     max_delay_ms=args.max_delay_ms,
+                                     queue_limit=args.queue_limit)
+        except ModelLoadError as e:
+            raise SystemExit(f"cannot deploy {name!r}: {e}")
+        print(json.dumps({"deployed": name,
+                          "input_shape": list(served.input_shape),
+                          "buckets": list(served.batcher.buckets)}),
+              file=sys.stderr)
+
+    server = ModelServer(registry, host=args.host, port=args.port,
+                         default_deadline_s=args.deadline_s)
+    print(json.dumps({"serving": server.url,
+                      "models": registry.names(),
+                      "endpoints": ["/v1/models", "/healthz", "/readyz",
+                                    "/metrics"]}))
+    sys.stdout.flush()
+
+    stop = threading.Event()
+
+    def _on_signal(signum, frame):
+        print(json.dumps({"signal": signum, "action": "drain"}),
+              file=sys.stderr)
+        stop.set()
+
+    for s in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(s, _on_signal)
+    stop.wait()
+    server.drain(timeout=args.drain_timeout_s)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,328 @@
+"""ModelRegistry — named, versioned servables with zero-downtime swaps.
+
+The TensorFlow-Serving servable lifecycle (load -> warm -> serve -> retire,
+with version history and rollback) mapped onto this framework's pieces:
+
+- **Sources.** A servable loads from any of the checkpoint/import surfaces
+  the training side already produces: a ResilientTrainer/CheckpointListener
+  checkpoint DIRECTORY (the newest manifest entry whose SHA-256 verifies —
+  a truncated or bit-rotted checkpoint falls back to the next-newest, never
+  serves), a plain `save_model` zip, a Keras .h5/.keras import, a
+  `zoo:<Arch>` architecture name (untrained — smoke/loadgen targets), or a
+  live MultiLayerNetwork/ComputationGraph object.
+- **Execution.** Each served model owns a `ParallelInference` (SEQUENTIAL
+  mode — the shape-bucketed batcher owns ALL coalescing) and a
+  `ShapeBucketedBatcher` whose ladder is AOT-warmed at load time.
+- **Hot swap.** `swap(name, source)` loads and warms the replacement
+  ENTIRELY off the request path (ParallelInference.update_model runs the
+  batcher's warmup against the new model's compiled fn first), then swaps
+  the (fn, model) pair atomically under the inference lock: in-flight
+  batches finish on the old version, the next batch runs the new one, and
+  no request ever observes a half-swapped model or a cold compile.
+- **Rollback.** Version history is kept in memory (bounded); `rollback`
+  re-activates the previous version through the same warmed-swap path.
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from deeplearning4j_tpu import monitor
+from deeplearning4j_tpu.serving.batcher import (
+    DEFAULT_BUCKETS, ShapeBucketedBatcher,
+)
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+
+class ModelLoadError(RuntimeError):
+    """A servable source could not be resolved/verified/loaded."""
+
+
+def _input_type_of(model):
+    """The single serving InputType of a container (multi-input graphs are
+    not servable over the single-tensor HTTP surface yet)."""
+    conf = model.conf
+    it = getattr(conf, "input_type", None)
+    if it is not None:
+        return it
+    types = getattr(conf, "input_types", None)
+    if types:
+        if len(types) > 1:
+            raise ModelLoadError(
+                "multi-input ComputationGraphs are not servable via the "
+                "HTTP predict surface (single input tensor per request)")
+        return types[0]
+    raise ModelLoadError(
+        f"{type(model).__name__} has no input_type; cannot derive the "
+        "serving input shape")
+
+
+def load_servable(source, cache_dir: Optional[str] = None):
+    """Resolve a servable source to an initialized model.
+
+    Accepted sources:
+    - live model object (MultiLayerNetwork / ComputationGraph)
+    - ``zoo:<ClassName>`` (e.g. ``zoo:LeNet``) — untrained zoo arch
+    - checkpoint directory with a ResilientTrainer ``manifest.json``
+      (newest SHA-256-verified entry; corrupt entries fall back)
+    - ``.zip`` — save_model / CheckpointListener / dl4j-import zip
+    - ``.h5`` / ``.keras`` — Keras import
+    """
+    if hasattr(source, "conf") and hasattr(source, "params"):
+        if source.params is None:
+            source.init()
+        return source
+    if not isinstance(source, (str, os.PathLike)):
+        raise ModelLoadError(f"cannot interpret servable source: "
+                             f"{type(source).__name__}")
+    src = str(source)
+    if src.startswith("zoo:"):
+        from deeplearning4j_tpu.models import zoo
+        return zoo.model_by_name(src[4:]).init()
+    if os.path.isdir(src):
+        from deeplearning4j_tpu.train.resilience import CheckpointManager
+        from deeplearning4j_tpu.util.serialization import load_model
+        entry = CheckpointManager(src).latest_valid()
+        if entry is None:
+            raise ModelLoadError(
+                f"{src}: no checkpoint in the manifest passed SHA-256 "
+                "verification")
+        log.info("serving: loading %s (iteration %d, sha256 verified)",
+                 entry["path"], entry.get("iteration", -1))
+        return load_model(entry["path"])
+    if not os.path.exists(src):
+        raise ModelLoadError(f"servable source not found: {src}")
+    lower = src.lower()
+    if lower.endswith((".h5", ".keras")):
+        from deeplearning4j_tpu.modelimport.keras import KerasModelImport
+        return KerasModelImport.import_keras_model_and_weights(src)
+    from deeplearning4j_tpu.util.serialization import load_model
+    return load_model(src)
+
+
+@dataclasses.dataclass
+class ServableVersion:
+    version: int
+    source: str
+    model: object = dataclasses.field(repr=False)
+    loaded_at: float = dataclasses.field(default_factory=time.time)
+
+    def describe(self) -> dict:
+        return {"version": self.version, "source": self.source,
+                "loaded_at": self.loaded_at,
+                "model_class": type(self.model).__name__}
+
+
+class ServedModel:
+    """One named servable: version history + ParallelInference + batcher."""
+
+    def __init__(self, name: str, model, source: str,
+                 buckets: Sequence[int] = DEFAULT_BUCKETS,
+                 max_delay_ms: float = 5.0,
+                 queue_limit: int = 256,
+                 mesh=None):
+        from deeplearning4j_tpu.parallel.inference import (
+            InferenceMode, ParallelInference,
+        )
+        self.name = name
+        self.status = "loading"
+        # _swap_lock serializes whole swap/rollback operations (incl. the
+        # multi-second warmup); _state_lock guards only brief mutations of
+        # versions/active, so describe() and the predict hot path never
+        # block behind a warming swap
+        self._swap_lock = threading.Lock()
+        self._state_lock = threading.Lock()
+        self.versions: List[ServableVersion] = [
+            ServableVersion(1, source, model)]
+        self.active = 0                     # index into versions
+        #: lock-free snapshot of the active version's metadata for the
+        #: request path (atomic attribute swap; never indexes live lists)
+        self.active_info = self.versions[0].describe()
+        self.pi = ParallelInference(model, mesh=mesh,
+                                    mode=InferenceMode.SEQUENTIAL)
+        it = _input_type_of(model)
+        self.input_shape: Tuple[int, ...] = tuple(it.shape)
+        self.batcher = ShapeBucketedBatcher(
+            self.pi.output, self.input_shape, buckets=buckets,
+            max_delay_ms=max_delay_ms, queue_limit=queue_limit, name=name)
+        self.batcher.warm()
+        self.status = "ready"
+        monitor.gauge("serving_model_ready",
+                      "1 while the servable is warmed and live",
+                      labels=("model",)).set(1, model=name)
+
+    # ----------------------------------------------------------- lifecycle
+    def _activate(self, sv: ServableVersion):
+        """Warm the candidate's full bucket ladder against its freshly
+        compiled forward, then atomically swap it live."""
+        new_model = sv.model
+        new_it = _input_type_of(new_model)
+        if tuple(new_it.shape) != self.input_shape:
+            raise ModelLoadError(
+                f"swap rejected: {sv.source!r} expects input "
+                f"{tuple(new_it.shape)}, live servable {self.name!r} "
+                f"serves {self.input_shape} (deploy under a new name)")
+        t0 = time.perf_counter()
+        with monitor.span("serving/swap", model=self.name,
+                          version=sv.version):
+            self.pi.update_model(new_model, warmup=self.batcher.warm)
+        monitor.histogram("serving_swap_seconds",
+                          "Load+warm+swap duration (off the request path)",
+                          labels=("model",),
+                          buckets=(0.1, 0.5, 1, 2.5, 5, 10, 30, 60, 120)
+                          ).observe(time.perf_counter() - t0,
+                                    model=self.name)
+
+    def swap(self, source, keep_versions: int = 3) -> dict:
+        """Load `source`, warm it off-path, make it the active version."""
+        model = load_servable(source)
+        with self._swap_lock:
+            with self._state_lock:
+                next_version = self.versions[-1].version + 1
+            sv = ServableVersion(next_version, str(source), model)
+            self._activate(sv)          # multi-second warm: no state lock
+            with self._state_lock:
+                self.versions.append(sv)
+                self.active = len(self.versions) - 1
+                # bound in-memory history; sources stay in the metadata
+                while len(self.versions) > keep_versions:
+                    dropped = self.versions.pop(0)
+                    self.active -= 1
+                    log.info("serving[%s]: retired v%d (%s) from memory",
+                             self.name, dropped.version, dropped.source)
+                self.active_info = sv.describe()
+            monitor.counter("serving_swaps_total",
+                            "Zero-downtime model hot-swaps",
+                            labels=("model",)).inc(model=self.name)
+        log.info("serving[%s]: now serving v%d (%s)", self.name,
+                 sv.version, sv.source)
+        return sv.describe()
+
+    def rollback(self) -> dict:
+        """One-step rollback: re-activate the version before the active
+        one through the same warmed-swap path."""
+        with self._swap_lock:
+            with self._state_lock:
+                if self.active == 0:
+                    raise ModelLoadError(
+                        f"serving[{self.name}]: no previous version in "
+                        "memory to roll back to")
+                sv = self.versions[self.active - 1]
+            self._activate(sv)          # multi-second warm: no state lock
+            with self._state_lock:
+                self.active -= 1
+                self.active_info = sv.describe()
+            monitor.counter("serving_rollbacks_total",
+                            "One-step version rollbacks",
+                            labels=("model",)).inc(model=self.name)
+        log.warning("serving[%s]: rolled back to v%d (%s)", self.name,
+                    sv.version, sv.source)
+        return sv.describe()
+
+    # ------------------------------------------------------------- queries
+    def predict(self, x, deadline: Optional[float] = None):
+        return self.batcher.predict(x, deadline=deadline)
+
+    def describe(self) -> dict:
+        with self._state_lock:
+            return {
+                "name": self.name,
+                "status": self.status,
+                "input_shape": list(self.input_shape),
+                "buckets": list(self.batcher.buckets),
+                "active_version": self.versions[self.active].version,
+                "versions": [v.describe() for v in self.versions],
+            }
+
+    def shutdown(self, drain: bool = True, timeout: float = 30.0):
+        self.status = "stopping"
+        monitor.gauge("serving_model_ready",
+                      "1 while the servable is warmed and live",
+                      labels=("model",)).set(0, model=self.name)
+        if drain:
+            self.batcher.drain(timeout=timeout)
+        else:
+            self.batcher.shutdown()
+        self.pi.shutdown()
+
+
+class ModelRegistry:
+    """Thread-safe name -> ServedModel registry (the servable manager)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # deploys are rare admin ops: serializing them end-to-end (incl.
+        # load+warm) closes the check-then-act race where two concurrent
+        # deploys of one name would both build ServedModels and leak one
+        self._deploy_lock = threading.Lock()
+        self._models: Dict[str, ServedModel] = {}
+
+    def deploy(self, name: str, source,
+               buckets: Sequence[int] = DEFAULT_BUCKETS,
+               max_delay_ms: float = 5.0,
+               queue_limit: int = 256,
+               mesh=None) -> ServedModel:
+        """Load, warm, and publish a servable under `name`. Deploying an
+        existing name is a swap (version bump), not a replacement — the
+        live batcher keeps ITS configuration (undeploy first to change
+        buckets/queue bounds)."""
+        with self._deploy_lock:
+            with self._lock:
+                existing = self._models.get(name)
+            if existing is not None:
+                if tuple(buckets) != existing.batcher.buckets \
+                        or queue_limit != existing.batcher._queue.maxsize:
+                    log.warning(
+                        "serving[%s]: redeploy is a version swap — the "
+                        "requested batcher config (buckets %s, queue %d) "
+                        "is IGNORED; live config stays %s/%d (undeploy "
+                        "first to change it)", name, tuple(buckets),
+                        queue_limit, existing.batcher.buckets,
+                        existing.batcher._queue.maxsize)
+                existing.swap(source)
+                return existing
+            model = load_servable(source)
+            served = ServedModel(name, model, str(source), buckets=buckets,
+                                 max_delay_ms=max_delay_ms,
+                                 queue_limit=queue_limit, mesh=mesh)
+            with self._lock:
+                self._models[name] = served
+        log.info("serving: deployed %r v1 (%s), buckets %s, input %s",
+                 name, source, served.batcher.buckets, served.input_shape)
+        return served
+
+    def get(self, name: str) -> Optional[ServedModel]:
+        with self._lock:
+            return self._models.get(name)
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._models)
+
+    def describe(self) -> dict:
+        with self._lock:
+            models = list(self._models.values())
+        return {"models": [m.describe() for m in models]}
+
+    def all_ready(self) -> bool:
+        with self._lock:
+            models = list(self._models.values())
+        return bool(models) and all(m.status == "ready" for m in models)
+
+    def undeploy(self, name: str, drain: bool = True):
+        with self._lock:
+            served = self._models.pop(name, None)
+        if served is not None:
+            served.shutdown(drain=drain)
+
+    def shutdown(self, drain: bool = True, timeout: float = 30.0):
+        with self._lock:
+            models = list(self._models.values())
+            self._models.clear()
+        for m in models:
+            m.shutdown(drain=drain, timeout=timeout)

@@ -1,0 +1,310 @@
+"""ModelServer — the network-facing serving front end.
+
+A threaded stdlib HTTP server (no new dependencies — the same
+ThreadingHTTPServer pattern as ui/server.py) in front of a ModelRegistry:
+
+    POST /v1/models/{name}/predict    JSON {"inputs": [...]} or raw .npy
+    GET  /v1/models                   all servables, versions, status
+    GET  /v1/models/{name}            one servable
+    POST /v1/models/{name}/swap       {"source": <path|zoo:Arch>}
+    POST /v1/models/{name}/rollback
+    GET  /healthz                     process liveness (always 200)
+    GET  /readyz                      200 only when warmed and not draining
+    GET  /metrics                     Prometheus exposition (monitor/)
+
+Failure discipline (the acceptance contract): admission control maps a
+full request queue to **429** with Retry-After (bounded queue -> explicit
+backpressure, never an unbounded latency collapse), an expired per-request
+deadline to **504**, a draining/not-ready server to **503**, bad payloads
+to **400**, and anything unexpected to a JSON **500** with the error class
+only — a traceback never crosses the wire. Every response increments
+``serving_requests_total{model,code}`` and observes
+``serving_request_seconds`` so the /metrics scrape sees exactly what
+clients saw.
+
+Shutdown: `drain()` (wired to SIGTERM by the CLI) flips /readyz to 503 so
+load balancers stop routing, lets in-flight + queued requests flush
+through the batchers, then stops the listener — the serving analog of
+ResilientTrainer's preemption-to-clean-exit contract.
+"""
+from __future__ import annotations
+
+import io
+import json
+import logging
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+import numpy as np
+
+from deeplearning4j_tpu import monitor
+from deeplearning4j_tpu.serving.batcher import (
+    DeadlineExceededError, ServerDrainingError, ServerOverloadedError,
+)
+from deeplearning4j_tpu.serving.registry import ModelLoadError, ModelRegistry
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+_MAX_BODY = 256 << 20           # admission guard on Content-Length
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "DL4JTPU-Serving/1.0"
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *a):          # requests are metered, not logged
+        pass
+
+    # ------------------------------------------------------------- plumbing
+    @property
+    def _srv(self) -> "ModelServer":
+        return self.server.model_server        # type: ignore[attr-defined]
+
+    def _reply(self, code: int, body: bytes, ctype: str, extra=()):
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in extra:
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _json(self, obj, code: int = 200, extra=()):
+        self._reply(code, json.dumps(obj).encode(), "application/json",
+                    extra)
+
+    def _meter(self, model: str, code: int, t0: float):
+        if code == 404:
+            # client-supplied names that don't resolve must not mint new
+            # label sets — a URL prober would grow the registry unbounded
+            model = "_unknown"
+        monitor.counter("serving_requests_total",
+                        "HTTP serving requests by model and status code",
+                        labels=("model", "code")).inc(
+            model=model, code=str(code))
+        monitor.histogram("serving_request_seconds",
+                          "End-to-end HTTP request latency",
+                          labels=("model",)).observe(
+            time.perf_counter() - t0, model=model)
+
+    def _body(self) -> bytes:
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+        except (TypeError, ValueError):
+            raise ValueError("bad Content-Length header")
+        if length < 0 or length > _MAX_BODY:
+            raise ValueError(f"unreasonable Content-Length {length}")
+        return self.rfile.read(length)
+
+    # ---------------------------------------------------------------- GET
+    def do_GET(self):
+        url = urlparse(self.path)
+        parts = [p for p in url.path.split("/") if p]
+        if url.path == "/healthz":
+            self._json({"status": "alive"})
+            return
+        if url.path == "/readyz":
+            if self._srv.ready():
+                self._json({"status": "ready",
+                            "models": self._srv.registry.names()})
+            else:
+                self._json({"status": "draining"
+                            if self._srv.draining else "loading"}, code=503)
+            return
+        if url.path == "/metrics":
+            self._reply(200, monitor.prometheus_text().encode(),
+                        "text/plain; version=0.0.4; charset=utf-8")
+            return
+        if parts[:2] == ["v1", "models"]:
+            if len(parts) == 2:
+                self._json(self._srv.registry.describe())
+                return
+            if len(parts) == 3:
+                served = self._srv.registry.get(parts[2])
+                if served is None:
+                    self._json({"error": f"unknown model {parts[2]!r}"},
+                               code=404)
+                else:
+                    self._json(served.describe())
+                return
+        self._json({"error": "not found"}, code=404)
+
+    # --------------------------------------------------------------- POST
+    def do_POST(self):
+        url = urlparse(self.path)
+        parts = [p for p in url.path.split("/") if p]
+        if parts[:2] == ["v1", "models"] and len(parts) == 4:
+            name, verb = parts[2], parts[3]
+            if verb == "predict":
+                self._predict(name, url)
+                return
+            if verb in ("swap", "rollback"):
+                self._admin(name, verb)
+                return
+        self._json({"error": "not found"}, code=404)
+
+    def _parse_inputs(self, url) -> np.ndarray:
+        """Request payload -> float array. JSON {"inputs": nested lists}
+        or a raw .npy body (Content-Type: application/octet-stream)."""
+        body = self._body()
+        ctype = (self.headers.get("Content-Type") or "").split(";")[0]
+        if ctype == "application/octet-stream":
+            x = np.load(io.BytesIO(body), allow_pickle=False)
+        else:
+            payload = json.loads(body or b"{}")
+            if not isinstance(payload, dict) or "inputs" not in payload:
+                raise ValueError('JSON body must be {"inputs": [...]}')
+            x = np.asarray(payload["inputs"], "float32")
+        if x.ndim == 0:
+            raise ValueError("inputs must be at least rank 1")
+        return x
+
+    def _predict(self, name: str, url):
+        t0 = time.perf_counter()
+        q = parse_qs(url.query)
+        served = self._srv.registry.get(name)
+        if served is None:
+            self._meter(name, 404, t0)
+            self._json({"error": f"unknown model {name!r}"}, code=404)
+            return
+        code = 500
+        try:
+            with monitor.span("serving/request", model=name):
+                x = self._parse_inputs(url)
+                batched = x.shape[1:] == served.input_shape
+                if not batched and x.shape == served.input_shape:
+                    x = x[None]          # single unbatched example
+                try:
+                    deadline = float(q["deadline_ms"][0]) / 1e3 \
+                        if "deadline_ms" in q else self._srv.default_deadline
+                except ValueError:
+                    raise ValueError("deadline_ms must be a number")
+                y = served.predict(x, deadline=deadline)
+                if not batched and y.shape[0] == 1:
+                    y = y[0]
+            accept = self.headers.get("Accept", "")
+            code = 200
+            if "application/octet-stream" in accept:
+                buf = io.BytesIO()
+                np.save(buf, np.asarray(y), allow_pickle=False)
+                self._reply(200, buf.getvalue(), "application/octet-stream")
+            else:
+                self._json({
+                    "model": name,
+                    "version": served.active_info["version"],
+                    "outputs": np.asarray(y).tolist(),
+                    "latency_ms": round(
+                        (time.perf_counter() - t0) * 1e3, 3),
+                })
+        except ServerOverloadedError as e:
+            code = 429
+            self._json({"error": str(e)}, code=429,
+                       extra=(("Retry-After", "1"),))
+        except DeadlineExceededError as e:
+            code = 504
+            self._json({"error": str(e)}, code=504)
+        except ServerDrainingError as e:
+            code = 503
+            self._json({"error": str(e)}, code=503,
+                       extra=(("Retry-After", "5"),))
+        except ValueError as e:
+            code = 400
+            self._json({"error": str(e)}, code=400)
+        except Exception as e:          # noqa: BLE001 — never a traceback
+            code = 500
+            log.exception("serving[%s]: predict failed", name)
+            self._json({"error": f"{type(e).__name__}: {e}"}, code=500)
+        finally:
+            self._meter(name, code, t0)
+
+    def _admin(self, name: str, verb: str):
+        t0 = time.perf_counter()
+        served = self._srv.registry.get(name)
+        if served is None:
+            self._meter(name, 404, t0)
+            self._json({"error": f"unknown model {name!r}"}, code=404)
+            return
+        code = 500
+        try:
+            if verb == "swap":
+                payload = json.loads(self._body() or b"{}")
+                source = payload.get("source") \
+                    if isinstance(payload, dict) else None
+                if not source:
+                    raise ValueError('body must be {"source": <path>}')
+                info = served.swap(source)
+            else:
+                info = served.rollback()
+            code = 200
+            self._json({"model": name, "active": info})
+        except (ValueError, ModelLoadError) as e:
+            code = 400
+            self._json({"error": str(e)}, code=400)
+        except Exception as e:          # noqa: BLE001
+            code = 500
+            log.exception("serving[%s]: %s failed", name, verb)
+            self._json({"error": f"{type(e).__name__}: {e}"}, code=500)
+        finally:
+            self._meter(name, code, t0)
+
+
+class ModelServer:
+    """HTTP front end over a ModelRegistry.
+
+    Usage:
+        registry = ModelRegistry()
+        registry.deploy("lenet", "zoo:LeNet")
+        server = ModelServer(registry, port=8500)   # serving immediately
+        ...
+        server.drain()                              # graceful stop
+    """
+
+    def __init__(self, registry: Optional[ModelRegistry] = None,
+                 host: str = "127.0.0.1", port: int = 0,
+                 default_deadline_s: float = 30.0):
+        self.registry = registry if registry is not None else ModelRegistry()
+        self.default_deadline = float(default_deadline_s)
+        self.draining = False
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.model_server = self          # type: ignore[attr-defined]
+        self.host = host
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True, name="ModelServer")
+        self._thread.start()
+        log.info("serving: listening on http://%s:%d", host, self.port)
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def ready(self) -> bool:
+        return not self.draining and self.registry.all_ready()
+
+    def drain(self, timeout: float = 30.0):
+        """Graceful shutdown: stop admitting (readyz -> 503 so the load
+        balancer drains us), flush in-flight and queued requests, then
+        stop the listener."""
+        if self.draining:
+            return
+        self.draining = True
+        monitor.counter("serving_drains_total",
+                        "Graceful drain/shutdown sequences").inc()
+        log.warning("serving: draining (readyz now 503; flushing queues)")
+        self.registry.shutdown(drain=True, timeout=timeout)
+        self.stop()
+        log.warning("serving: drained and stopped")
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        if not self.draining:
+            self.drain(timeout=5.0)

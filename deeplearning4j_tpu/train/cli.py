@@ -70,7 +70,34 @@ def build_parser() -> argparse.ArgumentParser:
                    help="record telemetry spans and write a Chrome "
                         "trace-event JSON to PATH on exit (load in "
                         "Perfetto / chrome://tracing)")
+    p.add_argument("--serve-port", type=int, default=None,
+                   help="after a successful fit, serve the trained model "
+                        "over HTTP on this port (shape-bucketed batching, "
+                        "warmed; docs/SERVING.md) until SIGTERM/SIGINT, "
+                        "then drain gracefully")
+    p.add_argument("--serve-buckets", default="1,8,32,128",
+                   help="batch-size bucket ladder for --serve-port")
     return p
+
+
+def _serve_trained(net, args) -> None:
+    """train -> serve handoff: publish the just-trained model on
+    --serve-port and block until a signal requests a graceful drain."""
+    import signal
+    import threading
+
+    from deeplearning4j_tpu.serving import ModelRegistry, ModelServer
+    registry = ModelRegistry()
+    registry.deploy("model", net, buckets=args.serve_buckets)
+    server = ModelServer(registry, port=args.serve_port)
+    print(json.dumps({"serving": server.url,
+                      "predict": "/v1/models/model/predict"}),
+          file=sys.stderr)
+    stop = threading.Event()
+    for s in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(s, lambda *_: stop.set())
+    stop.wait()
+    server.drain()
 
 
 def _load_data(name: str, batch_size: int, allow_synthetic: bool = False):
@@ -103,6 +130,14 @@ def _load_data(name: str, batch_size: int, allow_synthetic: bool = False):
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    # validate BEFORE the (possibly hours-long) fit: a typo'd ladder must
+    # not surface only when the post-training serve handoff starts
+    try:
+        args.serve_buckets = tuple(
+            int(b) for b in args.serve_buckets.split(",") if b)
+    except ValueError:
+        raise SystemExit(f"--serve-buckets must be comma-separated ints, "
+                         f"got {args.serve_buckets!r}")
     import os
 
     import jax
@@ -206,6 +241,8 @@ def main(argv=None) -> int:
                           "final_score": net.score(),
                           "iterations": net.iteration_count,
                           "epochs": net.epoch_count}))
+        if args.serve_port is not None:
+            _serve_trained(net, args)
         if ui_server is not None:
             ui_server.stop()
         return 0

@@ -1,0 +1,166 @@
+#!/usr/bin/env python
+"""Load generator for the model-serving HTTP surface (docs/SERVING.md).
+
+Closed loop (default): N worker threads each keep one request in flight —
+measures the server's saturated throughput and latency under a fixed
+concurrency. Open loop: requests fire on a fixed arrival schedule
+regardless of completions (the honest way to measure tail latency at a
+target offered rate — a closed loop self-throttles when the server slows,
+hiding queueing collapse).
+
+    python tools/serve_loadgen.py --url http://127.0.0.1:8500 \
+        --model lenet --requests 500 --concurrency 8 [--rate 200]
+
+Reports p50/p90/p99 latency, goodput (2xx/sec over the wall clock), and a
+status-code histogram as JSON on stdout. Exit 0 iff every request
+succeeded (2xx), so CI can use it as an assertion.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+
+
+def percentile(xs, p):
+    if not xs:
+        return None
+    xs = sorted(xs)
+    i = min(len(xs) - 1, int(round(p / 100 * (len(xs) - 1))))
+    return xs[i]
+
+
+class LoadGen:
+    def __init__(self, args, input_shape):
+        self.args = args
+        self.input_shape = tuple(input_shape)
+        self.url = (f"{args.url}/v1/models/{args.model}/predict"
+                    + (f"?deadline_ms={args.deadline_ms}"
+                       if args.deadline_ms else ""))
+        self.lock = threading.Lock()
+        self.latencies = []             # seconds, successful only
+        self.codes = {}
+        self.rs = np.random.RandomState(args.seed)
+        self.bodies = [
+            json.dumps({"inputs": self.rs.rand(
+                b, *self.input_shape).astype("float32").tolist()}).encode()
+            for b in (args.batch_sizes or [1])
+        ]
+
+    def one(self, i: int):
+        body = self.bodies[i % len(self.bodies)]
+        t0 = time.perf_counter()
+        try:
+            r = urllib.request.urlopen(urllib.request.Request(
+                self.url, data=body,
+                headers={"Content-Type": "application/json"}),
+                timeout=self.args.timeout_s)
+            code = r.status
+            r.read()
+        except urllib.error.HTTPError as e:
+            code = e.code
+            e.read()
+        except Exception:               # connection refused/reset, timeout
+            code = 0
+        dt = time.perf_counter() - t0
+        with self.lock:
+            self.codes[code] = self.codes.get(code, 0) + 1
+            if 200 <= code < 300:
+                self.latencies.append(dt)
+
+    def run_closed(self):
+        n = self.args.requests
+        counter = iter(range(n))
+        counter_lock = threading.Lock()
+
+        def worker():
+            while True:
+                with counter_lock:
+                    i = next(counter, None)
+                if i is None:
+                    return
+                self.one(i)
+
+        threads = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(self.args.concurrency)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return time.perf_counter() - t0
+
+    def run_open(self):
+        period = 1.0 / self.args.rate
+        threads = []
+        t0 = time.perf_counter()
+        for i in range(self.args.requests):
+            target = t0 + i * period
+            delay = target - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            t = threading.Thread(target=self.one, args=(i,), daemon=True)
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join(timeout=self.args.timeout_s + 5)
+        return time.perf_counter() - t0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--url", default="http://127.0.0.1:8500")
+    p.add_argument("--model", default="model")
+    p.add_argument("--requests", type=int, default=200)
+    p.add_argument("--concurrency", type=int, default=8,
+                   help="closed-loop worker threads")
+    p.add_argument("--rate", type=float, default=None,
+                   help="open-loop offered rate (req/s); omit = closed loop")
+    p.add_argument("--input-shape", default=None,
+                   help="comma ints; default: ask GET /v1/models/{name}")
+    p.add_argument("--batch-sizes", default="1,2,4",
+                   help="cycle of per-request batch sizes")
+    p.add_argument("--deadline-ms", type=float, default=None)
+    p.add_argument("--timeout-s", type=float, default=30.0)
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args(argv)
+    args.batch_sizes = [int(b) for b in str(args.batch_sizes).split(",") if b]
+
+    if args.input_shape:
+        shape = tuple(int(s) for s in args.input_shape.split(",") if s)
+    else:
+        meta = json.loads(urllib.request.urlopen(
+            f"{args.url}/v1/models/{args.model}", timeout=10).read())
+        shape = tuple(meta["input_shape"])
+
+    gen = LoadGen(args, shape)
+    wall = gen.run_open() if args.rate else gen.run_closed()
+    ok = sum(n for c, n in gen.codes.items() if 200 <= c < 300)
+    lat_ms = [l * 1e3 for l in gen.latencies]
+    report = {
+        "mode": "open" if args.rate else "closed",
+        "requests": args.requests,
+        "ok": ok,
+        "errors": args.requests - ok,
+        "codes": {str(k): v for k, v in sorted(gen.codes.items())},
+        "wall_s": round(wall, 3),
+        "goodput_rps": round(ok / wall, 2) if wall > 0 else None,
+        "latency_ms": {
+            "p50": round(percentile(lat_ms, 50), 3) if lat_ms else None,
+            "p90": round(percentile(lat_ms, 90), 3) if lat_ms else None,
+            "p99": round(percentile(lat_ms, 99), 3) if lat_ms else None,
+            "max": round(max(lat_ms), 3) if lat_ms else None,
+        },
+    }
+    print(json.dumps(report, indent=1))
+    return 0 if ok == args.requests else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

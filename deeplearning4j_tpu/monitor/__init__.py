@@ -12,6 +12,12 @@ slow and is it compute, ETL, or comms"). Two dependency-free halves:
   manager — zero-cost while disabled — producing thread-aware Chrome
   trace-event JSON loadable in Perfetto / chrome://tracing, with
   optional mirroring into jax.profiler trace annotations.
+- **Compiled-program ledger** (monitor/xla.py, `monitor.xla.*`): every
+  hot-path XLA program's fingerprint, compile time, cost_analysis FLOPs
+  / bytes accessed, and memory_analysis HBM breakdown — `xla_*` metric
+  families, live `train_mfu_pct` / `serving_mfu_pct` gauges, and a JSON
+  perf-ledger artifact gated by tools/perf_report.py. Zero-cost while
+  disabled (the default), same contract as `span()`.
 
 Everything in-tree records into the default registry: the fit loops
 (step wall time, host sync, examples/sec, score), the async ETL pipeline
@@ -37,6 +43,9 @@ from deeplearning4j_tpu.monitor.trace import (
     add_span, clear_trace, disable_tracing, enable_tracing, instant,
     save_trace, span, trace_events, tracing_enabled,
 )
+# the compiled-program ledger (xla_* families, MFU gauges, perf ledger
+# JSON) — namespaced as monitor.xla; see docs/OBSERVABILITY.md
+from deeplearning4j_tpu.monitor import xla  # noqa: E402,F401
 
 __all__ = [
     "DEFAULT_BUCKETS", "REGISTRY", "Counter", "Gauge", "Histogram",
@@ -44,4 +53,5 @@ __all__ = [
     "prometheus_text", "summary",
     "add_span", "clear_trace", "disable_tracing", "enable_tracing",
     "instant", "save_trace", "span", "trace_events", "tracing_enabled",
+    "xla",
 ]

@@ -1,0 +1,544 @@
+"""Compiled-program ledger — the third half of the telemetry subsystem.
+
+PR 4's metrics/tracing see everything *around* the compiled step (queues,
+spans, request latencies); this module sees *inside* it. For every XLA
+program compiled on a hot path (MLN/Graph fit in all variants,
+ParallelInference, the serving batcher's AOT warmups, bench.py), the
+ledger records:
+
+- a stable **program fingerprint** (name + argument shapes/dtypes + a
+  hash of the lowered HLO) — recompiles of the same program dedup to one
+  entry while ``xla_compiles_total`` keeps counting events;
+- **compile wall time** (the AOT ``lower().compile()`` at capture time;
+  with the persistent compile cache warm this is the cache-hit cost, and
+  the same number is emitted as an ``xla/compile`` trace span);
+- ``cost_analysis()`` **FLOPs and bytes accessed** → arithmetic
+  intensity and the program's roofline position vs. device peak;
+- ``memory_analysis()`` **HBM breakdown** (arguments/output/temps and
+  their sum as the peak-residency figure).
+
+On top of the ledger sits a live **MFU accountant**: call sites feed
+measured per-step wall time into :func:`observe_step` and the
+``train_mfu_pct`` / ``serving_mfu_pct`` gauges report
+``flops / step_seconds / device_peak`` — the number ROADMAP item 2 is
+chasing, self-reported by every fit and every bench run.
+
+Zero-cost-when-disabled is the same hard contract as ``trace.span()``:
+while the ledger is off (default), every hook is one module-global bool
+read and the hot paths are byte-identical to the uninstrumented code —
+no lowering, no clock reads, no device→host syncs. Backends without
+cost/memory analysis degrade gracefully: the probe failure increments
+``xla_analysis_unavailable_total{kind=...}`` and the rest of the record
+still lands.
+
+Quickstart:
+
+    from deeplearning4j_tpu import monitor
+    monitor.xla.enable_ledger("/tmp/perf_ledger.json")
+    net.fit(data, epochs=1)                  # programs captured as compiled
+    monitor.xla.save_ledger()                # JSON artifact for perf_report
+    print(monitor.prometheus_text())         # xla_* families + train_mfu_pct
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from deeplearning4j_tpu.monitor import metrics, trace
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+#: peak dense-matmul FLOPs/s per chip by jax device_kind (bf16 for TPUs).
+#: DL4J_TPU_PEAK_FLOPS overrides for unlisted devices (e.g. a nominal CPU
+#: peak in smoke tests — the gauge is then live but its absolute value is
+#: only as real as the override).
+PEAK_FLOPS_BY_KIND = {
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v4": 275e12,
+    "TPU v6 lite": 918e12,
+}
+
+#: HBM bandwidth bytes/s per chip — the roofline's memory ceiling
+#: (ridge point = peak_flops / hbm_bytes_per_sec).
+HBM_BYTES_PER_SEC_BY_KIND = {
+    "TPU v5 lite": 819e9,
+    "TPU v5e": 819e9,
+    "TPU v4": 1228e9,
+    "TPU v6 lite": 1640e9,
+}
+
+#: compile-time buckets: µs-scale cache hits through multi-minute TPU
+#: ResNet compiles (the r5 sweeps measured ~3 min/program via the tunnel).
+COMPILE_BUCKETS = (0.01, 0.05, 0.25, 1.0, 5.0, 15.0, 60.0, 180.0, 600.0)
+
+LEDGER_SCHEMA_VERSION = 1
+
+_lock = threading.RLock()
+_enabled = False
+_default_path: Optional[str] = None
+_records: Dict[str, "ProgramRecord"] = {}    # fingerprint -> record
+_latest: Dict[str, "ProgramRecord"] = {}     # domain -> last captured/observed
+_last_mfu: Dict[str, float] = {}             # domain -> last gauge value
+_device_info: Optional[Tuple[Optional[str], Optional[str]]] = None
+
+
+class ProgramRecord:
+    """One distinct compiled XLA program (deduped by fingerprint).
+
+    `flops` / `bytes_accessed` are cost_analysis numbers AS REPORTED by
+    XLA, which counts a while/scan body ONCE regardless of trip count —
+    so a fused scan-of-K train step reports ~1 step's flops. Callers
+    record `steps_per_call` (K for scan/accum programs, 1 otherwise) and
+    `total_flops_per_call` is the per-execution figure MFU uses."""
+
+    __slots__ = ("fingerprint", "name", "domain", "arg_shapes", "hlo_hash",
+                 "compile_seconds", "compiles", "flops", "bytes_accessed",
+                 "hbm", "examples_per_call", "steps_per_call",
+                 "first_captured_unix")
+
+    def __init__(self, fingerprint, name, domain, arg_shapes, hlo_hash,
+                 compile_seconds, flops, bytes_accessed, hbm,
+                 examples_per_call, steps_per_call):
+        self.fingerprint = fingerprint
+        self.name = name
+        self.domain = domain
+        self.arg_shapes = arg_shapes
+        self.hlo_hash = hlo_hash
+        self.compile_seconds = compile_seconds    # first capture's wall time
+        self.compiles = 1
+        self.flops = flops
+        self.bytes_accessed = bytes_accessed
+        self.hbm = hbm                            # dict or None
+        self.examples_per_call = examples_per_call
+        self.steps_per_call = max(int(steps_per_call), 1)
+        self.first_captured_unix = time.time()
+
+    @property
+    def total_flops_per_call(self) -> Optional[float]:
+        if not self.flops:
+            return None
+        return self.flops * self.steps_per_call
+
+    @property
+    def arithmetic_intensity(self) -> Optional[float]:
+        if self.flops and self.bytes_accessed:
+            return self.flops / self.bytes_accessed
+        return None
+
+    @property
+    def hbm_peak_bytes(self) -> Optional[int]:
+        return hbm_peak(self.hbm)
+
+    def to_json(self) -> dict:
+        ai = self.arithmetic_intensity
+        return {
+            "fingerprint": self.fingerprint,
+            "name": self.name,
+            "domain": self.domain,
+            "arg_shapes": list(self.arg_shapes),
+            "hlo_hash": self.hlo_hash,
+            "compile_seconds": round(self.compile_seconds, 6),
+            "compiles": self.compiles,
+            "flops": self.flops,
+            "bytes_accessed": self.bytes_accessed,
+            "arithmetic_intensity": None if ai is None else round(ai, 3),
+            "hbm": self.hbm,
+            "hbm_peak_bytes": self.hbm_peak_bytes,
+            "examples_per_call": self.examples_per_call,
+            "steps_per_call": self.steps_per_call,
+            "total_flops_per_call": self.total_flops_per_call,
+            "first_captured_unix": round(self.first_captured_unix, 3),
+        }
+
+    def brief(self) -> dict:
+        """Compact row for bench sweep JSON (full detail in the ledger)."""
+        out = {"name": self.name, "fingerprint": self.fingerprint,
+               "compile_s": round(self.compile_seconds, 3)}
+        total = self.total_flops_per_call
+        if total:
+            out["gflops_per_call"] = round(total / 1e9, 2)
+        ai = self.arithmetic_intensity
+        if ai is not None:
+            out["arithmetic_intensity"] = round(ai, 2)
+        peak = self.hbm_peak_bytes
+        if peak:
+            out["hbm_peak_bytes"] = peak
+        return out
+
+
+# ------------------------------------------------------------- lifecycle
+def enable_ledger(path: Optional[str] = None):
+    """Start capturing compiled programs (idempotent). `path` becomes the
+    default for save_ledger(). Registers every xla_* metric family so the
+    exposition carries them (with TYPE/HELP) even before the first
+    capture — scrapers can alert on absence, not just on values."""
+    global _enabled, _default_path
+    if path is not None:
+        _default_path = path
+    _register_families()
+    _enabled = True
+
+
+def disable_ledger():
+    global _enabled
+    _enabled = False
+
+
+def ledger_enabled() -> bool:
+    return _enabled
+
+
+#: alias used by the hot-path hooks (reads one module global).
+enabled = ledger_enabled
+
+
+def clear_ledger():
+    """Drop every record, the default path, and the cached device lookup
+    (tests)."""
+    global _device_info, _default_path
+    with _lock:
+        _records.clear()
+        _latest.clear()
+        _last_mfu.clear()
+        _device_info = None
+        _default_path = None
+
+
+def _register_families():
+    metrics.counter("xla_compiles_total",
+                    "XLA compile events captured by the program ledger "
+                    "(recompiles of the same fingerprint keep counting)",
+                    labels=("program",))
+    metrics.histogram("xla_compile_seconds",
+                      "Compile wall time per captured program (AOT "
+                      "lower+compile; cache-hit cost when the persistent "
+                      "compile cache is warm)",
+                      labels=("program",), buckets=COMPILE_BUCKETS)
+    metrics.gauge("xla_programs",
+                  "Distinct compiled programs in the ledger (fingerprint-"
+                  "deduped)")
+    metrics.gauge("xla_program_flops",
+                  "cost_analysis() FLOPs per call of the compiled program",
+                  labels=("program", "fingerprint"))
+    metrics.gauge("xla_program_bytes_accessed",
+                  "cost_analysis() bytes accessed per call",
+                  labels=("program", "fingerprint"))
+    metrics.gauge("xla_program_arithmetic_intensity",
+                  "FLOPs / bytes accessed (roofline x-coordinate)",
+                  labels=("program", "fingerprint"))
+    metrics.gauge("xla_hbm_peak_bytes",
+                  "memory_analysis() argument+output+temp bytes of the "
+                  "compiled program (peak HBM residency)",
+                  labels=("program", "fingerprint"))
+    metrics.counter("xla_analysis_unavailable_total",
+                    "cost/memory analysis probes that degraded (backend "
+                    "capability missing, not a lowering bug), by kind",
+                    labels=("kind",))
+    metrics.gauge("train_mfu_pct",
+                  "Live model FLOPs utilization of the training step: "
+                  "ledger FLOPs / measured step time / device peak, %")
+    metrics.gauge("serving_mfu_pct",
+                  "Live model FLOPs utilization of the serving forward, %")
+
+
+def analysis_unavailable(kind: str):
+    """Count a degraded capability probe (shared with util/memory.py's
+    backend-without-memory_analysis fallback — counted, never crashing)."""
+    metrics.counter("xla_analysis_unavailable_total",
+                    "cost/memory analysis probes that degraded (backend "
+                    "capability missing, not a lowering bug), by kind",
+                    labels=("kind",)).inc(kind=kind)
+
+
+# --------------------------------------------------------------- devices
+def _device() -> Tuple[Optional[str], Optional[str]]:
+    global _device_info
+    if _device_info is None:
+        try:
+            import jax
+            d = jax.devices()[0]
+            _device_info = (d.device_kind, d.platform)
+        except Exception:
+            _device_info = (None, None)
+    return _device_info
+
+
+def device_peak_flops() -> Optional[float]:
+    """Peak FLOPs/s for MFU accounting: the env override
+    DL4J_TPU_PEAK_FLOPS wins, then the per-device_kind table; None for
+    unlisted devices (the MFU gauges are then simply not set)."""
+    env = os.environ.get("DL4J_TPU_PEAK_FLOPS")
+    if env:
+        try:
+            return float(env)
+        except ValueError:
+            pass
+    kind, _ = _device()
+    return PEAK_FLOPS_BY_KIND.get(kind) if kind else None
+
+
+def device_hbm_bytes_per_sec() -> Optional[float]:
+    env = os.environ.get("DL4J_TPU_HBM_BYTES_PER_SEC")
+    if env:
+        try:
+            return float(env)
+        except ValueError:
+            pass
+    kind, _ = _device()
+    return HBM_BYTES_PER_SEC_BY_KIND.get(kind) if kind else None
+
+
+# --------------------------------------------------------------- capture
+def _leaf_sig(leaf) -> str:
+    if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+        return f"{leaf.dtype}[{','.join(map(str, leaf.shape))}]"
+    return type(leaf).__name__
+
+
+def shape_key(tree) -> Tuple[str, ...]:
+    """Cheap per-call cache key: shapes/dtypes of every array leaf (no
+    device sync, no lowering). Nones disappear with tree flattening."""
+    import jax
+    return tuple(_leaf_sig(l) for l in jax.tree_util.tree_leaves(tree))
+
+
+def hbm_peak(hbm: Optional[Dict[str, int]]) -> Optional[int]:
+    """THE peak-residency definition every surface shares (ledger
+    records, bench sweep rows, memory_report): arguments + output +
+    temps of the compiled program."""
+    if not hbm:
+        return None
+    return (hbm.get("argument_bytes", 0) + hbm.get("output_bytes", 0)
+            + hbm.get("temp_bytes", 0))
+
+
+def hbm_stats(ma) -> Dict[str, int]:
+    """CompiledMemoryStats -> plain dict: the one place the attr names
+    are spelled (shared with util/memory.py's compiled report)."""
+    return {
+        "argument_bytes": int(getattr(ma, "argument_size_in_bytes", 0)),
+        "output_bytes": int(getattr(ma, "output_size_in_bytes", 0)),
+        "temp_bytes": int(getattr(ma, "temp_size_in_bytes", 0)),
+        "alias_bytes": int(getattr(ma, "alias_size_in_bytes", 0)),
+        "generated_code_bytes": int(
+            getattr(ma, "generated_code_size_in_bytes", 0)),
+    }
+
+
+def analyze_compiled(compiled):
+    """(flops, bytes_accessed, hbm dict) from a jax.stages.Compiled —
+    None for whatever the backend cannot answer. The ONE place the XLA
+    analysis keys are parsed ('bytes accessed' vs 'bytes_accessed',
+    list-wrapped cost dicts, CompiledMemoryStats attrs), shared by
+    capture() and bench._bank_analysis so the handling can't drift."""
+    flops = bytes_accessed = None
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        if ca:
+            f = float(ca.get("flops", 0.0))
+            flops = f if f > 0 else None
+            b = float(ca.get("bytes accessed",
+                             ca.get("bytes_accessed", 0.0)))
+            bytes_accessed = b if b > 0 else None
+    except Exception:
+        pass
+    hbm = None
+    try:
+        ma = compiled.memory_analysis()
+        if ma is not None:
+            hbm = hbm_stats(ma)
+    except Exception:
+        pass
+    return flops, bytes_accessed, hbm
+
+
+def capture(name: str, fn, args, domain: str = "train",
+            examples_per_call: Optional[int] = None,
+            steps_per_call: int = 1) -> Optional[ProgramRecord]:
+    """Capture the compiled program `fn(*args)` into the ledger.
+
+    Call this once per compile EVENT the caller observed (first execution
+    of a shape, a post-hot-swap re-jit): every call increments
+    ``xla_compiles_total`` and times an AOT ``lower().compile()`` —
+    identical fingerprints dedup to one ledger entry. Returns None while
+    the ledger is disabled (one bool read) or if lowering itself fails
+    (counted, never raised — observability must not take down a fit)."""
+    if not _enabled:
+        return None
+    t0 = time.perf_counter()
+    try:
+        lowered = fn.lower(*args)
+        compiled = lowered.compile()
+    except Exception as e:  # noqa: BLE001 — ledger must never kill a fit
+        analysis_unavailable("lower")
+        log.warning("xla ledger: capture of %r failed: %r", name, e)
+        return None
+    t1 = time.perf_counter()
+    trace.add_span("xla/compile", t0, t1, program=name, domain=domain)
+
+    try:
+        hlo_hash = hashlib.sha256(
+            lowered.as_text().encode()).hexdigest()[:16]
+    except Exception:
+        hlo_hash = "unavailable"
+    arg_shapes = shape_key(args)
+    fingerprint = hashlib.sha256(
+        "|".join((name, hlo_hash) + arg_shapes).encode()).hexdigest()[:16]
+
+    flops, bytes_accessed, hbm = analyze_compiled(compiled)
+    if flops is None:
+        analysis_unavailable("cost")
+    if hbm is None:
+        analysis_unavailable("memory")
+
+    with _lock:
+        rec = _records.get(fingerprint)
+        if rec is None:
+            rec = ProgramRecord(fingerprint, name, domain, arg_shapes,
+                                hlo_hash, t1 - t0, flops, bytes_accessed,
+                                hbm, examples_per_call, steps_per_call)
+            _records[fingerprint] = rec
+        else:
+            rec.compiles += 1
+        _latest[domain] = rec
+        n_programs = len(_records)
+
+    metrics.counter("xla_compiles_total", labels=("program",)
+                    ).inc(program=name)
+    metrics.histogram("xla_compile_seconds", labels=("program",),
+                      buckets=COMPILE_BUCKETS).observe(t1 - t0, program=name)
+    metrics.gauge("xla_programs").set(n_programs)
+    if rec.flops:
+        metrics.gauge("xla_program_flops",
+                      labels=("program", "fingerprint")).set(
+            rec.flops, program=name, fingerprint=fingerprint)
+    if rec.bytes_accessed:
+        metrics.gauge("xla_program_bytes_accessed",
+                      labels=("program", "fingerprint")).set(
+            rec.bytes_accessed, program=name, fingerprint=fingerprint)
+    ai = rec.arithmetic_intensity
+    if ai is not None:
+        metrics.gauge("xla_program_arithmetic_intensity",
+                      labels=("program", "fingerprint")).set(
+            ai, program=name, fingerprint=fingerprint)
+    peak_bytes = rec.hbm_peak_bytes
+    if peak_bytes:
+        metrics.gauge("xla_hbm_peak_bytes",
+                      labels=("program", "fingerprint")).set(
+            peak_bytes, program=name, fingerprint=fingerprint)
+    return rec
+
+
+def capture_cached(cache: dict, key, name: str, fn, args,
+                   domain: str = "train",
+                   examples_per_call: Optional[int] = None,
+                   steps_per_call: int = 1) -> Optional[ProgramRecord]:
+    """Hot-loop helper: capture once per caller-observed program (`key`
+    is the caller's cheap identity — e.g. (id(jitted_fn), arg shapes)),
+    then a dict hit per step. A key can legitimately map to None (capture
+    failed) — that negative result is cached too, so a broken lowering
+    is probed once, not every step."""
+    if not _enabled:
+        return None
+    if key in cache:
+        return cache[key]
+    rec = capture(name, fn, args, domain=domain,
+                  examples_per_call=examples_per_call,
+                  steps_per_call=steps_per_call)
+    cache[key] = rec
+    return rec
+
+
+# ----------------------------------------------------------- observation
+def observe_step(rec: Optional[ProgramRecord], seconds: float,
+                 domain: Optional[str] = None):
+    """Feed one measured execution of `rec` (wall seconds) into the MFU
+    accountant. train → train_mfu_pct, serving → serving_mfu_pct; the
+    gauge is only set when both the program's FLOPs and the device peak
+    are known. No-op when the ledger is disabled or rec is None."""
+    if rec is None or not _enabled or seconds <= 0:
+        return
+    d = domain or rec.domain
+    with _lock:
+        _latest[d] = rec
+    peak = device_peak_flops()
+    total = rec.total_flops_per_call
+    if peak and total:
+        mfu = 100.0 * total / seconds / peak
+        with _lock:
+            _last_mfu[d] = mfu
+        metrics.gauge("train_mfu_pct" if d == "train"
+                      else "serving_mfu_pct").set(mfu)
+
+
+def latest_record(domain: str = "train") -> Optional[ProgramRecord]:
+    with _lock:
+        return _latest.get(domain)
+
+
+def last_mfu(domain: str = "train") -> Optional[float]:
+    with _lock:
+        return _last_mfu.get(domain)
+
+
+def records() -> List[ProgramRecord]:
+    with _lock:
+        return list(_records.values())
+
+
+# ------------------------------------------------------------ persistence
+def ledger_dict() -> dict:
+    """The persisted schema (validated by tools/telemetry_smoke.py and
+    consumed by tools/perf_report.py)."""
+    kind, backend = _device()
+    with _lock:
+        progs = [r.to_json() for r in _records.values()]
+    return {
+        "version": LEDGER_SCHEMA_VERSION,
+        "created_unix": round(time.time(), 3),
+        "device_kind": kind,
+        "backend": backend,
+        "peak_flops": device_peak_flops(),
+        "hbm_bytes_per_sec": device_hbm_bytes_per_sec(),
+        "programs": progs,
+    }
+
+
+def save_ledger(path: Optional[str] = None,
+                merge_existing: bool = False) -> int:
+    """Atomically write the ledger JSON (tmp + os.replace, like
+    save_trace). Returns the number of program records written.
+
+    merge_existing=True folds in the programs an earlier process already
+    wrote to `path` (deduped by fingerprint, this process's records win)
+    — bench runs every config in its own subprocess against ONE
+    DL4J_TPU_PERF_LEDGER file, and a plain overwrite would keep only the
+    last config's programs. Configs run sequentially, so read-merge-write
+    is race-free there."""
+    path = path or _default_path
+    if not path:
+        raise ValueError("no ledger path: pass one or enable_ledger(path)")
+    doc = ledger_dict()
+    if merge_existing and os.path.exists(path):
+        try:
+            with open(path) as f:
+                prior = json.load(f)
+            ours = {p["fingerprint"] for p in doc["programs"]}
+            doc["programs"] = [p for p in prior.get("programs", [])
+                               if p.get("fingerprint") not in ours] \
+                + doc["programs"]
+        except (OSError, ValueError, TypeError, KeyError):
+            pass                      # corrupt prior file: overwrite it
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1)
+    os.replace(tmp, path)
+    return len(doc["programs"])

@@ -58,6 +58,7 @@ class ComputationGraph:
         self._output_fn = None
         self._input_affine = None   # (shift, scale) during device-norm fit
         self._affine_fn = None
+        self._ledger_cache: Dict[Any, Any] = {}   # monitor.xla programs
 
     def set_listeners(self, *listeners):
         self.listeners = list(listeners)
@@ -467,6 +468,7 @@ class ComputationGraph:
 
     def _fit_epoch_per_call(self, data, rng, tbptt):
         from deeplearning4j_tpu import monitor
+        from deeplearning4j_tpu.monitor import xla as xla_ledger
         etl_start = time.perf_counter()
         for mds in self._mds_stream(data):
             step_start = time.perf_counter()
@@ -496,6 +498,21 @@ class ComputationGraph:
                 monitor.add_span("train/step", step_start, step_end,
                                  iteration=self.iteration_count,
                                  score=self._score, batch_size=bs)
+                if xla_ledger.enabled():
+                    key = (id(self._train_step), xla_ledger.shape_key(
+                        (inputs, labels, fmasks, lmasks)))
+                    fresh = key not in self._ledger_cache
+                    rec = xla_ledger.capture_cached(
+                        self._ledger_cache, key,
+                        "graph/train_step", self._train_step,
+                        (self.params, self.opt_state, self.state, inputs,
+                         labels, fmasks, lmasks, sub, None),
+                        examples_per_call=bs)
+                    if not fresh:
+                        # debut wall time includes the jit compile —
+                        # only steady-state steps feed the MFU gauge
+                        xla_ledger.observe_step(rec,
+                                                step_end - step_start)
                 _record_iteration(self._score, bs,
                                   step_seconds=step_end - step_start,
                                   sync_seconds=step_end - sync_start)
@@ -597,10 +614,17 @@ class ComputationGraph:
         """One optimizer step per K stacked micro-batches; chunking and
         ragged-tail handling as in _fit_epoch_scan, lockstep listener
         callbacks when a model-reading listener is attached."""
+        from deeplearning4j_tpu.monitor import xla as xla_ledger
+        last_sync = [None]
 
         def process(p):
-            loss, bs, etl_ms = p
+            loss, bs, etl_ms, rec = p
             self._score = float(loss)
+            if xla_ledger.enabled():
+                now = time.perf_counter()
+                if rec is not None and last_sync[0] is not None:
+                    xla_ledger.observe_step(rec, now - last_sync[0])
+                last_sync[0] = now
             _record_iteration(self._score, bs)
             for lst in self.listeners:
                 lst.iteration_done(self, self.iteration_count,
@@ -620,12 +644,28 @@ class ComputationGraph:
             sig = ("accum", fmasks is not None, lmasks is not None)
             if sig not in self._scan_step:
                 self._scan_step[sig] = self._make_accum_step()
+            kstep = self._scan_step[sig]
+            subs_d = jnp.stack(subs)
             (self.params, self.opt_state, self.state,
-             loss) = self._scan_step[sig](
+             loss) = kstep(
                 self.params, self.opt_state, self.state, inputs, labels,
-                fmasks, lmasks, jnp.stack(subs))
+                fmasks, lmasks, subs_d)
             bs = int(np.shape(group[0].features[0])[0]) * len(group)
-            return (loss, bs, etl_ms)
+            rec = None
+            if xla_ledger.enabled():
+                key = (id(kstep), xla_ledger.shape_key(
+                    (inputs, labels, fmasks, lmasks)))
+                fresh = key not in self._ledger_cache
+                rec = xla_ledger.capture_cached(
+                    self._ledger_cache, key,
+                    "graph/accum_step", kstep,
+                    (self.params, self.opt_state, self.state, inputs,
+                     labels, fmasks, lmasks, subs_d),
+                    examples_per_call=bs,
+                    steps_per_call=len(group))
+                if fresh:
+                    last_sync[0] = None   # exclude the AOT compile interval
+            return (loss, bs, etl_ms, rec)
 
         # _iter_data, not _mds_stream: dispatch stacks K host batches
         # into ONE transfer; the prefetch stream's per-batch device_put
@@ -644,10 +684,22 @@ class ComputationGraph:
         tails fall back to the per-call step."""
         if _scan_incompatible_listeners(self.listeners):
             return self._fit_epoch_per_call(data, rng, False)
+        from deeplearning4j_tpu.monitor import xla as xla_ledger
+        last_sync = [None]
 
         def process(p):
-            losses, bs, etl_ms = p
-            for loss in np.asarray(losses):
+            losses, bs, etl_ms, rec = p
+            arr = np.asarray(losses)
+            if xla_ledger.enabled():
+                # steady-state chunk wall = spacing between chunk syncs;
+                # the stamp advances on EVERY chunk so a ragged tail
+                # can't leak into the next interval (see
+                # MultiLayerNetwork._fit_epoch_scan)
+                now = time.perf_counter()
+                if rec is not None and last_sync[0] is not None:
+                    xla_ledger.observe_step(rec, now - last_sync[0])
+                last_sync[0] = now
+            for loss in arr:
                 self._score = float(loss)
                 _record_iteration(self._score, bs)
                 for lst in self.listeners:
@@ -683,18 +735,34 @@ class ComputationGraph:
                         self.params, self.opt_state, self.state, inputs,
                         labels, fmasks, lmasks, sub, None)
                     losses.append(loss)
-                return (jnp.stack(losses), bs, etl_ms)
+                return (jnp.stack(losses), bs, etl_ms, None)
             items = [to_dev(m) for m in group]
             inputs, labels, fmasks, lmasks = jax.tree_util.tree_map(
                 lambda *xs: jnp.stack(xs), *items)
             sig = (len(group), fmasks is not None, lmasks is not None)
             if sig not in self._scan_step:
                 self._scan_step[sig] = self._make_scan_step()
+            kstep = self._scan_step[sig]
+            subs_d = jnp.stack(subs)
             (self.params, self.opt_state, self.state,
-             losses) = self._scan_step[sig](
+             losses) = kstep(
                 self.params, self.opt_state, self.state, inputs, labels,
-                fmasks, lmasks, jnp.stack(subs))
-            return (losses, bs, etl_ms)
+                fmasks, lmasks, subs_d)
+            rec = None
+            if xla_ledger.enabled():
+                key = (id(kstep), xla_ledger.shape_key(
+                    (inputs, labels, fmasks, lmasks)))
+                fresh = key not in self._ledger_cache
+                rec = xla_ledger.capture_cached(
+                    self._ledger_cache, key,
+                    "graph/scan_step", kstep,
+                    (self.params, self.opt_state, self.state, inputs,
+                     labels, fmasks, lmasks, subs_d),
+                    examples_per_call=bs * len(group),
+                    steps_per_call=len(group))
+                if fresh:
+                    last_sync[0] = None   # exclude the AOT compile interval
+            return (losses, bs, etl_ms, rec)
 
         def sig_of(mds):
             shapes = lambda t: None if t is None else tuple(

@@ -299,6 +299,7 @@ class MultiLayerNetwork:
         self._output_fn = None
         self._input_affine = None   # (shift, scale) during device-norm fit
         self._affine_fn = None
+        self._ledger_cache: Dict[Any, Any] = {}   # monitor.xla programs
 
     # ------------------------------------------------------------ plumbing
     def _stage_x(self, a):
@@ -724,6 +725,7 @@ class MultiLayerNetwork:
 
     def _fit_epoch(self, iterator):
         from deeplearning4j_tpu import monitor
+        from deeplearning4j_tpu.monitor import xla as xla_ledger
         etl_start = time.perf_counter()
         rng = jax.random.PRNGKey(self.conf.seed + 7919 * (self.epoch_count + 1))
         grad_listeners = [lst for lst in self.listeners
@@ -738,11 +740,12 @@ class MultiLayerNetwork:
                        if lst.should_capture(self.iteration_count)]
             step = self._get_train_step(ds.features_mask, ds.labels_mask,
                                         None, with_stats=bool(capture))
-            out = step(
-                self.params, self.opt_state, self.state,
-                self._stage_x(ds.features),
-                _as_jnp(ds.labels, self._compute_dtype),
-                _as_jnp(ds.features_mask), _as_jnp(ds.labels_mask), sub, None)
+            xs = self._stage_x(ds.features)
+            ys = _as_jnp(ds.labels, self._compute_dtype)
+            fm = _as_jnp(ds.features_mask)
+            lm = _as_jnp(ds.labels_mask)
+            out = step(self.params, self.opt_state, self.state,
+                       xs, ys, fm, lm, sub, None)
             grads = updates = None
             if capture:
                 (self.params, self.opt_state, self.state, loss, _,
@@ -757,6 +760,17 @@ class MultiLayerNetwork:
             monitor.add_span("train/step", step_start, step_end,
                              iteration=self.iteration_count,
                              score=self._score, batch_size=bs)
+            if xla_ledger.enabled():
+                key = (id(step), xla_ledger.shape_key((xs, ys, fm, lm)))
+                fresh = key not in self._ledger_cache
+                rec = xla_ledger.capture_cached(
+                    self._ledger_cache, key, "mln/train_step", step,
+                    (self.params, self.opt_state, self.state, xs, ys, fm,
+                     lm, sub, None), examples_per_call=bs)
+                if not fresh:
+                    # the debut execution's wall time includes the jit
+                    # compile — only steady-state steps feed the MFU gauge
+                    xla_ledger.observe_step(rec, step_end - step_start)
             _record_iteration(self._score, bs,
                               step_seconds=step_end - step_start,
                               sync_seconds=step_end - sync_start)
@@ -862,16 +876,23 @@ class MultiLayerNetwork:
         listeners receive the AVERAGED per-step grads/updates (lockstep
         — wants_gradients forces defer=False below, so iteration_count
         at dispatch is the step being reported)."""
+        from deeplearning4j_tpu.monitor import xla as xla_ledger
         rng = jax.random.PRNGKey(self.conf.seed
                                  + 7919 * (self.epoch_count + 1))
         grad_listeners = [lst for lst in self.listeners
                           if getattr(lst, "wants_gradients", False)]
         sigs_seen = set()
         warned_partial = [False]
+        last_sync = [None]
 
         def process(p):
-            loss, bs, etl_ms, capture, grads, updates = p
+            loss, bs, etl_ms, capture, grads, updates, rec = p
             self._score = float(loss)
+            if xla_ledger.enabled():
+                now = time.perf_counter()
+                if rec is not None and last_sync[0] is not None:
+                    xla_ledger.observe_step(rec, now - last_sync[0])
+                last_sync[0] = now
             _record_iteration(self._score, bs)
             for lst in capture:
                 lst.on_gradients(self, self.iteration_count,
@@ -918,8 +939,9 @@ class MultiLayerNetwork:
             capture = [lst for lst in grad_listeners
                        if lst.should_capture(self.iteration_count)]
             kstep = self._get_accum_step(with_stats=bool(capture))
+            subs_d = jnp.stack(subs)
             out = kstep(self.params, self.opt_state, self.state, xs, ys,
-                        fms, lms, jnp.stack(subs))
+                        fms, lms, subs_d)
             grads = updates = None
             if capture:
                 (self.params, self.opt_state, self.state, loss, grads,
@@ -927,7 +949,19 @@ class MultiLayerNetwork:
             else:
                 self.params, self.opt_state, self.state, loss = out
             bs = int(np.shape(ds0.features)[0]) * len(group)
-            return loss, bs, etl_ms, capture, grads, updates
+            rec = None
+            if xla_ledger.enabled():
+                key = (id(kstep), xla_ledger.shape_key((xs, ys, fms, lms)))
+                fresh = key not in self._ledger_cache
+                rec = xla_ledger.capture_cached(
+                    self._ledger_cache, key,
+                    "mln/accum_step", kstep,
+                    (self.params, self.opt_state, self.state, xs, ys, fms,
+                     lms, subs_d), examples_per_call=bs,
+                    steps_per_call=len(group))
+                if fresh:
+                    last_sync[0] = None   # exclude the AOT compile interval
+            return loss, bs, etl_ms, capture, grads, updates, rec
 
         def sig_of(ds):
             s = (np.shape(ds.features), np.shape(ds.labels),
@@ -960,11 +994,25 @@ class MultiLayerNetwork:
         mid-epoch) fall back to per-call steps for those batches."""
         if _scan_incompatible_listeners(self.listeners):
             return self._fit_epoch(iterator)
+        from deeplearning4j_tpu.monitor import xla as xla_ledger
         rng = jax.random.PRNGKey(self.conf.seed + 7919 * (self.epoch_count + 1))
+        last_sync = [None]   # previous chunk-sync stamp: chunk wall clock
 
         def process(p):
-            losses, bs, etl_ms = p
-            for loss in np.asarray(losses):     # single blocking fetch/chunk
+            losses, bs, etl_ms, rec = p
+            arr = np.asarray(losses)            # single blocking fetch/chunk
+            if xla_ledger.enabled():
+                # steady-state chunk wall time = spacing between chunk
+                # syncs (the pipelined path has no un-overlapped "this
+                # chunk only" interval to time; the first chunk is
+                # skipped). The stamp advances on EVERY chunk — a ragged
+                # tail (rec None) must not leak its wall time into the
+                # next scan chunk's interval.
+                now = time.perf_counter()
+                if rec is not None and last_sync[0] is not None:
+                    xla_ledger.observe_step(rec, now - last_sync[0])
+                last_sync[0] = now
+            for loss in arr:
                 self._score = float(loss)
                 _record_iteration(self._score, bs)
                 for lst in self.listeners:
@@ -981,6 +1029,7 @@ class MultiLayerNetwork:
                 rng, sub = jax.random.split(rng)
                 subs.append(sub)
             ds0 = group[0]
+            rec = None
             if len(group) < K:
                 # ragged tail / shape-change remainder: reuse the already
                 # compiled per-call step rather than compiling a one-off
@@ -1008,10 +1057,28 @@ class MultiLayerNetwork:
                 fms = stack(lambda d: d.features_mask)
                 lms = stack(lambda d: d.labels_mask)
                 kstep = self._get_scan_step(fms, lms, len(group))
+                subs_d = jnp.stack(subs)
                 (self.params, self.opt_state, self.state,
                  losses) = kstep(self.params, self.opt_state, self.state,
-                                 xs, ys, fms, lms, jnp.stack(subs))
-            return losses, int(np.shape(ds0.features)[0]), etl_ms
+                                 xs, ys, fms, lms, subs_d)
+                if xla_ledger.enabled():
+                    key = (id(kstep),
+                           xla_ledger.shape_key((xs, ys, fms, lms)))
+                    fresh = key not in self._ledger_cache
+                    rec = xla_ledger.capture_cached(
+                        self._ledger_cache, key,
+                        "mln/scan_step", kstep,
+                        (self.params, self.opt_state, self.state, xs, ys,
+                         fms, lms, subs_d),
+                        examples_per_call=(
+                            int(np.shape(ds0.features)[0]) * len(group)),
+                        steps_per_call=len(group))
+                    if fresh:
+                        # the capture's AOT compile sat inside this
+                        # inter-chunk interval — restart the MFU clock so
+                        # it can't read as a slow chunk
+                        last_sync[0] = None
+            return losses, int(np.shape(ds0.features)[0]), etl_ms, rec
 
         def sig_of(ds):
             return (np.shape(ds.features), np.shape(ds.labels),

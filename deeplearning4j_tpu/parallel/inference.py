@@ -26,6 +26,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from deeplearning4j_tpu import monitor
+from deeplearning4j_tpu.monitor import xla as xla_ledger
 from deeplearning4j_tpu.parallel.mesh import DATA_AXIS, MeshConfig, build_mesh
 
 
@@ -70,6 +71,7 @@ class ParallelInference:
         self._queue: "queue.Queue[_Request]" = queue.Queue(maxsize=queue_limit)
         self._stop = threading.Event()
         self._fn = jax.jit(self._make_forward(model))
+        self._ledger_cache: dict = {}    # monitor.xla programs per shape
         self._swap_lock = threading.Lock()
         self._worker = None
         if self.mode == InferenceMode.BATCHED:
@@ -114,6 +116,28 @@ class ParallelInference:
         rep = NamedSharding(self.mesh, P())
         params = jax.device_put(params, rep)
         state = jax.device_put(state, rep)
+        if xla_ledger.enabled():
+            # ledger capture of the serving forward: one program per
+            # (jit fn, input shape), captured AFTER the run so a debut
+            # execution never pays the AOT lower+compile before its
+            # result exists. The batcher's AOT warmups flow through
+            # here, so in the production config every ladder bucket is
+            # captured during warmup, not on a live request. The debut's
+            # wall time includes the jit compile — only steady-state
+            # runs feed serving_mfu_pct.
+            key = (id(fn), tuple(xd.shape), str(xd.dtype))
+            fresh = key not in self._ledger_cache
+            t0 = time.perf_counter()
+            out = fn(params, state, xd)
+            res = np.asarray(out)[:n]           # host fetch = sync
+            dt = time.perf_counter() - t0
+            rec = xla_ledger.capture_cached(
+                self._ledger_cache, key,
+                "inference/forward", fn, (params, state, xd),
+                domain="serving", examples_per_call=int(xd.shape[0]))
+            if not fresh:
+                xla_ledger.observe_step(rec, dt, domain="serving")
+            return res
         out = fn(params, state, xd)
         return np.asarray(out)[:n]
 
@@ -235,6 +259,10 @@ class ParallelInference:
         with self._swap_lock:
             self.model = model
             self._fn = new_fn
+            # old generation's ledger keys (id(old_fn), shape) can never
+            # hit again — drop them so the cache stays bounded across swaps
+            self._ledger_cache = {k: v for k, v in self._ledger_cache.items()
+                                  if k[0] == id(new_fn)}
 
     def shutdown(self):
         self._stop.set()

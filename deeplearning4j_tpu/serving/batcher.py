@@ -41,6 +41,7 @@ from typing import Callable, Optional, Sequence, Tuple
 import numpy as np
 
 from deeplearning4j_tpu import monitor
+from deeplearning4j_tpu.monitor import xla as xla_ledger
 
 log = logging.getLogger("deeplearning4j_tpu")
 
@@ -160,7 +161,28 @@ class ShapeBucketedBatcher:
                         "REQUEST path (compile latency hits a live request) "
                         "— warm() was skipped or the ladder changed",
                         self.name, b)
-            outs.append(runner(chunk)[:take])
+            out_chunk = runner(chunk)[:take]
+            if first and xla_ledger.enabled():
+                # tie the ladder bucket to the compiled program the ledger
+                # just captured inside the runner (ParallelInference
+                # forwards land under domain "serving"). latest_record is
+                # a shared slot that concurrent traffic can overwrite, and
+                # the runner may pad the bucket up to its device mesh —
+                # accept any record at least bucket-sized (best-effort
+                # diagnostics; the record's own batch is in the line).
+                rec = xla_ledger.latest_record("serving")
+                if rec is not None and (rec.examples_per_call or 0) >= b:
+                    log.info(
+                        "serving[%s]: bucket %d -> program %s "
+                        "(%s, batch %d as compiled, %.2f GFLOP/call, "
+                        "HBM peak %s bytes, compile %.2fs)",
+                        self.name, b, rec.fingerprint, rec.name,
+                        rec.examples_per_call,
+                        (rec.flops or 0.0) / 1e9,
+                        rec.hbm_peak_bytes
+                        if rec.hbm_peak_bytes is not None else "n/a",
+                        rec.compile_seconds)
+            outs.append(out_chunk)
             ofs += take
         return outs[0] if len(outs) == 1 else np.concatenate(outs, axis=0)
 

@@ -70,6 +70,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="record telemetry spans and write a Chrome "
                         "trace-event JSON to PATH on exit (load in "
                         "Perfetto / chrome://tracing)")
+    p.add_argument("--perf-ledger", default=None, metavar="PATH",
+                   help="enable the compiled-program ledger (monitor.xla: "
+                        "per-program fingerprint, compile time, flops, "
+                        "bytes accessed, HBM peak; live train_mfu_pct) and "
+                        "write the ledger JSON to PATH on exit; defaults "
+                        "to perf_ledger.json alongside --trace-out when "
+                        "tracing is on (docs/OBSERVABILITY.md, gate it "
+                        "with tools/perf_report.py)")
     p.add_argument("--serve-port", type=int, default=None,
                    help="after a successful fit, serve the trained model "
                         "over HTTP on this port (shape-bucketed batching, "
@@ -154,6 +162,26 @@ def main(argv=None) -> int:
 
     if args.trace_out:
         monitor.enable_tracing()
+    if args.perf_ledger is None and args.trace_out:
+        # "alongside --trace-out": tracing runs double as perf-ledger runs
+        # unless the user points the ledger elsewhere
+        args.perf_ledger = os.path.join(
+            os.path.dirname(os.path.abspath(args.trace_out)),
+            "perf_ledger.json")
+    if args.perf_ledger:
+        monitor.xla.enable_ledger(args.perf_ledger)
+        # the ledger's captures are AOT lower+compile calls that bypass
+        # the jit __call__ cache — share bench's persistent XLA compile
+        # cache so they are disk hits, not multi-minute TPU recompiles
+        try:
+            from bench import cache_dir
+            jax.config.update(
+                "jax_compilation_cache_dir",
+                os.environ.get("JAX_COMPILATION_CACHE_DIR", cache_dir()))
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 2.0)
+        except Exception:
+            pass    # bench.py not importable (installed package): skip
 
     def emit_telemetry():
         # runs in a finally: a bad --trace-out path (unwritable dir, full
@@ -166,6 +194,14 @@ def main(argv=None) -> int:
                       file=sys.stderr)
             except OSError as e:
                 print(f"trace not written to {args.trace_out}: {e}",
+                      file=sys.stderr)
+        if args.perf_ledger:
+            try:
+                n = monitor.xla.save_ledger(args.perf_ledger)
+                print(f"perf ledger: {args.perf_ledger} ({n} programs)",
+                      file=sys.stderr)
+            except OSError as e:
+                print(f"perf ledger not written to {args.perf_ledger}: {e}",
                       file=sys.stderr)
         if args.metrics:
             print(json.dumps({"metrics": monitor.summary()}),

@@ -66,11 +66,41 @@ class PerformanceListener(TrainingListener):
         self.frequency = max(int(frequency), 1)
         self.report = report
         self._last_time: Optional[float] = None
+        self._compiled_logged: set = set()   # ledger fingerprints reported
         self.history: List[dict] = []
+
+    def _report_compiled(self):
+        """Once per distinct compiled program (first iteration after its
+        compile): log HBM peak and MFU, sourced from the monitor.xla
+        ledger — no re-lowering, just a dict read. No-op while the ledger
+        is disabled."""
+        from deeplearning4j_tpu.monitor import xla as xla_ledger
+        if not xla_ledger.enabled():
+            return
+        rec = xla_ledger.latest_record("train")
+        if rec is None or rec.fingerprint in self._compiled_logged:
+            return
+        mfu = xla_ledger.last_mfu("train")
+        if mfu is None and rec.flops and xla_ledger.device_peak_flops():
+            # debut iteration: its wall time included the compile, so no
+            # MFU sample exists yet — log on the next (steady) iteration
+            return
+        self._compiled_logged.add(rec.fingerprint)
+        peak = rec.hbm_peak_bytes
+        log.info(
+            "compiled step %s (fingerprint %s): %s GFLOP/call, HBM peak "
+            "%s, compile %.2f s, mfu %s",
+            rec.name, rec.fingerprint,
+            "n/a" if not rec.flops else f"{rec.flops / 1e9:.2f}",
+            "n/a" if peak is None else f"{peak / 2**20:.1f} MiB",
+            rec.compile_seconds,
+            "n/a" if mfu is None else f"{mfu:.1f}%")
 
     def iteration_done(self, model, iteration, epoch, score, etl_ms=0.0,
                        batch_size=0):
         from deeplearning4j_tpu import monitor
+        if self.report:
+            self._report_compiled()
         now = time.perf_counter()
         if self._last_time is not None and iteration % self.frequency == 0:
             dt = now - self._last_time

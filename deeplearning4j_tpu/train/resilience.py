@@ -347,8 +347,30 @@ class _NetDriver:
 
     rng_mult = 7919
 
+    #: ledger name of the compiled step this driver executes
+    ledger_program = "mln/train_step"
+
     def __init__(self, net):
         self.net = net
+        self._ledger_rec = None        # latest monitor.xla program record
+        self._ledger_fresh = False     # last capture was a debut
+        self._ledger_pending = None    # deferred capture args (see below)
+
+    def capture_ledger(self):
+        """Run the capture step() deferred, OUTSIDE the caller's attempt
+        clock — the first sight of a program pays an AOT lower+compile,
+        which must not inflate step_secs / train_step_seconds. Dict-hit
+        after the first call per program. Marks _ledger_fresh so the
+        caller can skip feeding the debut step's compile-inflated wall
+        time to the MFU accountant."""
+        from deeplearning4j_tpu.monitor import xla as xla_ledger
+        pending, self._ledger_pending = self._ledger_pending, None
+        if pending is None:
+            return
+        cache, key, name, fn, args, bs = pending
+        self._ledger_fresh = key not in cache
+        self._ledger_rec = xla_ledger.capture_cached(
+            cache, key, name, fn, args, examples_per_call=bs)
 
     def prepare(self):
         from deeplearning4j_tpu.util import params as param_util
@@ -402,14 +424,24 @@ class _NetDriver:
 
     def step(self, ds, sub):
         from deeplearning4j_tpu.nn.multilayer import _as_jnp
+        from deeplearning4j_tpu.monitor import xla as xla_ledger
         n = self.net
         fn = n._get_train_step(ds.features_mask, ds.labels_mask, None)
+        xs = n._stage_x(ds.features)
+        ys = _as_jnp(ds.labels, n._compute_dtype)
+        fm = _as_jnp(ds.features_mask)
+        lm = _as_jnp(ds.labels_mask)
         n.params, n.opt_state, n.state, loss, _ = fn(
-            n.params, n.opt_state, n.state,
-            n._stage_x(ds.features),
-            _as_jnp(ds.labels, n._compute_dtype),
-            _as_jnp(ds.features_mask), _as_jnp(ds.labels_mask), sub, None)
-        return loss, int(np.shape(ds.features)[0])
+            n.params, n.opt_state, n.state, xs, ys, fm, lm, sub, None)
+        bs = int(np.shape(ds.features)[0])
+        if xla_ledger.enabled():
+            self._ledger_pending = (
+                n._ledger_cache,
+                (id(fn), xla_ledger.shape_key((xs, ys, fm, lm))),
+                self.ledger_program, fn,
+                (n.params, n.opt_state, n.state, xs, ys, fm, lm, sub,
+                 None), bs)
+        return loss, bs
 
 
 class _GraphDriver(_NetDriver):
@@ -417,6 +449,8 @@ class _GraphDriver(_NetDriver):
     math; per-epoch RNG reseed for resumability)."""
 
     rng_mult = 331
+
+    ledger_program = "graph/train_step"
 
     def make_source(self, data, batch_size):
         return data
@@ -426,6 +460,7 @@ class _GraphDriver(_NetDriver):
 
     def step(self, mds, sub):
         from deeplearning4j_tpu.nn.multilayer import _as_jnp
+        from deeplearning4j_tpu.monitor import xla as xla_ledger
         n = self.net
         if n._train_step is None:
             n._train_step = n._make_train_step()
@@ -438,7 +473,16 @@ class _GraphDriver(_NetDriver):
         n.params, n.opt_state, n.state, loss, _ = n._train_step(
             n.params, n.opt_state, n.state, inputs, labels, fmasks,
             lmasks, sub, None)
-        return loss, int(np.shape(mds.features[0])[0])
+        bs = int(np.shape(mds.features[0])[0])
+        if xla_ledger.enabled():
+            self._ledger_pending = (
+                n._ledger_cache,
+                (id(n._train_step), xla_ledger.shape_key(
+                    (inputs, labels, fmasks, lmasks))),
+                self.ledger_program, n._train_step,
+                (n.params, n.opt_state, n.state, inputs, labels, fmasks,
+                 lmasks, sub, None), bs)
+        return loss, bs
 
 
 class _WrapperDriver(_NetDriver):
@@ -651,6 +695,10 @@ class ResilientTrainer:
                 step_secs = time.perf_counter() - attempt_start
                 monitor.add_span("train/step", attempt_start,
                                  attempt_start + step_secs, step=step_idx)
+                # capture AFTER the attempt clock stops: the first sight
+                # of a program pays an AOT lower+compile that must not
+                # read as compute time
+                self._driver.capture_ledger()
                 break
             except policy.transient_errors as e:
                 attempt += 1
@@ -696,7 +744,14 @@ class ResilientTrainer:
             return "skipped", loss_f, bs
         self._consecutive_skips = 0
         from deeplearning4j_tpu.nn.multilayer import _record_iteration
+        from deeplearning4j_tpu.monitor import xla as xla_ledger
         _record_iteration(loss_f, bs, step_seconds=step_secs)
+        if xla_ledger.enabled() and not self._driver._ledger_fresh:
+            # feed the MFU accountant the attempt-that-landed wall time
+            # against the program the driver captured for this step; the
+            # debut step (fresh capture) is skipped — its wall time
+            # includes the jit compile
+            xla_ledger.observe_step(self._driver._ledger_rec, step_secs)
         return "applied", loss_f, bs
 
     # ------------------------------------------------------------------ fit

@@ -89,11 +89,10 @@ class NetworkMemoryReport:
 
     @property
     def compiled_total_bytes(self) -> Optional[int]:
-        if not self.compiled:
-            return None
-        return (self.compiled.get("argument_bytes", 0) +
-                self.compiled.get("temp_bytes", 0) +
-                self.compiled.get("output_bytes", 0))
+        # one peak-residency formula across memory_report, the program
+        # ledger, and bench rows
+        from deeplearning4j_tpu.monitor.xla import hbm_peak
+        return hbm_peak(self.compiled)
 
     def summary(self) -> str:
         lines = [f"{'layer':<24}{'type':<22}{'params':>12}{'updater':>12}"
@@ -213,6 +212,20 @@ def build_memory_report(net, batch_size: int,
                                input_bytes=input_bytes, compiled=compiled)
 
 
+def _read_memory_analysis(compiled):
+    """Capability-probe seam: the one call that can legitimately fail on a
+    backend without memory_analysis support (tests monkeypatch this to
+    simulate such a backend)."""
+    return compiled.memory_analysis()
+
+
+def _count_unavailable():
+    """The degraded path is counted, not silent: visible on /metrics as
+    xla_analysis_unavailable_total{kind="memory"}."""
+    from deeplearning4j_tpu.monitor import xla as xla_ledger
+    xla_ledger.analysis_unavailable("memory")
+
+
 def _compiled_step_memory(net, batch_size, is_graph) -> Optional[Dict[str, int]]:
     """Lower + compile one training step and read XLA's memory analysis.
 
@@ -246,18 +259,15 @@ def _compiled_step_memory(net, batch_size, is_graph) -> Optional[Dict[str, int]]
         lowered = step.lower(net.params, net.opt_state, net.state, x, y,
                              None, None, jax.random.PRNGKey(0), None)
     try:
-        ma = lowered.compile().memory_analysis()
+        ma = _read_memory_analysis(lowered.compile())
     except Exception as e:      # backend without memory_analysis support
+        _count_unavailable()
         logging.getLogger("deeplearning4j_tpu").warning(
             "compiled memory analysis unavailable on this backend: %r", e)
         return None
     if ma is None:
+        _count_unavailable()
         return None
-    return {
-        "argument_bytes": int(getattr(ma, "argument_size_in_bytes", 0)),
-        "output_bytes": int(getattr(ma, "output_size_in_bytes", 0)),
-        "temp_bytes": int(getattr(ma, "temp_size_in_bytes", 0)),
-        "alias_bytes": int(getattr(ma, "alias_size_in_bytes", 0)),
-        "generated_code_bytes": int(
-            getattr(ma, "generated_code_size_in_bytes", 0)),
-    }
+    # shared attr parsing with the program ledger (one spelling to drift)
+    from deeplearning4j_tpu.monitor.xla import hbm_stats
+    return hbm_stats(ma)

@@ -75,3 +75,51 @@ def test_memory_report_graph():
     names = [r.name for r in rep.layers]
     assert "stem_conv" in names and "output" in names
     assert rep.total_train_bytes > rep.total_inference_bytes
+
+
+def test_memory_analysis_backend_fallback_is_counted_not_silent(
+        monkeypatch, caplog):
+    """A backend without memory_analysis degrades to compiled=None — the
+    documented not-a-lowering-bug path: the analytic report still lands,
+    a warning names the capability gap, and
+    xla_analysis_unavailable_total{kind="memory"} increments so the
+    degradation is visible on /metrics instead of silent."""
+    import logging
+
+    from deeplearning4j_tpu import monitor
+    from deeplearning4j_tpu.util import memory as memory_mod
+
+    monitor.REGISTRY.reset()
+
+    def _no_support(compiled):
+        raise RuntimeError("memory_analysis unimplemented on this backend")
+
+    monkeypatch.setattr(memory_mod, "_read_memory_analysis", _no_support)
+    net = MultiLayerNetwork(_lenet()).init()
+    with caplog.at_level(logging.WARNING, logger="deeplearning4j_tpu"):
+        rep = net.memory_report(batch_size=8, with_compiled=True)
+    assert rep.compiled is None                 # degraded, not crashed
+    assert rep.compiled_total_bytes is None
+    assert rep.total_train_bytes > 0            # analytic half intact
+    assert any("memory analysis unavailable" in r.message
+               for r in caplog.records)
+    ctr = monitor.REGISTRY.collect("xla_analysis_unavailable_total")
+    assert ctr is not None and ctr.value(kind="memory") == 1
+    monitor.REGISTRY.reset()
+
+
+def test_memory_analysis_none_result_also_counted(monkeypatch):
+    """Some backends return None instead of raising — same counted
+    fallback."""
+    from deeplearning4j_tpu import monitor
+    from deeplearning4j_tpu.util import memory as memory_mod
+
+    monitor.REGISTRY.reset()
+    monkeypatch.setattr(memory_mod, "_read_memory_analysis",
+                        lambda compiled: None)
+    net = MultiLayerNetwork(_lenet()).init()
+    rep = net.memory_report(batch_size=8, with_compiled=True)
+    assert rep.compiled is None
+    ctr = monitor.REGISTRY.collect("xla_analysis_unavailable_total")
+    assert ctr is not None and ctr.value(kind="memory") == 1
+    monitor.REGISTRY.reset()

@@ -1,0 +1,243 @@
+#!/usr/bin/env python
+"""Perf regression gate + roofline report — the CI teeth of the ledger.
+
+Merges two artifact streams:
+
+- the banked bench trajectory (``BENCH_r*.json`` /
+  ``BENCH_TPU_MEASURED_*.json``): every throughput series that appears in
+  more than one round — per-mode/batch ResNet imgs/sec, char-LSTM
+  chars/sec, Word2Vec pairs/sec, LeNet imgs/sec, h2d MB/s, and the
+  headline — is compared LATEST vs. BEST-EARLIER within its own device
+  class (CPU rows never gate TPU rows and vice versa);
+- the compiled-program ledger (``monitor.xla.save_ledger()`` JSON,
+  ``--ledger``): each program's arithmetic intensity is placed on the
+  device roofline (ridge = peak_flops / hbm_bandwidth) to report whether
+  it is compute- or memory-bound and what MFU ceiling the roofline allows
+  — the standing context for ROADMAP item 2's 27% -> 40% chase.
+
+Exit codes: 0 = no tracked series regressed beyond ``--threshold``
+(default 15%); 2 = regression(s); 1 = usage/IO error. CI usage:
+
+    python tools/perf_report.py                          # gate the repo
+    python tools/perf_report.py --ledger perf_ledger.json --json
+    python tools/perf_report.py --dir /path/to/artifacts --threshold 0.10
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+#: throughput keys a sweep row may carry; each becomes its own series.
+THROUGHPUT_KEYS = ("imgs_sec", "lenet_imgs_sec", "chars_sec", "pairs_sec",
+                   "h2d_f32_mbytes_sec", "h2d_u8_mbytes_sec")
+
+
+def _round_of(name: str) -> int:
+    m = re.search(r"_r(\d+)", name)
+    return int(m.group(1)) if m else 0
+
+
+def load_rounds(directory: str):
+    """Parse every banked bench artifact into (round, on_tpu, payload)
+    entries. Artifacts wrap the bench JSON under "parsed" (driver capture)
+    or are the bare JSON (watcher-banked TPU measurements); unparseable or
+    payload-less rounds are skipped, not fatal — a wedged round must not
+    break the gate."""
+    entries = []
+    names = (sorted(glob.glob(os.path.join(directory, "BENCH_r*.json")))
+             + sorted(glob.glob(os.path.join(directory,
+                                             "BENCH_TPU_MEASURED_*.json"))))
+    for path in names:
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        payload = doc.get("parsed", doc) if isinstance(doc, dict) else None
+        if not isinstance(payload, dict):
+            continue
+        if "sweep" not in payload and payload.get("value") is None:
+            continue
+        # absent flag = the early TPU rounds (r01/r02) that predate it
+        on_tpu = not payload.get("tpu_unavailable", False)
+        entries.append({"artifact": os.path.basename(path),
+                        "round": _round_of(os.path.basename(path)),
+                        "on_tpu": on_tpu, "payload": payload})
+    entries.sort(key=lambda e: (e["round"], e["artifact"]))
+    return entries
+
+
+def extract_series(entries):
+    """{series_id: [(round, artifact, value), ...]} — series_id keys are
+    (on_tpu, mode, batch, metric); the headline rides as
+    (on_tpu, "__headline__", None, "value")."""
+    series = {}
+
+    def add(sid, rnd, artifact, value):
+        series.setdefault(sid, []).append((rnd, artifact, float(value)))
+
+    for e in entries:
+        p = e["payload"]
+        if isinstance(p.get("value"), (int, float)):
+            add((e["on_tpu"], "__headline__", None, "value"),
+                e["round"], e["artifact"], p["value"])
+        for row in p.get("sweep", []) or []:
+            if not isinstance(row, dict) or "error" in row \
+                    or "skipped" in row:
+                continue
+            on_tpu = bool(row.get("on_tpu", e["on_tpu"]))
+            for key in THROUGHPUT_KEYS:
+                if isinstance(row.get(key), (int, float)):
+                    add((on_tpu, row.get("mode"), row.get("batch"), key),
+                        e["round"], e["artifact"], row[key])
+    return series
+
+
+def check_regressions(series, threshold: float):
+    """LATEST occurrence vs BEST of strictly-earlier rounds, per series.
+    Single-round series (e.g. a config measured only once) cannot gate."""
+    checked, regressions = [], []
+    for sid, points in sorted(series.items(), key=lambda kv: str(kv[0])):
+        rounds = {}
+        for rnd, artifact, value in points:
+            cur = rounds.get(rnd)
+            if cur is None or value > cur[1]:    # same-round dupes: best
+                rounds[rnd] = (artifact, value)
+        if len(rounds) < 2:
+            continue
+        latest_round = max(rounds)
+        latest_art, latest = rounds[latest_round]
+        base_round, (base_art, baseline) = max(
+            ((r, v) for r, v in rounds.items() if r != latest_round),
+            key=lambda rv: rv[1][1])
+        delta = (latest - baseline) / baseline if baseline > 0 else 0.0
+        on_tpu, mode, batch, key = sid
+        rec = {
+            "series": {"on_tpu": on_tpu, "mode": mode, "batch": batch,
+                       "metric": key},
+            "baseline": {"round": base_round, "artifact": base_art,
+                         "value": baseline},
+            "latest": {"round": latest_round, "artifact": latest_art,
+                       "value": latest},
+            "delta_pct": round(delta * 100, 2),
+            "regressed": delta < -threshold,
+        }
+        checked.append(rec)
+        if rec["regressed"]:
+            regressions.append(rec)
+    return checked, regressions
+
+
+def roofline(ledger: dict):
+    """Place every ledger program on the device roofline. Returns [] when
+    the ledger carries no peak numbers (unlisted device, no override) —
+    informational, never gating."""
+    peak = ledger.get("peak_flops")
+    bw = ledger.get("hbm_bytes_per_sec")
+    rows = []
+    for prog in ledger.get("programs", []):
+        ai = prog.get("arithmetic_intensity")
+        row = {"name": prog.get("name"),
+               "fingerprint": prog.get("fingerprint"),
+               "flops": prog.get("flops"),
+               "arithmetic_intensity": ai,
+               "hbm_peak_bytes": prog.get("hbm_peak_bytes"),
+               "compile_seconds": prog.get("compile_seconds")}
+        if ai and peak and bw:
+            ridge = peak / bw
+            attainable = min(peak, ai * bw)
+            row.update({
+                "ridge_intensity": round(ridge, 2),
+                "bound": "compute" if ai >= ridge else "memory",
+                "attainable_flops": attainable,
+                "mfu_ceiling_pct": round(100.0 * attainable / peak, 1),
+            })
+        rows.append(row)
+    return rows
+
+
+def _fmt_series(sid_rec) -> str:
+    s = sid_rec["series"]
+    where = "tpu" if s["on_tpu"] else "cpu"
+    mode = s["mode"] if s["mode"] != "__headline__" else "headline"
+    batch = "" if s["batch"] is None else f" b{s['batch']}"
+    return f"{where} {mode}{batch} [{s['metric']}]"
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--dir", default=os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))),
+        help="directory holding BENCH_*.json artifacts (default: repo root)")
+    p.add_argument("--ledger", default=None, metavar="PATH",
+                   help="perf-ledger JSON (monitor.xla.save_ledger / "
+                        "--perf-ledger) to roofline-annotate")
+    p.add_argument("--threshold", type=float, default=0.15,
+                   help="fractional regression that fails the gate "
+                        "(default 0.15 = 15%%)")
+    p.add_argument("--json", action="store_true",
+                   help="emit the full machine-readable report on stdout")
+    args = p.parse_args(argv)
+
+    entries = load_rounds(args.dir)
+    if not entries:
+        print(f"perf_report: no BENCH_*.json artifacts under {args.dir}",
+              file=sys.stderr)
+        return 1
+    series = extract_series(entries)
+    checked, regressions = check_regressions(series, args.threshold)
+
+    ledger_doc, roof = None, []
+    if args.ledger:
+        try:
+            with open(args.ledger) as f:
+                ledger_doc = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"perf_report: cannot read ledger {args.ledger}: {e}",
+                  file=sys.stderr)
+            return 1
+        roof = roofline(ledger_doc)
+
+    report = {
+        "artifacts": [e["artifact"] for e in entries],
+        "threshold": args.threshold,
+        "series_tracked": len(series),
+        "series_compared": len(checked),
+        "comparisons": checked,
+        "regressions": regressions,
+        "roofline": roof,
+        "ok": not regressions,
+    }
+    if args.json:
+        print(json.dumps(report, indent=1))
+    else:
+        print(f"perf_report: {len(entries)} artifacts, {len(series)} "
+              f"series, {len(checked)} compared "
+              f"(threshold {args.threshold:.0%})")
+        for rec in checked:
+            mark = "REGRESSED" if rec["regressed"] else "ok"
+            print(f"  {mark:>9}  {_fmt_series(rec):<42} "
+                  f"{rec['baseline']['value']:>12.2f} (r{rec['baseline']['round']})"
+                  f" -> {rec['latest']['value']:>12.2f} "
+                  f"(r{rec['latest']['round']})  {rec['delta_pct']:+.1f}%")
+        for row in roof:
+            pos = (f"{row['bound']}-bound, MFU ceiling "
+                   f"{row['mfu_ceiling_pct']}%"
+                   if "bound" in row else "roofline n/a (no device peak)")
+            ai = row["arithmetic_intensity"]
+            print(f"  roofline  {row['name']:<28} "
+                  f"AI={'n/a' if ai is None else f'{ai:.1f}'}  {pos}")
+        if regressions:
+            print(f"perf_report: {len(regressions)} series regressed "
+                  f"beyond {args.threshold:.0%} — failing the gate")
+        else:
+            print("perf_report: gate clean")
+    return 2 if regressions else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

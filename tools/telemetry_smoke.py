@@ -8,17 +8,23 @@ Exercises every instrumented subsystem on CPU in one process:
 
 - ResilientTrainer fit over an AsyncDataSetIterator (train + ETL +
   resilience series; one injected NaN step ticks
-  resilience_steps_skipped_total),
-- ParallelInference BATCHED serving (inference series),
+  resilience_steps_skipped_total) with the compiled-program ledger
+  enabled (xla_* series + a live train_mfu_pct),
+- ParallelInference BATCHED serving (inference + serving-side ledger),
 - a two-rank SocketTransport exchange (transport series),
 
 then asserts:
 
 - GET /metrics on a live UIServer returns valid Prometheus text with
-  >= 12 distinct metric families spanning train/ETL/transport/
-  resilience/inference,
+  >= 20 distinct metric families spanning train/ETL/transport/
+  resilience/inference/xla, including xla_compile_seconds,
+  xla_program_flops, xla_hbm_peak_bytes, and a train_mfu_pct gauge that
+  carries a live nonzero value from the real fit,
+- the perf-ledger JSON (monitor.xla.save_ledger) is schema-valid and
+  holds >= 1 captured program with a fingerprint and flops,
 - the Chrome trace JSON loads, spans nest (train/step inside
-  resilience/fit), and at least two distinct thread tracks appear.
+  resilience/fit), xla/compile spans appear, and at least two distinct
+  thread tracks appear.
 
 Exit code 0 on success, 1 on failure; prints a JSON summary either way.
 """
@@ -33,6 +39,10 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+# CPU has no tabulated device peak: a nominal override keeps the MFU
+# accountant live (the gauge's absolute value is synthetic on CPU — the
+# smoke asserts liveness, not truth)
+os.environ.setdefault("DL4J_TPU_PEAK_FLOPS", "1e12")
 
 import numpy as np  # noqa: E402
 
@@ -42,7 +52,21 @@ GROUPS = {
     "transport": ("transport_",),
     "resilience": ("resilience_",),
     "inference": ("inference_",),
+    "xla": ("xla_",),
 }
+
+#: acceptance families the compiled-step observatory must expose
+XLA_REQUIRED = ("xla_compile_seconds", "xla_program_flops",
+                "xla_hbm_peak_bytes", "train_mfu_pct")
+
+#: top-level + per-program keys of the persisted perf-ledger schema
+LEDGER_KEYS = ("version", "created_unix", "device_kind", "backend",
+               "peak_flops", "hbm_bytes_per_sec", "programs")
+PROGRAM_KEYS = ("fingerprint", "name", "domain", "arg_shapes", "hlo_hash",
+                "compile_seconds", "compiles", "flops", "bytes_accessed",
+                "arithmetic_intensity", "hbm", "hbm_peak_bytes",
+                "examples_per_call", "steps_per_call",
+                "total_flops_per_call", "first_captured_unix")
 
 
 def _net(seed=0):
@@ -91,11 +115,16 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     p.add_argument("--trace-out", default=None,
                    help="default: a fresh temp file")
+    p.add_argument("--perf-ledger", default=None,
+                   help="perf-ledger JSON path (default: alongside the "
+                        "trace)")
     p.add_argument("--epochs", type=int, default=2)
     p.add_argument("--batch-size", type=int, default=16)
     args = p.parse_args(argv)
     trace_path = args.trace_out or os.path.join(
         tempfile.mkdtemp(prefix="telemetry_smoke_"), "trace.json")
+    ledger_path = args.perf_ledger or os.path.join(
+        os.path.dirname(trace_path), "perf_ledger.json")
 
     from deeplearning4j_tpu import monitor
     from deeplearning4j_tpu.data.async_iterator import AsyncDataSetIterator
@@ -109,8 +138,9 @@ def main(argv=None) -> int:
     from deeplearning4j_tpu.util.faults import FaultInjector
 
     monitor.enable_tracing()
+    monitor.xla.enable_ledger(ledger_path)
     failures = []
-    summary = {"trace_out": trace_path}
+    summary = {"trace_out": trace_path, "perf_ledger": ledger_path}
 
     # ---- train + ETL + resilience -------------------------------------
     rs = np.random.RandomState(0)
@@ -151,15 +181,49 @@ def main(argv=None) -> int:
     families = [ln.split()[2] for ln in body.splitlines()
                 if ln.startswith("# TYPE ")]
     summary["metric_families"] = len(families)
-    if len(families) < 12:
+    if len(families) < 20:
         failures.append(f"only {len(families)} metric families exposed "
-                        f"(need >= 12): {families}")
+                        f"(need >= 20): {families}")
     for group, prefixes in GROUPS.items():
         if not any(f.startswith(pre) for f in families for pre in prefixes):
             failures.append(f"no {group} metrics in /metrics exposition")
+    for fam in XLA_REQUIRED:
+        if fam not in families:
+            failures.append(f"{fam} missing from /metrics exposition")
     skip_ctr = monitor.REGISTRY.collect("resilience_steps_skipped_total")
     if skip_ctr is None or skip_ctr.value() < 1:
         failures.append("resilience_steps_skipped_total did not increment")
+
+    # ---- compiled-program ledger ---------------------------------------
+    mfu = monitor.REGISTRY.collect("train_mfu_pct")
+    summary["train_mfu_pct"] = None if mfu is None else mfu.value()
+    if mfu is None or mfu.value() <= 0:
+        failures.append("train_mfu_pct gauge not live after the fit")
+    compiles = monitor.REGISTRY.collect("xla_compiles_total")
+    if compiles is None or not compiles._children:
+        failures.append("xla_compiles_total never incremented")
+    try:
+        n_progs = monitor.xla.save_ledger(ledger_path)
+        summary["ledger_programs"] = n_progs
+        with open(ledger_path) as f:
+            ledger = json.load(f)
+        missing = [k for k in LEDGER_KEYS if k not in ledger]
+        if missing:
+            failures.append(f"perf ledger missing keys: {missing}")
+        if not ledger.get("programs"):
+            failures.append("perf ledger captured no programs")
+        else:
+            prog = ledger["programs"][0]
+            missing = [k for k in PROGRAM_KEYS if k not in prog]
+            if missing:
+                failures.append(f"ledger program missing keys: {missing}")
+            if not prog.get("fingerprint"):
+                failures.append("ledger program has no fingerprint")
+            if not any(p.get("flops") for p in ledger["programs"]):
+                failures.append("no ledger program carries flops "
+                                "(cost_analysis degraded on CPU?)")
+    except (OSError, ValueError) as e:
+        failures.append(f"perf ledger invalid: {type(e).__name__}: {e}")
 
     # ---- trace validity ------------------------------------------------
     n_events = monitor.save_trace(trace_path)
@@ -175,6 +239,10 @@ def main(argv=None) -> int:
         elif not any(_nested(f, s) for f in fits for s in steps):
             failures.append("train/step spans do not nest inside "
                             "resilience/fit")
+        compiles = [e for e in spans if e["name"] == "xla/compile"]
+        summary["xla_compile_spans"] = len(compiles)
+        if not compiles:
+            failures.append("no xla/compile spans in the trace")
         tids = {e["tid"] for e in spans}
         summary["trace_threads"] = len(tids)
         if len(tids) < 2:

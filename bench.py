@@ -131,11 +131,19 @@ def probe_tpu(attempts: int = None, probe_timeout: int = None,
 # --------------------------------------------------------------------------
 
 def _timed_best(fn, best_of):
-    best = None
+    return _timed_best_stats(lambda: (fn(), {}), best_of)[0]
+
+
+def _timed_best_stats(fn, best_of):
+    """Like _timed_best for fns returning (dt, stats): the banked stats
+    are the BEST repetition's, so side-channel numbers (etl waits) stay
+    consistent with the throughput they sit next to."""
+    best, stats = None, {}
     for _ in range(best_of):
-        dt = fn()
-        best = dt if best is None else min(best, dt)
-    return best
+        dt, s = fn()
+        if best is None or dt < best:
+            best, stats = dt, s
+    return best, stats
 
 
 def _bank_analysis(out, jitted, args, examples, steps=1):
@@ -536,9 +544,299 @@ def _run_h2d(cfg):
     return {"mode": "h2d-micro", "payload_mb": mb, "on_tpu": on_tpu, **rows}
 
 
+# --------------------------------------------------------------------------
+# fit()-end-to-end: the PRODUCT path including ETL (disk -> decode ->
+# host -> device), not resident-data steps. Three BASELINE configs
+# (lenet image / char-lstm / word2vec), each streaming from the shard
+# data plane (data/shards.py + data/pipeline.py) through the default
+# double-buffered device prefetch. The lenet row also measures the
+# pre-shard per-sample-loop path (ImageRecordReader PIL decode per
+# sample) so the ETL-stack speedup is a banked series, and every row
+# carries the etl_fetch_wait delta — near zero means the fit was
+# compute-bound, not ETL-bound (ROADMAP item 3's acceptance).
+# --------------------------------------------------------------------------
+
+def _etl_wait_snapshot():
+    from deeplearning4j_tpu import monitor
+    s = monitor.histogram("etl_fetch_wait_seconds").snapshot()
+    return {"count": s["count"], "sum": s["sum"]}
+
+
+def _etl_wait_delta(before):
+    after = _etl_wait_snapshot()
+    cnt = after["count"] - before["count"]
+    tot = after["sum"] - before["sum"]
+    return {"etl_fetch_wait_count": cnt,
+            "etl_fetch_wait_mean_s": round(tot / cnt, 6) if cnt else 0.0}
+
+
+def _fit_e2e_lenet(on_tpu, best_of, tmp):
+    import dataclasses
+
+    import numpy as np
+    from PIL import Image
+
+    from deeplearning4j_tpu.data.normalization import (
+        ImagePreProcessingScaler)
+    from deeplearning4j_tpu.data.pipeline import (
+        MultiProcessDataSetIterator, ShardBatchLoader)
+    from deeplearning4j_tpu.data.records import (
+        ImageRecordReader, RecordReaderDataSetIterator)
+    from deeplearning4j_tpu.data.shards import write_shards
+    from deeplearning4j_tpu.models import LeNet
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    batch = 128
+    classes = 10
+    # divisible by BOTH classes and batch: the reader path and the
+    # drop_last shard path then see the identical 10-full-batch epoch
+    n = 3840 if on_tpu else 1280
+    src_hw = 512     # on-disk photos are camera-sized RGB JPEGs, far
+    # bigger than the 28x28 model input — the per-sample path pays
+    # decode+convert+resize per image per EPOCH; the shard conversion
+    # pays it ONCE and every epoch after reads raw 28x28 uint8
+    rs = np.random.RandomState(7)
+    for ci in range(classes):
+        d = os.path.join(tmp, "imgs", f"c{ci}")
+        os.makedirs(d)
+        for i in range(n // classes):
+            Image.fromarray(
+                rs.randint(0, 256, (src_hw, src_hw, 3), dtype=np.uint8),
+                mode="RGB").save(os.path.join(d, f"{i:05d}.jpg"),
+                                 quality=85)
+
+    def _net():
+        conf = LeNet().conf()
+        if on_tpu:
+            conf = dataclasses.replace(conf, compute_dtype="bfloat16")
+        return MultiLayerNetwork(conf).init()
+
+    def _reader_it(scaled=True):
+        """scaled=False: RAW batches for the shard conversion — the
+        scaler must NOT bake into the stored payload (shards keep uint8
+        pixels; normalization happens per-fit, on device)."""
+        rr = ImageRecordReader(28, 28, 1).initialize(
+            os.path.join(tmp, "imgs"))
+        it = RecordReaderDataSetIterator(rr, batch_size=batch,
+                                         label_index=-1,
+                                         num_classes=classes)
+        return it.set_pre_processor(ImagePreProcessingScaler()) \
+            if scaled else it
+
+    out = {"mode": "fit-e2e-lenet", "batch": batch, "n_imgs": n,
+           "on_tpu": on_tpu, "best_of": best_of}
+
+    # ---- baseline: the per-sample PIL loop (in-process, workers off;
+    # the caller's worker-count setting is restored afterwards)
+    prev_workers = os.environ.get("DL4J_TPU_ETL_WORKERS")
+    os.environ["DL4J_TPU_ETL_WORKERS"] = "0"
+    try:
+        net = _net()
+        base_it = _reader_it()
+        net.fit(base_it, epochs=1)          # compile + warm
+
+        def run_base():
+            base_it.reset()
+            t0 = time.perf_counter()
+            net.fit(base_it, epochs=1)
+            float(net.score())
+            return time.perf_counter() - t0
+
+        out["fit_e2e_baseline_imgs_sec"] = round(
+            n / _timed_best(run_base, best_of), 1)
+    finally:
+        if prev_workers is None:
+            del os.environ["DL4J_TPU_ETL_WORKERS"]
+        else:
+            os.environ["DL4J_TPU_ETL_WORKERS"] = prev_workers
+
+    # ---- the shard data plane: convert once, then stream whole batches
+    # through the multi-process ring into the default device prefetch
+    shard_dir = os.path.join(tmp, "shards")
+    t0 = time.perf_counter()
+    write_shards(_reader_it(scaled=False), shard_dir)
+    out["convert_s"] = round(time.perf_counter() - t0, 2)
+    with MultiProcessDataSetIterator(
+            ShardBatchLoader(shard_dir, batch), name="bench-etl") as pipe:
+        pipe.set_pre_processor(ImagePreProcessingScaler())
+        net2 = _net()
+        net2.fit(pipe, epochs=1)            # compile + warm
+
+        def run_pipe():
+            pipe.reset()
+            wait0 = _etl_wait_snapshot()
+            t0 = time.perf_counter()
+            net2.fit(pipe, epochs=1)
+            float(net2.score())
+            dt = time.perf_counter() - t0
+            return dt, _etl_wait_delta(wait0)
+
+        dt, waits = _timed_best_stats(run_pipe, best_of)
+        out.update(waits)
+        out["fit_e2e_imgs_sec"] = round(n / dt, 1)
+    out["fit_e2e_speedup"] = round(
+        out["fit_e2e_imgs_sec"] / out["fit_e2e_baseline_imgs_sec"], 2)
+    return out
+
+
+def _fit_e2e_char_lstm(on_tpu, best_of, tmp):
+    import dataclasses
+
+    import numpy as np
+
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.data.iterator import DataSetIterator
+    from deeplearning4j_tpu.data.shards import (
+        ShardDataSetIterator, ShardWriter)
+    from deeplearning4j_tpu.nn.conf import (
+        InputType, NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.layers import LSTM as LSTMLayer
+    from deeplearning4j_tpu.nn.layers import RnnOutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.updaters import Adam
+
+    vocab, units = 77, (200 if on_tpu else 32)
+    T = 50 if on_tpu else 16
+    bl = 64 if on_tpu else 16
+    steps = 10 if on_tpu else 6
+
+    # token-id shards: uint8 ids on disk/over the stream; the one-hot
+    # expansion to (B, T, V) float is the per-batch ETL the prefetch
+    # thread overlaps with the compiled step
+    rs = np.random.RandomState(2)
+    with ShardWriter(tmp, shard_records=256) as w:
+        for _ in range(bl * steps):
+            ids = rs.randint(0, vocab, (T,)).astype(np.uint8)
+            w.add(ids, np.roll(ids, -1).astype(np.uint8))
+
+    class OneHotSeqIterator(DataSetIterator):
+        def __init__(self, src, vocab):
+            self._src, self._v = src, vocab
+            self._eye = np.eye(vocab, dtype="float32")
+
+        def reset(self):
+            self._src.reset()
+
+        def batch_size(self):
+            return self._src.batch_size()
+
+        def __iter__(self):
+            for ds in self._src:
+                yield DataSet(self._eye[ds.features.astype(int)],
+                              self._eye[ds.labels.astype(int)])
+
+    it = OneHotSeqIterator(
+        ShardDataSetIterator(tmp, batch_size=bl, num_classes=None), vocab)
+    conf = (NeuralNetConfiguration.Builder().seed(0)
+            .updater(Adam(1e-3)).list()
+            .layer(LSTMLayer(n_out=units, activation="tanh"))
+            .layer(LSTMLayer(n_out=units, activation="tanh"))
+            .layer(RnnOutputLayer(n_out=vocab, activation="softmax",
+                                  loss="mcxent"))
+            .set_input_type(InputType.recurrent(vocab, T)))
+    built = conf.build()
+    if on_tpu:
+        built = dataclasses.replace(built, compute_dtype="bfloat16")
+    net = MultiLayerNetwork(built).init()
+    net.fit(it, epochs=1)                   # compile + warm
+
+    out = {"mode": "fit-e2e-char-lstm", "units": units, "tbptt": T,
+           "batch": bl, "on_tpu": on_tpu, "best_of": best_of}
+
+    def run():
+        it.reset()
+        wait0 = _etl_wait_snapshot()
+        t0 = time.perf_counter()
+        net.fit(it, epochs=1)
+        float(net.score())
+        dt = time.perf_counter() - t0
+        return dt, _etl_wait_delta(wait0)
+
+    dt, waits = _timed_best_stats(run, best_of)
+    out.update(waits)
+    out["fit_e2e_chars_sec"] = round(bl * T * steps / dt, 1)
+    return out
+
+
+def _fit_e2e_word2vec(on_tpu, best_of, tmp):
+    import numpy as np
+    import jax
+
+    from deeplearning4j_tpu.data.async_iterator import prefetch_iterable
+    from deeplearning4j_tpu.data.shards import (
+        ShardDataSetIterator, ShardWriter)
+    from deeplearning4j_tpu.embeddings.sequencevectors import _sg_ns_step
+
+    vocab, dim, neg = (50_000, 100, 5) if on_tpu else (2_000, 100, 5)
+    pairs = 8192 if on_tpu else 512
+    steps = 50 if on_tpu else 10
+
+    # pair shards: each record is int32 [center, pos, neg...] — the
+    # skip-gram stream a tokenizer would emit, read batch-at-a-time
+    rs = np.random.RandomState(3)
+    with ShardWriter(tmp, shard_records=4096) as w:
+        for _ in range(steps):
+            w.add_batch(np.concatenate(
+                [rs.randint(0, vocab, (pairs, 2)),
+                 rs.randint(0, vocab, (pairs, neg))],
+                axis=1).astype(np.int32))
+    labels = jax.numpy.asarray(np.concatenate(
+        [np.ones((pairs, 1), "float32"),
+         np.zeros((pairs, neg), "float32")], 1))
+    w_in = jax.numpy.asarray(rs.rand(vocab, dim).astype("float32"))
+    w_out = jax.numpy.asarray(np.zeros((vocab, dim), "float32"))
+
+    def stage(ds):
+        f = ds.features
+        return (jax.device_put(np.ascontiguousarray(f[:, 0])),
+                jax.device_put(np.ascontiguousarray(f[:, 1:])))
+
+    def one_epoch():
+        nonlocal w_in, w_out
+        it = ShardDataSetIterator(tmp, batch_size=pairs)
+        for centers, targets in prefetch_iterable(it, stage):
+            w_in, w_out, _loss = _sg_ns_step(w_in, w_out, centers,
+                                             targets, labels, 0.025)
+        np.asarray(w_in[0, 0])              # host fetch barrier
+
+    one_epoch()                             # compile + warm
+    out = {"mode": "fit-e2e-word2vec", "vocab": vocab, "dim": dim,
+           "negative": neg, "on_tpu": on_tpu, "best_of": best_of}
+
+    def run():
+        wait0 = _etl_wait_snapshot()
+        t0 = time.perf_counter()
+        one_epoch()
+        dt = time.perf_counter() - t0
+        return dt, _etl_wait_delta(wait0)
+
+    dt, waits = _timed_best_stats(run, best_of)
+    out.update(waits)
+    out["fit_e2e_pairs_sec"] = round(pairs * steps / dt, 0)
+    return out
+
+
+def _run_fit_e2e(cfg):
+    import shutil
+    import tempfile
+
+    on_tpu, best_of = _bench_env()
+    runner = {"lenet": _fit_e2e_lenet, "char-lstm": _fit_e2e_char_lstm,
+              "word2vec": _fit_e2e_word2vec}[cfg["model"]]
+    # the temp dataset (order-100MB of synthetic JPEGs for lenet) is
+    # removed even when the run raises; a config-timeout SIGKILL still
+    # leaks it, which is why it lives under the OS tempdir
+    tmp = tempfile.mkdtemp(prefix=f"bench_e2e_{cfg['model']}_")
+    try:
+        return runner(on_tpu, best_of, tmp)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 _KIND_RUNNERS = {"resnet": _run_resnet, "lenet": _run_lenet,
                  "char-lstm": _run_char_lstm, "word2vec": _run_word2vec,
-                 "attention": _run_attention, "h2d": _run_h2d}
+                 "attention": _run_attention, "h2d": _run_h2d,
+                 "fit_e2e": _run_fit_e2e}
 
 
 def run_one(cfg):
@@ -657,10 +955,18 @@ def _configs(on_tpu):
         cfgs.append({"kind": "word2vec"})
     if os.environ.get("DL4J_TPU_BENCH_LENET", "1") == "1":
         cfgs.append({"kind": "lenet"})
+    if os.environ.get("DL4J_TPU_BENCH_FIT_E2E", "1") == "1":
+        # the product-path (incl. ETL) rows for the three BASELINE
+        # configs — ROADMAP item 3's fit()-end-to-end series
+        cfgs += [{"kind": "fit_e2e", "model": m}
+                 for m in ("lenet", "char-lstm", "word2vec")]
     return cfgs
 
 
-def main():
+def main(mode: str = None):
+    """`mode` filters the sweep: "fit_e2e" runs only the
+    fit()-end-to-end configs (CLI: ``python bench.py --mode fit_e2e``);
+    None runs the full sweep."""
     _install_sigterm_handler()
     tpu_up = probe_tpu()
     cfg_timeout = int(os.environ.get("DL4J_TPU_BENCH_CONFIG_TIMEOUT",
@@ -679,7 +985,12 @@ def main():
     def canon(cfg):
         return _canon_mode(cfg, scan_k)
 
-    for cfg in _configs(tpu_up):
+    cfgs = _configs(tpu_up)
+    if mode is not None:
+        cfgs = [c for c in cfgs if c["kind"] == mode]
+        if not cfgs:
+            sys.stderr.write(f"bench: no configs for --mode {mode}\n")
+    for cfg in cfgs:
         label = json.dumps(cfg, sort_keys=True)
         if wedged:
             results.append({**canon(cfg), "skipped": "tunnel wedged"})
@@ -791,5 +1102,7 @@ def main():
 if __name__ == "__main__":
     if len(sys.argv) >= 3 and sys.argv[1] == "--one":
         run_one(json.loads(sys.argv[2]))
+    elif len(sys.argv) >= 3 and sys.argv[1] == "--mode":
+        main(mode=sys.argv[2])
     else:
         main()

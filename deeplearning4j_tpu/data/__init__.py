@@ -4,7 +4,14 @@ from deeplearning4j_tpu.data.iterator import (
     BenchmarkDataSetIterator,
 )
 from deeplearning4j_tpu.data.async_iterator import (
-    AsyncDataSetIterator, host_cast, prefetch_iterable,
+    AsyncDataSetIterator, host_cast, prefetch_depth, prefetch_iterable,
+)
+from deeplearning4j_tpu.data.shards import (
+    ShardDataSetIterator, ShardWriter, write_shards,
+)
+from deeplearning4j_tpu.data.pipeline import (
+    ImageFileBatchLoader, MultiProcessDataSetIterator, ShardBatchLoader,
+    etl_workers,
 )
 from deeplearning4j_tpu.data.utility_iterators import (
     AbstractDataSetIterator, AsyncMultiDataSetIterator,
@@ -43,6 +50,9 @@ __all__ = [
     "DataSet", "MultiDataSet", "DataSetIterator", "ArrayDataSetIterator",
     "ExistingDataSetIterator", "BenchmarkDataSetIterator",
     "AsyncDataSetIterator",
+    "ShardDataSetIterator", "ShardWriter", "write_shards",
+    "MultiProcessDataSetIterator", "ShardBatchLoader",
+    "ImageFileBatchLoader", "etl_workers", "prefetch_depth",
     "EarlyTerminationDataSetIterator", "MultipleEpochsIterator",
     "DataSetIteratorSplitter", "SamplingDataSetIterator",
     "IteratorDataSetIterator", "AsyncMultiDataSetIterator",

@@ -7,7 +7,27 @@ background thread pulls batches from the source iterator into a bounded queue
 so host ETL overlaps device compute. On TPU this additionally starts the
 host->HBM transfer (jax.device_put) from the worker thread, so the next
 batch's DMA overlaps the current step — the role DL4J's device-aware
-buffering plays for CUDA.
+buffering plays for CUDA. The default depth of 2 is DOUBLE BUFFERING:
+batch i+1 is staged (cast + device_put) while batch i computes.
+
+Environment knobs of the default data plane — the one reference list
+(mirrored in docs/DATA_PIPELINE.md); every switch follows the same
+``=="0"``-disables kill-switch contract:
+
+- ``DL4J_TPU_PREFETCH_DEPTH``: device-prefetch queue depth for the
+  default fit() wrap and prefetch_iterable (default 2 =
+  double-buffered); ``0`` disables the background thread entirely
+  (batches are staged synchronously — placement contract still holds).
+- ``DL4J_TPU_FIT_PREFETCH``: ``0`` skips the fit() async wrap
+  altogether (the legacy switch; prefer PREFETCH_DEPTH=0).
+- ``DL4J_TPU_HOST_CAST``: ``0`` restores transfer-then-cast for 16-bit
+  compute dtypes (see `host_cast`).
+- ``DL4J_TPU_DEVICE_NORM``: ``0`` keeps normalization on host instead
+  of the on-device affine + raw-uint8-over-the-wire path
+  (data/normalization.engaged_device_affine).
+- ``DL4J_TPU_ETL_WORKERS`` / ``DL4J_TPU_ETL_RING_SLOTS`` /
+  ``DL4J_TPU_ETL_MP_START``: the multi-process shared-memory ETL ring
+  (data/pipeline.py); ``DL4J_TPU_ETL_WORKERS=0`` disables.
 """
 from __future__ import annotations
 
@@ -15,6 +35,7 @@ import os
 import queue
 import threading
 import time
+from typing import Optional
 
 import jax
 import numpy as np
@@ -23,6 +44,24 @@ from deeplearning4j_tpu.data.dataset import DataSet
 from deeplearning4j_tpu.data.iterator import DataSetIterator
 
 _SENTINEL = object()
+
+
+def prefetch_depth(default: int = 2) -> int:
+    """Resolve DL4J_TPU_PREFETCH_DEPTH (default 2: double-buffered).
+    0 disables prefetching — the same kill-switch contract as
+    DL4J_TPU_HOST_CAST / DL4J_TPU_DEVICE_NORM (module docstring)."""
+    v = os.environ.get("DL4J_TPU_PREFETCH_DEPTH")
+    if v is None or v == "":
+        return default
+    return max(0, int(v))
+
+
+def fit_prefetch_enabled() -> bool:
+    """DL4J_TPU_FIT_PREFETCH resolved under the one kill-switch contract
+    of the module docstring: ONLY ``"0"`` disables; unset/empty/anything
+    else leaves the default fit() async wrap on. The single rule for
+    both fit gates (nn/multilayer.py, nn/graph.py)."""
+    return os.environ.get("DL4J_TPU_FIT_PREFETCH", "") != "0"
 
 
 def host_cast(a, dtype):
@@ -40,7 +79,7 @@ def host_cast(a, dtype):
     return a
 
 
-def prefetch_iterable(source, transform=None, queue_size: int = 2):
+def prefetch_iterable(source, transform=None, queue_size: Optional[int] = None):
     """Generic bounded background-thread pump: pull items from `source`,
     apply `transform` on the worker thread (host cast + async device_put
     live there), yield in order. The device-side analog of DL4J's
@@ -48,11 +87,28 @@ def prefetch_iterable(source, transform=None, queue_size: int = 2):
     MultiDataSet stream uses this; DataSet streams use
     AsyncDataSetIterator).
 
+    `queue_size` defaults to DL4J_TPU_PREFETCH_DEPTH (2 =
+    double-buffered); 0 degrades to a synchronous generator that still
+    applies `transform` per item, so the device-placement contract holds
+    with the background thread disabled.
+
     Telemetry (monitor/): `etl_queue_depth` tracks the prefetch buffer
     fill, `etl_fetch_wait_seconds` how long the consumer (the train
     loop) blocked on it — a consistently empty queue + large waits means
     the fit is ETL-bound, not compute-bound. Worker-side staging shows
     up as `etl/stage` spans on the prefetch thread's trace track."""
+    if queue_size is None:
+        queue_size = prefetch_depth()
+    if int(queue_size) <= 0:
+        return (item if transform is None else transform(item)
+                for item in source)
+    return _prefetch_pump(source, transform, int(queue_size))
+
+
+def _prefetch_pump(source, transform, queue_size: int):
+    """The background-thread pump half of prefetch_iterable (split out so
+    the depth-0 sync degrade can be a plain return, not a dead generator
+    branch)."""
     from deeplearning4j_tpu import monitor
     q: "queue.Queue" = queue.Queue(maxsize=int(queue_size))
     stop = threading.Event()
@@ -119,7 +175,8 @@ def prefetch_iterable(source, transform=None, queue_size: int = 2):
 
 
 class AsyncDataSetIterator(DataSetIterator):
-    def __init__(self, source: DataSetIterator, queue_size: int = 4,
+    def __init__(self, source: DataSetIterator,
+                 queue_size: Optional[int] = None,
                  device_put: bool = True, device=None, callback=None,
                  cast_dtype=None, cast_features: bool = True):
         """`callback` is a DataSetCallback (data/utility_iterators.py)
@@ -135,7 +192,14 @@ class AsyncDataSetIterator(DataSetIterator):
         restricts the cast to labels — fit() uses it when device-side
         normalization is engaged, where RAW features must reach the
         device uncast (normalize-then-cast preserves the f32 signal a
-        premature bf16 cast would quantize away)."""
+        premature bf16 cast would quantize away).
+
+        `queue_size` defaults to DL4J_TPU_PREFETCH_DEPTH (2 =
+        double-buffered: the next batch stages while the current one
+        computes); 0 disables the prefetch thread but keeps per-batch
+        staging (cast + placement) synchronous."""
+        if queue_size is None:
+            queue_size = prefetch_depth()
         if getattr(source, "async_supported", True) is False:
             # AsyncShieldDataSetIterator semantics: pass through unwrapped
             self._passthrough = source
@@ -200,6 +264,7 @@ class AsyncDataSetIterator(DataSetIterator):
 
     def _iter_async(self):
         # the one shared thread pump (bounded queue, sentinel, exception
-        # smuggling, drain-and-join teardown) lives in prefetch_iterable
+        # smuggling, drain-and-join teardown) lives in prefetch_iterable;
+        # queue_size 0 degrades it to synchronous per-batch staging
         yield from prefetch_iterable(self._source, self._stage,
                                      self._queue_size)

@@ -205,12 +205,15 @@ class RecordReaderDataSetIterator(DataSetIterator):
         self.regression = regression
         self.label_index_to = label_index_to if label_index_to is not None \
             else label_index
+        self._mp_pipe = None    # lazy multi-process image pipeline
 
     def batch_size(self):
         return self._batch
 
     def reset(self):
         self.reader.reset()
+        if self._mp_pipe:               # False = disabled after failure
+            self._mp_pipe.reset()
 
     def __iter__(self):
         # every batch flows through the attached pre-processor (the
@@ -239,20 +242,97 @@ class RecordReaderDataSetIterator(DataSetIterator):
         if buf:
             yield self._to_dataset(buf)
 
-    def _iter_image_batches(self):
-        imgs, labels = [], []
-        for img, lab in self.reader.records():
-            imgs.append(img)
-            labels.append(lab)
-            if len(imgs) == self._batch:
-                yield self._image_dataset(imgs, labels)
-                imgs, labels = [], []
-        if imgs:
-            yield self._image_dataset(imgs, labels)
+    def _image_pipeline(self):
+        """The multi-process hot image path (data/pipeline.py): for
+        file-backed image readers on datasets big enough to amortize
+        worker startup (etl_workers' auto rule, DL4J_TPU_ETL_WORKERS
+        overrides / =0 disables), decode happens in N worker processes
+        filling shared-memory ring slots — the per-sample PIL loop
+        leaves the training process entirely. Batch output is
+        bitwise-identical to the in-process path (same load_image +
+        one-hot rules; tools/etl_smoke.py proves it)."""
+        reader = self.reader
+        files = getattr(reader, "_files", None)
+        if self._mp_pipe is False:      # earlier startup failure: stay
+            return None                 # on the in-process path
+        if not files or getattr(reader, "normalize", None) is None:
+            return None
+        if self.label_index is not None and not self.regression \
+                and self.num_classes is None:
+            return None     # let the in-process path raise its error
+        from deeplearning4j_tpu.data.pipeline import etl_workers
+        workers = etl_workers(len(files))
+        if workers <= 0:
+            return None
+        if self._mp_pipe is None:
+            from deeplearning4j_tpu.data.pipeline import (
+                ImageFileBatchLoader, MultiProcessDataSetIterator,
+            )
+            labeled = self.label_index is not None
+            loader = ImageFileBatchLoader(
+                files, reader.height, reader.width, reader.channels,
+                self._batch,
+                num_classes=self.num_classes
+                if labeled and not self.regression else None,
+                regression=labeled and self.regression,
+                normalize=reader.normalize)
+            self._mp_pipe = MultiProcessDataSetIterator(
+                loader, num_workers=workers, name="image-etl")
+        return self._mp_pipe
 
-    def _image_dataset(self, imgs, labels) -> DataSet:
-        feats = np.stack(imgs)                          # (B, H, W, C)
-        if feats.dtype != np.uint8:     # raw bytes stay raw (device norm)
+    def _iter_image_batches(self):
+        pipe = self._image_pipeline()
+        if pipe is not None:
+            # the delegated ring is constructed copy=True: every yielded
+            # batch is owned, so stacking fits need no special handling.
+            # seek(0) pins each pass to a full epoch from the first file —
+            # the ring's own resume-at-position semantics would otherwise
+            # silently drop the already-served prefix after an abandoned
+            # pass, where the in-process decode loop below restarts.
+            pipe.seek(0)
+            it = iter(pipe)
+            try:
+                first = next(it)
+            except StopIteration:
+                return
+            except RuntimeError as e:
+                # worker startup failed (most often: an unguarded user
+                # script under the 'spawn' start method) — degrade to
+                # the in-process decode loop instead of failing the fit
+                log.warning("multi-process image ETL unavailable, "
+                            "falling back to in-process decode: %s", e)
+                try:
+                    pipe.close()
+                except Exception:
+                    pass
+                self._mp_pipe = False
+            else:
+                yield first
+                yield from it
+                return
+        buf, labels, fill = None, [], 0
+        for img, lab in self.reader.records():
+            img = np.asarray(img)
+            if buf is None:
+                # preallocate ONE (B, H, W, C) batch and fill in place —
+                # np.stack over a B-long Python list allocates B+1 arrays
+                # per batch (measurable allocator churn at b128). A fresh
+                # buffer per batch: the yielded DataSet escapes into the
+                # prefetch queue and must not be overwritten.
+                buf = np.empty((self._batch, *img.shape), img.dtype)
+            buf[fill] = img
+            labels.append(lab)
+            fill += 1
+            if fill == self._batch:
+                yield self._image_dataset(buf, labels)
+                buf, labels, fill = None, [], 0
+        if fill:
+            yield self._image_dataset(buf[:fill], labels)
+
+    def _image_dataset(self, feats, labels) -> DataSet:
+        feats = np.asarray(feats)                       # (B, H, W, C)
+        if feats.dtype not in (np.uint8, np.float32):
+            # raw bytes stay raw (device norm); floats stay as-is
             feats = feats.astype("float32")
         if self.label_index is None:    # unlabeled, as the tabular path
             return DataSet(feats)
@@ -260,8 +340,10 @@ class RecordReaderDataSetIterator(DataSetIterator):
             return DataSet(feats, np.asarray(labels, "float32")[:, None])
         if self.num_classes is None:
             raise ValueError("num_classes required for classification")
-        return DataSet(feats, np.eye(self.num_classes, dtype="float32")[
-            np.asarray(labels, int)])
+        from deeplearning4j_tpu.data.shards import one_hot_labels
+        return DataSet(feats,
+                       one_hot_labels(np.asarray(labels, int),
+                                      self.num_classes))
 
     def _to_dataset(self, rows) -> DataSet:
         arr = np.asarray(rows, "float32")
@@ -444,6 +526,26 @@ class RecordReaderMultiDataSetIterator(DataSetIterator):
         return a[:, lo:(a.shape[1] if hi is None else hi + 1)]
 
 
+def load_image(path: str, height: int, width: int, channels: int,
+               normalize: bool = False) -> np.ndarray:
+    """THE image decode rule — PIL open/convert/resize to (H, W, C),
+    uint8 raw (or float32 [0,1] with normalize). One definition shared
+    by ImageRecordReader (in-process per-sample path) and
+    data/pipeline.ImageFileBatchLoader (multi-process workers) so the
+    two paths are bitwise-identical by construction."""
+    from PIL import Image
+    img = Image.open(path)
+    img = img.convert("L" if channels == 1 else "RGB")
+    img = img.resize((width, height))
+    if normalize:
+        arr = np.asarray(img, np.float32) / 255.0
+    else:
+        arr = np.asarray(img, np.uint8)
+    if arr.ndim == 2:
+        arr = arr[..., None]
+    return arr
+
+
 class ImageRecordReader(RecordReader):
     """Images-from-directories reader (DataVec ImageRecordReader +
     ParentPathLabelGenerator): label = parent directory name, images
@@ -506,17 +608,8 @@ class ImageRecordReader(RecordReader):
         return len(self._labels)
 
     def _load(self, path: str) -> np.ndarray:
-        from PIL import Image
-        img = Image.open(path)
-        img = img.convert("L" if self.channels == 1 else "RGB")
-        img = img.resize((self.width, self.height))
-        if self.normalize:
-            arr = np.asarray(img, np.float32) / 255.0
-        else:
-            arr = np.asarray(img, np.uint8)
-        if arr.ndim == 2:
-            arr = arr[..., None]
-        return arr
+        return load_image(path, self.height, self.width, self.channels,
+                          self.normalize)
 
     def records(self):
         """Yields (image (H, W, C) uint8 — float32 [0,1] with

@@ -410,6 +410,16 @@ class ComputationGraph:
             if aff is not None:
                 self._input_affine = (jnp.asarray(aff[0]),
                                       jnp.asarray(aff[1]))
+            copy_marked = []
+            if not tbptt and (accumulate_steps > 1 or (
+                    scan_steps > 1
+                    and not _scan_incompatible_listeners(self.listeners))):
+                # the stacking fits hold K live batches before one
+                # transfer — shared-memory ring sources must yield copies
+                # (data/pipeline.mark_copy_for_stacking)
+                from deeplearning4j_tpu.data.pipeline import (
+                    mark_copy_for_stacking)
+                copy_marked = mark_copy_for_stacking(data)
             try:
                 from deeplearning4j_tpu import monitor
                 for _ in range(epochs):
@@ -431,6 +441,8 @@ class ComputationGraph:
                         data.reset()
             finally:
                 self._input_affine = None
+                for it_ in copy_marked:
+                    it_._copy = False
         return self
 
     def _mds_stream(self, data):
@@ -438,14 +450,16 @@ class ComputationGraph:
         overlaps host ETL + the bf16 host cast + the H2D transfer with
         device compute (the reference wraps every fit in an async iterator
         by default — MultiLayerNetwork.java:1272-1274, same contract for
-        graphs at ComputationGraph.java:1015). DL4J_TPU_FIT_PREFETCH=0
-        disables."""
-        if os.environ.get("DL4J_TPU_FIT_PREFETCH", "1") != "1" \
+        graphs at ComputationGraph.java:1015), DL4J_TPU_PREFETCH_DEPTH
+        batches deep (default 2: double-buffered H2D).
+        DL4J_TPU_FIT_PREFETCH=0 or DL4J_TPU_PREFETCH_DEPTH=0 disables
+        the thread (the latter keeps synchronous staging)."""
+        from deeplearning4j_tpu.data.async_iterator import (
+            fit_prefetch_enabled, host_cast, prefetch_iterable,
+        )
+        if not fit_prefetch_enabled() \
                 or getattr(data, "async_supported", True) is False:
             return self._iter_data(data)
-        from deeplearning4j_tpu.data.async_iterator import (
-            host_cast, prefetch_iterable,
-        )
         cast = self._compute_dtype \
             if np.dtype(self._compute_dtype).itemsize == 2 else None
         # device-norm engaged: features reach the device UNCAST so the

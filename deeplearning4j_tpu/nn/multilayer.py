@@ -577,12 +577,19 @@ class MultiLayerNetwork:
         check"): XLA:CPU pessimizes convolutions inside scan, so conv nets
         on CPU should keep scan_steps=1.
 
-        `prefetch` (default on, kill switch DL4J_TPU_FIT_PREFETCH=0):
-        wrap plain sources in AsyncDataSetIterator, like the reference
-        wraps every fit in an async iterator by default
-        (MultiLayerNetwork.java:1272-1274) — a worker thread overlaps host
-        ETL, the bf16 host cast, and the H2D transfer with device compute.
-        Already-async and async_supported=False sources pass through."""
+        `prefetch` (default on, kill switches DL4J_TPU_FIT_PREFETCH=0 /
+        DL4J_TPU_PREFETCH_DEPTH=0): wrap plain sources in
+        AsyncDataSetIterator, like the reference wraps every fit in an
+        async iterator by default (MultiLayerNetwork.java:1272-1274) — a
+        worker thread overlaps host ETL, the bf16 host cast, and the H2D
+        transfer with device compute, DL4J_TPU_PREFETCH_DEPTH batches
+        deep (default 2: double-buffered H2D). Already-async and
+        async_supported=False sources pass through. Multi-process
+        sources (data/pipeline.MultiProcessDataSetIterator, or the hot
+        image path's automatic delegation in data/records.py) compose:
+        the wrap's prefetch thread is the ring consumer, so worker
+        decode, device DMA, and the compiled step all overlap — see
+        docs/DATA_PIPELINE.md."""
         if self.params is None:
             self.init()
         # donated-buffer safety: params from ANY host source (checkpoint,
@@ -605,7 +612,10 @@ class MultiLayerNetwork:
             scan_steps = _default_scan_steps()
         iterator = self._as_iterator(data, batch_size)
         if prefetch is None:
-            prefetch = os.environ.get("DL4J_TPU_FIT_PREFETCH", "1") == "1"
+            from deeplearning4j_tpu.data.async_iterator import (
+                fit_prefetch_enabled,
+            )
+            prefetch = fit_prefetch_enabled()
         # device-side normalization (data/normalization.py
         # engaged_device_affine — env gate, listener gate, detach/restore,
         # feature-cast pause): an affine-representable pre-processor is
@@ -630,6 +640,15 @@ class MultiLayerNetwork:
                 scan_steps > 1
                 and self.conf.backprop_type != "tbptt"
                 and not _scan_incompatible_listeners(self.listeners))
+            copy_marked = []
+            if stacking:
+                # stacking holds K live batches before ONE transfer —
+                # shared-memory ring iterators must yield copies for it
+                # (their normal view batches are recycled on the next
+                # pull; data/pipeline.mark_copy_for_stacking)
+                from deeplearning4j_tpu.data.pipeline import (
+                    mark_copy_for_stacking)
+                copy_marked = mark_copy_for_stacking(iterator)
             if prefetch and not isinstance(iterator, AsyncDataSetIterator) \
                     and getattr(iterator, "async_supported", True):
                 iterator = AsyncDataSetIterator(
@@ -659,6 +678,8 @@ class MultiLayerNetwork:
                     iterator.reset()
             finally:
                 self._input_affine = None
+                for it_ in copy_marked:
+                    it_._copy = False
         return self
 
     def fit_pretrain(self, data, epochs: int = 1, batch_size: int = 32):

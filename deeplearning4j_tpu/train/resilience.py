@@ -54,6 +54,7 @@ import random
 import signal
 import threading
 import time
+import weakref
 import zipfile
 from typing import Optional, Tuple
 
@@ -653,6 +654,17 @@ class ResilientTrainer:
             "step_in_epoch": int(step_in_epoch),
             "dispatch_idx": int(self._dispatch_idx),
         }
+        src = getattr(self, "_source", None)
+        src = src() if src is not None else None
+        if src is not None and hasattr(src, "stream_state"):
+            # the exact shard file/offset the next batch starts at —
+            # step_in_epoch implies it (deterministic epoch order), but
+            # the explicit position makes checkpoints auditable and
+            # resumable by offset without replaying the order rule
+            try:
+                extra["stream"] = src.stream_state()
+            except Exception:
+                pass
         if self.net._score is not None:
             extra["score"] = float(self.net._score)
         nz = self._normalizer_extra()
@@ -794,6 +806,14 @@ class ResilientTrainer:
                          net.iteration_count, net.epoch_count, step_in_epoch)
 
         source = self._driver.make_source(data, batch_size)
+        # weakly held: _save banks the seekable stream position while the
+        # local `source` keeps it alive for the fit; a strong ref would
+        # pin a multi-process ETL pipeline (workers + shared-memory ring)
+        # to the trainer's lifetime after fit() returns
+        try:
+            self._source = weakref.ref(source)
+        except TypeError:
+            self._source = None     # plain list/array: no stream_state
         if self.normalizer is not None \
                 and getattr(source, "pre_processor", False) is None \
                 and hasattr(source, "set_pre_processor"):
@@ -819,8 +839,18 @@ class ResilientTrainer:
         with PreemptionGuard() as guard, \
                 monitor.span("resilience/fit", epochs=epochs):
             # the uninterrupted run resets the source once per completed
-            # epoch — replay those resets so epoch-dependent shuffles match
-            for _ in range(net.epoch_count):
+            # epoch — replay those resets so epoch-dependent shuffles
+            # match. A LIVE streaming source re-fit in the same process
+            # (preempt -> fit again on the same pipeline) already
+            # consumed its in-fit resets; stream_state names its current
+            # epoch, so replay only the difference — blind replay would
+            # double-advance the shuffle permutation the seek below
+            # resumes into.
+            src_epoch = 0
+            state_fn = getattr(source, "stream_state", None)
+            if callable(state_fn):
+                src_epoch = int(state_fn().get("epoch") or 0)
+            for _ in range(max(0, net.epoch_count - src_epoch)):
                 self._driver.reset(source)
             try:
                 while net.epoch_count < epochs:
@@ -831,8 +861,19 @@ class ResilientTrainer:
                         for lst in net.listeners:
                             lst.on_epoch_start(net, epoch)
                     resumed_mid_epoch = False
-                    it = self._driver.batches(source)
                     consumed = 0
+                    if step_in_epoch > 0 \
+                            and getattr(source, "supports_seek", False):
+                        # streaming sources (ShardDataSetIterator) land on
+                        # the exact next shard offset instead of replaying
+                        # — decoding the whole stream prefix just to throw
+                        # it away is the resume tax this skips
+                        source.seek(step_in_epoch)
+                        consumed = step_in_epoch
+                        if hasattr(source, "stream_state"):
+                            log.info("resume: seeked stream to %s",
+                                     source.stream_state())
+                    it = self._driver.batches(source)
                     while True:
                         if guard.requested or (
                                 self.injector is not None

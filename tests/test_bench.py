@@ -14,7 +14,7 @@ import bench
 
 _KNOBS = ("DL4J_TPU_BENCH_BATCHES", "DL4J_TPU_BENCH_ATTENTION",
           "DL4J_TPU_BENCH_LSTM", "DL4J_TPU_BENCH_W2V",
-          "DL4J_TPU_BENCH_LENET")
+          "DL4J_TPU_BENCH_LENET", "DL4J_TPU_BENCH_FIT_E2E")
 
 
 @pytest.fixture
@@ -40,6 +40,9 @@ class TestConfigs:
         assert {c["batch"] for c in cfgs[:3]} == {128}
         # full sweep carries all 4 BASELINE configs
         assert {"char-lstm", "word2vec", "lenet"} <= {k for k, _ in kinds}
+        # plus the fit()-end-to-end (product path incl. ETL) rows
+        assert [c.get("model") for c in cfgs if c["kind"] == "fit_e2e"] \
+            == ["lenet", "char-lstm", "word2vec"]
 
     def test_cpu_order_single_batch(self, clean_knobs):
         cfgs = bench._configs(False)
@@ -53,6 +56,7 @@ class TestConfigs:
         monkeypatch.setenv("DL4J_TPU_BENCH_LENET", "0")
         monkeypatch.setenv("DL4J_TPU_BENCH_ATTENTION", "0")
         monkeypatch.setenv("DL4J_TPU_BENCH_H2D", "0")
+        monkeypatch.setenv("DL4J_TPU_BENCH_FIT_E2E", "0")
         kinds = {c["kind"] for c in bench._configs(True)}
         assert kinds == {"resnet"}
 

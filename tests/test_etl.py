@@ -1,0 +1,627 @@
+"""Line-rate data plane: shard format round-trips, exact-position seek,
+multi-process shared-memory ring parity, the hot-image-path delegation,
+and the prefetch-depth env contract (data/shards.py, data/pipeline.py,
+data/async_iterator.py)."""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data.async_iterator import (
+    AsyncDataSetIterator, prefetch_depth, prefetch_iterable,
+)
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.data.iterator import ArrayDataSetIterator
+from deeplearning4j_tpu.data.pipeline import (
+    ImageFileBatchLoader, MultiProcessDataSetIterator, ShardBatchLoader,
+    etl_workers,
+)
+from deeplearning4j_tpu.data.records import (
+    ImageRecordReader, RecordReaderDataSetIterator,
+)
+from deeplearning4j_tpu.data.shards import (
+    ShardDataSetIterator, ShardWriter, read_footer, write_shards,
+)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _image_data(n=90, h=8, w=8, c=1, classes=5, seed=0):
+    rs = np.random.RandomState(seed)
+    X = rs.randint(0, 256, (n, h, w, c), dtype=np.uint8)
+    Y = np.eye(classes, dtype="float32")[rs.randint(0, classes, n)]
+    return X, Y
+
+
+def _write(tmp_path, X, Y, shard_records=32, batch=30):
+    d = str(tmp_path / "shards")
+    write_shards(ArrayDataSetIterator(X, Y, batch_size=batch,
+                                      drop_last=False),
+                 d, shard_records=shard_records)
+    return d
+
+
+# ------------------------------------------------------------- shard format
+def test_shard_roundtrip_bitwise(tmp_path):
+    X, Y = _image_data()
+    d = _write(tmp_path, X, Y)
+    it = ShardDataSetIterator(d, batch_size=30)
+    got = list(it)
+    assert len(got) == 3
+    for i, ds in enumerate(got):
+        np.testing.assert_array_equal(ds.features, X[i * 30:(i + 1) * 30])
+        assert ds.features.dtype == np.uint8     # raw over the wire
+        np.testing.assert_array_equal(ds.labels, Y[i * 30:(i + 1) * 30])
+        assert ds.labels.dtype == np.float32
+
+
+def test_shard_footer_and_compact_labels(tmp_path):
+    X, Y = _image_data(n=70)
+    d = _write(tmp_path, X, Y, shard_records=32)
+    with open(os.path.join(d, "index.json")) as f:
+        index = json.load(f)
+    # exact one-hot labels stored as int32 ids + num_classes
+    assert index["num_classes"] == 5
+    assert np.dtype(index["labels"]["dtype"]) == np.int32
+    assert index["n_records"] == 70
+    assert [s["records"] for s in index["shards"]] == [32, 32, 6]
+    footer = read_footer(os.path.join(d, index["shards"][0]["file"]))
+    assert footer["records"] == 32
+    assert tuple(footer["features"]["shape"]) == (8, 8, 1)
+
+
+def test_shard_crosses_boundaries_and_ragged_tail(tmp_path):
+    X, Y = _image_data(n=100)
+    d = _write(tmp_path, X, Y, shard_records=32)
+    it = ShardDataSetIterator(d, batch_size=48, drop_last=False)
+    got = list(it)
+    assert [b.features.shape[0] for b in got] == [48, 48, 4]
+    np.testing.assert_array_equal(got[1].features, X[48:96])    # 2 shards
+    np.testing.assert_array_equal(got[2].features, X[96:])
+
+
+def test_shard_noncompact_labels_verbatim(tmp_path):
+    rs = np.random.RandomState(3)
+    X = rs.randn(40, 6).astype("float32")
+    Y = rs.randn(40, 2).astype("float32")        # regression targets
+    d = str(tmp_path / "s")
+    write_shards(ArrayDataSetIterator(X, Y, batch_size=20), d)
+    got = list(ShardDataSetIterator(d, batch_size=20))
+    np.testing.assert_array_equal(got[0].features, X[:20])
+    np.testing.assert_array_equal(got[0].labels, Y[:20])
+
+
+def test_shard_reiterate_replays_like_other_iterators(tmp_path):
+    # an exhausted iterator replays on the next __iter__ (advancing the
+    # epoch's shuffle order) — same contract as ArrayDataSetIterator —
+    # while seek() pins the very next pass to the current epoch's
+    # remainder, even when that remainder is empty (exact-end resume)
+    X, Y = _image_data(n=100)
+    d = _write(tmp_path, X, Y)
+    it = ShardDataSetIterator(d, batch_size=25, shuffle=True, seed=3)
+    e0 = [np.array(b.features) for b in it]
+    e1 = [np.array(b.features) for b in it]
+    assert len(e0) == len(e1) == 4
+    assert not all(np.array_equal(a, b) for a, b in zip(e0, e1))
+    np.testing.assert_array_equal(np.sort(np.concatenate(e0), axis=0),
+                                  np.sort(np.concatenate(e1), axis=0))
+    it2 = ShardDataSetIterator(d, batch_size=25)
+    it2.seek(it2.n_batches)
+    assert list(it2) == []              # resumed-at-end: nothing left
+    assert len(list(it2)) == 4          # ...then the next epoch replays
+
+
+def test_write_shards_mixed_label_kinds(tmp_path):
+    X, Y = _image_data(n=20)
+    soft = np.full((10, 5), 0.2, dtype=np.float32)
+    # one-hot first, soft later: schema is locked to int32 ids by batch
+    # 0, so the writer must fail loudly (not with a schema mismatch)
+    with pytest.raises(ValueError, match="compact_labels=False"):
+        write_shards(iter([DataSet(X[:10], Y[:10]),
+                           DataSet(X[10:], soft)]),
+                     str(tmp_path / "mixed"))
+    # soft first: compaction locks OFF and everything stores verbatim
+    d = str(tmp_path / "soft_first")
+    write_shards(iter([DataSet(X[:10], soft), DataSet(X[10:], Y[10:])]), d)
+    got = list(ShardDataSetIterator(d, batch_size=10, drop_last=False))
+    np.testing.assert_array_equal(got[0].labels, soft)
+    np.testing.assert_array_equal(got[1].labels, Y[10:])
+
+
+def test_empty_shard_set_stream_state_sentinel(tmp_path):
+    d = str(tmp_path / "empty")
+    with ShardWriter(d):
+        pass
+    it = ShardDataSetIterator(d, batch_size=8)
+    state = it.stream_state()           # must not IndexError
+    assert state["shard_file"] is None
+    assert state["record_offset"] == 0
+    assert list(it) == []
+
+
+def test_shard_writer_schema_mismatch(tmp_path):
+    w = ShardWriter(str(tmp_path / "s"))
+    w.add(np.zeros((4, 4), np.uint8), np.int32(1))
+    with pytest.raises(ValueError, match="schema mismatch"):
+        w.add(np.zeros((5, 4), np.uint8), np.int32(0))
+    with pytest.raises(ValueError, match="cannot mix"):
+        w.add(np.zeros((4, 4), np.uint8))
+
+
+def test_shard_writer_crash_leaves_no_index(tmp_path):
+    # a conversion that raises mid-stream must NOT finalize a readable
+    # (truncated) dataset — the index is only written on clean close
+    d = str(tmp_path / "s")
+    with pytest.raises(RuntimeError, match="boom"):
+        with ShardWriter(d, shard_records=4) as w:
+            for i in range(6):      # one full shard flushed, one partial
+                w.add(np.full((2, 2), i, np.uint8), np.int32(0))
+            raise RuntimeError("boom")
+    assert not os.path.exists(os.path.join(d, "index.json"))
+    with pytest.raises(FileNotFoundError):
+        ShardDataSetIterator(d, batch_size=2)
+
+
+def test_shard_writer_closed_and_aborted_guards(tmp_path):
+    w = ShardWriter(str(tmp_path / "s"), shard_records=4)
+    w.add(np.zeros((2, 2), np.uint8), np.int32(0))
+    idx = w.close()
+    assert w.close() == idx         # idempotent: the index on disk
+    with pytest.raises(RuntimeError, match="closed"):
+        w.add(np.zeros((2, 2), np.uint8), np.int32(0))
+    with pytest.raises(RuntimeError, match="closed"):
+        w.add_batch(np.zeros((1, 2, 2), np.uint8),
+                    np.zeros((1,), np.int32))
+    assert idx["n_records"] == 1    # the rejected records never count
+    # aborted writer (__exit__ on exception): a later defensive close()
+    # must not return a success-looking index for an index-less dataset
+    w2 = ShardWriter(str(tmp_path / "s2"), shard_records=4)
+    with pytest.raises(RuntimeError, match="boom"):
+        with w2:
+            w2.add(np.zeros((2, 2), np.uint8), np.int32(0))
+            raise RuntimeError("boom")
+    with pytest.raises(RuntimeError, match="aborted"):
+        w2.close()
+
+
+def test_shard_seek_tell_stream_state(tmp_path):
+    X, Y = _image_data(n=120)
+    d = _write(tmp_path, X, Y)
+    it = ShardDataSetIterator(d, batch_size=30, shuffle=True, seed=7)
+    it.reset()      # epoch 1's shuffle
+    full = [np.array(b.features) for b in it]
+    it2 = ShardDataSetIterator(d, batch_size=30, shuffle=True, seed=7)
+    it2.reset()
+    it2.seek(2)
+    assert it2.tell() == 2
+    state = it2.stream_state()
+    assert state["next_batch"] == 2
+    assert state["record_offset"] % 30 == 0
+    assert state["shard_file"].endswith(".shard")
+    tail = [np.array(b.features) for b in it2]
+    assert len(tail) == 2
+    for a, b in zip(full[2:], tail):
+        np.testing.assert_array_equal(a, b)
+    # the seeked run read ONLY the tail — no prefix replay
+    assert it2.batches_read == 2
+
+
+# ------------------------------------------------------ multi-process ring
+def test_pipeline_bitwise_parity_and_order(tmp_path):
+    X, Y = _image_data(n=300, seed=2)
+    d = _write(tmp_path, X, Y, shard_records=64)
+    ref = list(ShardDataSetIterator(d, batch_size=32, shuffle=True, seed=9))
+    with MultiProcessDataSetIterator(
+            ShardBatchLoader(d, 32, shuffle=True, seed=9),
+            num_workers=2) as pipe:
+        got = [(np.array(b.features, copy=True),
+                np.array(b.labels, copy=True)) for b in pipe]
+        assert len(got) == len(ref)
+        for (f, l), r in zip(got, ref):
+            np.testing.assert_array_equal(f, r.features)
+            np.testing.assert_array_equal(l, r.labels)
+        # replay-on-exhaustion: re-iterating without reset() serves the
+        # NEXT epoch's order, matching ShardDataSetIterator semantics
+        it = ShardDataSetIterator(d, batch_size=32, shuffle=True, seed=9)
+        list(it)                        # epoch 0
+        ref2 = list(it)                 # re-__iter__ auto-advances: epoch 1
+        seen = []
+        for i, b in enumerate(pipe):    # pipe auto-advances too: epoch 1
+            seen.append(np.array(b.features, copy=True))
+            if i == 1:
+                break
+        for f, r in zip(seen, ref2):
+            np.testing.assert_array_equal(f, r.features)
+        # abandoned epoch (early break) must not corrupt the next one
+        pipe.reset()                    # abandoned epoch 1 -> epoch 2
+        it.reset()                      # epoch 2
+        ref3 = list(it)
+        got3 = [np.array(b.features, copy=True) for b in pipe]
+        assert len(got3) == len(ref3)
+        for f, r in zip(got3, ref3):
+            np.testing.assert_array_equal(f, r.features)
+    # per-worker ETL series exported with worker labels
+    from deeplearning4j_tpu import monitor
+    fam = monitor.REGISTRY.collect("etl_worker_batches_total")
+    assert fam is not None and fam.label_names == ("worker",)
+
+
+def test_pipeline_worker_error_surfaces(tmp_path):
+    X, Y = _image_data(n=64)
+    d = _write(tmp_path, X, Y, shard_records=32)
+    loader = ShardBatchLoader(d, 32)
+    loader.shard_dir = str(tmp_path / "missing")    # workers will fail
+    with MultiProcessDataSetIterator(loader, num_workers=1) as pipe:
+        with pytest.raises(RuntimeError, match="ETL worker"):
+            list(pipe)
+
+
+def test_pipeline_closed_raises_on_reuse(tmp_path):
+    # iterating a closed-but-previously-started pipeline must fail with
+    # the intended guard, not an obscure mp.Queue error or a stall
+    X, Y = _image_data(n=64)
+    d = _write(tmp_path, X, Y, shard_records=32)
+    pipe = MultiProcessDataSetIterator(ShardBatchLoader(d, 32),
+                                       num_workers=1)
+    with pipe:
+        next(iter(pipe))            # started, partially consumed
+    with pytest.raises(RuntimeError, match="pipeline is closed"):
+        next(iter(pipe))
+
+
+def test_fit_consumes_pipeline(tmp_path):
+    from deeplearning4j_tpu.nn.conf.base import InputType
+    from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.updaters import Adam
+    rs = np.random.RandomState(1)
+    X = rs.randn(200, 6).astype("float32")
+    Y = np.eye(3, dtype="float32")[rs.randint(0, 3, 200)]
+    d = str(tmp_path / "s")
+    write_shards(ArrayDataSetIterator(X, Y, batch_size=50), d)
+    conf = (NeuralNetConfiguration.Builder().seed(0).updater(Adam(1e-2))
+            .list()
+            .layer(DenseLayer(n_out=8, activation="relu"))
+            .layer(OutputLayer(n_out=3, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.feed_forward(6)).build())
+    with MultiProcessDataSetIterator(ShardBatchLoader(d, 50),
+                                     num_workers=2) as pipe:
+        net = MultiLayerNetwork(conf).init()
+        net.fit(pipe, epochs=2)     # default wrap consumes the ring
+        assert np.isfinite(net.score())
+        assert net.iteration_count == 8
+
+
+def test_scan_fit_over_ring_matches_inprocess(tmp_path):
+    """The stacking (scan) fit holds K live batches before one transfer;
+    ring batches are slot views recycled on the next pull — fit() must
+    flip the ring into copy mode (mark_copy_for_stacking) or the stacked
+    chunk trains on corrupted data. Proven by parameter parity with the
+    in-process iterator."""
+    from deeplearning4j_tpu.data.shards import ShardDataSetIterator
+    from deeplearning4j_tpu.nn.conf.base import InputType
+    from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.updaters import Adam
+    rs = np.random.RandomState(4)
+    X = rs.randn(240, 5).astype("float32")
+    Y = np.eye(3, dtype="float32")[rs.randint(0, 3, 240)]
+    d = str(tmp_path / "s")
+    write_shards(ArrayDataSetIterator(X, Y, batch_size=40), d)
+
+    def _conf():
+        return (NeuralNetConfiguration.Builder().seed(2)
+                .updater(Adam(1e-2)).list()
+                .layer(DenseLayer(n_out=8, activation="relu"))
+                .layer(OutputLayer(n_out=3, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(5)).build())
+
+    ref = MultiLayerNetwork(_conf()).init()
+    ref.fit(ShardDataSetIterator(d, batch_size=40), epochs=1,
+            scan_steps=3)
+    # copy=False: the expert view-batch mode — exactly the mode the
+    # stacking fit must flip to copy for the fit's duration
+    with MultiProcessDataSetIterator(ShardBatchLoader(d, 40),
+                                     num_workers=2, copy=False) as pipe:
+        assert pipe.view_batches
+        net = MultiLayerNetwork(_conf()).init()
+        net.fit(pipe, epochs=1, scan_steps=3)
+        assert pipe._copy is False      # restored after the fit
+    np.testing.assert_array_equal(np.asarray(ref.params_flat()),
+                                  np.asarray(net.params_flat()))
+
+
+def test_pipeline_worker_kill_switch_sync_mode(tmp_path, monkeypatch):
+    """DL4J_TPU_ETL_WORKERS=0 (and the auto rule resolving to 0) on a
+    num_workers=None pipeline runs the loader in-process — no worker
+    processes, identical stream. This is the escape hatch the dead-pool
+    error message points at, so it must actually disable the pool."""
+    X, Y = _image_data(n=100)
+    d = _write(tmp_path, X, Y)
+    ref = [(np.array(b.features), np.array(b.labels))
+           for b in ShardDataSetIterator(d, batch_size=16, shuffle=True,
+                                         seed=5, drop_last=False)]
+    monkeypatch.setenv("DL4J_TPU_ETL_WORKERS", "0")
+    with MultiProcessDataSetIterator(
+            ShardBatchLoader(d, 16, shuffle=True, seed=5,
+                             drop_last=False)) as pipe:
+        assert pipe._workers_n == 0 and not pipe._procs
+        got = [(np.array(b.features), np.array(b.labels)) for b in pipe]
+        assert len(got) == len(ref)
+        for (f, l), (rf, rl) in zip(got, ref):
+            np.testing.assert_array_equal(f, rf)
+            np.testing.assert_array_equal(l, rl)
+        pipe.reset()
+        assert len(list(pipe)) == len(ref)      # epoch replay still works
+    monkeypatch.delenv("DL4J_TPU_ETL_WORKERS")
+    with MultiProcessDataSetIterator(ShardBatchLoader(d, 16)) as p2:
+        assert p2._workers_n == 0               # auto: below the floor
+        assert len(list(p2)) == 100 // 16
+
+
+def test_pipeline_position_parity_sync_vs_workers(tmp_path):
+    """The =0 kill switch must deliver the IDENTICAL stream to worker
+    mode, position semantics included: a partially-consumed epoch
+    resumes at its position on re-__iter__ (never re-serving delivered
+    batches), and a fully-consumed one advances to the next epoch's
+    shuffle order. Sync mode once restarted at record 0 mid-epoch and
+    replayed the same order forever — this pins the fix."""
+    X, Y = _image_data(n=200, seed=6)
+    d = _write(tmp_path, X, Y, shard_records=64)
+    streams = {}
+    for w in (0, 2):
+        with MultiProcessDataSetIterator(
+                ShardBatchLoader(d, 20, shuffle=True, seed=5),
+                num_workers=w) as pipe:
+            seq = []
+            it = iter(pipe)
+            for _ in range(3):              # partial pass, then abandon
+                seq.append(np.array(next(it).features, copy=True))
+            del it
+            assert pipe.tell() == 3
+            seq += [np.array(b.features, copy=True) for b in pipe]
+            assert pipe.tell() == pipe.n_batches
+            # full re-__iter__ without reset(): next epoch's order
+            seq += [np.array(b.features, copy=True) for b in pipe]
+            streams[w] = seq
+    assert len(streams[0]) == 2 * (200 // 20)
+    for a, b in zip(streams[0], streams[2]):
+        np.testing.assert_array_equal(a, b)
+    half = len(streams[0]) // 2
+    e0 = np.sort(np.concatenate(streams[0][:half]), axis=None)
+    np.testing.assert_array_equal(e0, np.sort(X, axis=None))  # full epoch
+    assert not all(np.array_equal(a, b) for a, b in
+                   zip(streams[0][:half], streams[0][half:]))  # reshuffled
+
+
+def test_pipeline_seek_tell_stream_state(tmp_path):
+    """ShardDataSetIterator's seek surface on the ring (both modes):
+    supports_seek routes ResilientTrainer onto seek-instead-of-replay —
+    without it the fast-forward discarded step_in_epoch batches that a
+    position-resuming iterator had already skipped past (silent data
+    loss on same-process re-fit after preemption)."""
+    X, Y = _image_data(n=120, seed=8)
+    d = _write(tmp_path, X, Y)
+    for w in (0, 2):
+        with MultiProcessDataSetIterator(
+                ShardBatchLoader(d, 30, shuffle=True, seed=3),
+                num_workers=w) as pipe:
+            assert pipe.supports_seek
+            ref = [np.array(b.features, copy=True) for b in pipe]
+            assert pipe.stream_state() == {"epoch": 0, "next_batch": 4}
+            # exact-end pin: resume landing on the epoch end stays empty
+            pipe.seek(pipe.n_batches)
+            assert list(pipe) == []
+            assert pipe._epoch == 0         # pinned, not auto-advanced
+            # seek back mid-epoch: serves exactly the remainder
+            pipe.seek(2)
+            tail = [np.array(b.features, copy=True) for b in pipe]
+            assert len(tail) == 2
+            for a, b in zip(ref[2:], tail):
+                np.testing.assert_array_equal(a, b)
+            with pytest.raises(IndexError):
+                pipe.seek(pipe.n_batches + 1)
+
+
+# ------------------------------------------------------- hot image path
+def _png_tree(tmp_path, n_per_class=30, classes=2, hw=10):
+    from PIL import Image
+    rs = np.random.RandomState(0)
+    root = tmp_path / "imgs"
+    for ci in range(classes):
+        d = root / f"class{ci}"
+        d.mkdir(parents=True)
+        for i in range(n_per_class):
+            arr = rs.randint(0, 256, (hw, hw), dtype=np.uint8)
+            Image.fromarray(arr, mode="L").save(d / f"{i:03d}.png")
+    return str(root)
+
+
+def test_image_pipeline_delegation_parity(tmp_path, monkeypatch):
+    root = _png_tree(tmp_path)
+
+    def batches(workers):
+        monkeypatch.setenv("DL4J_TPU_ETL_WORKERS", workers)
+        rr = ImageRecordReader(10, 10, 1).initialize(root)
+        it = RecordReaderDataSetIterator(rr, batch_size=16, label_index=-1,
+                                         num_classes=rr.num_labels())
+        try:
+            return [(np.array(b.features, copy=True),
+                     np.array(b.labels, copy=True)) for b in it]
+        finally:
+            if it._mp_pipe is not None:
+                it._mp_pipe.close()
+
+    inproc = batches("0")
+    piped = batches("2")
+    assert len(piped) == len(inproc) == 4   # 60 imgs / b16, ragged tail
+    for (f1, l1), (f2, l2) in zip(inproc, piped):
+        np.testing.assert_array_equal(f1, f2)
+        np.testing.assert_array_equal(l1, l2)
+    assert inproc[0][0].dtype == np.uint8
+
+
+def test_image_delegation_reiter_restarts_epoch(tmp_path, monkeypatch):
+    """An abandoned pass over RecordReaderDataSetIterator restarts at the
+    first file on re-__iter__ — the in-process decode loop always did;
+    the delegated ring once resumed at its saved position instead,
+    silently dropping the already-served prefix from the epoch."""
+    root = _png_tree(tmp_path)
+
+    def first_twice(workers):
+        monkeypatch.setenv("DL4J_TPU_ETL_WORKERS", workers)
+        rr = ImageRecordReader(10, 10, 1).initialize(root)
+        it = RecordReaderDataSetIterator(rr, batch_size=16, label_index=-1,
+                                         num_classes=rr.num_labels())
+        try:
+            a = np.array(next(iter(it)).features, copy=True)
+            # no reset() between the abandoned pass and the next one
+            b = np.array(next(iter(it)).features, copy=True)
+            return a, b
+        finally:
+            if it._mp_pipe:
+                it._mp_pipe.close()
+
+    a0, b0 = first_twice("0")
+    a2, b2 = first_twice("2")
+    np.testing.assert_array_equal(a0, b0)
+    np.testing.assert_array_equal(a2, b2)   # delegated path restarts too
+    np.testing.assert_array_equal(a0, a2)
+
+
+def test_scan_fit_over_image_delegation_parity(tmp_path, monkeypatch):
+    """Stacking (scan) fit over the AUTO-delegated image ring: the ring
+    yields owned copies (copy=True), so the stacked chunk must train on
+    intact pixels — parity with the in-process path proves it."""
+    from deeplearning4j_tpu.nn.conf.base import InputType
+    from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.updaters import Adam
+    root = _png_tree(tmp_path, n_per_class=32)      # 64 imgs, b16 = 4
+
+    def _conf():
+        return (NeuralNetConfiguration.Builder().seed(3)
+                .updater(Adam(1e-2)).list()
+                .layer(DenseLayer(n_out=8, activation="relu"))
+                .layer(OutputLayer(n_out=2, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.convolutional(10, 10, 1))
+                .build())
+
+    def _fit(workers):
+        monkeypatch.setenv("DL4J_TPU_ETL_WORKERS", workers)
+        rr = ImageRecordReader(10, 10, 1).initialize(root)
+        it = RecordReaderDataSetIterator(rr, batch_size=16, label_index=-1,
+                                         num_classes=2)
+        net = MultiLayerNetwork(_conf()).init()
+        try:
+            net.fit(it, epochs=1, scan_steps=2)
+        finally:
+            if it._mp_pipe:
+                it._mp_pipe.close()
+        return np.asarray(net.params_flat())
+
+    np.testing.assert_array_equal(_fit("0"), _fit("2"))
+
+
+def test_image_prealloc_matches_stack(tmp_path):
+    # the preallocated fill must equal the old np.stack construction
+    root = _png_tree(tmp_path, n_per_class=8)
+    os.environ["DL4J_TPU_ETL_WORKERS"] = "0"
+    try:
+        rr = ImageRecordReader(10, 10, 1).initialize(root)
+        it = RecordReaderDataSetIterator(rr, batch_size=5, label_index=-1,
+                                         num_classes=2)
+        got = list(it)
+        imgs = [img for img, _ in rr.records()]
+        np.testing.assert_array_equal(got[0].features, np.stack(imgs[:5]))
+        assert got[-1].features.shape[0] == 1   # 16 % 5 ragged tail kept
+    finally:
+        del os.environ["DL4J_TPU_ETL_WORKERS"]
+
+
+def test_etl_workers_auto_rule(monkeypatch):
+    monkeypatch.delenv("DL4J_TPU_ETL_WORKERS", raising=False)
+    assert etl_workers(100) == 0            # below the auto floor
+    assert etl_workers(10_000) >= 1
+    monkeypatch.setenv("DL4J_TPU_ETL_WORKERS", "")
+    assert etl_workers(10_000) >= 1         # "" = unset, same as
+    monkeypatch.setenv("DL4J_TPU_ETL_MIN_RECORDS", "")  # PREFETCH_DEPTH
+    assert etl_workers(100) == 0
+    monkeypatch.setenv("DL4J_TPU_ETL_WORKERS", "0")
+    assert etl_workers(10_000) == 0         # kill switch
+    monkeypatch.setenv("DL4J_TPU_ETL_WORKERS", "3")
+    assert etl_workers(None) == 3
+
+
+# ------------------------------------------------------ prefetch depth env
+def test_prefetch_depth_env(monkeypatch):
+    monkeypatch.delenv("DL4J_TPU_PREFETCH_DEPTH", raising=False)
+    assert prefetch_depth() == 2            # double-buffered default
+    monkeypatch.setenv("DL4J_TPU_PREFETCH_DEPTH", "5")
+    assert prefetch_depth() == 5
+    monkeypatch.setenv("DL4J_TPU_PREFETCH_DEPTH", "0")
+    assert prefetch_depth() == 0
+
+
+def test_prefetch_depth_zero_sync_but_staged(monkeypatch):
+    monkeypatch.setenv("DL4J_TPU_PREFETCH_DEPTH", "0")
+    items = [1, 2, 3]
+    out = list(prefetch_iterable(iter(items), transform=lambda x: x * 10))
+    assert out == [10, 20, 30]
+    # the async wrap still stages (device placement) synchronously
+    X = np.random.RandomState(0).randn(8, 3).astype("float32")
+    Y = np.eye(2, dtype="float32")[np.zeros(8, int)]
+    wrapped = AsyncDataSetIterator(
+        ArrayDataSetIterator(X, Y, batch_size=4))
+    assert wrapped._queue_size == 0
+    got = list(wrapped)
+    assert len(got) == 2
+    import jax
+    assert isinstance(got[0].features, jax.Array)
+
+
+def test_async_default_queue_from_env(monkeypatch):
+    monkeypatch.setenv("DL4J_TPU_PREFETCH_DEPTH", "7")
+    it = AsyncDataSetIterator(ArrayDataSetIterator(
+        np.zeros((4, 2), "float32"), np.zeros((4, 2), "float32"),
+        batch_size=2))
+    assert it._queue_size == 7
+
+
+def test_fit_prefetch_kill_switch_contract(monkeypatch):
+    """DL4J_TPU_FIT_PREFETCH follows the one =='0'-disables contract:
+    unset, empty, and any other value leave the default fit() wrap ON.
+    The gates once disabled on anything != '1', so exporting '' (the
+    'treat as unset' convention of every other data-plane knob) silently
+    serialized host ETL with device compute."""
+    from deeplearning4j_tpu.data.async_iterator import fit_prefetch_enabled
+    monkeypatch.delenv("DL4J_TPU_FIT_PREFETCH", raising=False)
+    assert fit_prefetch_enabled()
+    for v in ("", "1", "true", "2"):
+        monkeypatch.setenv("DL4J_TPU_FIT_PREFETCH", v)
+        assert fit_prefetch_enabled(), v
+    monkeypatch.setenv("DL4J_TPU_FIT_PREFETCH", "0")
+    assert not fit_prefetch_enabled()
+
+
+# ---------------------------------------------------------------- CI smoke
+@pytest.mark.slow
+def test_etl_smoke_tool(tmp_path):
+    r = subprocess.run(
+        [sys.executable, os.path.join("tools", "etl_smoke.py")],
+        cwd=_REPO, capture_output=True, text=True, timeout=420,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+    summary = json.loads(r.stdout.splitlines()[-1])
+    assert summary["ok"]
+    assert summary["parity_batches"] > 0
+    assert summary["etl_fetch_wait_exported"]

@@ -138,6 +138,106 @@ def test_resume_parity_parallel_wrapper(tmp_path):
     np.testing.assert_array_equal(_flat(ref), _flat(resumed))
 
 
+def test_resume_under_streaming_lands_on_exact_shard_offset(tmp_path):
+    """ShardDataSetIterator + kill + auto-resume: bitwise parity with the
+    uninterrupted run AND the resume SEEKS to the checkpointed shard
+    offset (banked in resilience.json as `stream`) instead of replaying
+    the stream prefix — the resumed iterator reads only the remaining
+    batches."""
+    from deeplearning4j_tpu.data.shards import (
+        ShardDataSetIterator, write_shards,
+    )
+    shard_dir = str(tmp_path / "shards")
+    write_shards(ArrayDataSetIterator(X, Y, batch_size=30, drop_last=False),
+                 shard_dir, shard_records=32)
+
+    def _shard_it():
+        return ShardDataSetIterator(shard_dir, batch_size=30,
+                                    shuffle=True, seed=5)
+
+    ref = _net()
+    ResilientTrainer(ref, str(tmp_path / "a"), save_every_n_iterations=100,
+                     policy=FAST).fit(_shard_it(), epochs=3)
+
+    crashed = _net()
+    with pytest.raises(SimulatedCrash):
+        # 4 batches/epoch: checkpoint lands at step-in-epoch 2, the
+        # crash hits before the epoch completes — the newest checkpoint
+        # is MID-epoch, mid-stream
+        ResilientTrainer(crashed, str(tmp_path / "b"),
+                         save_every_n_iterations=2, policy=FAST,
+                         injector=FaultInjector(crash_at=3)
+                         ).fit(_shard_it(), epochs=3)
+
+    # the checkpoint banks the exact stream position the next batch
+    # starts at (shard file + record offset), not just a step count
+    entry = CheckpointManager(str(tmp_path / "b")).latest_valid()
+    with zipfile.ZipFile(entry["path"]) as zf:
+        extra = json.loads(zf.read("resilience.json"))
+    assert extra["step_in_epoch"] == 2
+    assert extra["stream"]["next_batch"] == 2
+    assert extra["stream"]["record_offset"] % 30 == 0
+    assert extra["stream"]["shard_file"].endswith(".shard")
+
+    resumed = _net()
+    it = _shard_it()
+    rep = ResilientTrainer(resumed, str(tmp_path / "b"),
+                           save_every_n_iterations=2, policy=FAST
+                           ).fit(it, epochs=3)
+    assert rep.resumed_from is not None
+    np.testing.assert_array_equal(_flat(ref), _flat(resumed))
+    assert ref.score() == resumed.score()
+    # exact-offset resume: 4 batches/epoch x 3 epochs = 12 total; 2 were
+    # stepped before the crash and must NOT be re-read on resume
+    assert it.batches_read == 12 - 2
+
+
+def test_preempt_refit_same_process_multiproc_pipeline(tmp_path):
+    """Preempt a worker-mode MultiProcessDataSetIterator fit at EPOCH 1,
+    then re-fit the SAME trainer state with the SAME live pipeline: the
+    ring resumes at its internal position, so resilience must take the
+    seek path (the replay fast-forward would discard step_in_epoch MORE
+    batches — checkpoint-counted but never trained), and the
+    replay-resets loop must skip the epoch resets the live source
+    already consumed in-fit (blind replay would seek into epoch 2's
+    shuffle permutation while training epoch 1)."""
+    from deeplearning4j_tpu.data.pipeline import (
+        MultiProcessDataSetIterator, ShardBatchLoader,
+    )
+    from deeplearning4j_tpu.data.shards import write_shards
+    shard_dir = str(tmp_path / "shards")
+    write_shards(ArrayDataSetIterator(X, Y, batch_size=30, drop_last=False),
+                 shard_dir, shard_records=32)
+
+    def _pipe():
+        return MultiProcessDataSetIterator(
+            ShardBatchLoader(shard_dir, 30, shuffle=True, seed=5,
+                             drop_last=False), num_workers=2)
+
+    ref = _net()
+    with _pipe() as p:
+        ResilientTrainer(ref, str(tmp_path / "a"),
+                         save_every_n_iterations=100, policy=FAST
+                         ).fit(p, epochs=2)
+
+    net = _net()
+    ckpt = str(tmp_path / "b")
+    with _pipe() as p:
+        rep = ResilientTrainer(net, ckpt, save_every_n_iterations=1,
+                               policy=FAST,
+                               injector=FaultInjector(preempt_at=5)
+                               ).fit(p, epochs=2)
+        assert rep.preempted
+        # 4 batches/epoch: dispatch 5 = epoch 1, step-in-epoch 1 —
+        # mid-epoch past the first in-fit reset, position retained
+        assert p.tell() == 1 and p.stream_state()["epoch"] == 1
+        rep2 = ResilientTrainer(net, ckpt, policy=FAST).fit(p, epochs=2)
+        assert rep2.resumed_from is not None
+        # 4 batches/epoch x 2 epochs = 8; 5 trained before preemption
+        assert rep2.applied_steps == 8 - 5
+    np.testing.assert_array_equal(_flat(ref), _flat(net))
+
+
 def test_completed_run_does_not_retrain_on_rerun(tmp_path):
     net = _net()
     t = ResilientTrainer(net, str(tmp_path), save_every_n_iterations=100,

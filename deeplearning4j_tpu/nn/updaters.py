@@ -216,11 +216,17 @@ class AdaGrad(Updater):
 
 @dataclasses.dataclass(frozen=True)
 class AdaDelta(Updater):
+    """DL4J AdaDelta carries no learning rate — the update magnitude is
+    the RMS(dx)/RMS(g) ratio itself (nd4j AdaDeltaUpdater applies the
+    raw delta), i.e. an effective LR of 1.0. optax >= 0.2 defaults
+    ``adadelta(learning_rate=None)`` which crashes inside
+    ``scale_by_learning_rate``; pin the DL4J semantics explicitly."""
+    learning_rate: float = 1.0
     rho: float = 0.95
     epsilon: float = 1e-6
 
     def to_optax(self):
-        return optax.adadelta(rho=self.rho, eps=self.epsilon)
+        return optax.adadelta(self._lr(), rho=self.rho, eps=self.epsilon)
 
 
 @dataclasses.dataclass(frozen=True)

@@ -13,7 +13,13 @@ Three parts (docs/SERVING.md):
   backpressure;
 - **server** — threaded stdlib HTTP front end (predict/swap/rollback/
   healthz/readyz/metrics) with admission control (429/504, never a
-  traceback) and graceful SIGTERM drain.
+  traceback) and graceful SIGTERM drain;
+- **fleet** — ReplicaSupervisor: N serving replicas health-probed with
+  deadlines, crash/wedge restarts with jittered backoff and a restart
+  budget, drain+replace after K failed probes;
+- **router** — ResilientRouter: power-of-two-choices spread, per-
+  (replica, model) circuit breakers, priority-class load shedding,
+  hedged retries for stragglers; RouterServer is its HTTP face.
 
 Quickstart:
 
@@ -29,15 +35,27 @@ from deeplearning4j_tpu.serving.batcher import (
     DEFAULT_BUCKETS, DeadlineExceededError, ServerDrainingError,
     ServerOverloadedError, ServingError, ShapeBucketedBatcher,
 )
+from deeplearning4j_tpu.serving.fleet import (
+    InProcessReplica, Replica, ReplicaSpec, ReplicaSupervisor,
+    SubprocessReplica,
+)
 from deeplearning4j_tpu.serving.registry import (
     ModelLoadError, ModelRegistry, ServedModel, ServableVersion,
     load_servable,
 )
-from deeplearning4j_tpu.serving.server import ModelServer
+from deeplearning4j_tpu.serving.router import (
+    CircuitBreaker, ResilientRouter, RouterServer,
+)
+from deeplearning4j_tpu.serving.server import (
+    ModelServer, retry_after_seconds,
+)
 
 __all__ = [
-    "DEFAULT_BUCKETS", "DeadlineExceededError", "ModelLoadError",
-    "ModelRegistry", "ModelServer", "ServableVersion", "ServedModel",
+    "CircuitBreaker", "DEFAULT_BUCKETS", "DeadlineExceededError",
+    "InProcessReplica", "ModelLoadError", "ModelRegistry", "ModelServer",
+    "Replica", "ReplicaSpec", "ReplicaSupervisor", "ResilientRouter",
+    "RouterServer", "ServableVersion", "ServedModel",
     "ServerDrainingError", "ServerOverloadedError", "ServingError",
-    "ShapeBucketedBatcher", "load_servable",
+    "ShapeBucketedBatcher", "SubprocessReplica", "load_servable",
+    "retry_after_seconds",
 ]

@@ -1,20 +1,29 @@
 """`python -m deeplearning4j_tpu.serving` — the serve CLI entrypoint.
 
-Stands up a ModelServer over one or more servables and runs until
-SIGTERM/SIGINT, then drains gracefully (stop admitting, flush in-flight,
-clean exit 0) — the deploy surface a process supervisor or container
-runtime manages.
+Single-replica mode (default): stands up a ModelServer over one or more
+servables and runs until SIGTERM/SIGINT, then drains gracefully (stop
+admitting, flush in-flight, clean exit 0) — the deploy surface a process
+supervisor or container runtime manages.
+
+Fleet mode (``--replicas N``, N >= 2): stands up a ReplicaSupervisor over
+N serving replicas (subprocess by default — each its own crash domain —
+or ``--replica-mode inprocess``) behind a ResilientRouter front end with
+per-(replica, model) circuit breakers, priority-class shedding
+(``--priority-classes``, ``X-Priority`` request header), and hedged
+retries. ``--port`` is then the ROUTER's port; replicas bind ephemeral
+ports on localhost.
 
 Usage:
     python -m deeplearning4j_tpu.serving \
         --model lenet=zoo:LeNet --port 8500 \
         --buckets 1,8,32,128 --max-delay-ms 5 --deadline-s 30
 
-    # serve a training run's newest verified checkpoint:
-    python -m deeplearning4j_tpu.serving --model prod=/ckpts/run17
+    # serve a training run's newest verified checkpoint, fleet of 3:
+    python -m deeplearning4j_tpu.serving --model prod=/ckpts/run17 \
+        --replicas 3 --priority-classes interactive,standard,batch
 
-See docs/SERVING.md for the API, bucket-ladder tuning, and the
-swap/rollback runbook.
+See docs/SERVING.md for the API, bucket-ladder tuning, the swap/rollback
+runbook, and the "Fleet operations" section for supervisor/router knobs.
 """
 from __future__ import annotations
 
@@ -23,6 +32,7 @@ import json
 import signal
 import sys
 import threading
+import time
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -49,6 +59,42 @@ def build_parser() -> argparse.ArgumentParser:
                    help="default per-request deadline (expired -> 504)")
     p.add_argument("--drain-timeout-s", type=float, default=30.0,
                    help="max time to flush in-flight work on SIGTERM")
+    p.add_argument("--enable-fault-injection", action="store_true",
+                   help="expose POST /v1/faults (chaos testing; wedge "
+                        "probes / predicts of THIS process) and honor "
+                        "$DL4J_TPU_SERVING_FAULTS. Never on by default.")
+    # ------------------------------------------------------ fleet mode
+    fleet = p.add_argument_group(
+        "fleet mode (docs/SERVING.md 'Fleet operations')")
+    fleet.add_argument("--replicas", type=int, default=1,
+                       help="N >= 2 supervises N replicas behind the "
+                            "resilient router; 1 = plain single server")
+    fleet.add_argument("--replica-mode", choices=("subprocess", "inprocess"),
+                       default="subprocess",
+                       help="subprocess = own crash domain per replica "
+                            "(production); inprocess = threads (tests)")
+    fleet.add_argument("--priority-classes",
+                       default="interactive,standard,batch",
+                       help="ordered priority ladder, highest first; "
+                            "requests select via the X-Priority header")
+    fleet.add_argument("--shed-floor", type=float, default=0.7,
+                       help="fleet utilization at which the LOWEST class "
+                            "starts shedding (higher classes shed at "
+                            "evenly spaced higher thresholds)")
+    fleet.add_argument("--per-replica-inflight", type=int, default=8,
+                       help="router-side in-flight cap per replica (the "
+                            "capacity unit behind shedding)")
+    fleet.add_argument("--probe-interval-s", type=float, default=1.0)
+    fleet.add_argument("--probe-timeout-s", type=float, default=2.0)
+    fleet.add_argument("--unhealthy-after", type=int, default=3,
+                       help="consecutive failed probes before a live "
+                            "replica is presumed wedged and replaced")
+    fleet.add_argument("--restart-budget", type=int, default=5,
+                       help="restarts allowed per replica per 10 min "
+                            "before it is marked dead (crash loop)")
+    fleet.add_argument("--no-hedge", action="store_true",
+                       help="disable hedged retries for straggler "
+                            "predicts")
     return p
 
 
@@ -61,6 +107,14 @@ def main(argv=None) -> int:
         # the axon TPU plugin force-appends itself to jax_platforms at
         # import, overriding the env var — pin the user's choice back
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    if os.environ.get("JAX_COMPILATION_CACHE_DIR"):
+        # same persistent-compile-cache convention as bench.py/conftest —
+        # fleet replicas and chaos-restarted replicas skip recompiling
+        # the bucket ladder
+        jax.config.update("jax_compilation_cache_dir",
+                          os.environ["JAX_COMPILATION_CACHE_DIR"])
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
     from deeplearning4j_tpu.serving.registry import (
         ModelLoadError, ModelRegistry,
     )
@@ -78,6 +132,9 @@ def main(argv=None) -> int:
             raise SystemExit(f"--model expects NAME=SOURCE, got {spec!r}")
         specs.append((name, source))
 
+    if args.replicas > 1:
+        return _main_fleet(args, specs, buckets)
+
     registry = ModelRegistry()
     for name, source in specs:
         try:
@@ -92,7 +149,8 @@ def main(argv=None) -> int:
               file=sys.stderr)
 
     server = ModelServer(registry, host=args.host, port=args.port,
-                         default_deadline_s=args.deadline_s)
+                         default_deadline_s=args.deadline_s,
+                         enable_faults=args.enable_fault_injection)
     print(json.dumps({"serving": server.url,
                       "models": registry.names(),
                       "endpoints": ["/v1/models", "/healthz", "/readyz",
@@ -110,6 +168,92 @@ def main(argv=None) -> int:
         signal.signal(s, _on_signal)
     stop.wait()
     server.drain(timeout=args.drain_timeout_s)
+    return 0
+
+
+def _main_fleet(args, specs, buckets) -> int:
+    """--replicas N: supervisor + router. --port is the router's port."""
+    import os
+
+    from deeplearning4j_tpu.serving.fleet import (
+        InProcessReplica, ReplicaSpec, ReplicaSupervisor, SubprocessReplica,
+    )
+    from deeplearning4j_tpu.serving.router import (
+        ResilientRouter, RouterServer,
+    )
+
+    classes = tuple(c.strip() for c in args.priority_classes.split(",")
+                    if c.strip())
+    if not classes:
+        raise SystemExit("--priority-classes must name at least one class")
+    spec = ReplicaSpec(specs, buckets=buckets,
+                       max_delay_ms=args.max_delay_ms,
+                       queue_limit=args.queue_limit,
+                       default_deadline_s=args.deadline_s,
+                       enable_faults=args.enable_fault_injection)
+    if args.replica_mode == "subprocess":
+        for _, source in specs:
+            if source.startswith("zoo:") or os.path.exists(source):
+                continue
+            raise SystemExit(f"fleet replicas cannot serve {source!r} "
+                             "(need a path or zoo: name)")
+
+        def factory(i):
+            return SubprocessReplica(f"replica-{i}", spec,
+                                     env=dict(os.environ))
+    else:
+        def factory(i):
+            return InProcessReplica(f"replica-{i}", spec)
+
+    supervisor = ReplicaSupervisor(
+        factory, args.replicas,
+        probe_interval_s=args.probe_interval_s,
+        probe_timeout_s=args.probe_timeout_s,
+        unhealthy_after=args.unhealthy_after,
+        restart_budget=args.restart_budget)
+    try:
+        supervisor.start()
+    except Exception as e:                    # noqa: BLE001
+        raise SystemExit(f"fleet launch failed: {e}")
+    router = ResilientRouter(
+        supervisor.healthy, classes=classes,
+        shed_floor=args.shed_floor,
+        per_replica_inflight=args.per_replica_inflight,
+        hedge=not args.no_hedge, timeout_s=args.deadline_s)
+    server = RouterServer(router, supervisor=supervisor,
+                          host=args.host, port=args.port)
+    print(json.dumps({"serving": server.url, "role": "router",
+                      "replicas": [r.describe() for r in
+                                   supervisor.replicas],
+                      "priority_classes": list(classes),
+                      "endpoints": ["/v1/models", "/v1/fleet", "/healthz",
+                                    "/readyz", "/metrics"]}))
+    sys.stdout.flush()
+
+    stop = threading.Event()
+
+    def _on_signal(signum, frame):
+        print(json.dumps({"signal": signum, "action": "fleet drain"}),
+              file=sys.stderr)
+        stop.set()
+
+    for s in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(s, _on_signal)
+    stop.wait()
+    # graceful fleet drain, same contract as single-replica mode: flip
+    # /readyz to 503 FIRST so the balancer stops sending, give it a
+    # moment to observe, let router-tracked in-flight work finish, and
+    # only then tear the replicas down (their own SIGTERM drain flushes
+    # whatever is still inside them)
+    server.draining = True
+    grace = min(2.0, args.drain_timeout_s)
+    time.sleep(grace)
+    deadline = time.monotonic() + max(0.0, args.drain_timeout_s - grace)
+    while time.monotonic() < deadline and any(
+            r.inflight() for r in supervisor.replicas):
+        time.sleep(0.1)
+    supervisor.stop()
+    server.stop()
     return 0
 
 

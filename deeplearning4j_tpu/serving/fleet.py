@@ -1,0 +1,616 @@
+"""ReplicaSupervisor — process supervision for a fleet of serving replicas.
+
+PR 3's ResilientTrainer made *training* survive faults; this module is the
+serving counterpart at fleet scope. One replica crash, one wedged batcher,
+or one slow model must never take the endpoint down: the supervisor runs N
+model-serving replicas, watches them the way a container runtime watches
+pods, and keeps the fleet converged on "N healthy":
+
+- **Probes with deadlines.** Every supervision tick, each replica is
+  health-checked over its own HTTP surface: ``/healthz`` (liveness) then
+  ``/readyz`` (warmed + not draining), each under ``probe_timeout_s``. A
+  wedged replica — event loop alive but the process stuck — answers
+  slowly or not at all; the deadline converts "slow" into "failed",
+  which a bare TCP connect check never would.
+- **Crash restarts with jittered exponential backoff.** A replica whose
+  process died (SIGKILL, OOM, segfault) is relaunched after
+  ``backoff * 2^attempt`` seconds, jittered to half its value so a
+  correlated fleet-wide crash does not produce a synchronized restart
+  stampede against the checkpoint store.
+- **Drain + replace after K consecutive probe failures.** A replica that
+  is alive but failed ``unhealthy_after`` probes in a row is presumed
+  wedged: it is killed (a wedged process cannot be trusted to drain) and
+  replaced by a fresh incarnation, bumping ``replica.generation`` so the
+  router's circuit breakers start clean.
+- **Restart budget.** More than ``restart_budget`` restarts inside
+  ``restart_budget_window_s`` marks the replica ``dead`` (crash-looping —
+  a bad model, a poisoned checkpoint, a broken host); the supervisor
+  stops burning capacity on it and the gap shows on /metrics
+  (`serving_fleet_replicas{state="dead"}`) for a human to page on.
+
+Replicas come in two shapes sharing the `Replica` contract:
+`SubprocessReplica` (a real ``python -m deeplearning4j_tpu.serving``
+process — full isolation, SIGKILL-able, what `tools/serve_chaos.py`
+drives) and `InProcessReplica` (a ModelServer in this process — cheap,
+what most tests drive). The supervision logic never cares which.
+
+Determinism: the supervision loop is a thin timer around `tick()`, and
+`tick()` plus the injectable `time_fn` / `rng` / `probe_fn` seams make
+every policy decision (backoff arithmetic, budget exhaustion, K-failure
+replacement) unit-testable with a fake clock — no sleeps-and-hope.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import queue as _queue
+import subprocess
+import sys
+import threading
+import time
+import random as _random
+import urllib.request
+from typing import Callable, List, Optional, Sequence, Tuple
+
+from deeplearning4j_tpu import monitor
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+#: replica lifecycle states (the serving_fleet_replicas{state} gauge keys)
+REPLICA_STATES = ("starting", "ready", "unhealthy", "backoff", "dead",
+                  "stopped")
+
+
+class ReplicaSpec:
+    """What one replica serves: the deploy arguments every incarnation of
+    the replica is (re)built from."""
+
+    def __init__(self, models: Sequence[Tuple[str, object]],
+                 buckets: Sequence[int] = (1, 8, 32, 128),
+                 max_delay_ms: float = 5.0, queue_limit: int = 256,
+                 default_deadline_s: float = 30.0,
+                 host: str = "127.0.0.1",
+                 enable_faults: bool = False):
+        self.models = list(models)              # [(name, source), ...]
+        self.buckets = tuple(int(b) for b in buckets)
+        self.max_delay_ms = float(max_delay_ms)
+        self.queue_limit = int(queue_limit)
+        self.default_deadline_s = float(default_deadline_s)
+        self.host = host
+        self.enable_faults = bool(enable_faults)
+
+
+class Replica:
+    """One supervised serving replica. Subclasses provide the process
+    mechanics (`launch` / `alive` / `kill` / `stop`); the supervisor and
+    router only read the shared fields below."""
+
+    def __init__(self, name: str, spec: Optional[ReplicaSpec] = None):
+        self.name = name
+        self.spec = spec
+        self.url: Optional[str] = None
+        self.state = "starting"
+        self.generation = 0                  # bumps on every relaunch
+        self.consecutive_probe_failures = 0
+        # router-maintained queue-depth signal (power-of-two-choices input)
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
+        # supervisor restart bookkeeping
+        self.restart_attempt = 0             # backoff exponent
+        self.restart_at: Optional[float] = None
+        self.restart_times: List[float] = []  # budget window
+
+    # ------------------------------------------------------------ inflight
+    def inflight(self) -> int:
+        with self._inflight_lock:
+            return self._inflight
+
+    def inflight_add(self, delta: int):
+        with self._inflight_lock:
+            self._inflight = max(0, self._inflight + delta)
+
+    # ------------------------------------------------- subclass contract
+    def launch(self):
+        """(Re)start the replica; must set `self.url` or raise."""
+        raise NotImplementedError
+
+    def alive(self) -> bool:
+        raise NotImplementedError
+
+    def kill(self):
+        """Hard-stop (crash analog / wedged process): no drain."""
+        raise NotImplementedError
+
+    def stop(self):
+        """Graceful stop (drain in-flight work)."""
+        self.kill()
+
+    def describe(self) -> dict:
+        return {"name": self.name, "url": self.url, "state": self.state,
+                "generation": self.generation,
+                "inflight": self.inflight(),
+                "probe_failures": self.consecutive_probe_failures}
+
+
+class InProcessReplica(Replica):
+    """A ModelServer (own registry, own port) inside this process. Cheap
+    replica for tests and single-host `--replica-mode inprocess` fleets;
+    "crash" = hard listener+batcher stop without drain."""
+
+    def __init__(self, name: str, spec: ReplicaSpec):
+        super().__init__(name, spec)
+        self._server = None
+        self._registry = None
+
+    def launch(self):
+        from deeplearning4j_tpu.serving.registry import ModelRegistry
+        from deeplearning4j_tpu.serving.server import ModelServer
+        from deeplearning4j_tpu.util.faults import ServingFaults
+        registry = ModelRegistry()
+        for model_name, source in self.spec.models:
+            registry.deploy(model_name, source, buckets=self.spec.buckets,
+                            max_delay_ms=self.spec.max_delay_ms,
+                            queue_limit=self.spec.queue_limit)
+        self._registry = registry
+        self._server = ModelServer(
+            registry, host=self.spec.host, port=0,
+            default_deadline_s=self.spec.default_deadline_s,
+            enable_faults=self.spec.enable_faults,
+            # own instance: wedging THIS replica must not wedge every
+            # in-process sibling through the module singleton
+            faults=ServingFaults())
+        self.url = self._server.url
+
+    def alive(self) -> bool:
+        return self._server is not None and self._server._thread.is_alive()
+
+    def kill(self):
+        if self._server is not None:
+            self._server.stop()
+        if self._registry is not None:
+            self._registry.shutdown(drain=False)
+        self._server = self._registry = None
+
+    def stop(self):
+        if self._server is not None:
+            self._server.drain(timeout=10.0)
+        self._server = self._registry = None
+
+
+class SubprocessReplica(Replica):
+    """A real ``python -m deeplearning4j_tpu.serving`` child process —
+    full crash isolation (SIGKILL-able, OOM-able), its own XLA runtime,
+    its own /metrics. The CLI fleet mode and tools/serve_chaos.py run
+    these. The child binds port 0 and announces its URL as the first JSON
+    line on stdout; launch() blocks until that line (or the deadline)."""
+
+    def __init__(self, name: str, spec: ReplicaSpec,
+                 env: Optional[dict] = None,
+                 launch_timeout_s: float = 180.0):
+        super().__init__(name, spec)
+        self.proc: Optional[subprocess.Popen] = None
+        self.env = env
+        self.launch_timeout_s = float(launch_timeout_s)
+
+    def _argv(self) -> List[str]:
+        argv = [sys.executable, "-m", "deeplearning4j_tpu.serving",
+                "--host", self.spec.host, "--port", "0",
+                "--buckets", ",".join(str(b) for b in self.spec.buckets),
+                "--max-delay-ms", str(self.spec.max_delay_ms),
+                "--queue-limit", str(self.spec.queue_limit),
+                "--deadline-s", str(self.spec.default_deadline_s)]
+        for model_name, source in self.spec.models:
+            if not isinstance(source, str):
+                raise TypeError(
+                    f"subprocess replica {self.name}: model source must be "
+                    f"a path/zoo name string, got {type(source).__name__}")
+            argv += ["--model", f"{model_name}={source}"]
+        if self.spec.enable_faults:
+            argv.append("--enable-fault-injection")
+        return argv
+
+    def launch(self):
+        self.proc = subprocess.Popen(
+            self._argv(), stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL, env=self.env, text=True)
+        # a silent hung child must not hang launch(): readline() has no
+        # deadline of its own, so a reader thread feeds a queue and the
+        # timeout lives on the queue get. The thread exits on the EOF
+        # that kill() forces.
+        proc, lineq = self.proc, _queue.Queue()
+
+        def _read_stdout():
+            for out_line in proc.stdout:
+                lineq.put(out_line)
+            lineq.put(None)                   # EOF marker
+
+        threading.Thread(target=_read_stdout, daemon=True,
+                         name=f"{self.name}-stdout").start()
+        deadline = time.monotonic() + self.launch_timeout_s
+        while True:
+            try:
+                line = lineq.get(
+                    timeout=max(0.0, deadline - time.monotonic()))
+            except _queue.Empty:
+                self.kill()
+                raise TimeoutError(
+                    f"replica {self.name}: no startup announcement within "
+                    f"{self.launch_timeout_s:.0f}s")
+            if line is None:                  # EOF — child died in startup
+                rc = self.proc.poll()
+                raise RuntimeError(
+                    f"replica {self.name}: exited rc={rc} before "
+                    "announcing its URL")
+            try:
+                doc = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(doc, dict) and doc.get("serving"):
+                self.url = doc["serving"]
+                return
+
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+    def kill(self):
+        if self.proc is not None and self.proc.poll() is None:
+            self.proc.kill()
+            self.proc.wait(timeout=10)
+
+    def stop(self):
+        if self.proc is not None and self.proc.poll() is None:
+            self.proc.terminate()            # SIGTERM -> CLI drains
+            try:
+                self.proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                self.kill()
+
+
+def _threaded_spawn(fn: Callable[[], None], name: str):
+    """Default relaunch spawner: a daemon thread, returned for joining.
+    Tests inject a synchronous spawner to keep tick() deterministic."""
+    t = threading.Thread(target=fn, daemon=True, name=name)
+    t.start()
+    return t
+
+
+def http_probe(replica: Replica, timeout: float) -> bool:
+    """Default probe: /healthz then /readyz, each 200 within `timeout`."""
+    if not replica.url:
+        return False
+    for path in ("/healthz", "/readyz"):
+        try:
+            r = urllib.request.urlopen(replica.url + path, timeout=timeout)
+            if r.status != 200:
+                return False
+            r.read()
+        except Exception:                     # noqa: BLE001 — any failure
+            return False                      # (timeout, 5xx, conn refused)
+    return True
+
+
+class ReplicaSupervisor:
+    """Keep N replicas healthy: probe, restart, replace, give up loudly.
+
+    Usage (production shape):
+
+        sup = ReplicaSupervisor(
+            lambda i: SubprocessReplica(f"replica-{i}", spec), n_replicas=3)
+        sup.start()                   # launch all, wait until ready
+        ...
+        sup.healthy()                 # the router's routing set
+        sup.stop()
+
+    Tests drive `tick()` directly with injected `time_fn`/`probe_fn`.
+    """
+
+    def __init__(self, factory: Callable[[int], Replica], n_replicas: int,
+                 probe_interval_s: float = 1.0,
+                 probe_timeout_s: float = 2.0,
+                 unhealthy_after: int = 3,
+                 restart_backoff_s: float = 0.5,
+                 restart_backoff_max_s: float = 30.0,
+                 restart_budget: int = 5,
+                 restart_budget_window_s: float = 600.0,
+                 start_deadline_s: float = 300.0,
+                 time_fn: Callable[[], float] = time.monotonic,
+                 sleep_fn: Callable[[float], None] = time.sleep,
+                 rng: Optional[_random.Random] = None,
+                 probe_fn: Callable[[Replica, float], bool] = http_probe,
+                 spawn_fn: Callable = _threaded_spawn):
+        if n_replicas < 1:
+            raise ValueError("n_replicas must be >= 1")
+        self.replicas = [factory(i) for i in range(int(n_replicas))]
+        names = [r.name for r in self.replicas]
+        if len(set(names)) != len(names):
+            raise ValueError(f"replica names must be unique: {names}")
+        self.probe_interval = float(probe_interval_s)
+        self.probe_timeout = float(probe_timeout_s)
+        self.unhealthy_after = int(unhealthy_after)
+        self.backoff = float(restart_backoff_s)
+        self.backoff_max = float(restart_backoff_max_s)
+        self.restart_budget = int(restart_budget)
+        self.budget_window = float(restart_budget_window_s)
+        self.start_deadline = float(start_deadline_s)
+        self._time = time_fn
+        self._sleep = sleep_fn
+        self._rng = rng if rng is not None else _random.Random()
+        self._probe = probe_fn
+        self._spawn = spawn_fn
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()        # serializes tick vs stop
+
+    # ------------------------------------------------------------- metrics
+    def _note_restart(self, replica: Replica, reason: str):
+        monitor.counter(
+            "serving_fleet_restarts_total",
+            "Replica restarts by the supervisor (reason: crash = process "
+            "died, probe = K consecutive probe failures, launch = "
+            "relaunch itself failed)",
+            labels=("replica", "reason")).inc(replica=replica.name,
+                                              reason=reason)
+
+    def _export_states(self):
+        counts = {s: 0 for s in REPLICA_STATES}
+        for r in self.replicas:
+            counts[r.state] = counts.get(r.state, 0) + 1
+        g = monitor.gauge("serving_fleet_replicas",
+                          "Replica count per lifecycle state",
+                          labels=("state",))
+        for s, n in counts.items():
+            g.set(n, state=s)
+        monitor.gauge("serving_fleet_size",
+                      "Configured replica count").set(len(self.replicas))
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self, wait_ready: bool = True):
+        """Launch every replica (in parallel — subprocess replicas pay a
+        runtime-import each), then optionally block until the whole fleet
+        probes ready, then start the supervision loop."""
+        errors: List[str] = []
+
+        def _launch(r: Replica):
+            try:
+                r.launch()
+            except Exception as e:            # noqa: BLE001
+                errors.append(f"{r.name}: {type(e).__name__}: {e}")
+                r.state = "unhealthy"
+
+        threads = [threading.Thread(target=_launch, args=(r,), daemon=True)
+                   for r in self.replicas]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            self.stop_replicas()
+            raise RuntimeError("fleet launch failed: " + "; ".join(errors))
+        if wait_ready:
+            deadline = self._time() + self.start_deadline
+            pending = list(self.replicas)
+            while pending:
+                pending = [r for r in pending
+                           if not self._probe_once(r, mark=True)]
+                if not pending:
+                    break
+                if self._time() > deadline:
+                    self.stop_replicas()
+                    raise TimeoutError(
+                        "fleet not ready within "
+                        f"{self.start_deadline:.0f}s: "
+                        f"{[r.name for r in pending]} still unready")
+                self._sleep(0.2)
+        self._export_states()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="ReplicaSupervisor")
+        self._thread.start()
+        log.info("fleet: supervising %d replicas (%s)", len(self.replicas),
+                 ", ".join(f"{r.name}@{r.url}" for r in self.replicas))
+
+    def _run(self):
+        while not self._stop.is_set():
+            try:
+                self.tick()
+            except Exception:                 # noqa: BLE001 — keep watching
+                log.exception("fleet: supervision tick failed")
+            self._sleep(self.probe_interval)
+
+    def healthy(self) -> List[Replica]:
+        return [r for r in self.replicas if r.state == "ready"]
+
+    def describe(self) -> dict:
+        return {"replicas": [r.describe() for r in self.replicas]}
+
+    def stop_replicas(self):
+        for r in self.replicas:
+            try:
+                r.stop()
+            except Exception:                 # noqa: BLE001
+                log.exception("fleet: stopping %s failed", r.name)
+            r.state = "stopped"
+        self._export_states()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=max(5.0, 2 * self.probe_interval))
+        # give in-flight relaunches a moment to notice the stop flag and
+        # clean up their own fresh processes; a hung one stays daemon
+        self._join_relaunches(timeout=5.0)
+        with self._lock:
+            self.stop_replicas()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # ------------------------------------------------------------- the tick
+    def _probe_once(self, replica: Replica, mark: bool = False) -> bool:
+        t0 = time.perf_counter()
+        with monitor.span("serving/probe", replica=replica.name):
+            ok = self._probe(replica, self.probe_timeout)
+        monitor.histogram("serving_fleet_probe_seconds",
+                          "Health-probe round-trip time",
+                          labels=("replica",)).observe(
+            time.perf_counter() - t0, replica=replica.name)
+        if ok and mark:
+            replica.state = "ready"
+            replica.consecutive_probe_failures = 0
+        return ok
+
+    def tick(self):
+        """One supervision pass. Deterministic given time_fn/probe_fn:
+        probes live replicas, schedules/executes restarts, enforces the
+        budget. Called by the loop every probe_interval; tests call it
+        directly. Relaunches run via `spawn_fn` (a daemon thread by
+        default) so one slow or hung launch never stalls supervision of
+        the rest of the fleet — or supervisor.stop()."""
+        due: List[Replica] = []
+        with self._lock:
+            if self._stop.is_set():
+                return
+            now = self._time()
+            live: List[Replica] = []
+            for r in self.replicas:
+                if r.state in ("dead", "stopped"):
+                    continue
+                launching = getattr(r, "_launch_thread", None)
+                if launching is not None and launching.is_alive():
+                    continue              # relaunch in flight: hands off
+                if r.state == "backoff":
+                    if now >= (r.restart_at or 0):
+                        # transition under the lock BEFORE spawning so
+                        # the next tick cannot double-launch
+                        r.generation += 1
+                        r.consecutive_probe_failures = 0
+                        r.restart_at = None
+                        r.state = "starting"
+                        due.append(r)
+                    continue
+                if not r.alive():
+                    log.warning("fleet: %s process died — scheduling "
+                                "restart", r.name)
+                    self._note_restart(r, "crash")
+                    self._schedule_restart(r, now)
+                    continue
+                live.append(r)
+            # probe live replicas CONCURRENTLY: N wedged replicas cost
+            # one probe window per tick, not N of them (each probe is
+            # already deadline-bounded by probe_timeout)
+            probe_ok = {}
+            if len(live) == 1:
+                probe_ok[live[0].name] = self._probe_once(live[0])
+            elif live:
+                probers = [threading.Thread(
+                    target=lambda r=r: probe_ok.__setitem__(
+                        r.name, self._probe_once(r)),
+                    daemon=True, name=f"probe-{r.name}") for r in live]
+                for t in probers:
+                    t.start()
+                for t in probers:
+                    t.join()
+            for r in live:
+                if probe_ok[r.name]:
+                    if r.state != "ready":
+                        log.info("fleet: %s is ready (gen %d)", r.name,
+                                 r.generation)
+                    r.state = "ready"
+                    r.consecutive_probe_failures = 0
+                    r.restart_attempt = 0    # stable again: backoff resets
+                    continue
+                r.consecutive_probe_failures += 1
+                monitor.counter("serving_fleet_probe_failures_total",
+                                "Failed health probes",
+                                labels=("replica",)).inc(replica=r.name)
+                # a replica still "starting" (warming its bucket ladder)
+                # gets 5x the probe patience before it is presumed wedged
+                patience = self.unhealthy_after * (
+                    5 if r.state == "starting" else 1)
+                if r.consecutive_probe_failures >= patience:
+                    # alive but failing probes = wedged. A wedged process
+                    # cannot be trusted to drain — kill and replace.
+                    log.warning(
+                        "fleet: %s failed %d consecutive probes — "
+                        "presumed wedged, replacing", r.name,
+                        r.consecutive_probe_failures)
+                    r.state = "unhealthy"
+                    self._note_restart(r, "probe")
+                    try:
+                        r.kill()
+                    except Exception:         # noqa: BLE001
+                        log.exception("fleet: killing wedged %s failed",
+                                      r.name)
+                    self._schedule_restart(r, now)
+            self._export_states()
+        for r in due:
+            r._launch_thread = self._spawn(
+                lambda r=r: self._relaunch(r), f"relaunch-{r.name}")
+
+    def _schedule_restart(self, replica: Replica, now: float):
+        replica.restart_times = [t for t in replica.restart_times
+                                 if now - t <= self.budget_window]
+        if len(replica.restart_times) >= self.restart_budget:
+            log.error(
+                "fleet: %s exceeded its restart budget (%d restarts in "
+                "%.0fs) — marking dead; a human should look at it",
+                replica.name, len(replica.restart_times),
+                self.budget_window)
+            monitor.counter("serving_fleet_gave_up_total",
+                            "Replicas abandoned after exhausting the "
+                            "restart budget (crash loop)",
+                            labels=("replica",)).inc(replica=replica.name)
+            replica.state = "dead"
+            try:
+                replica.kill()
+            except Exception:                 # noqa: BLE001
+                pass
+            return
+        replica.restart_times.append(now)
+        # jittered exponential backoff: full value down to half of it, so
+        # a correlated crash doesn't restart the whole fleet in lockstep
+        delay = min(self.backoff_max,
+                    self.backoff * (2 ** replica.restart_attempt))
+        delay *= 0.5 + 0.5 * self._rng.random()
+        replica.restart_attempt += 1
+        replica.restart_at = now + delay
+        replica.state = "backoff"
+        log.warning("fleet: restarting %s in %.2fs (attempt %d)",
+                    replica.name, delay, replica.restart_attempt)
+
+    def _relaunch(self, replica: Replica):
+        """Launch a fresh incarnation. Runs OUTSIDE the tick lock (on a
+        spawn_fn thread in production): only the post-launch bookkeeping
+        re-acquires it. tick() already moved the replica to 'starting'."""
+        with monitor.span("serving/restart", replica=replica.name,
+                          generation=replica.generation):
+            try:
+                replica.launch()
+            except Exception as e:            # noqa: BLE001
+                log.error("fleet: relaunching %s failed: %s: %s",
+                          replica.name, type(e).__name__, e)
+                with self._lock:
+                    if self._stop.is_set():
+                        return
+                    self._note_restart(replica, "launch")
+                    self._schedule_restart(replica, self._time())
+                return
+        with self._lock:
+            if self._stop.is_set():
+                # stop() raced the relaunch: don't leak a fresh process
+                try:
+                    replica.stop()
+                except Exception:             # noqa: BLE001
+                    pass
+                replica.state = "stopped"
+                return
+        log.info("fleet: relaunched %s (gen %d) at %s", replica.name,
+                 replica.generation, replica.url)
+
+    def _join_relaunches(self, timeout: float = 30.0):
+        for r in self.replicas:
+            t = getattr(r, "_launch_thread", None)
+            if t is not None:
+                t.join(timeout)

@@ -33,7 +33,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 from deeplearning4j_tpu import monitor
 from deeplearning4j_tpu.serving.batcher import (
-    DEFAULT_BUCKETS, ShapeBucketedBatcher,
+    DEFAULT_BUCKETS, ServerDrainingError, ShapeBucketedBatcher,
 )
 
 log = logging.getLogger("deeplearning4j_tpu")
@@ -182,6 +182,12 @@ class ServedModel:
         """Load `source`, warm it off-path, make it the active version."""
         model = load_servable(source)
         with self._swap_lock:
+            if self.status == "stopping":
+                # racing a drain: the batcher/inference engine under this
+                # servable is flushing for shutdown — a swap can neither
+                # warm nor go live. Expected during the shutdown window.
+                raise ServerDrainingError(
+                    f"serving[{self.name}] is draining; swap rejected")
             with self._state_lock:
                 next_version = self.versions[-1].version + 1
             sv = ServableVersion(next_version, str(source), model)
@@ -207,6 +213,9 @@ class ServedModel:
         """One-step rollback: re-activate the version before the active
         one through the same warmed-swap path."""
         with self._swap_lock:
+            if self.status == "stopping":
+                raise ServerDrainingError(
+                    f"serving[{self.name}] is draining; rollback rejected")
             with self._state_lock:
                 if self.active == 0:
                     raise ModelLoadError(

@@ -32,6 +32,7 @@ from __future__ import annotations
 import io
 import json
 import logging
+import random
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -45,10 +46,35 @@ from deeplearning4j_tpu.serving.batcher import (
     DeadlineExceededError, ServerDrainingError, ServerOverloadedError,
 )
 from deeplearning4j_tpu.serving.registry import ModelLoadError, ModelRegistry
+from deeplearning4j_tpu.util import faults as fault_util
 
 log = logging.getLogger("deeplearning4j_tpu")
 
 _MAX_BODY = 256 << 20           # admission guard on Content-Length
+
+
+def retry_after_seconds(queue_depth: int, queue_limit: int,
+                        draining: bool = False,
+                        rng: Optional[random.Random] = None) -> int:
+    """Backpressure hint for 429/503 responses, derived and jittered.
+
+    A constant Retry-After synchronizes every shed client into a retry
+    stampede that re-saturates the queue at the exact same instant — the
+    classic thundering herd. Instead: the *ceiling* of the hint scales
+    with how far gone the server is (queue fullness, or a flat horizon
+    while draining — a draining process never recovers, the client's
+    next attempt belongs at the balancer), and the returned value is
+    drawn uniformly from [1, ceiling] so retries spread out over the
+    whole window. RFC 7231 requires integer delay-seconds, so jitter is
+    realized as a per-response draw, not a fractional offset.
+    """
+    rng = rng if rng is not None else random
+    if draining:
+        ceiling = 5                       # replacement capacity, not ours
+    else:
+        fullness = min(1.0, queue_depth / max(1, queue_limit))
+        ceiling = 1 + int(round(4 * fullness))
+    return rng.randint(1, max(1, ceiling))
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -103,6 +129,15 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):
         url = urlparse(self.path)
         parts = [p for p in url.path.split("/") if p]
+        if url.path in ("/healthz", "/readyz"):
+            try:
+                # fault point: a wedged replica answers probes slowly (or
+                # not at all) — exactly what the fleet supervisor's probe
+                # deadline exists to catch
+                self._srv.faults.on_probe()
+            except Exception as e:      # noqa: BLE001 — injected blackhole
+                self._json({"error": f"{type(e).__name__}: {e}"}, code=500)
+                return
         if url.path == "/healthz":
             self._json({"status": "alive"})
             return
@@ -112,7 +147,15 @@ class _Handler(BaseHTTPRequestHandler):
                             "models": self._srv.registry.names()})
             else:
                 self._json({"status": "draining"
-                            if self._srv.draining else "loading"}, code=503)
+                            if self._srv.draining else "loading"}, code=503,
+                           extra=(("Retry-After",
+                                   self._srv.retry_after()),))
+            return
+        if url.path == "/v1/faults":
+            if not self._srv.enable_faults:
+                self._json({"error": "not found"}, code=404)
+            else:
+                self._json(self._srv.faults.describe())
             return
         if url.path == "/metrics":
             self._reply(200, monitor.prometheus_text().encode(),
@@ -144,6 +187,18 @@ class _Handler(BaseHTTPRequestHandler):
             if verb in ("swap", "rollback"):
                 self._admin(name, verb)
                 return
+        if url.path == "/v1/faults" and self._srv.enable_faults:
+            # chaos-tool surface: wedge/unwedge THIS replica mid-traffic.
+            # Only exists when fault injection was requested at startup.
+            try:
+                payload = json.loads(self._body() or b"{}")
+                if not isinstance(payload, dict):
+                    raise ValueError("body must be a JSON object")
+                self._srv.faults.set(**payload)
+                self._json(self._srv.faults.describe())
+            except (ValueError, TypeError) as e:
+                self._json({"error": str(e)}, code=400)
+            return
         self._json({"error": "not found"}, code=404)
 
     def _parse_inputs(self, url) -> np.ndarray:
@@ -167,6 +222,13 @@ class _Handler(BaseHTTPRequestHandler):
         q = parse_qs(url.query)
         served = self._srv.registry.get(name)
         if served is None:
+            if self._srv.draining:
+                # the drain emptied the registry — this is "server going
+                # away" (503 + Retry-After), not "no such model" (404)
+                self._meter(name, 503, t0)
+                self._json({"error": "server draining"}, code=503,
+                           extra=(("Retry-After", self._srv.retry_after()),))
+                return
             self._meter(name, 404, t0)
             self._json({"error": f"unknown model {name!r}"}, code=404)
             return
@@ -182,6 +244,7 @@ class _Handler(BaseHTTPRequestHandler):
                         if "deadline_ms" in q else self._srv.default_deadline
                 except ValueError:
                     raise ValueError("deadline_ms must be a number")
+                self._srv.faults.on_predict()
                 y = served.predict(x, deadline=deadline)
                 if not batched and y.shape[0] == 1:
                     y = y[0]
@@ -202,14 +265,16 @@ class _Handler(BaseHTTPRequestHandler):
         except ServerOverloadedError as e:
             code = 429
             self._json({"error": str(e)}, code=429,
-                       extra=(("Retry-After", "1"),))
+                       extra=(("Retry-After",
+                               self._srv.retry_after(served)),))
         except DeadlineExceededError as e:
             code = 504
             self._json({"error": str(e)}, code=504)
         except ServerDrainingError as e:
             code = 503
             self._json({"error": str(e)}, code=503,
-                       extra=(("Retry-After", "5"),))
+                       extra=(("Retry-After",
+                               self._srv.retry_after(served)),))
         except ValueError as e:
             code = 400
             self._json({"error": str(e)}, code=400)
@@ -224,6 +289,11 @@ class _Handler(BaseHTTPRequestHandler):
         t0 = time.perf_counter()
         served = self._srv.registry.get(name)
         if served is None:
+            if self._srv.draining:
+                self._meter(name, 503, t0)
+                self._json({"error": "server draining"}, code=503,
+                           extra=(("Retry-After", self._srv.retry_after()),))
+                return
             self._meter(name, 404, t0)
             self._json({"error": f"unknown model {name!r}"}, code=404)
             return
@@ -240,6 +310,13 @@ class _Handler(BaseHTTPRequestHandler):
                 info = served.rollback()
             code = 200
             self._json({"model": name, "active": info})
+        except ServerDrainingError as e:
+            # swap/rollback racing a drain is an expected shutdown-window
+            # outcome, not a server fault — 503, never a 500
+            code = 503
+            self._json({"error": str(e)}, code=503,
+                       extra=(("Retry-After",
+                               self._srv.retry_after(served)),))
         except (ValueError, ModelLoadError) as e:
             code = 400
             self._json({"error": str(e)}, code=400)
@@ -264,9 +341,21 @@ class ModelServer:
 
     def __init__(self, registry: Optional[ModelRegistry] = None,
                  host: str = "127.0.0.1", port: int = 0,
-                 default_deadline_s: float = 30.0):
+                 default_deadline_s: float = 30.0,
+                 enable_faults: bool = False,
+                 retry_jitter: Optional[random.Random] = None,
+                 faults: Optional[fault_util.ServingFaults] = None):
         self.registry = registry if registry is not None else ModelRegistry()
         self.default_deadline = float(default_deadline_s)
+        self.enable_faults = bool(enable_faults)
+        # fault toggles are per-server injectable so in-process fleets
+        # can wedge ONE replica; the default stays the process singleton
+        # (env-armed subprocess children, existing tests)
+        self.faults = faults if faults is not None \
+            else fault_util.serving_faults()
+        self._retry_rng = retry_jitter          # None -> module-level random
+        if self.enable_faults:
+            self.faults.apply_env()
         self.draining = False
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.model_server = self          # type: ignore[attr-defined]
@@ -283,6 +372,26 @@ class ModelServer:
 
     def ready(self) -> bool:
         return not self.draining and self.registry.all_ready()
+
+    def retry_after(self, served=None) -> str:
+        """Derived, jittered Retry-After header value for 429/503
+        responses (see retry_after_seconds). Uses the deepest batcher
+        queue when no specific servable is implicated."""
+        depth, limit = 0, 1
+        if served is not None:
+            depth = served.batcher._queue.qsize()
+            limit = served.batcher._queue.maxsize or 1
+        else:
+            for name in self.registry.names():
+                m = self.registry.get(name)
+                if m is None:
+                    continue
+                q = m.batcher._queue
+                if q.maxsize and q.qsize() / q.maxsize >= depth / limit:
+                    depth, limit = q.qsize(), q.maxsize
+        return str(retry_after_seconds(depth, limit,
+                                       draining=self.draining,
+                                       rng=self._retry_rng))
 
     def drain(self, timeout: float = 30.0):
         """Graceful shutdown: stop admitting (readyz -> 503 so the load

@@ -156,6 +156,117 @@ class FaultInjector:
         return True
 
 
+# --------------------------------------------------------------- serving
+class ServingFaults:
+    """Deterministic fault points for the serving fleet (serving/fleet.py,
+    serving/router.py, tools/serve_chaos.py).
+
+    Unlike the step-scheduled trainer faults above, serving faults are
+    *toggles*: a replica is wedged or it is not, a probe path is
+    blackholed or it is not. The process-global instance (``serving_
+    faults()``) is consulted by the serving hot paths:
+
+    - ``probe_delay_s`` / ``probe_error``: /healthz and /readyz handlers
+      sleep (probe deadline blows -> supervisor sees a wedged replica)
+      or return 500 (probe blackhole without paying wall-clock).
+    - ``predict_delay_s`` / ``predict_error``: the predict path of THIS
+      process turns into a straggler (hedging/breaker fodder) or fails
+      outright with TransientFaultError (breaker fodder).
+
+    Three ways to engage it, all reaching the same singleton:
+
+    - tests: ``serving_faults().set(predict_delay_s=0.2)`` (and
+      ``clear()`` in teardown);
+    - env (subprocess replicas wedged from birth):
+      ``DL4J_TPU_SERVING_FAULTS="probe_delay_s=5;predict_delay_s=5"``;
+    - HTTP (chaos tools wedging a live replica mid-traffic): ``POST
+      /v1/faults`` on a ModelServer started with fault injection
+      enabled (``--enable-fault-injection``; never on by default).
+    """
+
+    _FIELDS = ("probe_delay_s", "predict_delay_s", "probe_error",
+               "predict_error")
+
+    def __init__(self):
+        self.clear()
+
+    def clear(self):
+        self.probe_delay_s = 0.0
+        self.predict_delay_s = 0.0
+        self.probe_error = False
+        self.predict_error = False
+
+    def set(self, **kw) -> "ServingFaults":
+        for key, val in kw.items():
+            if key not in self._FIELDS:
+                raise ValueError(f"unknown serving fault {key!r} "
+                                 f"(known: {self._FIELDS})")
+            cur = getattr(self, key)
+            if isinstance(cur, bool):
+                if isinstance(val, str):
+                    # env path hands us strings: "0"/"false"/"off" mean
+                    # off, not bool("0") == True
+                    val = val.strip().lower() not in (
+                        "", "0", "false", "no", "off")
+                setattr(self, key, bool(val))
+            else:
+                setattr(self, key, float(val))
+        if self.active():
+            log.warning("serving fault injection ACTIVE: %s",
+                        self.describe())
+        return self
+
+    def active(self) -> bool:
+        return bool(self.probe_delay_s or self.predict_delay_s
+                    or self.probe_error or self.predict_error)
+
+    def describe(self) -> dict:
+        return {f: getattr(self, f) for f in self._FIELDS}
+
+    def apply_env(self, var: str = "DL4J_TPU_SERVING_FAULTS"
+                  ) -> "ServingFaults":
+        """``probe_delay_s=5;predict_error=1`` env syntax; unset/empty
+        leaves the toggles untouched."""
+        spec = os.environ.get(var, "").strip()
+        if not spec:
+            return self
+        kw = {}
+        for part in spec.split(";"):
+            part = part.strip()
+            if not part:
+                continue
+            key, sep, val = part.partition("=")
+            if not sep:
+                raise ValueError(f"{var}: expected key=value, got {part!r}")
+            kw[key.strip()] = val.strip()
+        return self.set(**kw)
+
+    # ------------------------------------------------------ fault points
+    def on_probe(self):
+        """Consulted by /healthz and /readyz handlers. Sleeps or raises."""
+        if self.probe_delay_s > 0:
+            import time
+            time.sleep(self.probe_delay_s)
+        if self.probe_error:
+            raise TransientFaultError("injected probe blackhole")
+
+    def on_predict(self):
+        """Consulted by the predict path before dispatch."""
+        if self.predict_delay_s > 0:
+            import time
+            time.sleep(self.predict_delay_s)
+        if self.predict_error:
+            raise TransientFaultError("injected predict fault")
+
+
+_SERVING_FAULTS = ServingFaults()
+
+
+def serving_faults() -> ServingFaults:
+    """The process-global serving fault toggles (see ServingFaults)."""
+    return _SERVING_FAULTS
+
+
 def attach_transport_faults(transport, injector: FaultInjector):
     """Wire the injector's message-drop schedule into a SocketTransport
     (its `broadcast` consults `send_filter` per outbound message)."""

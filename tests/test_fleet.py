@@ -1,0 +1,789 @@
+"""Fleet-resilience tests: ReplicaSupervisor, circuit breakers, priority
+shedding, power-of-two-choices, hedging (serving/fleet.py + router.py).
+
+Everything policy-level is pinned DETERMINISTICALLY: fake clocks drive the
+breaker lifecycle and the supervisor's backoff/budget arithmetic, fake
+replicas/transports make routing outcomes exact, and util/faults.py
+toggles wedge live servers — no sleeps-and-hope timing anywhere. The
+end-to-end chaos run (real subprocess replicas, SIGKILL, wedged probes)
+lives in tools/serve_chaos.py and rides as a slow-marked test here.
+"""
+import json
+import os
+import random
+import subprocess
+import sys
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import monitor
+from deeplearning4j_tpu.serving import retry_after_seconds
+from deeplearning4j_tpu.serving.fleet import (
+    Replica, ReplicaSpec, ReplicaSupervisor,
+)
+from deeplearning4j_tpu.serving.router import (
+    BREAKER_CLOSED, BREAKER_HALF_OPEN, BREAKER_OPEN, CircuitBreaker,
+    ReplicaTransportError, ResilientRouter,
+)
+from deeplearning4j_tpu.util.faults import serving_faults
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# ------------------------------------------------------- circuit breaker
+def test_breaker_full_lifecycle_closed_open_half_open_closed():
+    clock = FakeClock()
+    br = CircuitBreaker(window=10, min_samples=4, failure_rate=0.5,
+                        open_for_s=10.0, time_fn=clock)
+    assert br.state == BREAKER_CLOSED
+    # below min_samples: failures alone cannot open it
+    br.record_failure()
+    br.record_failure()
+    br.record_failure()
+    assert br.state == BREAKER_CLOSED and br.allow()
+    # 4th sample crosses min_samples at 100% failure rate -> OPEN
+    br.record_failure()
+    assert br.state == BREAKER_OPEN
+    assert not br.allow() and not br.would_allow()
+    # time heals nothing until open_for_s elapses
+    clock.advance(9.9)
+    assert not br.allow()
+    clock.advance(0.2)
+    # first allow() after the cooldown is the half-open probe
+    assert br.would_allow()
+    assert br.allow()
+    assert br.state == BREAKER_HALF_OPEN
+    # only one probe may be in flight
+    assert not br.allow()
+    # probe success -> CLOSED, window reset (old failures forgotten)
+    br.record_success()
+    assert br.state == BREAKER_CLOSED
+    br.record_failure()
+    br.record_failure()
+    br.record_failure()
+    assert br.state == BREAKER_CLOSED      # fresh window: 3 < min_samples
+
+
+def test_breaker_half_open_failure_reopens_for_full_cooldown():
+    clock = FakeClock()
+    br = CircuitBreaker(window=4, min_samples=2, failure_rate=0.5,
+                        open_for_s=5.0, time_fn=clock)
+    br.record_failure()
+    br.record_failure()
+    assert br.state == BREAKER_OPEN
+    clock.advance(5.0)
+    assert br.allow()                      # the half-open probe
+    br.record_failure()                    # probe failed
+    assert br.state == BREAKER_OPEN
+    clock.advance(4.9)
+    assert not br.allow()                  # a FULL new cooldown
+    clock.advance(0.2)
+    assert br.allow()
+
+
+def test_breaker_mixed_rate_below_threshold_stays_closed():
+    clock = FakeClock()
+    br = CircuitBreaker(window=10, min_samples=4, failure_rate=0.5,
+                        open_for_s=5.0, time_fn=clock)
+    for _ in range(8):
+        br.record_success()
+        br.record_failure()                # 50% in a window of 10...
+    # exactly at threshold -> opens (>= semantics)
+    assert br.state == BREAKER_OPEN
+
+
+# ------------------------------------------------------------ the router
+def _ready_replicas(n, inflight=()):
+    reps = []
+    for i in range(n):
+        r = Replica(f"r{i}")
+        r.state = "ready"
+        r.url = f"http://fake-{i}"
+        if i < len(inflight):
+            r.inflight_add(inflight[i])
+        reps.append(r)
+    return reps
+
+
+def _ok_transport(replica, path, body, headers, timeout):
+    return 200, {"Content-Type": "application/json"}, json.dumps(
+        {"who": replica.name}).encode()
+
+
+def _router(reps, **kw):
+    kw.setdefault("transport", _ok_transport)
+    kw.setdefault("hedge", False)
+    kw.setdefault("rng", random.Random(0))
+    return ResilientRouter(lambda: [r for r in reps
+                                    if r.state == "ready"], **kw)
+
+
+def test_priority_shedding_order_is_deterministic():
+    """The pinned shed order: utilization sheds strictly lowest-class
+    first — batch at the floor, standard next, interactive only when the
+    fleet is hard-full."""
+    reps = _ready_replicas(2)
+    router = _router(reps, classes=("interactive", "standard", "batch"),
+                     default_class="standard", shed_floor=0.5,
+                     per_replica_inflight=4)    # capacity 8
+    # thresholds: interactive 1.0, standard 0.75, batch 0.5
+    assert router.shed_at == {"interactive": 1.0, "standard": 0.75,
+                              "batch": 0.5}
+
+    def code_for(cls, used):
+        for r in reps:
+            r._inflight = 0
+        reps[0]._inflight = used
+        code, _, _ = router.route_predict(
+            "m", b"{}", {"X-Priority": cls} if cls else {})
+        return code
+
+    # util 0.5: batch shed, standard + interactive flow
+    assert code_for("batch", 4) == 429
+    assert code_for("standard", 4) == 200
+    assert code_for("interactive", 4) == 200
+    # util 0.75: batch + standard shed, interactive flows
+    assert code_for("batch", 6) == 429
+    assert code_for("standard", 6) == 429
+    assert code_for("interactive", 6) == 200
+    # hard full: everyone sheds
+    assert code_for("interactive", 8) == 429
+    # no header -> default class (standard here)
+    assert code_for(None, 6) == 429
+    assert code_for(None, 0) == 200
+    # unknown class name -> default class, not a crash
+    assert code_for("no-such-class", 6) == 429
+    # shed responses carry a jittered integer Retry-After
+    for r in reps:
+        r._inflight = 4
+    code, headers, body = router.route_predict(
+        "m", b"{}", {"X-Priority": "batch"})
+    assert code == 429
+    ra = dict(headers).get("Retry-After")
+    assert ra is not None and 1 <= int(ra) <= 5
+    shed = monitor.REGISTRY.collect("serving_router_shed_total")
+    assert shed is not None and shed.value(cls="batch") >= 3
+    assert shed.value(cls="standard") >= 2     # the defaulted sheds
+
+
+def test_priority_classes_match_case_insensitively():
+    """--priority-classes Interactive,Batch must still match the
+    lowercased X-Priority header: classes normalize to lowercase."""
+    reps = _ready_replicas(1)
+    router = _router(reps, classes=("Interactive", "Batch"),
+                     per_replica_inflight=4)
+    assert router.classes == ("interactive", "batch")
+    assert router.default_class == "batch"
+    reps[0]._inflight = 3                  # past batch's shed floor
+    code, _, _ = router.route_predict("m", b"{}",
+                                      {"X-Priority": "INTERACTIVE"})
+    assert code == 200                     # top class, matched, not shed
+    code, _, _ = router.route_predict("m", b"{}",
+                                      {"X-Priority": "batch"})
+    assert code == 429                     # low class sheds
+
+
+def test_power_of_two_choices_prefers_lower_inflight():
+    reps = _ready_replicas(2, inflight=(5, 0))
+    router = _router(reps, per_replica_inflight=100)
+    served_by = set()
+    for _ in range(10):
+        code, headers, _ = router.route_predict("m", b"{}", {})
+        assert code == 200
+        served_by.add(dict(headers)["X-Served-By"])
+    assert served_by == {"r1"}             # always the shallower queue
+
+
+def test_router_fails_over_past_a_dead_replica():
+    reps = _ready_replicas(2)
+    calls = []
+
+    def transport(replica, path, body, headers, timeout):
+        calls.append(replica.name)
+        if replica.name == "r0":
+            raise ReplicaTransportError("r0: connection refused")
+        return _ok_transport(replica, path, body, headers, timeout)
+
+    router = _router(reps, transport=transport, max_attempts=2,
+                     breaker_min_samples=3)
+    # every request lands 200 on r1 whether or not r0 was tried first
+    for _ in range(8):
+        code, headers, _ = router.route_predict("m", b"{}", {})
+        assert code == 200
+        assert dict(headers)["X-Served-By"] == "r1"
+    assert "r0" in calls                   # r0 was really attempted
+    # r0's transport failures opened its breaker -> stops being attempted
+    assert not router.breaker(reps[0], "m").would_allow()
+    n0 = calls.count("r0")
+    for _ in range(5):
+        assert router.route_predict("m", b"{}", {})[0] == 200
+    assert calls.count("r0") == n0         # no further traffic to r0
+
+
+def test_breaker_resets_on_replica_generation_bump():
+    reps = _ready_replicas(1)
+    router = _router(reps)
+    br = router.breaker(reps[0], "m")
+    for _ in range(10):
+        br.record_failure()
+    assert not br.would_allow()
+    reps[0].generation += 1                # supervisor replaced it
+    fresh = router.breaker(reps[0], "m")
+    assert fresh is not br and fresh.would_allow()
+
+
+def test_router_503_when_no_replica_routable():
+    reps = _ready_replicas(1)
+    router = _router(reps)
+    for _ in range(10):
+        router.breaker(reps[0], "m").record_failure()
+    code, headers, body = router.route_predict("m", b"{}", {})
+    assert code == 503
+    assert 1 <= int(dict(headers)["Retry-After"]) <= 5
+    assert "error" in json.loads(body)
+    # and with an empty fleet
+    code, _, _ = _router([]).route_predict("m", b"{}", {})
+    assert code == 503
+
+
+def test_hedged_request_wins_on_straggling_primary():
+    """Deterministic straggler: the primary's transport blocks on an
+    Event; the hedge must fire (tracked-p99 delay) and its fast response
+    must be returned while the primary is still stuck."""
+    monitor.REGISTRY.reset()
+    reps = _ready_replicas(2, inflight=(0, 3))   # p2c primary pick = r0
+    release = threading.Event()
+    calls = []
+
+    def transport(replica, path, body, headers, timeout):
+        calls.append(replica.name)
+        if replica.name == "r0":
+            release.wait(10)               # straggler until released
+        return _ok_transport(replica, path, body, headers, timeout)
+
+    router = _router(reps, transport=transport, hedge=True,
+                     hedge_min_s=0.02, hedge_min_samples=1)
+    router._note_latency("m", 0.01)        # p99 tracker armed
+    try:
+        code, headers, _ = router.route_predict("m", b"{}", {})
+        assert code == 200
+        assert dict(headers)["X-Served-By"] == "r1"
+        assert calls == ["r0", "r1"]       # hedge really was a second send
+        hedges = monitor.REGISTRY.collect("serving_router_hedges_total")
+        assert hedges.value(model="m") == 1
+    finally:
+        release.set()
+
+
+# --------------------------------------------------------- the supervisor
+class FakeReplica(Replica):
+    """Scripted replica: tests flip `alive_flag`/`probe_ok`."""
+
+    def __init__(self, name, spec=None):
+        super().__init__(name, spec)
+        self.alive_flag = False
+        self.probe_ok = True
+        self.launches = 0
+        self.kills = 0
+
+    def launch(self):
+        self.launches += 1
+        self.alive_flag = True
+        self.url = f"http://fake/{self.name}/{self.launches}"
+
+    def alive(self):
+        return self.alive_flag
+
+    def kill(self):
+        self.kills += 1
+        self.alive_flag = False
+
+
+def _supervisor(n=1, clock=None, **kw):
+    clock = clock or FakeClock()
+    reps = []
+
+    def factory(i):
+        r = FakeReplica(f"f{i}")
+        reps.append(r)
+        return r
+
+    kw.setdefault("probe_interval_s", 1.0)
+    kw.setdefault("unhealthy_after", 3)
+    kw.setdefault("restart_backoff_s", 1.0)
+    kw.setdefault("restart_budget", 3)
+    kw.setdefault("restart_budget_window_s", 100.0)
+    # synchronous relaunches keep these policy tests single-threaded;
+    # the threaded default is pinned by
+    # test_hung_relaunch_does_not_stall_supervision
+    kw.setdefault("spawn_fn", lambda fn, name: (fn(), None)[1])
+    sup = ReplicaSupervisor(
+        factory, n, time_fn=clock, sleep_fn=lambda s: None,
+        rng=random.Random(0),
+        probe_fn=lambda r, timeout: r.probe_ok and r.alive(), **kw)
+    # tests drive tick() directly — launch without the loop thread
+    for r in sup.replicas:
+        r.launch()
+    return sup, reps, clock
+
+
+def test_supervisor_restarts_crashed_replica_with_backoff():
+    sup, (r,), clock = _supervisor()
+    sup.tick()
+    assert r.state == "ready"
+    r.alive_flag = False                   # SIGKILL analog
+    sup.tick()
+    assert r.state == "backoff" and r.restart_at is not None
+    # jittered exponential backoff: within (0.5, 1.0] * base
+    delay = r.restart_at - clock()
+    assert 0.5 < delay <= 1.0
+    assert monitor.REGISTRY.collect("serving_fleet_restarts_total").value(
+        replica="f0", reason="crash") >= 1
+    # before the backoff deadline: no relaunch
+    sup.tick()
+    assert r.launches == 1
+    clock.advance(1.1)
+    sup.tick()                             # relaunch fires
+    assert r.launches == 2 and r.generation == 1 and r.state == "starting"
+    sup.tick()                             # first good probe -> ready
+    assert r.state == "ready"
+    assert r.consecutive_probe_failures == 0
+
+
+def test_supervisor_replaces_wedged_replica_after_k_probes():
+    sup, (r,), clock = _supervisor(unhealthy_after=3)
+    sup.tick()
+    assert r.state == "ready"
+    r.probe_ok = False                     # alive but wedged
+    sup.tick()
+    sup.tick()
+    assert r.state == "ready"              # 2 failures: still tolerated
+    assert r.consecutive_probe_failures == 2
+    sup.tick()                             # 3rd consecutive: replaced
+    assert r.kills == 1                    # a wedged process gets killed
+    assert r.state == "backoff"
+    assert monitor.REGISTRY.collect("serving_fleet_restarts_total").value(
+        replica="f0", reason="probe") >= 1
+    r.probe_ok = True
+    clock.advance(5.0)
+    sup.tick()                             # relaunch
+    sup.tick()                             # probe ok
+    assert r.state == "ready" and r.generation == 1
+
+
+def test_supervisor_one_good_probe_resets_failure_count():
+    sup, (r,), clock = _supervisor(unhealthy_after=3)
+    sup.tick()
+    r.probe_ok = False
+    sup.tick()
+    sup.tick()
+    r.probe_ok = True
+    sup.tick()                             # heals
+    assert r.consecutive_probe_failures == 0
+    r.probe_ok = False
+    sup.tick()
+    sup.tick()
+    assert r.state == "ready"              # the count really restarted
+
+
+def test_supervisor_restart_budget_marks_crash_loop_dead():
+    sup, (r,), clock = _supervisor(restart_budget=2,
+                                   restart_budget_window_s=100.0,
+                                   restart_backoff_s=0.1)
+    sup.tick()
+    for _ in range(2):                     # two budgeted restarts
+        r.alive_flag = False
+        sup.tick()
+        clock.advance(10.0)
+        sup.tick()                         # relaunch
+        sup.tick()                         # ready again
+        assert r.state == "ready"
+    r.alive_flag = False                   # third crash inside the window
+    sup.tick()
+    assert r.state == "dead"
+    assert monitor.REGISTRY.collect("serving_fleet_gave_up_total").value(
+        replica="f0") == 1
+    # dead replicas are left alone...
+    clock.advance(50.0)
+    sup.tick()
+    assert r.state == "dead" and r.launches == 3
+    # ...but the budget is a WINDOW: crashes spread beyond it still heal
+    sup2, (r2,), clock2 = _supervisor(restart_budget=2,
+                                      restart_budget_window_s=100.0,
+                                      restart_backoff_s=0.1)
+    sup2.tick()
+    for _ in range(4):                     # 4 crashes, 150s apart
+        r2.alive_flag = False
+        sup2.tick()
+        clock2.advance(150.0)
+        sup2.tick()
+        sup2.tick()
+        assert r2.state == "ready"
+
+
+def test_supervisor_backoff_grows_exponentially_until_stable():
+    sup, (r,), clock = _supervisor(restart_backoff_s=1.0, restart_budget=10)
+    sup.tick()
+    delays = []
+    for _ in range(3):
+        r.alive_flag = False
+        r.probe_ok = False                 # relaunched incarnation stays bad
+        sup.tick()
+        delays.append(r.restart_at - clock())
+        clock.advance(delays[-1] + 0.01)
+        sup.tick()                         # relaunch (comes up not-ready)
+        r.alive_flag = False               # crashes again immediately
+        sup.tick()
+    # attempt exponent grew: each full-jitter window doubles
+    assert delays[0] <= 1.0 < delays[1] <= 2.0 < delays[2] <= 4.0
+    # a stable ready period resets the exponent
+    r.probe_ok = True
+    clock.advance(10.0)
+    sup.tick()                             # relaunch
+    sup.tick()                             # ready
+    assert r.state == "ready" and r.restart_attempt == 0
+
+
+def test_hung_relaunch_does_not_stall_supervision():
+    """One replica's relaunch hanging (silent child, slow model load)
+    must not block probing/restarting the rest of the fleet: relaunches
+    run on spawn_fn threads, outside the tick lock."""
+    clock = FakeClock()
+    gate = threading.Event()
+    reps = []
+
+    class Hanging(FakeReplica):
+        def launch(self):
+            if self.name == "h0" and self.launches >= 1:
+                gate.wait(10)          # hung relaunch analog
+            super().launch()
+
+    def factory(i):
+        r = Hanging(f"h{i}")
+        reps.append(r)
+        return r
+
+    sup = ReplicaSupervisor(
+        factory, 2, time_fn=clock, sleep_fn=lambda s: None,
+        rng=random.Random(0), restart_backoff_s=1.0,
+        probe_fn=lambda r, timeout: r.probe_ok and r.alive())
+    try:
+        for r in sup.replicas:
+            r.launch()
+        sup.tick()
+        assert all(r.state == "ready" for r in reps)
+        reps[0].alive_flag = False
+        sup.tick()                     # h0 -> backoff
+        clock.advance(2.0)
+        sup.tick()                     # h0 relaunch spawns and HANGS
+        assert reps[0].state == "starting"
+        # while h0's relaunch hangs, h1 is still supervised:
+        reps[1].alive_flag = False
+        sup.tick()
+        assert reps[1].state == "backoff"
+        clock.advance(2.0)
+        sup.tick()                     # h1 relaunches (its own thread)
+        reps[1]._launch_thread.join(10)
+        sup.tick()
+        assert reps[1].state == "ready" and reps[1].generation == 1
+        assert reps[0].state == "starting"   # h0 still stuck, contained
+    finally:
+        gate.set()
+    reps[0]._launch_thread.join(10)
+    sup.tick()
+    assert reps[0].state == "ready" and reps[0].generation == 1
+
+
+def test_router_relays_replica_504_without_poisoning_breaker():
+    """A replica 504 (the request's own deadline expired) is client
+    backpressure: it must be relayed, and must NOT count toward the
+    breaker the way a 500 does — a tight-deadline client cannot open
+    breakers on healthy replicas."""
+    reps = _ready_replicas(1)
+
+    def transport_504(replica, path, body, headers, timeout):
+        return 504, {"Content-Type": "application/json"}, json.dumps(
+            {"error": "deadline"}).encode()
+
+    router = _router(reps, transport=transport_504)
+    for _ in range(10):
+        code, _, body = router.route_predict("m", b"{}", {})
+        assert code == 504, code
+        assert "deadline" in json.loads(body)["error"]
+    from deeplearning4j_tpu.serving.router import BREAKER_CLOSED
+    assert router.breaker(reps[0], "m").state == BREAKER_CLOSED
+
+
+def test_failover_skips_denied_breaker_and_reaches_third_replica():
+    """Failover after a primary failure must loop past a candidate whose
+    breaker denies allow() (half-open slot consumed mid-request) and
+    reach the next closed-breaker replica instead of giving up."""
+    clock = FakeClock()
+    reps = _ready_replicas(3)          # r0 primary (lowest inflight)
+    reps[1].inflight_add(1)
+    reps[2].inflight_add(2)
+    calls = []
+    router_box = []
+
+    def transport(replica, path, body, headers, timeout):
+        calls.append(replica.name)
+        if replica.name == "r0":
+            # while r0 is in flight, r1's half-open probe slot is taken
+            # by "another request", then r0 fails at the wire
+            router_box[0].breaker(reps[1], "m").allow()
+            raise ReplicaTransportError("r0 died")
+        return _ok_transport(replica, path, body, headers, timeout)
+
+    # seed 1: the first p2c sample is (r0, r2) -> r0 (lowest inflight)
+    # is deterministically the primary
+    router = _router(reps, transport=transport, time_fn=clock,
+                     breaker_open_for_s=5.0, rng=random.Random(1))
+    router_box.append(router)
+    # put r1's breaker into half-open: open it, then lapse the cooldown
+    br1 = router.breaker(reps[1], "m")
+    for _ in range(5):
+        br1.record_failure()
+    assert br1.state == BREAKER_OPEN
+    clock.advance(6.0)
+    assert br1.would_allow()           # half-open, one probe slot free
+    code, headers, _ = router.route_predict("m", b"{}", {})
+    assert code == 200, code
+    assert dict(headers)["X-Served-By"] == "r2"
+    assert calls == ["r0", "r2"]       # r1 denied, skipped — not dropped
+    retries = monitor.REGISTRY.collect("serving_router_retries_total")
+    assert retries.value(model="m") >= 1
+
+
+def test_half_open_probe_slot_released_on_backpressure():
+    """A half-open probe answered with 429/503/504 is INCONCLUSIVE: the
+    probe slot must be given back (not leaked), or the breaker wedges in
+    half-open and a healthy replica never gets traffic again."""
+    clock = FakeClock()
+    codes = [429, 200]
+
+    def transport(replica, path, body, headers, timeout):
+        return codes.pop(0), {"Content-Type": "application/json"}, \
+            json.dumps({"who": replica.name}).encode()
+
+    reps = _ready_replicas(1)
+    router = _router(reps, transport=transport, time_fn=clock,
+                     breaker_open_for_s=5.0)
+    br = router.breaker(reps[0], "m")
+    for _ in range(5):
+        br.record_failure()
+    assert br.state == BREAKER_OPEN
+    clock.advance(6.0)
+    # half-open probe hits momentary backpressure: relayed, slot freed
+    code, _, _ = router.route_predict("m", b"{}", {})
+    assert code == 429
+    assert br.state == BREAKER_HALF_OPEN
+    assert br.would_allow()            # the slot came back
+    # next probe succeeds and closes the breaker
+    code, _, _ = router.route_predict("m", b"{}", {})
+    assert code == 200
+    assert br.state == BREAKER_CLOSED
+
+
+def test_hedge_loops_past_denied_spare_breaker():
+    """Hedging must try the next candidate when the first spare's
+    breaker denies allow() — symmetric with the failover loop."""
+    monitor.REGISTRY.reset()
+    clock = FakeClock()
+    reps = _ready_replicas(3)
+    reps[1].inflight_add(1)            # hedge pool pick order: r1 first
+    reps[2].inflight_add(2)
+    release = threading.Event()
+    calls = []
+    router_box = []
+
+    def transport(replica, path, body, headers, timeout):
+        calls.append(replica.name)
+        if replica.name == "r0":
+            release.wait(10)           # straggler primary
+        return _ok_transport(replica, path, body, headers, timeout)
+
+    # seed 1: first p2c sample is (r0, r2) -> r0 primary
+    router = _router(reps, transport=transport, hedge=True,
+                     hedge_min_s=0.02, hedge_min_samples=1,
+                     time_fn=clock, breaker_open_for_s=5.0,
+                     rng=random.Random(1))
+    router_box.append(router)
+    router._note_latency("m", 0.01)    # p99 tracker armed
+    # r1 half-open with its only probe slot consumed -> allow() denies
+    br1 = router.breaker(reps[1], "m")
+    for _ in range(5):
+        br1.record_failure()
+    clock.advance(6.0)
+    assert br1.allow()                 # consume the half-open slot
+    try:
+        code, headers, _ = router.route_predict("m", b"{}", {})
+        assert code == 200
+        assert dict(headers)["X-Served-By"] == "r2"
+        assert calls == ["r0", "r2"]   # r1 denied, r2 hedged instead
+        hedges = monitor.REGISTRY.collect("serving_router_hedges_total")
+        assert hedges.value(model="m") == 1
+    finally:
+        release.set()
+
+
+def test_fleet_swap_updates_spec_and_reports_skipped():
+    """A fleet swap must leave future incarnations on the NEW source
+    (spec updated) and name the replicas the fan-out could not reach."""
+    from deeplearning4j_tpu.serving.router import RouterServer
+
+    spec = ReplicaSpec([("m", "/old/src")])
+    reps = _ready_replicas(2)
+    for r in reps:
+        r.spec = spec
+    down = Replica("r-down", spec)     # crashed: not in the routing set
+    down.state = "backoff"
+
+    def transport(replica, path, body, headers, timeout):
+        return 200, {"Content-Type": "application/json"}, json.dumps(
+            {"model": "m", "active": {"version": 2}}).encode()
+
+    class Sup:                         # duck-typed supervisor view
+        replicas = reps + [down]
+
+        def healthy(self):
+            return [r for r in self.replicas if r.state == "ready"]
+
+    router = _router(reps, transport=transport)
+    server = RouterServer(router, supervisor=Sup())
+    try:
+        req = urllib.request.Request(
+            f"{server.url}/v1/models/m/swap",
+            data=json.dumps({"source": "/new/src"}).encode(),
+            headers={"Content-Type": "application/json"})
+        r = urllib.request.urlopen(req, timeout=10)
+        doc = json.loads(r.read())
+        assert r.status == 200 and doc["ok"], doc
+        assert doc["skipped_unhealthy"] == ["r-down"]
+        # the shared spec now carries the swapped source: a supervisor
+        # relaunch of r-down will load /new/src, not /old/src
+        assert spec.models == [("m", "/new/src")]
+    finally:
+        server.stop()
+
+
+def test_supervisor_healthy_excludes_non_ready():
+    sup, reps, clock = _supervisor(3)
+    sup.tick()
+    assert [r.name for r in sup.healthy()] == ["f0", "f1", "f2"]
+    reps[1].alive_flag = False
+    sup.tick()
+    assert [r.name for r in sup.healthy()] == ["f0", "f2"]
+    gauge = monitor.REGISTRY.collect("serving_fleet_replicas")
+    assert gauge.value(state="ready") == 2
+    assert gauge.value(state="backoff") == 1
+
+
+def test_supervisor_rejects_bad_config():
+    with pytest.raises(ValueError, match="n_replicas"):
+        ReplicaSupervisor(lambda i: FakeReplica("x"), 0)
+    with pytest.raises(ValueError, match="unique"):
+        ReplicaSupervisor(lambda i: FakeReplica("same"), 2)
+
+
+# -------------------------------------------------- retry-after / faults
+def test_router_server_drain_flips_readyz():
+    """The fleet CLI's SIGTERM path flips RouterServer.draining before
+    tearing replicas down: /readyz must go 503 (balancer drains us) with
+    a jittered Retry-After while predicts still route."""
+    from deeplearning4j_tpu.serving.router import RouterServer
+
+    reps = _ready_replicas(1)
+    server = RouterServer(_router(reps))
+    try:
+        r = urllib.request.urlopen(f"{server.url}/readyz", timeout=10)
+        assert r.status == 200
+        r.read()
+        server.draining = True
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(f"{server.url}/readyz", timeout=10)
+        assert e.value.code == 503
+        assert 1 <= int(e.value.headers["Retry-After"]) <= 5
+        assert json.loads(e.value.read())["status"] == "draining"
+        # in-flight/late traffic still routes during the drain window
+        req = urllib.request.Request(
+            f"{server.url}/v1/models/m/predict", data=b"{}",
+            headers={"Content-Type": "application/json"})
+        r = urllib.request.urlopen(req, timeout=10)
+        assert r.status == 200
+        r.read()
+    finally:
+        server.stop()
+
+
+def test_retry_after_seconds_scales_and_jitters():
+    rng = random.Random(0)
+    # empty queue: always the 1s floor
+    assert {retry_after_seconds(0, 64, rng=rng) for _ in range(20)} == {1}
+    # full queue: jittered across [1, 5]
+    vals = {retry_after_seconds(64, 64, rng=rng) for _ in range(50)}
+    assert vals == {1, 2, 3, 4, 5}
+    # draining: flat [1, 5] horizon regardless of queue
+    vals = {retry_after_seconds(0, 64, draining=True, rng=rng)
+            for _ in range(50)}
+    assert vals == {1, 2, 3, 4, 5}
+    # half-full: ceiling 3
+    vals = {retry_after_seconds(32, 64, rng=rng) for _ in range(50)}
+    assert vals == {1, 2, 3}
+
+
+def test_serving_faults_toggles_and_env(monkeypatch):
+    sf = serving_faults()
+    sf.clear()
+    assert not sf.active()
+    sf.set(predict_delay_s=0.25, probe_error=True)
+    assert sf.active()
+    assert sf.describe()["predict_delay_s"] == 0.25
+    with pytest.raises(ValueError, match="unknown serving fault"):
+        sf.set(nonsense=1)
+    sf.clear()
+    monkeypatch.setenv("DL4J_TPU_SERVING_FAULTS",
+                       "probe_delay_s=2;predict_error=1")
+    sf.apply_env()
+    assert sf.probe_delay_s == 2.0 and sf.predict_error
+    # falsy env strings mean OFF — bool("0") must not arm the fault
+    monkeypatch.setenv("DL4J_TPU_SERVING_FAULTS",
+                       "predict_error=0;probe_error=false")
+    sf.clear()
+    sf.apply_env()
+    assert not sf.predict_error and not sf.probe_error
+    assert not sf.active()
+    sf.clear()
+
+
+# ------------------------------------------------- chaos SLO gate (slow)
+@pytest.mark.slow
+def test_serve_chaos_slo_gate(tmp_path):
+    """The acceptance run: 3 subprocess replicas, SIGKILL + wedge under
+    traffic, zero 5xx, restart-and-rejoin, p99 recovery — all asserted
+    by tools/serve_chaos.py itself (exit 0 == SLO held)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, os.path.join("tools", "serve_chaos.py")],
+        capture_output=True, text=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        env=env, timeout=580)
+    assert proc.returncode == 0, \
+        f"chaos SLO gate failed:\n{proc.stdout[-4000:]}\n{proc.stderr[-2000:]}"
+    report = json.loads(proc.stdout)
+    assert report["ok"] and not report["failures"]
+    assert report["fleet_restarts_total"] >= 2
+    assert report["shed"]["batch"] > 0

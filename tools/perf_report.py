@@ -40,7 +40,14 @@ import sys
 THROUGHPUT_KEYS = ("imgs_sec", "lenet_imgs_sec", "chars_sec", "pairs_sec",
                    "h2d_f32_mbytes_sec", "h2d_u8_mbytes_sec",
                    "fit_e2e_imgs_sec",
-                   "fit_e2e_chars_sec", "fit_e2e_pairs_sec")
+                   "fit_e2e_chars_sec", "fit_e2e_pairs_sec",
+                   "chaos_goodput_under_fault_rps")
+
+#: lower-is-better series (latencies). Banked by tools/serve_chaos.py
+#: (CHAOS_r*.json): p99 while a replica is killed + another wedged, and
+#: post-fault recovered p99. Gated inverted: baseline = best (lowest)
+#: earlier round, regression = latest above baseline by > threshold.
+LATENCY_KEYS = ("chaos_p99_under_fault_ms", "chaos_recovered_p99_ms")
 
 
 def _round_of(name: str) -> int:
@@ -57,7 +64,8 @@ def load_rounds(directory: str):
     entries = []
     names = (sorted(glob.glob(os.path.join(directory, "BENCH_r*.json")))
              + sorted(glob.glob(os.path.join(directory,
-                                             "BENCH_TPU_MEASURED_*.json"))))
+                                             "BENCH_TPU_MEASURED_*.json")))
+             + sorted(glob.glob(os.path.join(directory, "CHAOS_r*.json"))))
     for path in names:
         try:
             with open(path) as f:
@@ -97,7 +105,7 @@ def extract_series(entries):
                     or "skipped" in row:
                 continue
             on_tpu = bool(row.get("on_tpu", e["on_tpu"]))
-            for key in THROUGHPUT_KEYS:
+            for key in THROUGHPUT_KEYS + LATENCY_KEYS:
                 if isinstance(row.get(key), (int, float)):
                     add((on_tpu, row.get("mode"), row.get("batch"), key),
                         e["round"], e["artifact"], row[key])
@@ -106,22 +114,31 @@ def extract_series(entries):
 
 def check_regressions(series, threshold: float):
     """LATEST occurrence vs BEST of strictly-earlier rounds, per series.
-    Single-round series (e.g. a config measured only once) cannot gate."""
+    "Best" is direction-aware: highest for throughput series, lowest for
+    LATENCY_KEYS series, and a regression is a move AWAY from best beyond
+    the threshold in either regime. Single-round series (e.g. a config
+    measured only once) cannot gate."""
     checked, regressions = [], []
     for sid, points in sorted(series.items(), key=lambda kv: str(kv[0])):
+        lower_better = sid[3] in LATENCY_KEYS
+        better = (lambda a, b: a < b) if lower_better \
+            else (lambda a, b: a > b)
         rounds = {}
         for rnd, artifact, value in points:
             cur = rounds.get(rnd)
-            if cur is None or value > cur[1]:    # same-round dupes: best
+            if cur is None or better(value, cur[1]):  # same-round: best
                 rounds[rnd] = (artifact, value)
         if len(rounds) < 2:
             continue
         latest_round = max(rounds)
         latest_art, latest = rounds[latest_round]
-        base_round, (base_art, baseline) = max(
-            ((r, v) for r, v in rounds.items() if r != latest_round),
-            key=lambda rv: rv[1][1])
+        base_round, (base_art, baseline) = \
+            (min if lower_better else max)(
+                ((r, v) for r, v in rounds.items() if r != latest_round),
+                key=lambda rv: rv[1][1])
         delta = (latest - baseline) / baseline if baseline > 0 else 0.0
+        if lower_better:
+            delta = -delta      # normalized: negative delta == worse
         on_tpu, mode, batch, key = sid
         rec = {
             "series": {"on_tpu": on_tpu, "mode": mode, "batch": batch,

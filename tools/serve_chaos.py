@@ -1,0 +1,274 @@
+#!/usr/bin/env python
+"""Chaos SLO gate: the serving fleet must hold its contract under faults.
+
+    JAX_PLATFORMS=cpu python tools/serve_chaos.py
+
+The fleet-scope counterpart of tools/chaos_fit.py: stands up a REAL fleet
+(3 subprocess serving replicas — each its own OS process and XLA runtime —
+behind the ReplicaSupervisor + ResilientRouter), drives closed-loop
+priority-tagged traffic through the router, and mid-traffic:
+
+1. **SIGKILLs one replica** (machine-loss analog: no drain, no goodbye);
+2. **wedges another** via its fault endpoint (`POST /v1/faults` with
+   ``probe_delay_s`` + ``predict_delay_s`` — alive process, dead service:
+   probes and predicts hang past every deadline).
+
+The SLO asserted from the traffic log and the router's /metrics:
+
+- **zero 5xx**: every response is 200 or explicit backpressure (429
+  shed / 503 no-backend) — faults never surface as server errors;
+- the killed AND the wedged replica are **restarted and rejoin** (state
+  ready, generation bumped) within the recovery budget, proven by
+  ``serving_fleet_restarts_total`` and live /readyz;
+- the breaker state gauge and per-class shed counters are exposed, and
+  shedding hit the LOW class (`serving_router_shed_total{cls="batch"}`);
+- **post-fault p99 recovers** to within a CI-noise multiple of the
+  pre-fault baseline.
+
+Prints a JSON report (with a bench-style "sweep" row carrying
+``chaos_p99_under_fault_ms`` / ``chaos_goodput_under_fault_rps`` /
+``chaos_recovered_p99_ms`` so the driver can bank it as CHAOS_r*.json for
+tools/perf_report.py's regression gate). Exit 0 iff every SLO held.
+"""
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+
+N_IN, N_OUT = 6, 3
+RECOVERY_BUDGET_S = 150.0       # CPU CI: replica relaunch pays a jax import
+
+
+def _metric_total(metrics: str, prefix: str, contains: str = "") -> float:
+    total = 0.0
+    for line in metrics.splitlines():
+        if line.startswith(prefix) and not line.startswith("# ") \
+                and contains in line:
+            try:
+                total += float(line.rsplit(" ", 1)[1])
+            except ValueError:
+                pass
+    return total
+
+
+def main() -> int:
+    import numpy as np
+
+    from bench import cache_dir
+    from deeplearning4j_tpu.nn.conf.base import InputType
+    from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.updaters import Adam
+    from deeplearning4j_tpu.serving import (
+        ReplicaSpec, ReplicaSupervisor, ResilientRouter, RouterServer,
+        SubprocessReplica,
+    )
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from serve_loadgen import LoadGen
+
+    failures = []
+    summary = {}
+
+    conf = (NeuralNetConfiguration.Builder().seed(0).updater(Adam(1e-2))
+            .list()
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=N_OUT, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.feed_forward(N_IN)).build())
+    net = MultiLayerNetwork(conf).init()
+    tmp = tempfile.mkdtemp(prefix="serve_chaos_")
+    model_zip = os.path.join(tmp, "model.zip")
+    from deeplearning4j_tpu.util.serialization import save_model
+    save_model(net, model_zip)
+
+    env = dict(os.environ)
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", cache_dir())
+    spec = ReplicaSpec([("m", model_zip)], buckets=(1, 8),
+                       max_delay_ms=2.0, queue_limit=64,
+                       default_deadline_s=30.0, enable_faults=True)
+    supervisor = ReplicaSupervisor(
+        lambda i: SubprocessReplica(f"replica-{i}", spec, env=env),
+        n_replicas=3, probe_interval_s=0.5, probe_timeout_s=2.0,
+        unhealthy_after=3, restart_backoff_s=0.5, restart_budget=6)
+    t0 = time.perf_counter()
+    supervisor.start()
+    summary["fleet_start_s"] = round(time.perf_counter() - t0, 1)
+
+    router = ResilientRouter(
+        supervisor.healthy, classes=("interactive", "batch"),
+        default_class="interactive", shed_floor=0.5,
+        per_replica_inflight=4, hedge=True, hedge_min_s=0.2,
+        timeout_s=30.0, breaker_open_for_s=3.0)
+    server = RouterServer(router, supervisor=supervisor, port=0)
+
+    class Args:                      # LoadGen's knob surface, programmatic
+        url = server.url
+        model = "m"
+        requests = 120
+        concurrency = 6
+        rate = None
+        batch_sizes = [1, 2, 4]
+        priority_mix = {"interactive": 1, "batch": 1}
+        max_retries = 4
+        retry_cap_s = 2.0
+        deadline_ms = None
+        timeout_s = 60.0
+        seed = 0
+
+    try:
+        # ---------------- phase A: pre-fault baseline -------------------
+        base = LoadGen(Args, (N_IN,))
+        wall, ok = base.run_closed()
+        base_rep = base.report(wall, ok)
+        summary["baseline"] = {"ok": ok, "codes": base_rep["codes"],
+                               "p99_ms": base_rep["latency_ms"]["p99"]}
+        if ok != Args.requests:
+            failures.append(f"baseline phase not clean: {base_rep['codes']}")
+
+        # ---------------- phase B: faults under traffic -----------------
+        chaos_args = type("C", (Args,), {"requests": 240,
+                                         "concurrency": 12,
+                                         "seed": 1})
+        chaos = LoadGen(chaos_args, (N_IN,))
+        faults_done = threading.Event()
+
+        def inject():
+            time.sleep(0.5)          # traffic flowing first
+            victim = supervisor.replicas[0]
+            victim_gen = victim.generation
+            victim.proc.kill()       # machine loss: SIGKILL, no drain
+            wedged = supervisor.replicas[1]
+            wedged_gen = wedged.generation
+            try:
+                urllib.request.urlopen(urllib.request.Request(
+                    wedged.url + "/v1/faults",
+                    data=json.dumps({"probe_delay_s": 5.0,
+                                     "predict_delay_s": 5.0}).encode(),
+                    headers={"Content-Type": "application/json"}),
+                    timeout=10).read()
+            except Exception as e:   # noqa: BLE001
+                failures.append(f"could not wedge replica-1: {e}")
+            summary["faults"] = {"killed": victim.name,
+                                 "killed_gen": victim_gen,
+                                 "wedged": wedged.name,
+                                 "wedged_gen": wedged_gen}
+            faults_done.set()
+
+        injector = threading.Thread(target=inject, daemon=True)
+        injector.start()
+        fault_wall, fault_ok = chaos.run_closed()
+        injector.join(timeout=30)
+        # keep offering traffic until both faulted replicas rejoined (the
+        # rejoin-within-budget half of the SLO) — stats accumulate
+        deadline = time.monotonic() + RECOVERY_BUDGET_S
+        extra_walls = 0.0
+
+        def recovered() -> bool:
+            a, b = supervisor.replicas[0], supervisor.replicas[1]
+            return a.generation >= 1 and a.state == "ready" \
+                and b.generation >= 1 and b.state == "ready"
+
+        while not recovered() and time.monotonic() < deadline:
+            w, o = chaos.run_closed()
+            extra_walls += w
+            fault_ok += o
+        chaos_rep = chaos.report(fault_wall + extra_walls, fault_ok)
+        summary["under_fault"] = {
+            "requests_total": sum(
+                v for v in chaos.codes.values()),
+            "codes": chaos_rep["codes"],
+            "error_classes": chaos_rep["error_classes"],
+            "retries": chaos_rep["retries"],
+            "p99_ms": chaos_rep["latency_ms"]["p99"],
+            "goodput_rps": chaos_rep["goodput_rps"],
+            "per_class": chaos_rep.get("per_class"),
+        }
+        bad = {c: n for c, n in chaos.codes.items()
+               if isinstance(c, int) and c >= 500 and c not in (503,)}
+        if bad:
+            failures.append(f"5xx under fault: {bad} (contract: only "
+                            "200/429/503)")
+        if chaos.codes.get("transport"):
+            failures.append(
+                f"{chaos.codes['transport']} transport-level failures "
+                "reached the client through the router")
+        if not recovered():
+            failures.append(
+                "faulted replicas did not rejoin within "
+                f"{RECOVERY_BUDGET_S:.0f}s: "
+                f"{[r.describe() for r in supervisor.replicas]}")
+        summary["recovery"] = {
+            "replicas": [r.describe() for r in supervisor.replicas]}
+
+        # ---------------- phase C: post-fault recovery ------------------
+        rec_args = type("R", (Args,), {"seed": 2})
+        rec = LoadGen(rec_args, (N_IN,))
+        wall, ok = rec.run_closed()
+        rec_rep = rec.report(wall, ok)
+        summary["recovered"] = {"ok": ok, "codes": rec_rep["codes"],
+                                "p99_ms": rec_rep["latency_ms"]["p99"]}
+        if ok != Args.requests:
+            failures.append(
+                f"post-fault phase not clean: {rec_rep['codes']}")
+        base_p99 = base_rep["latency_ms"]["p99"] or 0.0
+        rec_p99 = rec_rep["latency_ms"]["p99"] or float("inf")
+        p99_budget = max(3.0 * base_p99, base_p99 + 500.0)
+        if rec_p99 > p99_budget:
+            failures.append(
+                f"post-fault p99 {rec_p99:.1f}ms did not recover "
+                f"(baseline {base_p99:.1f}ms, budget {p99_budget:.1f}ms)")
+
+        # ---------------- metrics assertions ----------------------------
+        metrics = urllib.request.urlopen(server.url + "/metrics",
+                                         timeout=10).read().decode()
+        restarts = _metric_total(metrics, "serving_fleet_restarts_total")
+        summary["fleet_restarts_total"] = restarts
+        if restarts < 2:
+            failures.append(f"expected >= 2 supervised restarts (kill + "
+                            f"wedge), /metrics shows {restarts}")
+        if "serving_router_breaker_state" not in metrics:
+            failures.append("/metrics missing serving_router_breaker_state")
+        shed_batch = _metric_total(metrics, "serving_router_shed_total",
+                                   contains='cls="batch"')
+        shed_inter = _metric_total(metrics, "serving_router_shed_total",
+                                   contains='cls="interactive"')
+        summary["shed"] = {"batch": shed_batch, "interactive": shed_inter}
+        if shed_batch == 0:
+            failures.append("fleet saturation never shed the batch class "
+                            "(serving_router_shed_total{cls=batch} == 0)")
+        for fam in ("serving_fleet_replicas", "serving_fleet_probe_seconds",
+                    "serving_router_requests_total"):
+            if fam not in metrics:
+                failures.append(f"/metrics missing {fam}")
+    finally:
+        supervisor.stop()
+        server.stop()
+
+    summary["ok"] = not failures
+    summary["failures"] = failures
+    # bench-style row so the driver can bank this run as CHAOS_r*.json and
+    # tools/perf_report.py can gate the chaos-SLO trajectory
+    summary["sweep"] = [{
+        "mode": "serve_chaos", "on_tpu": False, "batch": None,
+        "chaos_p99_under_fault_ms": summary.get(
+            "under_fault", {}).get("p99_ms"),
+        "chaos_goodput_under_fault_rps": summary.get(
+            "under_fault", {}).get("goodput_rps"),
+        "chaos_recovered_p99_ms": summary.get(
+            "recovered", {}).get("p99_ms"),
+    }]
+    print(json.dumps(summary, indent=1))
+    return 0 if not failures else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -3,17 +3,29 @@
 
 Closed loop (default): N worker threads each keep one request in flight —
 measures the server's saturated throughput and latency under a fixed
-concurrency. Open loop: requests fire on a fixed arrival schedule
-regardless of completions (the honest way to measure tail latency at a
-target offered rate — a closed loop self-throttles when the server slows,
-hiding queueing collapse).
+concurrency. A closed-loop client is a *polite* client: on 429/503 it
+honors the server's ``Retry-After`` hint (jittered server-side exactly so
+shed clients don't stampede back in sync) before retrying, up to
+``--max-retries`` per logical request. Open loop: requests fire on a fixed
+arrival schedule regardless of completions (the honest way to measure tail
+latency at a target offered rate — a closed loop self-throttles when the
+server slows, hiding queueing collapse); open loop never retries, an
+offered request is an offered request.
+
+Priority classes: ``--priority-mix interactive=3,batch=1`` tags requests
+with ``X-Priority`` headers in a deterministic weighted cycle and reports
+latency percentiles and an error breakdown *per class* — the view that
+shows shedding hitting the batch tier while interactive p99 holds.
 
     python tools/serve_loadgen.py --url http://127.0.0.1:8500 \
-        --model lenet --requests 500 --concurrency 8 [--rate 200]
+        --model lenet --requests 500 --concurrency 8 [--rate 200] \
+        [--priority-mix interactive=3,batch=1]
 
-Reports p50/p90/p99 latency, goodput (2xx/sec over the wall clock), and a
-status-code histogram as JSON on stdout. Exit 0 iff every request
-succeeded (2xx), so CI can use it as an assertion.
+Reports p50/p90/p99 latency, goodput (2xx/sec over the wall clock), a
+status-code histogram, and an error-class taxonomy (429 shed / 503
+unavailable / 504 deadline / 5xx server / transport) as JSON on stdout.
+Exit 0 iff every request ultimately succeeded (2xx; shed-then-retried-ok
+counts as ok), so CI can use it as an assertion.
 """
 from __future__ import annotations
 
@@ -36,6 +48,33 @@ def percentile(xs, p):
     return xs[i]
 
 
+def classify(code) -> str:
+    """Error taxonomy: what *kind* of failure (or backpressure) was it."""
+    if isinstance(code, int):
+        if 200 <= code < 300:
+            return "ok"
+        if code == 429:
+            return "shed_429"
+        if code == 503:
+            return "unavailable_503"
+        if code == 504:
+            return "deadline_504"
+        if 500 <= code < 600:
+            return "server_5xx"
+        return f"client_{code}"
+    return "transport"
+
+
+def _latency_stats(lat_s):
+    ms = [v * 1e3 for v in lat_s]
+    return {
+        "p50": round(percentile(ms, 50), 3) if ms else None,
+        "p90": round(percentile(ms, 90), 3) if ms else None,
+        "p99": round(percentile(ms, 99), 3) if ms else None,
+        "max": round(max(ms), 3) if ms else None,
+    }
+
+
 class LoadGen:
     def __init__(self, args, input_shape):
         self.args = args
@@ -44,40 +83,100 @@ class LoadGen:
                     + (f"?deadline_ms={args.deadline_ms}"
                        if args.deadline_ms else ""))
         self.lock = threading.Lock()
-        self.latencies = []             # seconds, successful only
+        self.latencies = {}             # class -> [seconds], 2xx only
         self.codes = {}
+        self.class_codes = {}           # class -> {taxonomy: count}
+        self.retries = 0
+        self.retry_wait_s = 0.0
+        self.issued = 0        # logical requests, across every run_* call
         self.rs = np.random.RandomState(args.seed)
         self.bodies = [
             json.dumps({"inputs": self.rs.rand(
                 b, *self.input_shape).astype("float32").tolist()}).encode()
             for b in (args.batch_sizes or [1])
         ]
+        # deterministic weighted cycle of priority classes (None = no
+        # header) so runs are reproducible request-for-request
+        mix = args.priority_mix or {}
+        self.class_cycle = [c for c, w in sorted(mix.items())
+                            for _ in range(w)] or [None]
 
-    def one(self, i: int):
+    def _class_of(self, i: int):
+        return self.class_cycle[i % len(self.class_cycle)]
+
+    def _send(self, i: int):
+        """One HTTP attempt: (code_or_'transport', latency_s,
+        retry_after_s_or_None)."""
         body = self.bodies[i % len(self.bodies)]
+        headers = {"Content-Type": "application/json"}
+        cls = self._class_of(i)
+        if cls is not None:
+            headers["X-Priority"] = cls
         t0 = time.perf_counter()
+        retry_after = None
         try:
             r = urllib.request.urlopen(urllib.request.Request(
-                self.url, data=body,
-                headers={"Content-Type": "application/json"}),
+                self.url, data=body, headers=headers),
                 timeout=self.args.timeout_s)
             code = r.status
             r.read()
         except urllib.error.HTTPError as e:
             code = e.code
+            retry_after = e.headers.get("Retry-After")
             e.read()
         except Exception:               # connection refused/reset, timeout
             code = 0
-        dt = time.perf_counter() - t0
+        return code, time.perf_counter() - t0, retry_after
+
+    def _record(self, i: int, code, dt: float):
+        cls = self._class_of(i) or "default"
+        kind = classify(code if code != 0 else "transport")
         with self.lock:
-            self.codes[code] = self.codes.get(code, 0) + 1
-            if 200 <= code < 300:
-                self.latencies.append(dt)
+            key = code if code != 0 else "transport"
+            self.codes[key] = self.codes.get(key, 0) + 1
+            self.class_codes.setdefault(cls, {})
+            self.class_codes[cls][kind] = \
+                self.class_codes[cls].get(kind, 0) + 1
+            if isinstance(code, int) and 200 <= code < 300:
+                self.latencies.setdefault(cls, []).append(dt)
+
+    def one_closed(self, i: int) -> bool:
+        """One logical request, honoring Retry-After backpressure. Every
+        ATTEMPT is recorded in the code histogram; returns True iff the
+        request ultimately succeeded."""
+        with self.lock:
+            self.issued += 1
+        attempts = 0
+        while True:
+            code, dt, retry_after = self._send(i)
+            self._record(i, code, dt)
+            if isinstance(code, int) and 200 <= code < 300:
+                return True
+            if code not in (429, 503) or attempts >= self.args.max_retries:
+                return False
+            attempts += 1
+            try:
+                wait = min(float(retry_after), self.args.retry_cap_s) \
+                    if retry_after else 0.1
+            except ValueError:
+                wait = 0.1
+            with self.lock:
+                self.retries += 1
+                self.retry_wait_s += wait
+            time.sleep(wait)
+
+    def one_open(self, i: int) -> bool:
+        with self.lock:
+            self.issued += 1
+        code, dt, _ = self._send(i)
+        self._record(i, code, dt)
+        return isinstance(code, int) and 200 <= code < 300
 
     def run_closed(self):
         n = self.args.requests
         counter = iter(range(n))
         counter_lock = threading.Lock()
+        ok = [0]
 
         def worker():
             while True:
@@ -85,7 +184,9 @@ class LoadGen:
                     i = next(counter, None)
                 if i is None:
                     return
-                self.one(i)
+                if self.one_closed(i):
+                    with self.lock:
+                        ok[0] += 1
 
         threads = [threading.Thread(target=worker, daemon=True)
                    for _ in range(self.args.concurrency)]
@@ -94,23 +195,80 @@ class LoadGen:
             t.start()
         for t in threads:
             t.join()
-        return time.perf_counter() - t0
+        return time.perf_counter() - t0, ok[0]
 
     def run_open(self):
         period = 1.0 / self.args.rate
         threads = []
+        ok = [0]
+
+        def fire(i):
+            if self.one_open(i):
+                with self.lock:
+                    ok[0] += 1
+
         t0 = time.perf_counter()
         for i in range(self.args.requests):
             target = t0 + i * period
             delay = target - time.perf_counter()
             if delay > 0:
                 time.sleep(delay)
-            t = threading.Thread(target=self.one, args=(i,), daemon=True)
+            t = threading.Thread(target=fire, args=(i,), daemon=True)
             t.start()
             threads.append(t)
         for t in threads:
             t.join(timeout=self.args.timeout_s + 5)
-        return time.perf_counter() - t0
+        return time.perf_counter() - t0, ok[0]
+
+    def report(self, wall: float, ok: int) -> dict:
+        all_lat = [v for lats in self.latencies.values() for v in lats]
+        taxonomy = {}
+        for cls_counts in self.class_codes.values():
+            for kind, cnt in cls_counts.items():
+                taxonomy[kind] = taxonomy.get(kind, 0) + cnt
+        rep = {
+            "mode": "open" if self.args.rate else "closed",
+            # issued, not args.requests: callers (serve_chaos) accumulate
+            # several run_closed() passes into one LoadGen/report
+            "requests": self.issued,
+            "ok": ok,
+            "errors": self.issued - ok,
+            "codes": {str(k): v for k, v in sorted(
+                self.codes.items(), key=lambda kv: str(kv[0]))},
+            "error_classes": dict(sorted(taxonomy.items())),
+            "retries": self.retries,
+            "retry_wait_s": round(self.retry_wait_s, 3),
+            "wall_s": round(wall, 3),
+            "goodput_rps": round(ok / wall, 2) if wall > 0 else None,
+            "latency_ms": _latency_stats(all_lat),
+        }
+        if len(self.class_cycle) > 1 or self.class_cycle[0] is not None:
+            rep["per_class"] = {
+                cls: {"latency_ms": _latency_stats(
+                          self.latencies.get(cls, [])),
+                      "outcomes": dict(sorted(counts.items()))}
+                for cls, counts in sorted(self.class_codes.items())}
+        return rep
+
+
+def parse_priority_mix(spec):
+    """``interactive=3,batch=1`` -> {"interactive": 3, "batch": 1}."""
+    if not spec:
+        return {}
+    mix = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, sep, w = part.partition("=")
+        try:
+            mix[name.strip()] = int(w) if sep else 1
+        except ValueError:
+            raise SystemExit(
+                f"--priority-mix expects CLASS=WEIGHT, got {part!r}")
+        if mix[name.strip()] < 1:
+            raise SystemExit(f"--priority-mix weight must be >= 1: {part!r}")
+    return mix
 
 
 def main(argv=None) -> int:
@@ -126,11 +284,20 @@ def main(argv=None) -> int:
                    help="comma ints; default: ask GET /v1/models/{name}")
     p.add_argument("--batch-sizes", default="1,2,4",
                    help="cycle of per-request batch sizes")
+    p.add_argument("--priority-mix", default=None,
+                   help="weighted X-Priority cycle, e.g. "
+                        "interactive=3,batch=1 (default: no header)")
+    p.add_argument("--max-retries", type=int, default=3,
+                   help="closed-loop retries of a 429/503 (honoring "
+                        "Retry-After) before the request counts failed")
+    p.add_argument("--retry-cap-s", type=float, default=5.0,
+                   help="cap on a single honored Retry-After wait")
     p.add_argument("--deadline-ms", type=float, default=None)
     p.add_argument("--timeout-s", type=float, default=30.0)
     p.add_argument("--seed", type=int, default=0)
     args = p.parse_args(argv)
     args.batch_sizes = [int(b) for b in str(args.batch_sizes).split(",") if b]
+    args.priority_mix = parse_priority_mix(args.priority_mix)
 
     if args.input_shape:
         shape = tuple(int(s) for s in args.input_shape.split(",") if s)
@@ -140,25 +307,8 @@ def main(argv=None) -> int:
         shape = tuple(meta["input_shape"])
 
     gen = LoadGen(args, shape)
-    wall = gen.run_open() if args.rate else gen.run_closed()
-    ok = sum(n for c, n in gen.codes.items() if 200 <= c < 300)
-    lat_ms = [l * 1e3 for l in gen.latencies]
-    report = {
-        "mode": "open" if args.rate else "closed",
-        "requests": args.requests,
-        "ok": ok,
-        "errors": args.requests - ok,
-        "codes": {str(k): v for k, v in sorted(gen.codes.items())},
-        "wall_s": round(wall, 3),
-        "goodput_rps": round(ok / wall, 2) if wall > 0 else None,
-        "latency_ms": {
-            "p50": round(percentile(lat_ms, 50), 3) if lat_ms else None,
-            "p90": round(percentile(lat_ms, 90), 3) if lat_ms else None,
-            "p99": round(percentile(lat_ms, 99), 3) if lat_ms else None,
-            "max": round(max(lat_ms), 3) if lat_ms else None,
-        },
-    }
-    print(json.dumps(report, indent=1))
+    wall, ok = gen.run_open() if args.rate else gen.run_closed()
+    print(json.dumps(gen.report(wall, ok), indent=1))
     return 0 if ok == args.requests else 1
 
 

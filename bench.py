@@ -58,6 +58,23 @@ TARGET = 0.8 * ASSUMED_A100_IMGS_SEC   # north-star floor
 PEAK_FLOPS = {"TPU v5 lite": 197e12}   # bf16 peak per chip
 
 
+def _load_env_accessors():
+    """util/env.py loaded standalone (importlib, no package import): the
+    orchestrator must never import the package root — that pulls jax,
+    and a wedged axon tunnel can hang jax import/device init (the whole
+    reason every timed config runs in a subprocess)."""
+    import importlib.util
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "deeplearning4j_tpu", "util", "env.py")
+    spec = importlib.util.spec_from_file_location("_dl4j_tpu_env", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+ENV = _load_env_accessors()
+
+
 def cache_dir() -> str:
     """Default persistent XLA compile-cache dir, shared by the bench, the
     test suite (tests/conftest.py) and the driver hooks (__graft_entry__)
@@ -92,10 +109,10 @@ def probe_tpu(attempts: int = None, probe_timeout: int = None,
     """Check the TPU backend comes up, in a subprocess with a hard timeout
     so a wedged tunnel cannot hang the bench process itself. Returns True
     once a probe sees a non-cpu device; False after all attempts fail."""
-    attempts = attempts or int(os.environ.get("DL4J_TPU_BENCH_PROBES", "4"))
-    probe_timeout = probe_timeout or int(
-        os.environ.get("DL4J_TPU_BENCH_PROBE_TIMEOUT", "240"))
-    backoff = backoff or int(os.environ.get("DL4J_TPU_BENCH_BACKOFF", "30"))
+    attempts = attempts or ENV.env_int("DL4J_TPU_BENCH_PROBES", 4)
+    probe_timeout = probe_timeout or ENV.env_int(
+        "DL4J_TPU_BENCH_PROBE_TIMEOUT", 240)
+    backoff = backoff or ENV.env_int("DL4J_TPU_BENCH_BACKOFF", 30)
     # NB: the axon TPU plugin force-appends itself to jax_platforms at
     # import, overriding JAX_PLATFORMS=cpu — pin the config back when the
     # caller explicitly forced CPU so a wedged tunnel can't hang the probe
@@ -185,8 +202,7 @@ def _bench_env():
     per-kind runners can't drift apart."""
     import jax
     on_tpu = jax.devices()[0].platform != "cpu"
-    best_of = int(os.environ.get("DL4J_TPU_BENCH_BEST_OF",
-                                 "3" if on_tpu else "1"))
+    best_of = ENV.env_int("DL4J_TPU_BENCH_BEST_OF", 3 if on_tpu else 1)
     return on_tpu, best_of
 
 
@@ -212,7 +228,7 @@ def _run_resnet(cfg):
 
     # DL4J_TPU_BENCH_S2D=1: MLPerf-style space-to-depth stem (exactly
     # equivalent model, MXU-friendlier head conv) for hardware A/B
-    s2d = os.environ.get("DL4J_TPU_BENCH_S2D", "0") == "1"
+    s2d = ENV.env_flag("DL4J_TPU_BENCH_S2D", default=False)
     model = ResNet50(num_classes=1000, input_shape=(hw, hw, 3),
                      space_to_depth_stem=s2d)
     conf = model.conf()
@@ -249,6 +265,7 @@ def _run_resnet(cfg):
         p, o, s = net.params, net.opt_state, net.state
         rng = jax.random.PRNGKey(0)
         if mode == "per-call":
+            # graftlint: disable=donated-aliasing -- p/o/s come from net.init() on-device in this subprocess; no host/deserialized leaf reaches the donated args, and an own_tree copy would distort the measured steady state
             jstep = jax.jit(raw_step, donate_argnums=(0, 1, 2))
             # warmup / compile (float() is a host fetch = hard barrier;
             # block_until_ready is unreliable through the axon tunnel)
@@ -628,9 +645,7 @@ def _fit_e2e_lenet(on_tpu, best_of, tmp):
 
     # ---- baseline: the per-sample PIL loop (in-process, workers off;
     # the caller's worker-count setting is restored afterwards)
-    prev_workers = os.environ.get("DL4J_TPU_ETL_WORKERS")
-    os.environ["DL4J_TPU_ETL_WORKERS"] = "0"
-    try:
+    with ENV.scoped("DL4J_TPU_ETL_WORKERS", "0"):
         net = _net()
         base_it = _reader_it()
         net.fit(base_it, epochs=1)          # compile + warm
@@ -644,11 +659,6 @@ def _fit_e2e_lenet(on_tpu, best_of, tmp):
 
         out["fit_e2e_baseline_imgs_sec"] = round(
             n / _timed_best(run_base, best_of), 1)
-    finally:
-        if prev_workers is None:
-            del os.environ["DL4J_TPU_ETL_WORKERS"]
-        else:
-            os.environ["DL4J_TPU_ETL_WORKERS"] = prev_workers
 
     # ---- the shard data plane: convert once, then stream whole batches
     # through the multi-process ring into the default device prefetch
@@ -858,16 +868,16 @@ def run_one(cfg):
     # timed regions (captures happen during warmup; the steady-state cost
     # is a dict hit + gauge set per chunk). DL4J_TPU_BENCH_LEDGER=0
     # disables; DL4J_TPU_PERF_LEDGER=PATH additionally persists the JSON.
-    ledger_on = os.environ.get("DL4J_TPU_BENCH_LEDGER", "1") == "1"
+    ledger_on = ENV.env_flag("DL4J_TPU_BENCH_LEDGER")
     if ledger_on:
         from deeplearning4j_tpu.monitor import xla as xla_ledger
-        xla_ledger.enable_ledger(os.environ.get("DL4J_TPU_PERF_LEDGER"))
+        xla_ledger.enable_ledger(ENV.env_str("DL4J_TPU_PERF_LEDGER"))
     res = _KIND_RUNNERS[cfg["kind"]](cfg)
     if ledger_on:
         progs = [r.brief() for r in xla_ledger.records()]
         if progs:
             res["xla_programs"] = progs
-        if os.environ.get("DL4J_TPU_PERF_LEDGER"):
+        if ENV.env_str("DL4J_TPU_PERF_LEDGER"):
             try:
                 # merge: every sweep config is its own subprocess writing
                 # the SAME file — a plain overwrite would keep only the
@@ -929,7 +939,7 @@ def _canon_mode(cfg, scan_k):
 
 
 def _configs(on_tpu):
-    batches = [int(b) for b in os.environ.get(
+    batches = [int(b) for b in ENV.env_str(
         "DL4J_TPU_BENCH_BATCHES",
         "128,256" if on_tpu else "8").split(",")]
     b0 = batches[0]
@@ -940,22 +950,21 @@ def _configs(on_tpu):
     cfgs = [{"kind": "resnet", "batch": b0, "mode": "per-call"},
             {"kind": "resnet", "batch": b0, "mode": "scan"},
             {"kind": "resnet", "batch": b0, "mode": "fit"}]
-    if os.environ.get("DL4J_TPU_BENCH_H2D", "1") == "1":
+    if ENV.env_flag("DL4J_TPU_BENCH_H2D"):
         cfgs.append({"kind": "h2d"})   # cheap; attributes the fit number
-    if os.environ.get("DL4J_TPU_BENCH_ATTENTION",
-                      "1" if on_tpu else "0") == "1":
+    if ENV.env_flag("DL4J_TPU_BENCH_ATTENTION", default=on_tpu):
         cfgs.append({"kind": "attention"})
     for b in batches[1:]:
         cfgs += [{"kind": "resnet", "batch": b, "mode": "per-call"},
                  {"kind": "resnet", "batch": b, "mode": "scan"},
                  {"kind": "resnet", "batch": b, "mode": "fit"}]
-    if os.environ.get("DL4J_TPU_BENCH_LSTM", "1") == "1":
+    if ENV.env_flag("DL4J_TPU_BENCH_LSTM"):
         cfgs.append({"kind": "char-lstm"})
-    if os.environ.get("DL4J_TPU_BENCH_W2V", "1") == "1":
+    if ENV.env_flag("DL4J_TPU_BENCH_W2V"):
         cfgs.append({"kind": "word2vec"})
-    if os.environ.get("DL4J_TPU_BENCH_LENET", "1") == "1":
+    if ENV.env_flag("DL4J_TPU_BENCH_LENET"):
         cfgs.append({"kind": "lenet"})
-    if os.environ.get("DL4J_TPU_BENCH_FIT_E2E", "1") == "1":
+    if ENV.env_flag("DL4J_TPU_BENCH_FIT_E2E"):
         # the product-path (incl. ETL) rows for the three BASELINE
         # configs — ROADMAP item 3's fit()-end-to-end series
         cfgs += [{"kind": "fit_e2e", "model": m}
@@ -969,10 +978,9 @@ def main(mode: str = None):
     None runs the full sweep."""
     _install_sigterm_handler()
     tpu_up = probe_tpu()
-    cfg_timeout = int(os.environ.get("DL4J_TPU_BENCH_CONFIG_TIMEOUT",
-                                     "1800"))
-    partial_path = os.environ.get("DL4J_TPU_BENCH_PARTIAL",
-                                  "/tmp/bench_partial.jsonl")
+    cfg_timeout = ENV.env_int("DL4J_TPU_BENCH_CONFIG_TIMEOUT", 1800)
+    partial_path = ENV.env_str("DL4J_TPU_BENCH_PARTIAL",
+                               "/tmp/bench_partial.jsonl")
     env = dict(os.environ)
     env.setdefault("JAX_COMPILATION_CACHE_DIR", cache_dir())
     if not tpu_up:

@@ -1,0 +1,31 @@
+"""graftlint rule registry — one module per bug-class family.
+
+Every rule encodes a defect this repo actually shipped (the historical
+note on each Rule subclass names the PR). Adding a rule: subclass
+`analysis.core.Rule`, register it in ALL_RULES here, add a
+positive+negative fixture pair under tests/fixtures/graftlint/, and
+document it in docs/STATIC_ANALYSIS.md.
+"""
+from deeplearning4j_tpu.analysis.rules.donation import DonatedAliasingRule
+from deeplearning4j_tpu.analysis.rules.envknobs import EnvKnobContractRule
+from deeplearning4j_tpu.analysis.rules.excepts import BareExceptSwallowRule
+from deeplearning4j_tpu.analysis.rules.hotpath import (
+    HostSyncInHotPathRule, RecompileHazardRule,
+)
+from deeplearning4j_tpu.analysis.rules.locks import BlockingUnderLockRule
+from deeplearning4j_tpu.analysis.rules.telemetry import (
+    MetricFamilyRegistrationRule, TelemetryZeroCostRule,
+)
+
+ALL_RULES = [
+    DonatedAliasingRule(),
+    HostSyncInHotPathRule(),
+    RecompileHazardRule(),
+    EnvKnobContractRule(),
+    BlockingUnderLockRule(),
+    TelemetryZeroCostRule(),
+    BareExceptSwallowRule(),
+    MetricFamilyRegistrationRule(),
+]
+
+__all__ = ["ALL_RULES"]

@@ -31,7 +31,6 @@ Environment knobs of the default data plane — the one reference list
 """
 from __future__ import annotations
 
-import os
 import queue
 import threading
 import time
@@ -39,6 +38,8 @@ from typing import Optional
 
 import jax
 import numpy as np
+
+from deeplearning4j_tpu.util.env import env_flag, env_int
 
 from deeplearning4j_tpu.data.dataset import DataSet
 from deeplearning4j_tpu.data.iterator import DataSetIterator
@@ -50,10 +51,7 @@ def prefetch_depth(default: int = 2) -> int:
     """Resolve DL4J_TPU_PREFETCH_DEPTH (default 2: double-buffered).
     0 disables prefetching — the same kill-switch contract as
     DL4J_TPU_HOST_CAST / DL4J_TPU_DEVICE_NORM (module docstring)."""
-    v = os.environ.get("DL4J_TPU_PREFETCH_DEPTH")
-    if v is None or v == "":
-        return default
-    return max(0, int(v))
+    return max(0, env_int("DL4J_TPU_PREFETCH_DEPTH", default))
 
 
 def fit_prefetch_enabled() -> bool:
@@ -61,7 +59,7 @@ def fit_prefetch_enabled() -> bool:
     of the module docstring: ONLY ``"0"`` disables; unset/empty/anything
     else leaves the default fit() async wrap on. The single rule for
     both fit gates (nn/multilayer.py, nn/graph.py)."""
-    return os.environ.get("DL4J_TPU_FIT_PREFETCH", "") != "0"
+    return env_flag("DL4J_TPU_FIT_PREFETCH")
 
 
 def host_cast(a, dtype):
@@ -74,7 +72,7 @@ def host_cast(a, dtype):
     if (dtype is not None and isinstance(a, np.ndarray)
             and a.dtype == np.float32
             and np.dtype(dtype).itemsize == 2
-            and os.environ.get("DL4J_TPU_HOST_CAST", "1") == "1"):
+            and env_flag("DL4J_TPU_HOST_CAST")):
         return a.astype(dtype)
     return a
 

@@ -28,6 +28,7 @@ import numpy as np
 
 from deeplearning4j_tpu.data.dataset import DataSet
 from deeplearning4j_tpu.data.iterator import ArrayDataSetIterator
+from deeplearning4j_tpu.util.env import env_str
 
 
 def _u8_to_unit(a: np.ndarray) -> np.ndarray:
@@ -40,7 +41,7 @@ def _u8_to_unit(a: np.ndarray) -> np.ndarray:
 
 
 def data_dir() -> str:
-    return os.environ.get(
+    return env_str(
         "DL4J_TPU_DATA_DIR",
         os.path.expanduser("~/.deeplearning4j_tpu/datasets"))
 

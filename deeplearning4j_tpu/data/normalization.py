@@ -17,12 +17,12 @@ from __future__ import annotations
 
 import contextlib
 import json
-import os
 from typing import Optional
 
 import numpy as np
 
 from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
+from deeplearning4j_tpu.util.env import env_flag
 
 
 class DataSetPreProcessor:
@@ -110,7 +110,7 @@ def engaged_device_affine(iterator, listeners=()):
       already in the chain (a user-constructed wrap with cast_dtype set
       would otherwise bf16-quantize RAW features before the device
       affine — the cast-before-normalize bug) + restore in finally."""
-    if os.environ.get("DL4J_TPU_DEVICE_NORM", "1") == "0" \
+    if not env_flag("DL4J_TPU_DEVICE_NORM") \
             or any(getattr(lst, "reads_model", False) for lst in listeners):
         yield None
         return

@@ -73,6 +73,8 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
+from deeplearning4j_tpu.util.env import env_int, env_str
+
 from deeplearning4j_tpu.data.dataset import DataSet
 from deeplearning4j_tpu.data.iterator import DataSetIterator
 from deeplearning4j_tpu.data.shards import (
@@ -89,17 +91,17 @@ def etl_workers(n_records: Optional[int] = None) -> int:
     amortize worker startup (DL4J_TPU_ETL_MIN_RECORDS, default 512) —
     the fast path is the default path at production scale while tiny
     test datasets stay in-process."""
-    v = os.environ.get("DL4J_TPU_ETL_WORKERS") or "auto"  # ""=unset,
-    if v != "auto":                     # same as DL4J_TPU_PREFETCH_DEPTH
+    v = env_str("DL4J_TPU_ETL_WORKERS", "auto")
+    if v != "auto":
         return max(0, int(v))
-    floor = int(os.environ.get("DL4J_TPU_ETL_MIN_RECORDS") or "512")
+    floor = env_int("DL4J_TPU_ETL_MIN_RECORDS", 512)
     if n_records is None or n_records < floor:
         return 0
     return min(4, os.cpu_count() or 1)
 
 
 def _mp_context():
-    method = os.environ.get("DL4J_TPU_ETL_MP_START") or "spawn"
+    method = env_str("DL4J_TPU_ETL_MP_START", "spawn")
     return mp.get_context(method)
 
 
@@ -270,8 +272,8 @@ class MultiProcessDataSetIterator(EpochPositionMixin, DataSetIterator):
         self._workers_n = max(0, int(
             num_workers if num_workers is not None
             else etl_workers(self.n_batches * self._batch)))
-        self._slots_n = int(slots if slots is not None else os.environ.get(
-            "DL4J_TPU_ETL_RING_SLOTS") or self._workers_n + 2)
+        self._slots_n = int(slots if slots is not None else env_int(
+            "DL4J_TPU_ETL_RING_SLOTS", self._workers_n + 2))
         self._slots_n = max(2, self._slots_n)
         self._init_position()
         self._gen = 0
@@ -350,6 +352,7 @@ class MultiProcessDataSetIterator(EpochPositionMixin, DataSetIterator):
                 # everything still queued is stale now: workers skip-ack
                 self._gen_val.value = self._gen + 1
                 self._drain_inflight()
+            # graftlint: disable=bare-except-swallow -- best-effort drain while closing a possibly-dead pool; the finalizer (sentinels+join+unlink) still runs and close() must never raise over the original failure
             except Exception:
                 pass
             self._finalizer()       # sentinels + join + unlink, once

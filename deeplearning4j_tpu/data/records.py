@@ -21,6 +21,7 @@ import numpy as np
 
 from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
 from deeplearning4j_tpu.data.iterator import DataSetIterator
+from deeplearning4j_tpu.util.env import env_int
 
 log = logging.getLogger("deeplearning4j_tpu")
 
@@ -97,7 +98,7 @@ class CSVRecordReader(RecordReader):
         that produce float32 anyway (RecordReaderDataSetIterator)."""
         if not self.numeric:
             return None
-        limit = int(os.environ.get("DL4J_TPU_CSV_FAST_MAX_BYTES", 1 << 30))
+        limit = env_int("DL4J_TPU_CSV_FAST_MAX_BYTES", 1 << 30)
         try:
             stat = os.stat(self.path)
             if stat.st_size > limit:

@@ -126,6 +126,7 @@ class Glove(SequenceVectors):
                     jnp.asarray(pairs[selp, 0]), jnp.asarray(pairs[selp, 1]),
                     jnp.asarray(logx[selp]), jnp.asarray(fxb),
                     jnp.float32(self.learning_rate))
+                # graftlint: disable=host-sync-in-hot-path -- the step's ONE budgeted loss fetch (the deliberate per-iteration sync; PERF.md)
                 self.last_loss = float(loss)
         self.vectors = np.asarray(w) + np.asarray(wc)
         return self

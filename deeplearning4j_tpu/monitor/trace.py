@@ -79,6 +79,7 @@ class _Span:
         if self._ann is not None:
             try:
                 self._ann.__exit__(*exc)
+            # graftlint: disable=bare-except-swallow -- best-effort jax profiler annotation exit: a profiler failure must never break the traced code path (zero-cost contract)
             except Exception:
                 pass
         _record(self.name, self.t0, t1, self.args)

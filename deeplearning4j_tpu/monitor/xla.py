@@ -50,6 +50,7 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from deeplearning4j_tpu.monitor import metrics, trace
+from deeplearning4j_tpu.util.env import env_float
 
 log = logging.getLogger("deeplearning4j_tpu")
 
@@ -269,27 +270,39 @@ def _device() -> Tuple[Optional[str], Optional[str]]:
     return _device_info
 
 
+def _peak_override(var: str) -> Optional[float]:
+    """env_float, but a malformed value DEGRADES to the device table
+    with one warning instead of raising: these are telemetry overrides
+    read from the MFU accountant on the fit path — a typo'd knob must
+    never kill a training run (the fail-loud contract is for knobs read
+    at startup)."""
+    try:
+        return env_float(var)
+    except ValueError as e:
+        if var not in _warned_overrides:
+            _warned_overrides.add(var)
+            log.warning("%s — falling back to the device table", e)
+        return None
+
+
+_warned_overrides: set = set()
+
+
 def device_peak_flops() -> Optional[float]:
     """Peak FLOPs/s for MFU accounting: the env override
     DL4J_TPU_PEAK_FLOPS wins, then the per-device_kind table; None for
     unlisted devices (the MFU gauges are then simply not set)."""
-    env = os.environ.get("DL4J_TPU_PEAK_FLOPS")
-    if env:
-        try:
-            return float(env)
-        except ValueError:
-            pass
+    env = _peak_override("DL4J_TPU_PEAK_FLOPS")
+    if env is not None:
+        return env
     kind, _ = _device()
     return PEAK_FLOPS_BY_KIND.get(kind) if kind else None
 
 
 def device_hbm_bytes_per_sec() -> Optional[float]:
-    env = os.environ.get("DL4J_TPU_HBM_BYTES_PER_SEC")
-    if env:
-        try:
-            return float(env)
-        except ValueError:
-            pass
+    env = _peak_override("DL4J_TPU_HBM_BYTES_PER_SEC")
+    if env is not None:
+        return env
     kind, _ = _device()
     return HBM_BYTES_PER_SEC_BY_KIND.get(kind) if kind else None
 
@@ -348,6 +361,7 @@ def analyze_compiled(compiled):
             b = float(ca.get("bytes accessed",
                              ca.get("bytes_accessed", 0.0)))
             bytes_accessed = b if b > 0 else None
+    # graftlint: disable=bare-except-swallow -- capability probe: capture() counts the degradation via analysis_unavailable('cost') when flops comes back None
     except Exception:
         pass
     hbm = None
@@ -355,6 +369,7 @@ def analyze_compiled(compiled):
         ma = compiled.memory_analysis()
         if ma is not None:
             hbm = hbm_stats(ma)
+    # graftlint: disable=bare-except-swallow -- capability probe: capture() counts the degradation via analysis_unavailable('memory') when hbm comes back None
     except Exception:
         pass
     return flops, bytes_accessed, hbm

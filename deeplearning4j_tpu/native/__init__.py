@@ -31,7 +31,8 @@ _build_failed = False
 
 
 def _cache_dir() -> str:
-    d = os.environ.get(
+    from deeplearning4j_tpu.util.env import env_str
+    d = env_str(
         "DL4J_TPU_NATIVE_CACHE",
         os.path.join(os.path.expanduser("~"), ".cache",
                      "deeplearning4j_tpu"))

@@ -13,7 +13,6 @@ across vertices (DL4J walks GraphVertex objects at runtime instead).
 from __future__ import annotations
 
 import logging
-import os
 import time
 from typing import Any, Dict, List, Optional, Sequence
 
@@ -506,6 +505,7 @@ class ComputationGraph:
                     self.params, self.opt_state, self.state, inputs,
                     labels, fmasks, lmasks, sub, None)
                 sync_start = time.perf_counter()
+                # graftlint: disable=host-sync-in-hot-path -- the step's ONE budgeted loss fetch (the deliberate per-iteration sync; PERF.md) — bracketed by the train/host_sync span
                 self._score = float(loss)
                 step_end = time.perf_counter()
                 monitor.add_span("train/host_sync", sync_start, step_end)
@@ -714,6 +714,7 @@ class ComputationGraph:
                     xla_ledger.observe_step(rec, now - last_sync[0])
                 last_sync[0] = now
             for loss in arr:
+                # graftlint: disable=host-sync-in-hot-path -- chunk losses are already host-resident (np.asarray above IS the deferred chunk sync); this is per-iteration bookkeeping
                 self._score = float(loss)
                 _record_iteration(self._score, bs)
                 for lst in self.listeners:
@@ -836,6 +837,7 @@ class ComputationGraph:
                 clm, sub, carries)
             carries = jax.tree_util.tree_map(jax.lax.stop_gradient,
                                              new_carries)
+            # graftlint: disable=host-sync-in-hot-path -- the tbptt chunk's one budgeted loss fetch
             self._score = float(loss)
             _record_iteration(self._score, bs)
             for lst in self.listeners:

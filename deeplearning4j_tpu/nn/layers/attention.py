@@ -234,11 +234,11 @@ class MultiHeadAttention(LayerConf):
         # DL4J_TPU_FLASH=0 is the first-contact kill switch: if the Pallas
         # kernel miscompiles on real hardware, everything falls back to
         # the lax online-softmax paths without a code edit.
-        import os
+        from deeplearning4j_tpu.util.env import env_flag
         use_flash = (self.attention_impl in ("flash", "blockwise")
                      and drop == 0.0
                      and is_tpu_backend()
-                     and os.environ.get("DL4J_TPU_FLASH", "1") != "0")
+                     and env_flag("DL4J_TPU_FLASH"))
         if _CONTEXT_PARALLEL_AXIS is not None:
             if use_flash:
                 from deeplearning4j_tpu.parallel.ring import (

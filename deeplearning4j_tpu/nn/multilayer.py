@@ -20,7 +20,6 @@ DL4J's flattenedParams single buffer (:114,603-627).
 from __future__ import annotations
 
 import logging
-import os
 import time
 from typing import Any, Dict, List, Optional
 
@@ -40,6 +39,7 @@ from deeplearning4j_tpu.nn.conf.base import (
 from deeplearning4j_tpu.nn.conf.network import MultiLayerConfiguration
 from deeplearning4j_tpu.nn.updaters import build_optimizer, NoOp
 from deeplearning4j_tpu.util import params as param_util
+from deeplearning4j_tpu.util.env import env_int
 from deeplearning4j_tpu.util.platform import is_tpu_backend
 
 log = logging.getLogger("deeplearning4j_tpu")
@@ -201,9 +201,9 @@ def _default_scan_steps() -> int:
     pessimizes convolutions inside scan (10.9x slower, PERF.md
     "mechanism check"), so per-call stays the CPU default.
     DL4J_TPU_SCAN_STEPS overrides either way."""
-    env = os.environ.get("DL4J_TPU_SCAN_STEPS")
-    if env:
-        return int(env)
+    env = env_int("DL4J_TPU_SCAN_STEPS")
+    if env is not None:
+        return env
     # TPU only — GPU/other backends are unmeasured, and the CPU
     # mechanism check shows conv-in-scan can regress badly off-TPU
     return 10 if is_tpu_backend() else 1
@@ -774,6 +774,7 @@ class MultiLayerNetwork:
             else:
                 self.params, self.opt_state, self.state, loss, _ = out
             sync_start = time.perf_counter()
+            # graftlint: disable=host-sync-in-hot-path -- the step's ONE budgeted loss fetch (the deliberate per-iteration sync; PERF.md) — bracketed by the train/host_sync span
             self._score = float(loss)     # the step's one blocking fetch
             step_end = time.perf_counter()
             bs = int(np.shape(ds.features)[0])
@@ -1034,6 +1035,7 @@ class MultiLayerNetwork:
                     xla_ledger.observe_step(rec, now - last_sync[0])
                 last_sync[0] = now
             for loss in arr:
+                # graftlint: disable=host-sync-in-hot-path -- chunk losses are already host-resident (np.asarray above IS the deferred chunk sync); this is per-iteration bookkeeping
                 self._score = float(loss)
                 _record_iteration(self._score, bs)
                 for lst in self.listeners:
@@ -1133,6 +1135,7 @@ class MultiLayerNetwork:
                     _as_jnp(fm), _as_jnp(lm), sub, carries)
                 # stop gradient across chunk boundary
                 carries = jax.tree_util.tree_map(jax.lax.stop_gradient, new_carries)
+                # graftlint: disable=host-sync-in-hot-path -- the tbptt chunk's one budgeted loss fetch
                 self._score = float(loss)
                 _record_iteration(self._score, int(np.shape(x)[0]))
                 for lst in self.listeners:

@@ -31,6 +31,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from deeplearning4j_tpu.util.env import env_int
 from deeplearning4j_tpu.util.platform import is_tpu_backend
 
 NEG = -1e30
@@ -363,11 +364,10 @@ def flash_attention(q, k, v, *, mask=None, causal: bool = False,
     # arguments — they are the first-contact VMEM/tiling recovery knobs
     # (PERF.md) and must work even for layers that pass explicit sizes
     # (MultiHeadAttention forwards its block_size config here)
-    import os
-    bq_env = os.environ.get("DL4J_TPU_FLASH_BLOCK_Q")
-    bk_env = os.environ.get("DL4J_TPU_FLASH_BLOCK_K")
-    block_q = int(bq_env) if bq_env else (block_q or 128)
-    block_k = int(bk_env) if bk_env else (block_k or 128)
+    bq_env = env_int("DL4J_TPU_FLASH_BLOCK_Q")
+    bk_env = env_int("DL4J_TPU_FLASH_BLOCK_K")
+    block_q = bq_env if bq_env else (block_q or 128)
+    block_k = bk_env if bk_env else (block_k or 128)
     block_q = min(block_q, max(tq, 1))
     block_k = min(block_k, max(tk, 1))
     pq = (-tq) % block_q

@@ -230,6 +230,16 @@ class ContextParallelTrainer:
 
     def fit(self, data, epochs: int = 1, batch_size: int = 32):
         net = self.model
+        # donated-buffer safety (util/params.owned_leaf): the step below
+        # donates params/opt_state/state, so leaves from ANY host source
+        # (checkpoint restore, keras/dl4j import, user numpy) must be
+        # copied into XLA-owned buffers first — same contract as
+        # MultiLayerNetwork.fit; zero-copy numpy aliases donated into
+        # XLA are the PR-3 serde-resume segfault
+        from deeplearning4j_tpu.util import params as param_util
+        net.params = param_util.own_tree(net.params)
+        net.state = param_util.own_tree(net.state)
+        net.opt_state = param_util.own_tree(net.opt_state)
         # vary by epoch_count so repeated fit() calls draw fresh dropout
         # masks (matches MultiLayerNetwork._fit_epoch keying)
         rng = jax.random.fold_in(
@@ -248,6 +258,7 @@ class ContextParallelTrainer:
                 net.params, net.opt_state, net.state, loss = \
                     self._step[sig](net.params, net.opt_state, net.state,
                                     x, y, fm, lm, sub)
+                # graftlint: disable=host-sync-in-hot-path -- the step's ONE budgeted loss fetch (the deliberate per-iteration sync; PERF.md)
                 net._score = float(loss)
                 for lst in net.listeners:
                     lst.iteration_done(net, net.iteration_count,

@@ -256,6 +256,14 @@ class PipelineParallelTrainer:
 
     def fit(self, data, epochs: int = 1, batch_size: int = 32):
         net = self.model
+        # donated-buffer safety (util/params.owned_leaf): the pipeline
+        # step donates params/opt_state — host-sourced leaves (restored
+        # checkpoints, imports, user numpy) must be XLA-owned before the
+        # first donation, or XLA frees memory it does not own (the PR-3
+        # serde-resume segfault class)
+        from deeplearning4j_tpu.util import params as param_util
+        net.params = param_util.own_tree(net.params)
+        net.opt_state = param_util.own_tree(net.opt_state)
         source = net._as_iterator(data, batch_size)
         rng = jax.random.PRNGKey(net.conf.seed + 777)
         if self._step is None:
@@ -278,6 +286,7 @@ class PipelineParallelTrainer:
                     jnp.asarray(np.asarray(ds.features), net._compute_dtype),
                     jnp.asarray(np.asarray(ds.labels), net._compute_dtype),
                     fm, lm, sub)
+                # graftlint: disable=host-sync-in-hot-path -- the step's ONE budgeted loss fetch (the deliberate per-iteration sync; PERF.md)
                 net._score = float(loss)
                 for lst in net.listeners:
                     lst.iteration_done(net, net.iteration_count,

@@ -241,6 +241,7 @@ class SocketTransport:
                     continue
                 if peer not in self._out:
                     self._out[peer] = self._connect(peer)
+                # graftlint: disable=blocking-under-lock -- serializing frame writes on the shared socket IS this lock's purpose — concurrent sendall would interleave wire frames; sends are bounded by the socket timeout
                 self._out[peer].sendall(data)
                 self.messages_sent += 1
                 self.bytes_sent += len(data)

@@ -322,6 +322,7 @@ class ParallelWrapper:
                 # listener consumes the value (score() reads the
                 # epoch-end catch-up below otherwise)
                 if net.listeners:
+                    # graftlint: disable=host-sync-in-hot-path -- deliberate: only paid when listeners consume the per-step value (see comment above); listener-less fits defer to the epoch-end catch-up
                     net._score = float(loss)
                     for lst in net.listeners:
                         lst.iteration_done(net, net.iteration_count,
@@ -330,6 +331,7 @@ class ParallelWrapper:
                 net.iteration_count += 1
                 etl_start = time.perf_counter()
             if loss is not None and not net.listeners:
+                # graftlint: disable=host-sync-in-hot-path -- one catch-up fetch per EPOCH so score() is never stale
                 net._score = float(loss)    # one catch-up fetch per epoch
             for lst in net.listeners:
                 lst.on_epoch_end(net, net.epoch_count)
@@ -412,6 +414,7 @@ class ParallelWrapper:
                     # deferred flush, so the dispatch pipeline never
                     # serializes on a device->host sync
                     if at_avg and not net.listeners:
+                        # graftlint: disable=host-sync-in-hot-path -- fetch at the averaging boundary only, listener-less path (see comment above) — the deliberate cadence
                         net._score = float(jnp.mean(losses))
                     if net.listeners:
                         pending = (
@@ -428,6 +431,7 @@ class ParallelWrapper:
                 # when no listeners forced per-iteration fetches
                 if losses is not None and not net.listeners and \
                         not self.report_score_after_averaging:
+                    # graftlint: disable=host-sync-in-hot-path -- one catch-up fetch per EPOCH so score() is never stale
                     net._score = float(jnp.mean(losses))
         finally:
             # a deferred listener callback must not be lost when fit aborts
@@ -435,6 +439,7 @@ class ParallelWrapper:
             # into the failing step — then there is nothing to deliver)
             try:
                 flush_pending()
+            # graftlint: disable=bare-except-swallow -- the deferred listener fetch may legitimately fail when buffers were donated into the failing step (comment above) — fit's own exception is already propagating
             except Exception:
                 pass
             # final average + write back to the wrapped network; preserves

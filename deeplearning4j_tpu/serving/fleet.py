@@ -511,6 +511,7 @@ class ReplicaSupervisor:
                 for t in probers:
                     t.start()
                 for t in probers:
+                    # graftlint: disable=blocking-under-lock -- each probe thread is deadline-bounded by probe_timeout (never unbounded); tick() deliberately holds its lock for ONE bounded probe window (PR-8 design)
                     t.join()
             for r in live:
                 if probe_ok[r.name]:
@@ -565,6 +566,7 @@ class ReplicaSupervisor:
             replica.state = "dead"
             try:
                 replica.kill()
+            # graftlint: disable=bare-except-swallow -- best-effort kill of an already-dead-to-us process; state=dead + serving_fleet_gave_up_total above are the observable record
             except Exception:                 # noqa: BLE001
                 pass
             return
@@ -602,6 +604,7 @@ class ReplicaSupervisor:
                 # stop() raced the relaunch: don't leak a fresh process
                 try:
                     replica.stop()
+                # graftlint: disable=bare-except-swallow -- best-effort teardown of a stop-raced fresh process; state=stopped below is the record and stop() must not raise
                 except Exception:             # noqa: BLE001
                     pass
                 replica.state = "stopped"

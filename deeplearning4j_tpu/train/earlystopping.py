@@ -280,13 +280,15 @@ class EarlyStoppingTrainer:
                         break
                     epoch += 1
                     continue
-                score = calc.calculate(model) if calc else model.score()
+                # graftlint: disable=host-sync-in-hot-path -- ONE per-epoch score materialization (not per-step); everything below reuses the host float
+                score = float(calc.calculate(model) if calc
+                              else model.score())
                 minimize = calc.minimize if calc else True
-                score_history[epoch] = float(score)
+                score_history[epoch] = score
                 better = (best_score is None or
                           (score < best_score if minimize else score > best_score))
                 if better:
-                    best_score = float(score)
+                    best_score = score
                     best_epoch = epoch
                     cfg.model_saver.save_best(model)
                 if cfg.save_last_model:
@@ -295,7 +297,7 @@ class EarlyStoppingTrainer:
                 for c in cfg.epoch_termination_conditions:
                     if c.uses_score and hasattr(c, "minimize"):
                         c.minimize = minimize
-                    if c.terminate(epoch, float(score)):
+                    if c.terminate(epoch, score):
                         fired = c
                         break
                 if fired is not None:

@@ -664,7 +664,12 @@ class ResilientTrainer:
             try:
                 extra["stream"] = src.stream_state()
             except Exception:
-                pass
+                # checkpoint still lands (position replay covers resume)
+                # but the missing offset must be visible in the log, not
+                # silently absent from an "auditable" manifest
+                log.warning("checkpoint: stream_state() unavailable — "
+                            "banking position-replay resume only",
+                            exc_info=True)
         if self.net._score is not None:
             extra["score"] = float(self.net._score)
         nz = self._normalizer_extra()

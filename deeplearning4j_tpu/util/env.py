@@ -1,0 +1,106 @@
+"""Central typed accessors for every ``DL4J_TPU_*`` environment knob.
+
+One module owns the parsing contract that PRs 5, 7, and 8 each had to
+re-fix by hand at scattered ``os.environ`` call sites:
+
+- **Kill switches** (features on by default): ONLY the literal ``"0"``
+  disables. Unset, ``""``, ``"false"``, ``"2"`` — anything else — leaves
+  the feature ON. A hand-rolled ``== "1"`` silently turns
+  ``DL4J_TPU_HOST_CAST=true`` into a disable; a hand-rolled ``!= '1'``
+  turns ``""`` into one. Both shipped, both were review findings.
+- **Opt-ins** (features off by default): the mirror image — ONLY the
+  literal ``"1"`` enables.
+- **Values**: ``""`` is UNSET, never a value. ``DL4J_TPU_ETL_WORKERS=''``
+  must mean "use the default", not ``int('')`` crashing the fit.
+
+``env_flag``/``env_int``/``env_float``/``env_str`` encode those three
+rules once; ``scoped`` sets-and-restores a knob around a block (for
+tools that pin a child knob). graftlint's ``env-knob-contract`` rule
+(analysis/rules/envknobs.py) flags any ``DL4J_TPU_*`` read that bypasses
+this module, so the contract cannot regress silently.
+
+The knob catalog itself lives with each subsystem (docs/DATA_PIPELINE.md
+for the data plane, docs/SERVING.md for serving, docs/OBSERVABILITY.md
+for telemetry).
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Iterator, Optional
+
+def env_raw(name: str) -> Optional[str]:
+    """The raw value with ``""`` normalized to unset (None). Prefer the
+    typed accessors; this exists for save/restore plumbing."""
+    v = os.environ.get(name)
+    if v is None or v == "":
+        return None
+    return v
+
+
+def env_str(name: str, default: Optional[str] = None) -> Optional[str]:
+    """String knob; ``""`` means unset and yields `default`."""
+    v = env_raw(name)
+    return default if v is None else v
+
+
+def env_int(name: str, default: Optional[int] = None) -> Optional[int]:
+    """Integer knob; unset/``""`` yields `default`. A non-integer value
+    raises ValueError naming the variable (fail loud at startup, not
+    deep in a fit loop)."""
+    v = env_raw(name)
+    if v is None:
+        return default
+    try:
+        return int(v)
+    except ValueError:
+        raise ValueError(
+            f"{name}={v!r}: expected an integer") from None
+
+
+def env_float(name: str, default: Optional[float] = None) -> Optional[float]:
+    """Float knob; unset/``""`` yields `default`."""
+    v = env_raw(name)
+    if v is None:
+        return default
+    try:
+        return float(v)
+    except ValueError:
+        raise ValueError(
+            f"{name}={v!r}: expected a number") from None
+
+
+def env_flag(name: str, default: bool = True) -> bool:
+    """The one boolean-knob contract (module docstring):
+
+    - `default=True`  (kill switch): ONLY ``"0"`` disables.
+    - `default=False` (opt-in):      ONLY ``"1"`` enables.
+
+    Everything else — unset, ``""``, typos — keeps the default, so a
+    fat-fingered value can never silently flip a production feature."""
+    v = env_raw(name)
+    if v is None:
+        return default
+    if default:
+        return v != "0"
+    return v == "1"
+
+
+@contextlib.contextmanager
+def scoped(name: str, value: Optional[str]) -> Iterator[None]:
+    """Set (or, with ``value=None``, unset) a knob for the extent of the
+    block, restoring the previous state on exit — the save/set/restore
+    dance tools do around subprocesses, without touching os.environ by
+    hand at the call site."""
+    prev = os.environ.get(name)
+    try:
+        if value is None:
+            os.environ.pop(name, None)
+        else:
+            os.environ[name] = str(value)
+        yield
+    finally:
+        if prev is None:
+            os.environ.pop(name, None)
+        else:
+            os.environ[name] = prev

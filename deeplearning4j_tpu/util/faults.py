@@ -29,6 +29,8 @@ import logging
 import os
 from typing import Iterable, Optional, Set, Tuple
 
+from deeplearning4j_tpu.util.env import env_str
+
 log = logging.getLogger("deeplearning4j_tpu")
 
 
@@ -92,7 +94,7 @@ class FaultInjector:
     def from_env(cls, var: str = "DL4J_TPU_FAULTS") -> Optional["FaultInjector"]:
         """Build an injector from ``nan_at=..;transient_every=..`` env
         syntax; None when the variable is unset/empty."""
-        spec = os.environ.get(var, "").strip()
+        spec = env_str(var, "").strip()
         if not spec:
             return None
         kw: dict = {}
@@ -227,7 +229,7 @@ class ServingFaults:
                   ) -> "ServingFaults":
         """``probe_delay_s=5;predict_error=1`` env syntax; unset/empty
         leaves the toggles untouched."""
-        spec = os.environ.get(var, "").strip()
+        spec = env_str(var, "").strip()
         if not spec:
             return self
         kw = {}

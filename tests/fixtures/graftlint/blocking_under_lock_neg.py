@@ -1,0 +1,35 @@
+"""graftlint fixture: blocking-under-lock NEAR-MISS NEGATIVES.
+
+str.join under a lock is not a thread join; work scheduled via a nested
+def does NOT run while the lock is held (the PR-8 fix moved launches
+into exactly such spawn threads); condition-variable wait is the
+correct idiom; blocking calls OUTSIDE the critical section are fine.
+Zero findings expected.
+"""
+import threading
+import time
+
+
+class Supervisor:
+    def __init__(self):
+        self._tick_lock = threading.Lock()
+        self._cv = threading.Condition()
+
+    def describe(self, parts):
+        with self._tick_lock:
+            return ", ".join(parts)        # str.join, not thread join
+
+    def tick(self, replica):
+        with self._tick_lock:
+            # the PR-8 FIX shape: the launch runs on a spawn thread,
+            # not under the lock
+            def relaunch_off_lock():
+                time.sleep(0.5)
+                replica.relaunch(timeout=180)
+            t = threading.Thread(target=relaunch_off_lock, daemon=True)
+            t.start()
+        t.join()                           # outside the critical section
+
+    def wait_for_work(self):
+        with self._cv:
+            self._cv.wait()                # the Condition idiom

@@ -1,0 +1,31 @@
+"""graftlint fixture: blocking-under-lock TRUE POSITIVES, including the
+PR-8 launch-under-tick-lock shape that froze fleet supervision."""
+import subprocess
+import threading
+import time
+
+
+class Supervisor:
+    def __init__(self):
+        self._tick_lock = threading.Lock()
+        self._procs = []
+
+    def tick(self, replica):
+        # the PR-8 bug: a hung replica launch under the tick lock stalls
+        # probing of the WHOLE fleet and deadlocks stop()
+        with self._tick_lock:
+            if not replica.alive():
+                replica.relaunch(timeout=180)  # EXPECT
+            time.sleep(0.5)  # EXPECT
+
+    def drain(self, worker):
+        with self._tick_lock:
+            worker.join()  # EXPECT
+
+    def spawn(self, cmd):
+        with self._tick_lock:
+            return subprocess.run(cmd, capture_output=True)  # EXPECT
+
+    def probe(self, sock, addr):
+        with self._tick_lock:
+            sock.connect(addr)  # EXPECT

@@ -1,0 +1,19 @@
+"""graftlint fixture: donated-aliasing NEAR-MISS NEGATIVE.
+
+Donating programs in a module that launders restored state through
+util/params.own_tree before the first donation — the fixed PR-3 shape.
+Zero findings expected.
+"""
+import numpy as np
+import jax
+
+from deeplearning4j_tpu.util.params import own_tree
+
+
+class Trainer:
+    def build(self, step):
+        self._step = jax.jit(step, donate_argnums=(0,))
+
+    def resume(self, path):
+        restored = own_tree(np.load(path))   # XLA-owned copies
+        return self._step(restored)

@@ -1,0 +1,16 @@
+"""graftlint fixture: donated-aliasing TRUE POSITIVE (module contract).
+
+A module that builds donating programs but never launders host buffers
+through util/params.own_tree — every donation site must be flagged.
+Lines expected to be flagged carry an EXPECT marker comment.
+"""
+import jax
+import numpy as np
+
+
+def make_step(step):
+    return jax.jit(step, donate_argnums=(0, 1))  # EXPECT
+
+
+def stage(x, dev):
+    return jax.device_put(x, dev, donate=True)  # EXPECT

@@ -1,0 +1,34 @@
+"""graftlint fixture: donated-aliasing TRUE POSITIVE — the PR-3
+serde-resume segfault shape.
+
+Checkpoint-restored (numpy-backed) params flow into a donating jitted
+step WITHOUT passing through own_tree. The module references own_tree
+(so the module-level contract check passes) — only the lightweight
+dataflow check can catch this, which is exactly what PR 3 shipped.
+"""
+import numpy as np
+import jax
+
+from deeplearning4j_tpu.util.params import own_tree
+
+
+class Trainer:
+    def build(self, step):
+        self._step = jax.jit(step, donate_argnums=(0,))
+
+    def resume(self, path):
+        # numpy-backed leaves straight off disk: XLA does NOT own this
+        # memory, and the donating step below will free/reuse it
+        restored = np.load(path)
+        loss = self._step(restored)  # EXPECT
+        return loss
+
+    def resume_via_asarray(self, path):
+        # jnp.asarray on numpy is ZERO-COPY on CPU: it TRANSPORTS the
+        # alias, it does not launder it — the exact PR-3 mechanism
+        staged = jax.numpy.asarray(np.load(path))
+        return self._step(staged)  # EXPECT
+
+    def resume_safely(self, path):
+        restored = own_tree(np.load(path))
+        return self._step(restored)   # laundered: not flagged

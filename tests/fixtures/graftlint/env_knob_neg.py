@@ -1,0 +1,31 @@
+"""graftlint fixture: env-knob-contract NEAR-MISS NEGATIVES.
+
+Typed accessors, env WRITES (seeding child processes), non-DL4J_TPU
+variables, and value (not flag) comparisons. Zero findings expected.
+"""
+import os
+
+from deeplearning4j_tpu.util.env import env_flag, env_int, env_str, scoped
+
+
+def proper_reads():
+    on = env_flag("DL4J_TPU_FEATURE")
+    depth = env_int("DL4J_TPU_DEPTH", 2)
+    mode = env_str("DL4J_TPU_MODE", "auto")
+    return on, depth, mode
+
+
+def writes_are_fine(child_env):
+    os.environ["DL4J_TPU_WORKERS"] = "0"          # write: allowed
+    os.environ.setdefault("DL4J_TPU_SEED", "1")   # child seeding: allowed
+    del os.environ["DL4J_TPU_WORKERS"]
+    with scoped("DL4J_TPU_WORKERS", "4"):
+        child_env.update(os.environ)
+
+
+def other_namespaces():
+    return os.environ.get("JAX_PLATFORMS", "cpu")  # not our namespace
+
+
+def value_compare_is_fine():
+    return env_str("DL4J_TPU_MODE", "auto") == "auto"   # value, not flag

@@ -1,0 +1,32 @@
+"""graftlint fixture: env-knob-contract TRUE POSITIVES.
+
+Raw DL4J_TPU_* reads bypassing util/env.py, the shipped `!= '1'` /
+`== '1'` truthiness bugs, and hand-rolled flag logic on accessor
+results.
+"""
+import os
+
+from deeplearning4j_tpu.util.env import env_str
+
+
+def scattered_reads():
+    a = os.environ.get("DL4J_TPU_THING", "1")  # EXPECT
+    b = os.environ["DL4J_TPU_OTHER"]  # EXPECT
+    c = os.getenv("DL4J_TPU_THIRD")  # EXPECT
+    return a, b, c
+
+
+def shipped_bug_shapes():
+    # '' disables a default-on feature (PR-7 FIT_PREFETCH bug)
+    on = os.environ.get("DL4J_TPU_FEATURE", "") != "1"  # EXPECT
+    # 'true' disables a default-on feature (PR-5 DEVICE_NORM bug)
+    also_on = os.environ.get("DL4J_TPU_FEATURE2", "1") == "1"  # EXPECT
+    return on, also_on
+
+
+def handrolled_on_accessor():
+    return env_str("DL4J_TPU_FLAGGY") == "1"  # EXPECT
+
+
+def read_through_setdefault():
+    return int(os.environ.setdefault("DL4J_TPU_DEPTH", "2"))  # EXPECT

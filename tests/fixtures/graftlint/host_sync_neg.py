@@ -1,0 +1,28 @@
+"""graftlint fixture: host-sync-in-hot-path NEAR-MISS NEGATIVES.
+
+Shape/len reads are static under tracing; host-side numpy parsing in a
+fit loop is legitimate ETL; a float() on a CONSTANT is not a sync.
+Zero findings expected.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def step(params, x):
+    n = int(x.shape[0])          # static fact, no transfer
+    k = len(params)              # static fact
+    return jnp.dot(params, x) / n * k
+
+
+def outside_hot_path(y):
+    return float(y[0])           # not in a compiled region / fit loop
+
+
+class Net:
+    def fit(self, batches, step_fn):
+        for b in batches:
+            feats = np.asarray(b.features, dtype="float32")  # host ETL
+            lr = float("1e-3")   # constant, not a device value
+            self.last = step_fn(feats, lr)

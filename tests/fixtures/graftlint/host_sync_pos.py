@@ -1,0 +1,33 @@
+"""graftlint fixture: host-sync-in-hot-path TRUE POSITIVES.
+
+Device->host syncs inside compiled regions and an extra sync inside a
+fit inner loop.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+@jax.jit
+def step(params, x):
+    y = jnp.dot(params, x)
+    scale = float(y[0])  # EXPECT
+    return y * scale
+
+
+def scan_pipeline(xs, carry0):
+    def body(carry, x):
+        v = carry + x
+        host = v.item()  # EXPECT
+        return v, host
+    return lax.scan(body, carry0, xs)
+
+
+class Net:
+    def fit(self, batches, step_fn):
+        for b in batches:
+            params, loss = step_fn(b)
+            probe = float(loss)  # EXPECT
+            extra = float(loss)  # EXPECT
+            self.history.append((probe, extra))

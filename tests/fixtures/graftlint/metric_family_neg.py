@@ -1,0 +1,12 @@
+"""graftlint fixture: metric-family-registration NEAR-MISS NEGATIVES.
+
+Cataloged families pass; gauges and non-contract suffixes are outside
+the `*_total`/`*_seconds` contract. Zero findings.
+"""
+from deeplearning4j_tpu import monitor
+
+
+def record(dt, depth):
+    monitor.counter("fixture_documented_total", "in catalog").inc()
+    monitor.histogram("fixture_documented_seconds", "in catalog").observe(dt)
+    monitor.gauge("fixture_queue_depth", "gauge: no suffix contract").set(depth)

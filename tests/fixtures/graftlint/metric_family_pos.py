@@ -1,0 +1,13 @@
+"""graftlint fixture: metric-family-registration TRUE POSITIVES.
+
+Emits `*_total` / `*_seconds` families missing from the (injected)
+catalog — operators alert on the catalog, not the code.
+"""
+from deeplearning4j_tpu import monitor
+
+
+def record(dt):
+    monitor.counter("fixture_undocumented_total", "not in catalog").inc()  # EXPECT
+    monitor.histogram("fixture_undocumented_seconds", "nope").observe(dt)  # EXPECT
+    # documented family next to the undocumented ones
+    monitor.counter("fixture_documented_total", "in catalog").inc()

@@ -1,0 +1,22 @@
+"""graftlint fixture: bare-except-swallow TRUE POSITIVES.
+
+Lives under a `parallel/` path segment — process-boundary scope. A bare
+except breaks clean preemption; a broad swallow turns worker crashes
+into silent hangs.
+"""
+
+
+def worker_loop(tasks, out_q):
+    for t in tasks:
+        try:
+            out_q.put(t.run())
+        except:  # EXPECT
+            continue
+
+
+def supervisor_tick(replicas):
+    for r in replicas:
+        try:
+            r.probe()
+        except Exception:  # EXPECT
+            pass

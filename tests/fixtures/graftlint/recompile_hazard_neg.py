@@ -1,0 +1,22 @@
+"""graftlint fixture: recompile-hazard NEAR-MISS NEGATIVES — branches on
+static facts (shape/dtype/None-ness) are fine under tracing, and value
+branches OUTSIDE compiled code are plain Python. Zero findings."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def step(params, x, mask):
+    if x.ndim == 3:                      # shapes are static
+        x = x.reshape(x.shape[0], -1)
+    if mask is not None:                 # None-ness is static
+        x = x * mask
+    if isinstance(params, dict):         # type is static
+        params = params["w"]
+    return jnp.dot(params, x.T)
+
+
+def host_side(loss):
+    if loss > 10.0:                      # not a compiled region
+        return True
+    return False

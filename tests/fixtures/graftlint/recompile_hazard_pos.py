@@ -1,0 +1,19 @@
+"""graftlint fixture: recompile-hazard TRUE POSITIVES — Python branches
+on traced VALUES inside jitted functions."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def clip_step(params, grads):
+    if jnp.abs(grads).max() > 10.0:  # EXPECT
+        grads = grads / 10.0
+    return params - grads
+
+
+def make_step():
+    def step(params, x):
+        while params.sum() > 1.0:  # EXPECT
+            params = params * 0.5
+        return params + x
+    return jax.jit(step)

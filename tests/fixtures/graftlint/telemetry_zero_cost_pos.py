@@ -1,0 +1,25 @@
+"""graftlint fixture: telemetry-zero-cost TRUE POSITIVES.
+
+Telemetry inside compiled code records once at trace time; expensive
+span attrs are evaluated eagerly even while tracing is disabled.
+"""
+import jax
+
+from deeplearning4j_tpu import monitor
+
+
+@jax.jit
+def step(params, x):
+    with monitor.span("train/inner"):  # EXPECT
+        y = params @ x
+    monitor.counter("steps_total", "steps").inc()  # EXPECT
+    return y
+
+
+def fit_loop(batches, step_fn):
+    for b in batches:
+        loss = step_fn(b)
+        # float(loss) runs even while tracing is disabled: an always-on
+        # device->host sync smuggled in through span attrs
+        with monitor.span("train/step", loss=float(loss)):  # EXPECT
+            pass

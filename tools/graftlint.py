@@ -1,0 +1,170 @@
+#!/usr/bin/env python
+"""graftlint CLI — the project-native static-analysis suite.
+
+    python tools/graftlint.py deeplearning4j_tpu tools bench.py
+    python tools/graftlint.py --json ... | jq .
+    python tools/graftlint.py --list-rules
+    python tools/graftlint.py --write-baseline lint_baseline.json ...
+    python tools/graftlint.py --baseline lint_baseline.json ...
+
+Exit codes: 0 clean (or all findings baselined/suppressed), 2 on
+unsuppressed findings, 1 on usage/internal error.
+
+Suppression: ``# graftlint: disable=<rule>[,<rule>] -- <justification>``
+on the flagged line (``disable-file=`` near the top of a file for
+file-wide). The justification is REQUIRED; empty ones and stale pragmas
+are findings themselves.
+
+Baseline workflow (landing a NEW rule without blocking): run with
+``--write-baseline lint_baseline.json`` once, commit the burn-down
+file, and gate with ``--baseline lint_baseline.json`` — only NEW
+findings fail; stale entries are reported so the file shrinks with the
+debt. See docs/STATIC_ANALYSIS.md.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+import types
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+# The analyzer is stdlib-only, but `deeplearning4j_tpu/__init__.py`
+# imports the whole framework (jax included). Register a namespace stub
+# so `deeplearning4j_tpu.analysis` imports WITHOUT executing the heavy
+# package root — the lint must run fast on boxes with no accelerator
+# stack warmed up. (No-op when the real package is already imported,
+# e.g. under pytest.)
+if "deeplearning4j_tpu" not in sys.modules:
+    _pkg = types.ModuleType("deeplearning4j_tpu")
+    _pkg.__path__ = [os.path.join(ROOT, "deeplearning4j_tpu")]
+    sys.modules["deeplearning4j_tpu"] = _pkg
+
+from deeplearning4j_tpu import analysis  # noqa: E402
+
+
+def _parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="graftlint",
+        description="project-native static analysis (docs/STATIC_ANALYSIS.md)")
+    p.add_argument("paths", nargs="*",
+                   default=[os.path.join(ROOT, "deeplearning4j_tpu"),
+                            os.path.join(ROOT, "tools"),
+                            os.path.join(ROOT, "bench.py")],
+                   help="files/dirs to lint (default: the shipped tree)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable findings on stdout")
+    p.add_argument("--select", metavar="RULES",
+                   help="comma-separated rule names to run (default all)")
+    p.add_argument("--baseline", metavar="FILE",
+                   help="suppress findings recorded in FILE; only NEW "
+                        "findings gate")
+    p.add_argument("--write-baseline", metavar="FILE",
+                   help="snapshot current unsuppressed findings to FILE "
+                        "and exit 0 (the burn-down workflow)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalog and exit")
+    return p
+
+
+def _list_rules() -> int:
+    for rule in analysis.ALL_RULES:
+        print(f"{rule.name}")
+        print(f"    {rule.summary}")
+        print(f"    history: {rule.historical}")
+    print(f"{analysis.PRAGMA_RULE}")
+    print("    framework check: pragmas need non-empty justifications "
+          "and must suppress something")
+    print("parse-error")
+    print("    framework check: an unreadable/unparseable file is a "
+          "finding, never 'clean'")
+    return 0
+
+
+def main(argv=None) -> int:
+    args = _parser().parse_args(argv)
+    if args.list_rules:
+        return _list_rules()
+    select = None
+    if args.select:
+        select = {s.strip() for s in args.select.split(",") if s.strip()}
+        known = {r.name for r in analysis.ALL_RULES}
+        bad = select - known
+        if bad:
+            print(f"graftlint: unknown rule(s): {', '.join(sorted(bad))}",
+                  file=sys.stderr)
+            return 1
+    t0 = time.time()
+    try:
+        result = analysis.run(args.paths, select=select)
+    except OSError as e:
+        print(f"graftlint: {e}", file=sys.stderr)
+        return 1
+    if result.files == 0 and not result.findings:
+        # a typo'd path must not read as a clean gate
+        print("graftlint: no Python files under "
+              f"{', '.join(args.paths)} — nothing was linted",
+              file=sys.stderr)
+        return 1
+
+    if args.write_baseline:
+        if select is not None:
+            print("graftlint: refusing --write-baseline with --select — "
+                  "the file would silently drop the other rules' debt",
+                  file=sys.stderr)
+            return 1
+        analysis.write_baseline(args.write_baseline, result)
+        n = len(result.all_unsuppressed)
+        print(f"graftlint: baselined {n} finding(s) -> "
+              f"{args.write_baseline}")
+        return 0
+
+    gating = result.all_unsuppressed
+    stale = []
+    if args.baseline:
+        try:
+            gating, stale = analysis.apply_baseline(args.baseline, result)
+        except (OSError, ValueError, KeyError) as e:
+            print(f"graftlint: bad baseline {args.baseline}: {e}",
+                  file=sys.stderr)
+            return 1
+        if select is not None:
+            # a rule-filtered run cannot see the other rules' debt —
+            # their baseline entries are NOT stale, just out of scope
+            stale = []
+
+    elapsed = time.time() - t0
+    if args.json:
+        payload = {
+            "version": 1,
+            "files": result.files,
+            "elapsed_seconds": round(elapsed, 3),
+            "findings": [
+                {"rule": f.rule, "path": os.path.relpath(f.path, ROOT),
+                 "line": f.line, "message": f.message}
+                for f in gating],
+            "suppressed": len(result.suppressed),
+            "baselined": (len(result.all_unsuppressed) - len(gating)
+                          if args.baseline else 0),
+            "stale_baseline_entries": stale,
+        }
+        print(json.dumps(payload, indent=2))
+    else:
+        for f in gating:
+            print(f.render(ROOT))
+        for key in stale:
+            print(f"stale baseline entry (fixed — rewrite the "
+                  f"baseline to bank it): {key}")
+        n, s = len(gating), len(result.suppressed)
+        print(f"graftlint: {result.files} files, {n} finding(s)"
+              + (f", {s} suppressed" if s else "")
+              + (f", {len(result.all_unsuppressed) - n} baselined"
+                 if args.baseline else "")
+              + f" [{elapsed:.1f}s]")
+    return 2 if gating else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -19,9 +19,11 @@ from jax import lax
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from deeplearning4j_tpu.util.env import env_flag, env_int, env_str
+
 # CPU run allowed only for smoke-testing the script itself (tiny batch);
 # the watcher always runs it on hardware
-if os.environ.get("DL4J_TPU_TRACE_ALLOW_CPU", "0") == "1":
+if env_flag("DL4J_TPU_TRACE_ALLOW_CPU", default=False):
     # the axon plugin force-appends itself to jax_platforms at import —
     # pin back to CPU or a wedged tunnel hangs the smoke in backend init
     jax.config.update("jax_platforms", "cpu")
@@ -33,11 +35,11 @@ import dataclasses
 from deeplearning4j_tpu.models import ResNet50
 from deeplearning4j_tpu.nn.graph import ComputationGraph
 
-TRACE_DIR = os.environ.get("DL4J_TPU_TRACE_DIR", "/tmp/dl4jtpu_trace")
-BATCH = int(os.environ.get("DL4J_TPU_TRACE_BATCH", "128"))
+TRACE_DIR = env_str("DL4J_TPU_TRACE_DIR", "/tmp/dl4jtpu_trace")
+BATCH = env_int("DL4J_TPU_TRACE_BATCH", 128)
 # input size knob so the ALLOW_CPU smoke can shrink the model (a 224x224
 # ResNet-50 compile on CPU runs minutes; 64x64 is seconds)
-HW = int(os.environ.get("DL4J_TPU_TRACE_HW", "224"))
+HW = env_int("DL4J_TPU_TRACE_HW", 224)
 
 model = ResNet50(num_classes=1000, input_shape=(HW, HW, 3))
 conf = dataclasses.replace(model.conf(), compute_dtype="bfloat16")
@@ -60,6 +62,7 @@ def raw_step(params, opt_state, state, rng):
     return optax.apply_updates(params, updates), new_opt, new_state, loss
 
 
+# graftlint: disable=donated-aliasing -- params come from ComputationGraph.init() on-device in this process; nothing deserialized/numpy-backed reaches the donated args
 jstep = jax.jit(raw_step, donate_argnums=(0, 1, 2))
 
 

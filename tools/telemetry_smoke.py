@@ -190,6 +190,24 @@ def main(argv=None) -> int:
     for fam in XLA_REQUIRED:
         if fam not in families:
             failures.append(f"{fam} missing from /metrics exposition")
+
+    # ---- static<->live family cross-check ------------------------------
+    # graftlint rule (8) extracts the emitted families from the AST and
+    # gates them against docs/OBSERVABILITY.md; the smoke consumes the
+    # SAME extraction so the catalog check and the live scrape can't
+    # drift apart: every family this live run exposed must be one the
+    # static analysis knows about.
+    from deeplearning4j_tpu.analysis import extract_metric_families
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    static_families = set(extract_metric_families(
+        [os.path.join(repo, "deeplearning4j_tpu")]))
+    summary["static_metric_families"] = len(static_families)
+    unknown = sorted(f for f in families if f not in static_families)
+    if unknown:
+        failures.append(
+            "live /metrics exposes families the static extraction (and "
+            f"therefore the catalog gate) cannot see: {unknown} — "
+            "dynamic family names bypass metric-family-registration")
     skip_ctr = monitor.REGISTRY.collect("resilience_steps_skipped_total")
     if skip_ctr is None or skip_ctr.value() < 1:
         failures.append("resilience_steps_skipped_total did not increment")

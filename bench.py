@@ -843,10 +843,93 @@ def _run_fit_e2e(cfg):
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+#: the GSPMD plan grid `--mode mesh` sweeps: one subprocess per entry,
+#: banked as MULTICHIP_r06.json and gated by perf_report's mesh_* series
+MESH_PLANS = ("single", "dp", "dp_tp", "zero1", "zero3")
+
+
+def _run_mesh(cfg):
+    """One GSPMD ShardingPlan config through the PRODUCT fit() path
+    (nn/multilayer.py — the plan compiles into the default step): times
+    steady-state epochs of a wide MLP and banks imgs/s next to the XLA
+    ledger's per-program compile count and HBM residency, so the sweep
+    shows (a) ONE compile per (plan, shape) and (b) per-program argument
+    bytes dropping ~1/N with zero_stage=3. On CPU the orchestrator
+    forces 8 host devices into this subprocess; on TPU the real chips
+    form the mesh."""
+    import numpy as np
+    import jax
+
+    from deeplearning4j_tpu.data.iterator import ArrayDataSetIterator
+    from deeplearning4j_tpu.monitor import xla as xla_ledger
+    from deeplearning4j_tpu.nn.conf.base import InputType
+    from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.updaters import Adam
+    from deeplearning4j_tpu.parallel.plan import ShardingPlan
+    from deeplearning4j_tpu.parallel.sharding import ShardingRules
+
+    on_tpu, best_of = _bench_env()
+    n = len(jax.devices())
+    plan_name = cfg["plan"]
+    plans = {
+        "single": None,
+        "dp": ShardingPlan(data=-1),
+        "dp_tp": ShardingPlan(data=-1, model=2 if n % 2 == 0 else 1,
+                              rules=ShardingRules.megatron()),
+        "zero1": ShardingPlan(data=-1, zero_stage=1),
+        "zero3": ShardingPlan(data=-1, zero_stage=3),
+    }
+    plan = plans[plan_name]
+
+    width, feat, classes = 512, 128, 16
+    batch, nbatch, epochs = 256, 8, 3
+    conf = (NeuralNetConfiguration.Builder().seed(7).updater(Adam(1e-3))
+            .list()
+            .layer(DenseLayer(n_out=width, activation="relu"))
+            .layer(DenseLayer(n_out=width, activation="relu"))
+            .layer(OutputLayer(n_out=classes, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.feed_forward(feat)).build())
+    rs = np.random.RandomState(0)
+    X = rs.rand(batch * nbatch, feat).astype("float32")
+    Y = np.eye(classes, dtype="float32")[
+        rs.randint(0, classes, batch * nbatch)]
+    it = lambda: ArrayDataSetIterator(X, Y, batch_size=batch)
+
+    net = MultiLayerNetwork(conf).init()
+    net.fit(it(), epochs=1, plan=plan)          # compile + placement warm
+
+    def run():
+        t0 = time.perf_counter()
+        net.fit(it(), epochs=epochs, plan=plan)
+        # the per-call fit's loss fetch already synced every step
+        return time.perf_counter() - t0
+
+    dt = _timed_best(run, best_of)
+    out = {"mode": f"mesh-{plan_name}", "batch": batch,
+           "n_devices": n, "on_tpu": on_tpu, "best_of": best_of,
+           "device_kind": jax.devices()[0].device_kind,
+           "plan": None if plan is None else plan.describe(),
+           "mesh_imgs_sec": round(batch * nbatch * epochs / dt, 1)}
+    train_recs = [r for r in xla_ledger.records()
+                  if r.name == "mln/train_step"]
+    if train_recs:
+        rec = train_recs[0]
+        out["xla_train_programs"] = len(train_recs)
+        out["xla_train_compiles"] = sum(r.compiles for r in train_recs)
+        if rec.hbm:
+            out["hbm_argument_bytes"] = rec.hbm.get("argument_bytes")
+            out["hbm_peak_bytes"] = rec.hbm_peak_bytes
+        out["arg_shardings_sharded"] = rec.is_sharded
+    return out
+
+
 _KIND_RUNNERS = {"resnet": _run_resnet, "lenet": _run_lenet,
                  "char-lstm": _run_char_lstm, "word2vec": _run_word2vec,
                  "attention": _run_attention, "h2d": _run_h2d,
-                 "fit_e2e": _run_fit_e2e}
+                 "fit_e2e": _run_fit_e2e, "mesh": _run_mesh}
 
 
 def run_one(cfg):
@@ -993,16 +1076,32 @@ def main(mode: str = None):
     def canon(cfg):
         return _canon_mode(cfg, scan_k)
 
-    cfgs = _configs(tpu_up)
-    if mode is not None:
-        cfgs = [c for c in cfgs if c["kind"] == mode]
-        if not cfgs:
-            sys.stderr.write(f"bench: no configs for --mode {mode}\n")
+    if mode == "mesh":
+        # the GSPMD plan scaling grid (ROADMAP item 1): plan-sharded
+        # product fit() per config, banked as MULTICHIP_r06.json
+        cfgs = [{"kind": "mesh", "plan": p} for p in MESH_PLANS]
+    else:
+        cfgs = _configs(tpu_up)
+        if mode is not None:
+            cfgs = [c for c in cfgs if c["kind"] == mode]
+            if not cfgs:
+                sys.stderr.write(f"bench: no configs for --mode {mode}\n")
     for cfg in cfgs:
         label = json.dumps(cfg, sort_keys=True)
         if wedged:
             results.append({**canon(cfg), "skipped": "tunnel wedged"})
             continue
+        cfg_env = env
+        if cfg.get("kind") == "mesh" and not tpu_up:
+            # the mesh grid needs devices to shard over: force the
+            # 8-virtual-device CPU topology into THIS subprocess only
+            # (the flag must not leak into the other configs' timings)
+            cfg_env = dict(env)
+            flags = cfg_env.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" not in flags:
+                cfg_env["XLA_FLAGS"] = (
+                    flags + " --xla_force_host_platform_device_count=8"
+                ).strip()
         sys.stderr.write(f"bench: running {label}\n")
         t0 = time.time()
         # Popen (not run) so an outer SIGTERM to the orchestrator can kill
@@ -1011,7 +1110,7 @@ def main(mode: str = None):
             [sys.executable, os.path.abspath(__file__), "--one",
              json.dumps(cfg)],
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-            env=env)
+            env=cfg_env)
         _set_active_child(child)
         try:
             stdout, stderr = child.communicate(timeout=cfg_timeout)
@@ -1041,6 +1140,38 @@ def main(mode: str = None):
                 f.write(json.dumps(res) + "\n")
         except OSError:
             pass
+
+    # mesh grid post-pass: scaling efficiency vs the single-device row,
+    # then bank the whole sweep as the MULTICHIP artifact perf_report
+    # gates (mesh_imgs_sec series)
+    single = next((r.get("mesh_imgs_sec") for r in results
+                   if r.get("mode") == "mesh-single"), None)
+    for r in results:
+        if single and r.get("mesh_imgs_sec") \
+                and r.get("mode") != "mesh-single":
+            r["mesh_scaling_vs_single"] = round(
+                r["mesh_imgs_sec"] / single, 3)
+    if mode == "mesh":
+        here = os.path.dirname(os.path.abspath(__file__))
+        out_path = ENV.env_str("DL4J_TPU_MESH_OUT") or os.path.join(
+            here, "MULTICHIP_r06.json")
+        doc = {"metric": "mesh_plan_scaling",
+               "tpu_unavailable": not tpu_up,
+               "n_devices": next((r.get("n_devices") for r in results
+                                  if r.get("n_devices")), None),
+               # value stays None ON PURPOSE: a non-null value would
+               # join perf_report's __headline__ series and shadow the
+               # real ResNet headline — mesh rows gate via mesh_imgs_sec
+               "value": None,
+               "unit": "imgs/sec (mesh-dp plan-sharded product fit; see "
+                       "sweep rows)",
+               "sweep": results}
+        try:
+            with open(out_path, "w") as f:
+                json.dump(doc, f, indent=1)
+            sys.stderr.write(f"bench: mesh sweep banked at {out_path}\n")
+        except OSError as e:
+            sys.stderr.write(f"bench: cannot bank mesh sweep: {e}\n")
 
     on_tpu = tpu_up
     flops_per_img = next((r["gflops_per_img"] * 1e9 for r in results

@@ -13,12 +13,16 @@ from deeplearning4j_tpu.analysis.rules.hotpath import (
     HostSyncInHotPathRule, RecompileHazardRule,
 )
 from deeplearning4j_tpu.analysis.rules.locks import BlockingUnderLockRule
+from deeplearning4j_tpu.analysis.rules.restore import (
+    UnlaunderedRestorePlacementRule,
+)
 from deeplearning4j_tpu.analysis.rules.telemetry import (
     MetricFamilyRegistrationRule, TelemetryZeroCostRule,
 )
 
 ALL_RULES = [
     DonatedAliasingRule(),
+    UnlaunderedRestorePlacementRule(),
     HostSyncInHotPathRule(),
     RecompileHazardRule(),
     EnvKnobContractRule(),

@@ -1,0 +1,160 @@
+"""Rule: unlaundered-restore-placement — the sharding-aware variant of
+the PR-3 donated-aliasing shape.
+
+Since the GSPMD ShardingPlan (PR 10), checkpoint restore paths place
+parameters onto explicit mesh shardings. ``jax.device_put`` of a freshly
+DESERIALIZED value (``np.load`` npz trees, ``flax.serialization
+.from_bytes`` updater state, ``pickle.load``) straight onto a sharding
+looks correct — the arrays land where the plan wants them — but on CPU
+backends a replicated/single-device placement can be ZERO-COPY, so the
+"placed" jax array still aliases numpy-owned heap memory; the first
+donating train step after resume then frees memory XLA does not own
+(the PR-3 serde-resume segfault, now wearing a sharding).
+
+The blessed path is ``util/params.own_tree(tree, shardings)`` /
+``owned_leaf(leaf, sharding)`` (or any route that copies first:
+``jnp.array(..., copy=True)`` then place) — copy into an XLA-owned
+buffer, THEN place.
+
+Detection (per function scope, same lightweight taint style as the
+donated-aliasing rule): values assigned from deserialization calls are
+tainted; simple-name propagation follows ``x = y``; passing through
+``own_tree``/``owned_leaf``/``jnp.array(copy=True)`` clears; a
+``device_put`` call whose value argument is tainted AND that names an
+explicit placement (second positional arg, or a ``device=``/
+``sharding=``/``donate=`` keyword) is flagged.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Set
+
+from deeplearning4j_tpu.analysis.core import Finding, ModuleInfo, Rule
+
+_DEVICE_PUT = {"jax.device_put", "device_put"}
+_OWNING = {"own_tree", "owned_leaf"}
+#: deserialization producers — deliberately NARROWER than the
+#: donated-aliasing rule's np.* namespace: plain numpy batch staging may
+#: legitimately device_put (batches are never donated); RESTORED state is
+#: what reaches donate_argnums.
+_TAINT_CALLS = {"numpy.load", "np.load", "pickle.load", "pickle.loads"}
+_TAINT_SUFFIX = (".from_bytes",)
+
+
+def _target_name(t: ast.AST):
+    if isinstance(t, ast.Name):
+        return t.id
+    if isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name):
+        return f"{t.value.id}.{t.attr}"
+    return None
+
+
+class UnlaunderedRestorePlacementRule(Rule):
+    name = "unlaundered-restore-placement"
+    summary = ("restored/deserialized leaves must go through "
+               "util/params.own_tree(tree, shardings) (or an explicit "
+               "copy) before device_put onto a placement")
+    historical = ("PR 3 / PR 10: checkpoint-restored numpy-aliased params "
+                  "device_put onto plan shardings can be zero-copy on CPU "
+                  "— the donating post-resume step then corrupts the heap "
+                  "(the serde-resume segfault, sharding-aware variant)")
+
+    def check(self, mod: ModuleInfo) -> Iterable[Finding]:
+        scopes = [mod.tree] + [n for n in ast.walk(mod.tree)
+                               if isinstance(n, (ast.FunctionDef,
+                                                 ast.AsyncFunctionDef))]
+        for scope in scopes:
+            yield from self._scope(mod, scope)
+
+    # ------------------------------------------------------------- taint
+    def _scope(self, mod: ModuleInfo, scope: ast.AST) -> Iterable[Finding]:
+        tainted: Set[str] = set()
+        body = scope.body if hasattr(scope, "body") else []
+        for stmt in body:
+            yield from self._stmt(mod, stmt, tainted)
+
+    def _stmt(self, mod: ModuleInfo, stmt: ast.AST,
+              tainted: Set[str]) -> Iterable[Finding]:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return      # nested scopes visited on their own
+        if isinstance(stmt, ast.Assign):
+            taints = self._taints(mod, stmt.value, tainted)
+            for t in stmt.targets:
+                tn = _target_name(t)
+                if tn is not None:
+                    (tainted.add if taints else tainted.discard)(tn)
+        # check only this statement's OWN expressions — the recursion
+        # below visits nested statements exactly once (walking the whole
+        # subtree here would double-report a flagged call per enclosing
+        # compound statement, the defect class the PR-9 hardening fixed
+        # for blocking-under-lock)
+        for expr in self._own_exprs(stmt):
+            for node in ast.walk(expr):
+                if isinstance(node, ast.Call):
+                    yield from self._check_put(mod, node, tainted)
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.stmt):
+                yield from self._stmt(mod, child, tainted)
+
+    @staticmethod
+    def _own_exprs(stmt: ast.AST):
+        """The statement's direct expression children (nested statement
+        bodies are excluded — the statement recursion covers those)."""
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.expr):
+                yield child
+            elif isinstance(child, (ast.withitem, ast.keyword)):
+                for sub in ast.iter_child_nodes(child):
+                    if isinstance(sub, ast.expr):
+                        yield sub
+
+    def _taints(self, mod: ModuleInfo, expr: ast.AST,
+                tainted: Set[str]) -> bool:
+        if isinstance(expr, ast.Call):
+            name = mod.call_name(expr) or ""
+            base = name.split(".")[-1]
+            if base in _OWNING:
+                return False
+            if name in ("jax.numpy.array", "jnp.array"):
+                copy_kw = next((kw.value.value for kw in expr.keywords
+                                if kw.arg == "copy"
+                                and isinstance(kw.value, ast.Constant)),
+                               None)
+                if copy_kw is not False:      # jnp.array default-copies
+                    return False
+                return bool(expr.args) and self._taints(mod, expr.args[0],
+                                                        tainted)
+            if name in ("jax.numpy.asarray", "jnp.asarray"):
+                # asarray TRANSPORTS taint (zero-copy on CPU)
+                return bool(expr.args) and self._taints(mod, expr.args[0],
+                                                        tainted)
+            if name in _TAINT_CALLS or name.endswith(_TAINT_SUFFIX):
+                return True
+            return False
+        if isinstance(expr, ast.Name):
+            return expr.id in tainted
+        if isinstance(expr, ast.Attribute) and isinstance(expr.value,
+                                                          ast.Name):
+            return f"{expr.value.id}.{expr.attr}" in tainted
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            return any(self._taints(mod, e, tainted) for e in expr.elts)
+        return False
+
+    def _check_put(self, mod: ModuleInfo, call: ast.Call,
+                   tainted: Set[str]) -> Iterable[Finding]:
+        if mod.call_name(call) not in _DEVICE_PUT:
+            return
+        explicit_placement = len(call.args) >= 2 or any(
+            kw.arg in ("device", "sharding", "donate") for kw in call.keywords)
+        if not explicit_placement or not call.args:
+            return
+        if self._taints(mod, call.args[0], tainted):
+            yield self.finding(
+                mod, call,
+                "device_put of a deserialized/restored value onto an "
+                "explicit placement without util/params.own_tree — on CPU "
+                "the placed array can alias numpy-owned heap memory, and "
+                "the first donating step after resume corrupts it (the "
+                "PR-3 serde-resume segfault, sharding-aware variant); "
+                "launder with own_tree(tree, shardings)/owned_leaf first")

@@ -235,12 +235,17 @@ class AsyncDataSetIterator(DataSetIterator):
             )
         if self._device_put:
             dev = self._device or jax.local_devices()[0]
-            ds = DataSet(
-                jax.device_put(ds.features, dev),
-                None if ds.labels is None else jax.device_put(ds.labels, dev),
-                None if ds.features_mask is None else jax.device_put(ds.features_mask, dev),
-                None if ds.labels_mask is None else jax.device_put(ds.labels_mask, dev),
-            )
+            if isinstance(dev, jax.sharding.Sharding):
+                # mesh placement (GSPMD-plan fit): the shared ragged-tail
+                # fallback (parallel/plan.put_batch) keeps a
+                # non-divisible batch from killing the prefetch thread
+                from deeplearning4j_tpu.parallel.plan import put_batch
+                put = lambda a: None if a is None else put_batch(a, dev)
+            else:
+                put = lambda a: None if a is None \
+                    else jax.device_put(a, dev)
+            ds = DataSet(put(ds.features), put(ds.labels),
+                         put(ds.features_mask), put(ds.labels_mask))
         if self._callback is not None:
             out = self._callback.call(ds)
             ds = ds if out is None else out

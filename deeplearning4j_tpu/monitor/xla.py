@@ -101,11 +101,11 @@ class ProgramRecord:
     __slots__ = ("fingerprint", "name", "domain", "arg_shapes", "hlo_hash",
                  "compile_seconds", "compiles", "flops", "bytes_accessed",
                  "hbm", "examples_per_call", "steps_per_call",
-                 "first_captured_unix")
+                 "first_captured_unix", "arg_shardings")
 
     def __init__(self, fingerprint, name, domain, arg_shapes, hlo_hash,
                  compile_seconds, flops, bytes_accessed, hbm,
-                 examples_per_call, steps_per_call):
+                 examples_per_call, steps_per_call, arg_shardings=None):
         self.fingerprint = fingerprint
         self.name = name
         self.domain = domain
@@ -118,6 +118,11 @@ class ProgramRecord:
         self.hbm = hbm                            # dict or None
         self.examples_per_call = examples_per_call
         self.steps_per_call = max(int(steps_per_call), 1)
+        #: stringified per-arg PartitionSpecs ("PartitionSpec('data',)",
+        #: "replicated", "single", "host") — lets perf_report rooflines
+        #: and the MFU accountant tell a GSPMD-plan-sharded program from
+        #: a replicated one
+        self.arg_shardings = tuple(arg_shardings or ())
         self.first_captured_unix = time.time()
 
     @property
@@ -135,6 +140,13 @@ class ProgramRecord:
     @property
     def hbm_peak_bytes(self) -> Optional[int]:
         return hbm_peak(self.hbm)
+
+    @property
+    def is_sharded(self) -> bool:
+        """True when any argument carries a non-trivial PartitionSpec
+        (a mesh axis name appears in it)."""
+        return any("PartitionSpec(" in s and s != "PartitionSpec()"
+                   for s in self.arg_shardings)
 
     def to_json(self) -> dict:
         ai = self.arithmetic_intensity
@@ -154,6 +166,8 @@ class ProgramRecord:
             "examples_per_call": self.examples_per_call,
             "steps_per_call": self.steps_per_call,
             "total_flops_per_call": self.total_flops_per_call,
+            "arg_shardings": list(self.arg_shardings),
+            "sharded": self.is_sharded,
             "first_captured_unix": round(self.first_captured_unix, 3),
         }
 
@@ -170,6 +184,8 @@ class ProgramRecord:
         peak = self.hbm_peak_bytes
         if peak:
             out["hbm_peak_bytes"] = peak
+        if self.is_sharded:
+            out["sharded"] = True
         return out
 
 
@@ -236,6 +252,11 @@ def _register_families():
     metrics.gauge("xla_hbm_peak_bytes",
                   "memory_analysis() argument+output+temp bytes of the "
                   "compiled program (peak HBM residency)",
+                  labels=("program", "fingerprint"))
+    metrics.gauge("xla_program_sharded",
+                  "1 when the program's arguments carry non-trivial "
+                  "PartitionSpecs (GSPMD plan), 0 when replicated/"
+                  "single-device",
                   labels=("program", "fingerprint"))
     metrics.counter("xla_analysis_unavailable_total",
                     "cost/memory analysis probes that degraded (backend "
@@ -319,6 +340,29 @@ def shape_key(tree) -> Tuple[str, ...]:
     device sync, no lowering). Nones disappear with tree flattening."""
     import jax
     return tuple(_leaf_sig(l) for l in jax.tree_util.tree_leaves(tree))
+
+
+def _leaf_sharding(leaf) -> str:
+    """One leaf's placement as a short string: the stringified
+    PartitionSpec for mesh-placed jax arrays ("PartitionSpec('data',)"),
+    "single" for single-device arrays, "host" for numpy/scalars."""
+    s = getattr(leaf, "sharding", None)
+    if s is None:
+        return "host"
+    spec = getattr(s, "spec", None)
+    if spec is not None:
+        return str(spec)
+    return "single"
+
+
+def sharding_key(tree) -> Tuple[str, ...]:
+    """Per-arg placement fingerprint paired with shape_key: the ledger's
+    `arg_shardings` field (stringified PartitionSpecs per program), so
+    downstream consumers (tools/perf_report.py rooflines, the /metrics
+    MFU accountant) can distinguish GSPMD-plan-sharded programs from
+    replicated ones."""
+    import jax
+    return tuple(_leaf_sharding(l) for l in jax.tree_util.tree_leaves(tree))
 
 
 def hbm_peak(hbm: Optional[Dict[str, int]]) -> Optional[int]:
@@ -405,6 +449,7 @@ def capture(name: str, fn, args, domain: str = "train",
     except Exception:
         hlo_hash = "unavailable"
     arg_shapes = shape_key(args)
+    arg_shardings = sharding_key(args)
     fingerprint = hashlib.sha256(
         "|".join((name, hlo_hash) + arg_shapes).encode()).hexdigest()[:16]
 
@@ -419,7 +464,8 @@ def capture(name: str, fn, args, domain: str = "train",
         if rec is None:
             rec = ProgramRecord(fingerprint, name, domain, arg_shapes,
                                 hlo_hash, t1 - t0, flops, bytes_accessed,
-                                hbm, examples_per_call, steps_per_call)
+                                hbm, examples_per_call, steps_per_call,
+                                arg_shardings=arg_shardings)
             _records[fingerprint] = rec
         else:
             rec.compiles += 1
@@ -449,6 +495,10 @@ def capture(name: str, fn, args, domain: str = "train",
         metrics.gauge("xla_hbm_peak_bytes",
                       labels=("program", "fingerprint")).set(
             peak_bytes, program=name, fingerprint=fingerprint)
+    metrics.gauge("xla_program_sharded",
+                  labels=("program", "fingerprint")).set(
+        1.0 if rec.is_sharded else 0.0, program=name,
+        fingerprint=fingerprint)
     return rec
 
 

@@ -58,6 +58,22 @@ class ComputationGraph:
         self._input_affine = None   # (shift, scale) during device-norm fit
         self._affine_fn = None
         self._ledger_cache: Dict[Any, Any] = {}   # monitor.xla programs
+        self._plan = None           # active GSPMD ShardingPlan (parallel/plan)
+
+    def _engage_plan(self, plan):
+        """Activate a GSPMD ShardingPlan for this graph's compiled steps
+        (the shared MultiLayerNetwork._engage_plan_impl contract)."""
+        from deeplearning4j_tpu.nn.multilayer import _engage_plan_impl
+        _engage_plan_impl(self, plan)
+
+    def _shard_tuple(self, t, stacked: bool = False):
+        """Place one tuple of staged batch operands (graph inputs/labels/
+        masks) per the active plan; identity without one."""
+        plan = self._plan
+        if plan is None or t is None:
+            return t
+        return tuple(None if a is None else plan.shard_batch(a, stacked=stacked)
+                     for a in t)
 
     def set_listeners(self, *listeners):
         self.listeners = list(listeners)
@@ -344,6 +360,7 @@ class ComputationGraph:
         tx = self._tx
         layer_map = constraint_map(self)
         constrained = has_constraints(layer_map.values())
+        plan = self._plan   # GSPMD plan: sharding constraints in-jit
 
         def step(params, opt_state, state, inputs, labels, fmasks, lmasks,
                  rng, carries):
@@ -352,16 +369,27 @@ class ComputationGraph:
                                       lmasks, True, rng, carries=carries)
             (loss, (new_state, new_carries)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params)
+            if plan is not None:
+                # pin grads to the ZeRO/TP compute layout: the single
+                # hint from which XLA derives reduce-scatter -> sharded
+                # update -> all-gather (parallel/plan.py)
+                grads = plan.constrain_grads(grads)
             updates, new_opt = tx.update(grads, opt_state, params)
+            if plan is not None:
+                updates = plan.constrain_grads(updates)
             new_params = optax.apply_updates(params, updates)
             if constrained:     # post-update projection (DL4J applyConstraints)
                 new_params = apply_constraints(layer_map, new_params)
+            if plan is not None:
+                new_params = plan.constrain_params(new_params)
+                new_opt = plan.constrain_opt(new_opt, new_params)
+                new_state = plan.constrain_replicated(new_state)
             return new_params, new_opt, new_state, loss, new_carries
 
         return jax.jit(step, donate_argnums=(0, 1, 2))
 
     def fit(self, data, epochs: int = 1, scan_steps: Optional[int] = None,
-            accumulate_steps: int = 1):
+            accumulate_steps: int = 1, plan=None):
         """Train on a MultiDataSet / DataSet / iterator of either
         (ComputationGraph.fit, :1015).
 
@@ -379,10 +407,22 @@ class ComputationGraph:
             self.init()
         # donated-buffer safety: see util/params.owned_leaf (params from a
         # checkpoint or import may alias numpy memory the donating step
-        # would otherwise free)
-        self.params = param_util.own_tree(self.params)
-        self.state = param_util.own_tree(self.state)
-        self.opt_state = param_util.own_tree(self.opt_state)
+        # would otherwise free); under a GSPMD plan the laundered copies
+        # additionally land on the plan placements (docs/PARALLELISM.md)
+        from deeplearning4j_tpu.parallel.plan import active_plan
+        if plan is None:
+            plan = active_plan()
+        if plan is None and self._plan is None:
+            # deliberately inlined (mirrors _engage_plan_impl's no-plan
+            # branch): the donated-aliasing lint contract requires the
+            # own_tree laundering to live IN the module that builds the
+            # donating steps, not only behind the shared impl — keep in
+            # sync with nn/multilayer._engage_plan_impl
+            self.params = param_util.own_tree(self.params)
+            self.state = param_util.own_tree(self.state)
+            self.opt_state = param_util.own_tree(self.opt_state)
+        else:
+            self._engage_plan(plan)
         if self._train_step is None:
             self._train_step = self._make_train_step()
         if accumulate_steps > 1:
@@ -465,10 +505,21 @@ class ComputationGraph:
         # affine normalizes the full-precision values (normalize-then-
         # cast); labels still ship 16-bit
         fcast = None if self._input_affine is not None else cast
-        dev = jax.local_devices()[0]
+        # under a GSPMD plan the worker thread stages straight onto the
+        # mesh (batch dim over "data"); ragged tails degrade to the
+        # default device via the shared fallback (parallel/plan.put_batch)
+        # instead of killing the prefetch thread
+        if self._plan is not None:
+            from deeplearning4j_tpu.parallel.plan import put_batch
+            dev = self._plan.batch_sharding()
+            put_fn = put_batch
+        else:
+            dev = jax.local_devices()[0]
+            put_fn = jax.device_put
 
         def stage(mds):
-            put = lambda a: None if a is None else jax.device_put(a, dev)
+            def put(a):
+                return None if a is None else put_fn(a, dev)
             return MultiDataSet(
                 tuple(put(host_cast(f, fcast)) for f in mds.features),
                 tuple(put(host_cast(l, cast)) for l in mds.labels),
@@ -488,12 +539,16 @@ class ComputationGraph:
             etl_ms = (step_start - etl_start) * 1e3
             monitor.add_span("train/etl", etl_start, step_start,
                              iteration=self.iteration_count)
-            inputs = tuple(self._stage_x(f) for f in mds.features)
-            labels = tuple(_as_jnp(l, self._compute_dtype) for l in mds.labels)
-            fmasks = None if mds.features_masks is None else tuple(
-                _as_jnp(m) for m in mds.features_masks)
-            lmasks = None if mds.labels_masks is None else tuple(
-                _as_jnp(m) for m in mds.labels_masks)
+            inputs = self._shard_tuple(
+                tuple(self._stage_x(f) for f in mds.features))
+            labels = self._shard_tuple(
+                tuple(_as_jnp(l, self._compute_dtype) for l in mds.labels))
+            fmasks = self._shard_tuple(
+                None if mds.features_masks is None else tuple(
+                    _as_jnp(m) for m in mds.features_masks))
+            lmasks = self._shard_tuple(
+                None if mds.labels_masks is None else tuple(
+                    _as_jnp(m) for m in mds.labels_masks))
             bs = int(np.shape(mds.features[0])[0])
             if tbptt:
                 rng = self._fit_tbptt_batch(inputs, labels, fmasks,
@@ -546,6 +601,8 @@ class ComputationGraph:
         layer_map = constraint_map(self)
         constrained = has_constraints(layer_map.values())
 
+        plan = self._plan   # GSPMD plan: sharding constraints in-jit
+
         def kstep(params, opt_state, state, inputs, labels, fmasks, lmasks,
                   subs):
             def body(carry, batch):
@@ -556,10 +613,18 @@ class ComputationGraph:
                                           True, sub, carries=None)
                 (loss, (new_state, _)), grads = jax.value_and_grad(
                     loss_fn, has_aux=True)(params)
+                if plan is not None:
+                    grads = plan.constrain_grads(grads)
                 updates, new_opt = tx.update(grads, opt_state, params)
+                if plan is not None:
+                    updates = plan.constrain_grads(updates)
                 new_params = optax.apply_updates(params, updates)
                 if constrained:
                     new_params = apply_constraints(layer_map, new_params)
+                if plan is not None:
+                    new_params = plan.constrain_params(new_params)
+                    new_opt = plan.constrain_opt(new_opt, new_params)
+                    new_state = plan.constrain_replicated(new_state)
                 return (new_params, new_opt, new_state), loss
 
             (params, opt_state, state), losses = jax.lax.scan(
@@ -596,6 +661,8 @@ class ComputationGraph:
         layer_map = constraint_map(self)
         constrained = has_constraints(layer_map.values())
 
+        plan = self._plan   # GSPMD plan: sharding constraints in-jit
+
         def kaccum(params, opt_state, state, inputs, labels, fmasks,
                    lmasks, subs):
             k = subs.shape[0]
@@ -609,6 +676,11 @@ class ComputationGraph:
                 (loss, (new_state, _)), grads = jax.value_and_grad(
                     loss_fn, has_aux=True)(params)
                 gsum = jax.tree_util.tree_map(jnp.add, gsum, grads)
+                if plan is not None:
+                    # the accumulator carries in the ZeRO layout: micro-
+                    # batch grads reduce-scatter into it instead of ever
+                    # materializing whole per chip
+                    gsum = plan.constrain_grads(gsum)
                 return (gsum, new_state), loss
 
             zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
@@ -617,9 +689,15 @@ class ComputationGraph:
                                        subs))
             grads = jax.tree_util.tree_map(lambda g: g / k, gsum)
             updates, new_opt = tx.update(grads, opt_state, params)
+            if plan is not None:
+                updates = plan.constrain_grads(updates)
             new_params = optax.apply_updates(params, updates)
             if constrained:
                 new_params = apply_constraints(layer_map, new_params)
+            if plan is not None:
+                new_params = plan.constrain_params(new_params)
+                new_opt = plan.constrain_opt(new_opt, new_params)
+                state = plan.constrain_replicated(state)
             return new_params, new_opt, state, jnp.mean(losses)
 
         return jax.jit(kaccum, donate_argnums=(0, 1, 2))
@@ -655,6 +733,10 @@ class ComputationGraph:
             items = [self._mds_to_dev(m) for m in group]
             inputs, labels, fmasks, lmasks = jax.tree_util.tree_map(
                 lambda *xs: jnp.stack(xs), *items)
+            inputs = self._shard_tuple(inputs, stacked=True)
+            labels = self._shard_tuple(labels, stacked=True)
+            fmasks = self._shard_tuple(fmasks, stacked=True)
+            lmasks = self._shard_tuple(lmasks, stacked=True)
             sig = ("accum", fmasks is not None, lmasks is not None)
             if sig not in self._scan_step:
                 self._scan_step[sig] = self._make_accum_step()
@@ -745,6 +827,10 @@ class ComputationGraph:
                 losses = []
                 for mds, sub in zip(group, subs):
                     inputs, labels, fmasks, lmasks = to_dev(mds)
+                    inputs = self._shard_tuple(inputs)
+                    labels = self._shard_tuple(labels)
+                    fmasks = self._shard_tuple(fmasks)
+                    lmasks = self._shard_tuple(lmasks)
                     (self.params, self.opt_state, self.state, loss,
                      _) = self._train_step(
                         self.params, self.opt_state, self.state, inputs,
@@ -754,6 +840,10 @@ class ComputationGraph:
             items = [to_dev(m) for m in group]
             inputs, labels, fmasks, lmasks = jax.tree_util.tree_map(
                 lambda *xs: jnp.stack(xs), *items)
+            inputs = self._shard_tuple(inputs, stacked=True)
+            labels = self._shard_tuple(labels, stacked=True)
+            fmasks = self._shard_tuple(fmasks, stacked=True)
+            lmasks = self._shard_tuple(lmasks, stacked=True)
             sig = (len(group), fmasks is not None, lmasks is not None)
             if sig not in self._scan_step:
                 self._scan_step[sig] = self._make_scan_step()

@@ -209,6 +209,51 @@ def _default_scan_steps() -> int:
     return 10 if is_tpu_backend() else 1
 
 
+def _engage_plan_impl(net, plan):
+    """Shared by MultiLayerNetwork/ComputationGraph (and the resilience
+    drivers): activate a GSPMD ShardingPlan for a net's compiled steps —
+    or plain single-device training when None. Either way
+    params/opt/state are laundered into XLA-owned buffers
+    (donated-buffer safety, util/params.owned_leaf); under a plan the
+    laundered copies additionally land on the plan's placements
+    (sharding-aware own_tree), and a plan CHANGE drops the compiled-step
+    caches so the next step re-lowers against the new layout instead of
+    silently running the old one."""
+    prior = net._plan
+    if plan != prior:
+        net._plan = plan
+        net._train_step = None
+        net._scan_step = {}
+        net._output_fn = None
+        # the ledger cache keys on id(step_fn): with the old jitted fns
+        # dropped above, CPython may reuse their ids for the NEW steps —
+        # a stale hit would misattribute the re-compiled (sharded)
+        # program's timings to the old record
+        net._ledger_cache = {}
+    if plan is None:
+        if prior is not None:
+            # leaving a plan: gather mesh-committed leaves back to the
+            # default device FIRST — the owned copy below preserves
+            # committed shardings, and a plain fit stages its batches
+            # single-device (incompatible-devices error otherwise)
+            dev = jax.local_devices()[0]
+            gather = lambda t: jax.tree_util.tree_map(
+                lambda a: jax.device_put(a, dev), t)
+            net.params = gather(net.params)
+            net.state = gather(net.state)
+            net.opt_state = gather(net.opt_state)
+        net.params = param_util.own_tree(net.params)
+        net.state = param_util.own_tree(net.state)
+        net.opt_state = param_util.own_tree(net.opt_state)
+    else:
+        net.params = param_util.own_tree(
+            net.params, plan.param_shardings(net.params))
+        net.state = param_util.own_tree(
+            net.state, plan.replicated_shardings(net.state))
+        net.opt_state = param_util.own_tree(
+            net.opt_state, plan.opt_shardings(net.opt_state, net.params))
+
+
 def _stage_with_affine(net, a):
     """Features -> device, shared by MultiLayerNetwork._stage_x and
     ComputationGraph._stage_x. With a device affine engaged (fit through
@@ -300,10 +345,27 @@ class MultiLayerNetwork:
         self._input_affine = None   # (shift, scale) during device-norm fit
         self._affine_fn = None
         self._ledger_cache: Dict[Any, Any] = {}   # monitor.xla programs
+        self._plan = None           # active GSPMD ShardingPlan (parallel/plan)
 
     # ------------------------------------------------------------ plumbing
     def _stage_x(self, a):
         return _stage_with_affine(self, a)
+
+    def _engage_plan(self, plan):
+        """Activate a GSPMD ShardingPlan (parallel/plan.py) for this
+        net's compiled steps — or plain single-device training when
+        None (the shared `_engage_plan_impl`; also used by
+        ComputationGraph and the ResilientTrainer drivers)."""
+        _engage_plan_impl(self, plan)
+
+    def _shard_batch(self, *arrs, stacked: bool = False):
+        """Place staged batch operands per the active plan — dim 0 (dim
+        1 for host-stacked scan/accum chunks) split over the mesh "data"
+        axis. Identity without a plan."""
+        plan = self._plan
+        if plan is None:
+            return arrs
+        return tuple(plan.shard_batch(a, stacked=stacked) for a in arrs)
 
     def set_listeners(self, *listeners):
         self.listeners = list(listeners)
@@ -515,6 +577,7 @@ class MultiLayerNetwork:
         tx = self._tx
         constrained = has_constraints(self.layers)
         layer_map = constraint_map(self)
+        plan = self._plan   # GSPMD plan: sharding constraints in-jit
 
         def step(params, opt_state, state, x, y, fmask, lmask, rng, carries):
             def loss_fn(p):
@@ -522,10 +585,21 @@ class MultiLayerNetwork:
                                       carries=carries)
             (loss, (new_state, new_carries)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params)
+            if plan is not None:
+                # pin grads to the ZeRO/TP compute layout: this single
+                # hint makes XLA derive reduce-scatter -> sharded update
+                # -> all-gather (parallel/plan.py)
+                grads = plan.constrain_grads(grads)
             updates, new_opt = tx.update(grads, opt_state, params)
+            if plan is not None:
+                updates = plan.constrain_grads(updates)
             new_params = optax.apply_updates(params, updates)
             if constrained:     # post-update projection (DL4J applyConstraints)
                 new_params = apply_constraints(layer_map, new_params)
+            if plan is not None:
+                new_params = plan.constrain_params(new_params)
+                new_opt = plan.constrain_opt(new_opt, new_params)
+                new_state = plan.constrain_replicated(new_state)
             if with_stats:
                 # StatsListener capture iterations also return the raw
                 # gradient and update pytrees (DL4J onGradientCalculation /
@@ -549,7 +623,8 @@ class MultiLayerNetwork:
     def fit(self, data, epochs: int = 1, batch_size: int = 32,
             scan_steps: Optional[int] = None,
             prefetch: Optional[bool] = None,
-            accumulate_steps: int = 1):
+            accumulate_steps: int = 1,
+            plan=None):
         """Train (DL4J fit(DataSetIterator), :1268). Accepts a DataSetIterator,
         a DataSet, or (features, labels) arrays.
 
@@ -589,15 +664,23 @@ class MultiLayerNetwork:
         image path's automatic delegation in data/records.py) compose:
         the wrap's prefetch thread is the ring consumer, so worker
         decode, device DMA, and the compiled step all overlap — see
-        docs/DATA_PIPELINE.md."""
+        docs/DATA_PIPELINE.md.
+
+        `plan` (or an enclosing `parallel.use_mesh(plan)` context): a
+        GSPMD ShardingPlan (parallel/plan.py) — the SAME compiled step
+        runs SPMD over the plan's ("data", "model") mesh with DP
+        all-reduce, tensor-parallel matmuls, and ZeRO reduce-scatter/
+        all-gather as jit-inserted collectives. See docs/PARALLELISM.md."""
         if self.params is None:
             self.init()
         # donated-buffer safety: params from ANY host source (checkpoint,
         # keras/dl4j import, set_params_flat) may alias numpy memory that
-        # the donating train step must not free (util/params.owned_leaf)
-        self.params = param_util.own_tree(self.params)
-        self.state = param_util.own_tree(self.state)
-        self.opt_state = param_util.own_tree(self.opt_state)
+        # the donating train step must not free (util/params.owned_leaf);
+        # under a plan the laundered copies land on the plan placements
+        from deeplearning4j_tpu.parallel.plan import active_plan
+        if plan is None:
+            plan = active_plan()
+        self._engage_plan(plan)
         if accumulate_steps > 1:
             if self.conf.backprop_type == "tbptt":
                 raise ValueError("accumulate_steps does not apply to "
@@ -653,6 +736,11 @@ class MultiLayerNetwork:
                     and getattr(iterator, "async_supported", True):
                 iterator = AsyncDataSetIterator(
                     iterator, device_put=not stacking,
+                    # under a plan the worker thread stages straight onto
+                    # the mesh (device arg accepts a Sharding), so the
+                    # double-buffered H2D lands already batch-sharded
+                    device=(self._plan.batch_sharding()
+                            if self._plan is not None else None),
                     cast_dtype=self._compute_dtype
                     if np.dtype(self._compute_dtype).itemsize == 2
                     else None,
@@ -765,6 +853,7 @@ class MultiLayerNetwork:
             ys = _as_jnp(ds.labels, self._compute_dtype)
             fm = _as_jnp(ds.features_mask)
             lm = _as_jnp(ds.labels_mask)
+            xs, ys, fm, lm = self._shard_batch(xs, ys, fm, lm)
             out = step(self.params, self.opt_state, self.state,
                        xs, ys, fm, lm, sub, None)
             grads = updates = None
@@ -815,6 +904,7 @@ class MultiLayerNetwork:
         tx = self._tx
         constrained = has_constraints(self.layers)
         layer_map = constraint_map(self)
+        plan = self._plan   # GSPMD plan: sharding constraints in-jit
 
         def kstep(params, opt_state, state, xs, ys, fms, lms, subs):
             def body(carry, batch):
@@ -825,10 +915,18 @@ class MultiLayerNetwork:
                                           carries=None)
                 (loss, (new_state, _)), grads = jax.value_and_grad(
                     loss_fn, has_aux=True)(params)
+                if plan is not None:
+                    grads = plan.constrain_grads(grads)
                 updates, new_opt = tx.update(grads, opt_state, params)
+                if plan is not None:
+                    updates = plan.constrain_grads(updates)
                 new_params = optax.apply_updates(params, updates)
                 if constrained:
                     new_params = apply_constraints(layer_map, new_params)
+                if plan is not None:
+                    new_params = plan.constrain_params(new_params)
+                    new_opt = plan.constrain_opt(new_opt, new_params)
+                    new_state = plan.constrain_replicated(new_state)
                 return (new_params, new_opt, new_state), loss
 
             (params, opt_state, state), losses = jax.lax.scan(
@@ -855,6 +953,7 @@ class MultiLayerNetwork:
         tx = self._tx
         constrained = has_constraints(self.layers)
         layer_map = constraint_map(self)
+        plan = self._plan   # GSPMD plan: sharding constraints in-jit
 
         def kaccum(params, opt_state, state, xs, ys, fms, lms, subs):
             def body(carry, batch):
@@ -866,6 +965,11 @@ class MultiLayerNetwork:
                 (loss, (new_state, _)), grads = jax.value_and_grad(
                     loss_fn, has_aux=True)(params)
                 gsum = jax.tree_util.tree_map(jnp.add, gsum, grads)
+                if plan is not None:
+                    # the accumulator carries in the ZeRO layout: micro-
+                    # batch grads reduce-scatter into it instead of ever
+                    # materializing whole per chip
+                    gsum = plan.constrain_grads(gsum)
                 return (gsum, new_state), loss
 
             zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
@@ -874,9 +978,15 @@ class MultiLayerNetwork:
             grads = jax.tree_util.tree_map(
                 lambda g: g / subs.shape[0], gsum)
             updates, new_opt = tx.update(grads, opt_state, params)
+            if plan is not None:
+                updates = plan.constrain_grads(updates)
             new_params = optax.apply_updates(params, updates)
             if constrained:
                 new_params = apply_constraints(layer_map, new_params)
+            if plan is not None:
+                new_params = plan.constrain_params(new_params)
+                new_opt = plan.constrain_opt(new_opt, new_params)
+                state = plan.constrain_replicated(state)
             if with_stats:
                 return (new_params, new_opt, state, jnp.mean(losses),
                         grads, updates)
@@ -958,6 +1068,8 @@ class MultiLayerNetwork:
             ys = stack(lambda d: d.labels, self._compute_dtype)
             fms = stack(lambda d: d.features_mask)
             lms = stack(lambda d: d.labels_mask)
+            xs, ys, fms, lms = self._shard_batch(xs, ys, fms, lms,
+                                                 stacked=True)
             capture = [lst for lst in grad_listeners
                        if lst.should_capture(self.iteration_count)]
             kstep = self._get_accum_step(with_stats=bool(capture))
@@ -1061,11 +1173,13 @@ class MultiLayerNetwork:
                                             ds0.labels_mask, None)
                 losses = []
                 for ds, sub in zip(group, subs):
+                    txs, tys, tfm, tlm = self._shard_batch(
+                        self._stage_x(ds.features),
+                        _as_jnp(ds.labels, self._compute_dtype),
+                        _as_jnp(ds.features_mask),
+                        _as_jnp(ds.labels_mask))
                     out = step(self.params, self.opt_state, self.state,
-                               self._stage_x(ds.features),
-                               _as_jnp(ds.labels, self._compute_dtype),
-                               _as_jnp(ds.features_mask),
-                               _as_jnp(ds.labels_mask), sub, None)
+                               txs, tys, tfm, tlm, sub, None)
                     self.params, self.opt_state, self.state, loss, _ = out
                     losses.append(loss)
                 losses = jnp.stack(losses)
@@ -1079,6 +1193,8 @@ class MultiLayerNetwork:
                 ys = stack(lambda d: d.labels, self._compute_dtype)
                 fms = stack(lambda d: d.features_mask)
                 lms = stack(lambda d: d.labels_mask)
+                xs, ys, fms, lms = self._shard_batch(xs, ys, fms, lms,
+                                                     stacked=True)
                 kstep = self._get_scan_step(fms, lms, len(group))
                 subs_d = jnp.stack(subs)
                 (self.params, self.opt_state, self.state,
@@ -1128,11 +1244,12 @@ class MultiLayerNetwork:
                 lm = ds.labels_mask[:, t0:t1] if ds.labels_mask is not None else None
                 rng, sub = jax.random.split(rng)
                 step = self._get_train_step(fm, lm, carries)
+                txs, tys, tfm, tlm = self._shard_batch(
+                    self._stage_x(x), _as_jnp(y, self._compute_dtype),
+                    _as_jnp(fm), _as_jnp(lm))
                 self.params, self.opt_state, self.state, loss, new_carries = step(
                     self.params, self.opt_state, self.state,
-                    self._stage_x(x),
-                    _as_jnp(y, self._compute_dtype),
-                    _as_jnp(fm), _as_jnp(lm), sub, carries)
+                    txs, tys, tfm, tlm, sub, carries)
                 # stop gradient across chunk boundary
                 carries = jax.tree_util.tree_map(jax.lax.stop_gradient, new_carries)
                 # graftlint: disable=host-sync-in-hot-path -- the tbptt chunk's one budgeted loss fetch

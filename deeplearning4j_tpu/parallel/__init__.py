@@ -37,6 +37,9 @@ from deeplearning4j_tpu.parallel.shared import (
 from deeplearning4j_tpu.parallel.zero import (
     sharded_fraction, zero_place, zero_spec,
 )
+from deeplearning4j_tpu.parallel.plan import (
+    ShardingPlan, active_plan, parse_plan, use_mesh,
+)
 
 __all__ = [
     "MeshConfig", "build_mesh", "data_sharding", "replicated_sharding",
@@ -51,4 +54,5 @@ __all__ = [
     "ContextParallelTrainer", "PipelineParallelTrainer",
     "SharedGradientsTrainer", "LoopbackTransport",
     "zero_place", "zero_spec", "sharded_fraction",
+    "ShardingPlan", "use_mesh", "active_plan", "parse_plan",
 ]

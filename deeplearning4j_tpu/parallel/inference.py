@@ -59,10 +59,19 @@ class ParallelInference:
     def __init__(self, model, mesh: Optional[Mesh] = None,
                  mode: InferenceMode = InferenceMode.BATCHED,
                  max_batch_size: int = 64,
-                 queue_limit: int = 64):
+                 queue_limit: int = 64,
+                 plan=None):
         if model.params is None:
             raise RuntimeError("model must be initialized before serving")
         self.model = model
+        # `plan` (parallel/plan.ShardingPlan): serve a TENSOR-PARALLEL
+        # servable — params placed per the plan's rules (Megatron
+        # column/row kernels stay sharded over "model" in HBM, the same
+        # rule table training used) while the batch still shards over
+        # "data". Without a plan, params replicate (pure replica DP).
+        self._plan = plan
+        if plan is not None and mesh is None:
+            mesh = plan.mesh()
         self.mesh = mesh if mesh is not None else build_mesh(MeshConfig())
         self.mode = InferenceMode(mode)
         self.max_batch_size = int(max_batch_size)
@@ -111,10 +120,14 @@ class ParallelInference:
             pad = np.zeros((pad_to - n,) + x.shape[1:], x.dtype)
             x = np.concatenate([x, pad], axis=0)
         xd = jax.device_put(jnp.asarray(x), self._shard)
-        # replicate weights over the mesh (no-op when already placed —
-        # required when update_model swapped in a single-device model)
+        # place weights over the mesh (no-op when already placed —
+        # required when update_model swapped in a single-device model):
+        # replicated without a plan, per the plan's TP rules with one
         rep = NamedSharding(self.mesh, P())
-        params = jax.device_put(params, rep)
+        if self._plan is not None:
+            params = self._plan.place_params(params)
+        else:
+            params = jax.device_put(params, rep)
         state = jax.device_put(state, rep)
         if xla_ledger.enabled():
             # ledger capture of the serving forward: one program per

@@ -1,0 +1,424 @@
+"""One mesh, one step — the unified GSPMD sharding plan.
+
+ROADMAP item 1: the reference's four data-parallel variants and this
+repo's own parallelism islands (wrapper.py SYNC_GRADIENTS, zero.py
+placement, sharding.py TP rules) collapse onto ONE declarative object.
+A :class:`ShardingPlan` names a 2-D logical mesh (``("data", "model")``),
+a per-leaf `PartitionSpec` rule table (:class:`ShardingRules`), a ZeRO
+stage, and the batch spec — and the **existing default fit()** compiles
+it: `nn/multilayer.py` and `nn/graph.py` place params/opt-state on the
+plan's shardings at fit entry and pin gradients/updates/new-state with
+``with_sharding_constraint`` inside the already-jitted train step, so
+
+- DP's gradient all-reduce,
+- Megatron column/row tensor-parallel matmuls, and
+- ZeRO's reduce-scatter / sharded-update / all-gather schedule
+
+are all collectives XLA's SPMD partitioner derives inside ONE compiled
+program per (plan, batch shape) — no trainer subclasses, no transports,
+no hand-rolled gather/scatter. This is the SNIPPETS.md [1]/[3] recipe:
+declare placements once, scale by changing the plan, never the code.
+
+Spec derivation (the whole scheme):
+
+====================  ===========================  =====================
+pytree                placement at fit entry       in-jit constraint
+====================  ===========================  =====================
+params                rules spec (+ ``data`` dim   same (``param_spec``)
+                      overlay at zero_stage 3)
+grads / updates       —                            rules spec + ``data``
+                                                   overlay at stage >= 1
+                                                   (``state_spec``)
+optimizer state       ``state_spec`` per matching  same
+                      param path; replicated else
+layer state (BN)      replicated                   replicated
+batch (x/y/masks)     dim 0 over ``data``          (propagated)
+====================  ===========================  =====================
+
+The ``data`` overlay shards the first rule-free, evenly-divisible dim
+over the data axis — dim 0 for plain kernels (the legacy `zero.py`
+rule), the first TP-free dim when tensor parallelism already claimed
+one. Leaves too small to split stay replicated (their bytes are noise
+next to the kernels, and padding would cost more than it saves).
+
+Activation: pass ``net.fit(..., plan=plan)``, or make it process-wide::
+
+    with parallel.use_mesh(ShardingPlan(data=4, model=2,
+                                        rules=ShardingRules.megatron(),
+                                        zero_stage=1)):
+        net.fit(iterator, epochs=3)        # existing script, unchanged
+
+`ResilientTrainer`, the train CLI (``--mesh``), `ParallelWrapper`
+(SYNC_GRADIENTS) and `bench.py --mode mesh` all resolve
+:func:`active_plan` the same way. See docs/PARALLELISM.md.
+"""
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import logging
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.parallel.mesh import (
+    DATA_AXIS, MODEL_AXIS, MeshConfig, build_mesh,
+)
+from deeplearning4j_tpu.parallel.sharding import ShardingRules
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+VALID_ZERO_STAGES = (0, 1, 3)
+
+
+def overlay_data_spec(spec: P, shape: Tuple[int, ...], n_data: int) -> P:
+    """THE ZeRO sharding rule, shared with `parallel/zero.py`: overlay
+    the ``data`` axis onto the first dimension the base `spec` leaves
+    free and that splits evenly over `n_data`. Returns `spec` unchanged
+    when nothing qualifies (small biases, scalars, step counters)."""
+    if n_data <= 1:
+        return spec
+    dims: List = list(spec) + [None] * (len(shape) - len(spec))
+    for i, d in enumerate(dims):
+        if d is None and shape[i] >= n_data and shape[i] % n_data == 0:
+            dims[i] = DATA_AXIS
+            break
+    else:
+        return spec
+    while dims and dims[-1] is None:
+        dims.pop()
+    return P(*dims)
+
+
+def _pad_spec(spec: P, ndim: int) -> P:
+    """Clamp a rule spec to the leaf's rank (a 2-D rule on a 1-D bias
+    degrades to replicated, matching ShardingRules.spec_for)."""
+    if len(spec) > ndim:
+        return P()
+    return spec
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardingPlan:
+    """Declarative parallelism: mesh extents + per-leaf specs + ZeRO.
+
+    ``data=-1`` means "all remaining devices" (MeshConfig semantics).
+    ``rules=None`` is pure data parallelism (every param replicated).
+    Frozen + comparable: the fit paths key their compiled-step caches on
+    plan equality, so two equal plans share programs and a changed plan
+    forces the re-trace it needs.
+    """
+
+    data: int = -1
+    model: int = 1
+    rules: Optional[ShardingRules] = None
+    zero_stage: int = 0
+    #: prebuilt mesh (ParallelWrapper hands in its own); None -> built
+    #: from the data/model extents over all devices.
+    mesh_override: Optional[Mesh] = None
+
+    def __post_init__(self):
+        if self.zero_stage not in VALID_ZERO_STAGES:
+            raise ValueError(
+                f"zero_stage must be one of {VALID_ZERO_STAGES} (got "
+                f"{self.zero_stage}); stage 2 is subsumed by stage 1 — "
+                "the reduce-scattered gradient never materializes whole")
+
+    @classmethod
+    def for_mesh(cls, mesh: Mesh, rules: Optional[ShardingRules] = None,
+                 zero_stage: int = 0) -> "ShardingPlan":
+        """Wrap an existing mesh (axis sizes read off it) — the
+        ParallelWrapper shim path."""
+        return cls(data=int(mesh.shape.get(DATA_AXIS, 1)),
+                   model=int(mesh.shape.get(MODEL_AXIS, 1)),
+                   rules=rules, zero_stage=zero_stage, mesh_override=mesh)
+
+    # ----------------------------------------------------------- topology
+    def mesh(self) -> Mesh:
+        if self.mesh_override is not None:
+            return self.mesh_override
+        cached = _MESH_CACHE.get((self.data, self.model))
+        if cached is None:
+            cached = build_mesh(MeshConfig(data=self.data, model=self.model))
+            _MESH_CACHE[(self.data, self.model)] = cached
+        return cached
+
+    @property
+    def data_degree(self) -> int:
+        return int(self.mesh().shape[DATA_AXIS])
+
+    @property
+    def model_degree(self) -> int:
+        return int(self.mesh().shape.get(MODEL_AXIS, 1))
+
+    def describe(self) -> dict:
+        """JSON-able summary (bench rows, checkpoint extras, logs)."""
+        return {"data": self.data_degree, "model": self.model_degree,
+                "zero_stage": self.zero_stage,
+                "rules": None if self.rules is None
+                else [[pat, str(spec)] for pat, spec in self.rules.rules]}
+
+    # ------------------------------------------------------------- specs
+    def _rule_spec(self, path: str, ndim: int) -> P:
+        if self.rules is None:
+            return P()
+        return _pad_spec(self.rules.spec_for(path, ndim), ndim)
+
+    def param_spec(self, path: str, leaf) -> P:
+        """Stored-parameter layout: TP rules, plus the ZeRO ``data``
+        overlay at stage 3 (params live sharded in HBM)."""
+        shape = tuple(getattr(leaf, "shape", ()))
+        spec = self._rule_spec(path, len(shape))
+        if self.zero_stage == 3:
+            spec = overlay_data_spec(spec, shape, self.data_degree)
+        return spec
+
+    def state_spec(self, path: str, leaf) -> P:
+        """Gradient/update/optimizer-moment layout: TP rules, plus the
+        ``data`` overlay at any ZeRO stage — constraining grads to this
+        is the single hint from which XLA derives reduce-scatter →
+        sharded optimizer math → all-gather."""
+        shape = tuple(getattr(leaf, "shape", ()))
+        spec = self._rule_spec(path, len(shape))
+        if self.zero_stage >= 1:
+            spec = overlay_data_spec(spec, shape, self.data_degree)
+        return spec
+
+    def batch_sharding(self) -> NamedSharding:
+        """Global-batch placement: dim 0 split over ``data``."""
+        return NamedSharding(self.mesh(), P(DATA_AXIS))
+
+    # ------------------------------------------------- pytree path walks
+    def _walk(self, tree, leaf_fn, prefix=""):
+        if isinstance(tree, dict):
+            return {k: self._walk(v, leaf_fn, f"{prefix}{k}/")
+                    for k, v in tree.items()}
+        if isinstance(tree, (list, tuple)):
+            out = [self._walk(v, leaf_fn, f"{prefix}{i}/")
+                   for i, v in enumerate(tree)]
+            return type(tree)(out) if isinstance(tree, tuple) else out
+        if tree is None:
+            return None
+        return leaf_fn(prefix[:-1], tree)
+
+    def param_shardings(self, params):
+        """Pytree of NamedShardings congruent with `params` — the
+        sharding-aware `util/params.own_tree` placement argument."""
+        mesh = self.mesh()
+        return self._walk(params, lambda p, leaf: NamedSharding(
+            mesh, self.param_spec(p, leaf)))
+
+    def replicated_shardings(self, tree):
+        mesh = self.mesh()
+        rep = NamedSharding(mesh, P())
+        return jax.tree_util.tree_map(lambda _: rep, tree)
+
+    def opt_shardings(self, opt_state, params):
+        """Shardings congruent with an optax state pytree: any subtree
+        congruent with `params` (Adam's mu/nu, momentum buffers) gets the
+        per-path ``state_spec``; everything else (step counters, empty
+        states) follows the conservative per-leaf fallback — the ``data``
+        overlay at ZeRO stages, replicated otherwise."""
+        mesh = self.mesh()
+        pstruct = jax.tree_util.tree_structure(params)
+
+        def fallback(leaf):
+            spec = P()
+            if self.zero_stage >= 1:
+                spec = overlay_data_spec(
+                    spec, tuple(getattr(leaf, "shape", ())),
+                    self.data_degree)
+            return NamedSharding(mesh, spec)
+
+        def walk(node):
+            if node is None:
+                return None
+            # unregistered/exotic nodes flatten to a single leaf, so the
+            # structure probe is total — no match falls through to the
+            # container walk / per-leaf fallback
+            if jax.tree_util.tree_structure(node) == pstruct:
+                return self._walk(node, lambda p, leaf: NamedSharding(
+                    mesh, self.state_spec(p, leaf)))
+            if isinstance(node, dict):
+                return {k: walk(v) for k, v in node.items()}
+            if isinstance(node, tuple) and hasattr(node, "_fields"):
+                return type(node)(*[walk(getattr(node, f))
+                                    for f in node._fields])
+            if isinstance(node, (tuple, list)):
+                out = [walk(v) for v in node]
+                return tuple(out) if isinstance(node, tuple) else out
+            return fallback(node)
+
+        return walk(opt_state)
+
+    # -------------------------------------------- host-side placement
+    def place_params(self, params):
+        """device_put a params pytree onto the plan's stored layout
+        (idempotent — correctly-placed leaves pass through for free)."""
+        return jax.tree_util.tree_map(
+            jax.device_put, params, self.param_shardings(params))
+
+    def place_opt(self, opt_state, params):
+        """device_put an optax state onto the plan's ZeRO/TP layout."""
+        return jax.tree_util.tree_map(
+            lambda a, s: a if s is None else jax.device_put(a, s),
+            opt_state, self.opt_shardings(opt_state, params),
+            is_leaf=lambda x: x is None)
+
+    def place_replicated(self, tree):
+        rep = NamedSharding(self.mesh(), P())
+        return jax.tree_util.tree_map(
+            lambda a: jax.device_put(a, rep), tree)
+
+    # ------------------------------------------------ in-jit constraints
+    def constrain_params(self, params):
+        """Pin a params-shaped pytree (new params) to the stored layout."""
+        mesh = self.mesh()
+        return self._walk(
+            params,
+            lambda p, leaf: jax.lax.with_sharding_constraint(
+                leaf, NamedSharding(mesh, self.param_spec(p, leaf))))
+
+    def constrain_grads(self, grads):
+        """Pin a params-shaped pytree (grads / updates) to the ZeRO/TP
+        compute layout."""
+        mesh = self.mesh()
+        return self._walk(
+            grads,
+            lambda p, leaf: jax.lax.with_sharding_constraint(
+                leaf, NamedSharding(mesh, self.state_spec(p, leaf))))
+
+    def constrain_opt(self, opt_state, params):
+        """Pin new optimizer state; layout identical to `opt_shardings`
+        so the donated input buffers stay reusable across steps."""
+        shardings = self.opt_shardings(opt_state, params)
+        return jax.tree_util.tree_map(
+            lambda leaf, s: leaf if s is None
+            else jax.lax.with_sharding_constraint(leaf, s),
+            opt_state, shardings,
+            is_leaf=lambda x: x is None)
+
+    def constrain_replicated(self, tree):
+        mesh = self.mesh()
+        rep = NamedSharding(mesh, P())
+        return jax.tree_util.tree_map(
+            lambda leaf: jax.lax.with_sharding_constraint(leaf, rep), tree)
+
+    # --------------------------------------------------------- the batch
+    def shard_batch(self, a, stacked: bool = False):
+        """Place one batch array with its batch dim split over ``data``
+        (dim 1 for the scan/accum paths' host-stacked ``(K, B, ...)``
+        arrays). Already-correctly-placed arrays pass through for free
+        (device_put is an identity there); HOST arrays transfer each
+        shard's slice directly — never a whole-batch hop through the
+        default device first. Batches whose batch dim does not divide
+        the data degree fall back unsharded with a one-time warning —
+        the step still runs correctly (XLA reshards), it just pays a
+        gather; use drop_last / padded iterators for uniform shapes."""
+        if a is None:
+            return None
+        shape = np.shape(a)
+        dim = 1 if stacked else 0
+        n = self.data_degree
+        if len(shape) <= dim or (shape[dim] % n) != 0:
+            _warn_ragged(shape, n)
+            return a if isinstance(a, jax.Array) else jnp.asarray(a)
+        spec = P(*([None] * dim + [DATA_AXIS]))
+        return jax.device_put(a, NamedSharding(self.mesh(), spec))
+
+
+#: (data, model) -> Mesh; meshes are process-wide singletons so equal
+#: plans share device placements (and NamedSharding equality holds).
+_MESH_CACHE: dict = {}
+_warned_ragged_batch: list = []
+
+
+def _warn_ragged(shape, n_data):
+    if not _warned_ragged_batch:
+        _warned_ragged_batch.append(True)
+        log.warning(
+            "ShardingPlan: batch shape %s not divisible by data degree "
+            "%d — staging unsharded (correct but slower; use drop_last "
+            "for uniform shapes)", tuple(shape), n_data)
+
+
+def put_batch(a, target):
+    """THE ragged-mesh device_put fallback, shared by every staging path
+    that places batches onto a plan sharding (AsyncDataSetIterator's
+    worker, the graph MultiDataSet prefetch stage, shard_batch's
+    explicit check): a placement ValueError — batch dim not divisible by
+    the mesh — degrades to default-device staging with a ONE-TIME
+    warning instead of killing the staging thread or the fit."""
+    try:
+        return jax.device_put(a, target)
+    except ValueError:
+        _warn_ragged(np.shape(a), getattr(target, "num_devices", 0))
+        return jax.device_put(a)
+
+
+# -------------------------------------------------------- process context
+_ACTIVE: List[ShardingPlan] = []
+
+
+def active_plan() -> Optional[ShardingPlan]:
+    """The innermost `use_mesh` plan, or None. Resolved by
+    MultiLayerNetwork/ComputationGraph.fit, ResilientTrainer,
+    ParallelWrapper, and bench.py — the zero-code-change pickup."""
+    return _ACTIVE[-1] if _ACTIVE else None
+
+
+@contextlib.contextmanager
+def use_mesh(plan: ShardingPlan):
+    """Process-wide plan activation::
+
+        with parallel.use_mesh(ShardingPlan(data=8)):
+            net.fit(iterator)      # existing call, now mesh-sharded
+    """
+    if not isinstance(plan, ShardingPlan):
+        raise TypeError(f"use_mesh expects a ShardingPlan, got "
+                        f"{type(plan).__name__}")
+    _ACTIVE.append(plan)
+    try:
+        yield plan
+    finally:
+        _ACTIVE.pop()
+
+
+def parse_plan(spec: str) -> ShardingPlan:
+    """CLI surface: ``"data=4,model=2,zero=1,rules=megatron"`` ->
+    ShardingPlan. Unknown keys fail loudly (a typo'd axis must not
+    silently train unsharded)."""
+    kw: dict = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(f"--mesh entry {part!r} is not key=value")
+        k, v = (s.strip() for s in part.split("=", 1))
+        if k in ("data", "dp"):
+            kw["data"] = int(v)
+        elif k in ("model", "tp"):
+            kw["model"] = int(v)
+        elif k in ("zero", "zero_stage"):
+            kw["zero_stage"] = int(v)
+        elif k == "rules":
+            if v != "megatron":
+                raise ValueError(f"unknown rules preset {v!r} "
+                                 "(known: megatron)")
+            kw["rules"] = ShardingRules.megatron()
+        else:
+            raise ValueError(f"unknown --mesh key {k!r} "
+                             "(known: data, model, zero, rules)")
+    return ShardingPlan(**kw)
+
+
+def leaf_shard_shape(leaf) -> Tuple[int, ...]:
+    """Per-device shard shape of a placed leaf (test/diagnostic helper)."""
+    shards = getattr(leaf, "addressable_shards", None)
+    if not shards:
+        return tuple(np.shape(leaf))
+    return tuple(shards[0].data.shape)

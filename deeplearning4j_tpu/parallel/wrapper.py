@@ -47,6 +47,7 @@ from deeplearning4j_tpu.parallel import zero
 from deeplearning4j_tpu.parallel.mesh import (
     DATA_AXIS, build_mesh, MeshConfig, stacked_sharding,
 )
+from deeplearning4j_tpu.parallel.plan import ShardingPlan, active_plan
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 log = logging.getLogger("deeplearning4j_tpu")
@@ -83,12 +84,29 @@ class ParallelWrapper:
                  averaging_frequency: int = 5,
                  average_updaters: bool = True,
                  report_score_after_averaging: bool = False,
-                 zero_stage: int = 0):
+                 zero_stage: int = 0,
+                 plan: Optional[ShardingPlan] = None):
         if model.params is None:
             model.init()
         self.model = model
-        self.mesh = mesh if mesh is not None else build_mesh(MeshConfig())
+        # since PR 10 the wrapper is a thin shim over a GSPMD
+        # ShardingPlan (parallel/plan.py): an explicit `plan` (or, with
+        # no explicit mesh/zero args, the process-wide use_mesh plan)
+        # supplies mesh extents, TP rules and ZeRO stage; otherwise a
+        # DP-only plan is derived from the ctor args so SYNC_GRADIENTS
+        # and ZeRO compile through the exact same constraint machinery
+        # plain net.fit(plan=...) uses. AVERAGING keeps per-worker
+        # replica semantics by definition: it adopts only the plan's
+        # MESH, never its zero stage or TP rules.
         self.mode = TrainingMode(mode)
+        if plan is None and mesh is None and zero_stage == 0:
+            plan = active_plan()
+        if plan is not None:
+            if mesh is None:
+                mesh = plan.mesh()
+            if zero_stage == 0 and self.mode == TrainingMode.SYNC_GRADIENTS:
+                zero_stage = plan.zero_stage
+        self.mesh = mesh if mesh is not None else build_mesh(MeshConfig())
         if zero_stage not in zero.VALID_STAGES:
             raise ValueError(
                 f"zero_stage must be one of {zero.VALID_STAGES} "
@@ -99,6 +117,14 @@ class ParallelWrapper:
                              "(AVERAGING keeps per-worker full copies by "
                              "definition)")
         self.zero_stage = zero_stage
+        # re-derive over the wrapper's resolved mesh/zero_stage so an
+        # explicit ctor arg always wins over what the plan carried;
+        # AVERAGING's vmapped step never reads the plan
+        self.plan = ShardingPlan.for_mesh(
+            self.mesh,
+            rules=(plan.rules if plan is not None
+                   and self.mode == TrainingMode.SYNC_GRADIENTS else None),
+            zero_stage=zero_stage)
         self.averaging_frequency = max(1, int(averaging_frequency))
         self.average_updaters = average_updaters
         self.report_score_after_averaging = report_score_after_averaging
@@ -147,27 +173,19 @@ class ParallelWrapper:
 
     # --------------------------------------------------------- compiled fns
     def _build_sync_step(self):
-        # Params/opt/state replicated, batch sharded on dim 0: XLA inserts
-        # the ICI gradient all-reduce (the compiled analog of DL4J's
-        # EncodedGradientsAccumulator broadcast queue).
-        def step(params, opt_state, state, x, y, fmask, lmask, rng):
-            return self._local_step(params, opt_state, state, x, y,
-                                    fmask, lmask, rng)
-
-        return jax.jit(step, donate_argnums=(0, 1, 2))
-
-    def _build_zero_step(self):
-        # Same math as the sync step; the only additions are sharding
-        # constraints pinning grads/updates/opt-state to the ZeRO layout
-        # (dim 0 split over "data") and params to their stage's layout.
-        # XLA derives the schedule: reduce-scatter grads -> sharded
-        # optimizer math -> all-gather (updates at stage 1, params at the
-        # next forward's use sites at stage 3). See parallel/zero.py.
+        # THE plan-compiled data-parallel step (parallel/plan.py): batch
+        # sharded on dim 0 over "data", params/grads/updates/opt-state
+        # pinned to the plan's layout in-jit. XLA derives the gradient
+        # all-reduce (the compiled analog of DL4J's
+        # EncodedGradientsAccumulator broadcast queue) — and, at
+        # zero_stage >= 1, the reduce-scatter -> sharded optimizer math
+        # -> all-gather schedule (updates at stage 1, params at the next
+        # forward's use sites at stage 3). ZeRO and Megatron TP are spec
+        # choices on the plan, not separate code paths.
         from deeplearning4j_tpu.nn.regularization import (
             apply_constraints, constraint_map, has_constraints,
         )
-        mesh = self.mesh
-        stage3 = self.zero_stage == 3
+        plan = self.plan
         layer_map = constraint_map(self.model)
         constrained = has_constraints(layer_map.values())
 
@@ -176,29 +194,38 @@ class ParallelWrapper:
                 return self._loss_fn(p, state, x, y, fmask, lmask, rng)
             (loss, new_state), grads = \
                 jax.value_and_grad(lf, has_aux=True)(params)
-            grads = zero.zero_constraint(grads, mesh)
+            grads = plan.constrain_grads(grads)
             updates, new_opt = self.model._tx.update(grads, opt_state,
                                                      params)
-            updates = zero.zero_constraint(updates, mesh)
-            new_opt = zero.zero_constraint(new_opt, mesh)
+            updates = plan.constrain_grads(updates)
             new_params = optax.apply_updates(params, updates)
             if constrained:   # post-update projection (DL4J applyConstraints)
                 new_params = apply_constraints(layer_map, new_params)
-            new_params = zero.zero_constraint(new_params, mesh) if stage3 \
-                else zero.replicated_constraint(new_params, mesh)
+            new_params = plan.constrain_params(new_params)
+            new_opt = plan.constrain_opt(new_opt, new_params)
             return new_params, new_opt, new_state, loss
 
         return jax.jit(step, donate_argnums=(0, 1, 2))
 
+    def _build_zero_step(self):
+        """ZeRO is a plan spec choice now — the same compiled step."""
+        return self._build_sync_step()
+
+    def _needs_placement(self) -> bool:
+        """Host-side placement is required when the plan stores anything
+        sharded (ZeRO state, TP kernels); pure replicated DP lets jit
+        replicate uncommitted params on first use."""
+        return bool(self.zero_stage) or (
+            self.plan.rules is not None and self.plan.model_degree > 1)
+
     def _zero_place(self):
-        """Place the wrapped net's params/opt-state in the ZeRO layout for
-        this stage (idempotent; called at fit start)."""
+        """Place the wrapped net's params/opt-state in the plan's layout
+        (idempotent; called at fit start): stage-1 params come out
+        replicated, stage-3 (and TP-ruled) params sharded — one spec
+        derivation for every mode (plan.param_spec/state_spec)."""
         net = self.model
-        net.opt_state = zero.zero_place(net.opt_state, self.mesh)
-        if self.zero_stage == 3:
-            net.params = zero.zero_place(net.params, self.mesh)
-        else:
-            net.params = zero.replicate_place(net.params, self.mesh)
+        net.opt_state = self.plan.place_opt(net.opt_state, net.params)
+        net.params = self.plan.place_params(net.params)
 
     def _zero_gather(self):
         """Restore DL4J post-fit semantics — "after fit() the wrapped
@@ -296,24 +323,33 @@ class ParallelWrapper:
 
     # --- SYNC_GRADIENTS ---------------------------------------------------
     def _fit_sync(self, source, epochs):
+        from deeplearning4j_tpu.data.async_iterator import prefetch_iterable
         net = self.model
         mesh = self.mesh
         shard = NamedSharding(mesh, P(DATA_AXIS))
         if self._step_fn is None:
-            self._step_fn = self._build_zero_step() if self.zero_stage \
-                else self._build_sync_step()
-        if self.zero_stage:
+            self._step_fn = self._build_sync_step()
+        if self._needs_placement():
             self._zero_place()
         rng = jax.random.PRNGKey(net.conf.seed + 65537)
+
+        def stage(b):
+            # worker-thread staging: pad + mesh-sharded device_put run
+            # on the prefetch thread (honoring DL4J_TPU_PREFETCH_DEPTH,
+            # same double-buffered H2D contract plain fit() gets) so the
+            # consumer loop never pays a synchronous H2D per step. The
+            # TRUE example count is banked before padding.
+            bs = self._batch_count(b[0])
+            return self._device_batch(*b, shard), bs
+
         for _ in range(epochs):
             for lst in net.listeners:
                 lst.on_epoch_start(net, net.epoch_count)
             etl_start = time.perf_counter()
             loss = None
-            for x, y, fm, lm in self._batches(source):
+            for (x, y, fm, lm), bs in prefetch_iterable(
+                    self._batches(source), stage):
                 etl_ms = (time.perf_counter() - etl_start) * 1e3
-                bs = self._batch_count(x)
-                x, y, fm, lm = self._device_batch(x, y, fm, lm, shard)
                 rng, sub = jax.random.split(rng)
                 net.params, net.opt_state, net.state, loss = self._step_fn(
                     net.params, net.opt_state, net.state, x, y, fm, lm, sub)
@@ -337,7 +373,7 @@ class ParallelWrapper:
                 lst.on_epoch_end(net, net.epoch_count)
             net.epoch_count += 1
             self._reset(source)
-        if self.zero_stage:
+        if self.zero_stage == 3:
             self._zero_gather()
         # note: the wrapped net's own compiled-step caches are kept — jit
         # re-lowers automatically if the params' sharding changed, so
@@ -345,6 +381,7 @@ class ParallelWrapper:
 
     # --- AVERAGING --------------------------------------------------------
     def _fit_averaging(self, source, epochs):
+        from deeplearning4j_tpu.data.async_iterator import prefetch_iterable
         net = self.model
         n = self.n_workers
         if self._step_fn is None:
@@ -388,13 +425,18 @@ class ParallelWrapper:
             for lst in net.listeners:
                 lst.iteration_done(net, pit, net.epoch_count, net._score,
                                    0.0, pbs)
+        def stage(b):
+            # worker-thread pad + split + per-replica placement (the
+            # prefetch_iterable contract _fit_sync documents)
+            bs = self._batch_count(b[0])
+            return self._split_batch(*b), bs
+
         try:
             for _ in range(epochs):
                 for lst in net.listeners:
                     lst.on_epoch_start(net, net.epoch_count)
-                for x, y, fm, lm in self._batches(source):
-                    bs = self._batch_count(x)
-                    x, y, fm, lm = self._split_batch(x, y, fm, lm)
+                for (x, y, fm, lm), bs in prefetch_iterable(
+                        self._batches(source), stage):
                     rng, sub = jax.random.split(rng)
                     subs = jax.random.split(sub, n)
                     sp, so, ss, losses = self._step_fn(sp, so, ss, x, y, fm,

@@ -8,13 +8,15 @@ ZeRO insight applies directly: a data-parallel group of N chips only needs
 1/N-th of the optimizer state (stage 1) — and of the parameters themselves
 (stage 3) — resident per chip.
 
-TPU-native formulation: no gather/scatter bookkeeping code at all. The
-whole scheme is expressed as sharding placements + in-jit
-`with_sharding_constraint`s over the existing SYNC_GRADIENTS step, and
-XLA's SPMD partitioner derives the collectives:
+Since PR 10 this module is a thin shim over `parallel/plan.py`: the one
+sharding rule (`plan.overlay_data_spec` — overlay the "data" axis onto
+the first free, evenly-divisible dim) and the placement/constraint
+machinery live on :class:`~deeplearning4j_tpu.parallel.plan.ShardingPlan`,
+where they compose with tensor parallelism instead of being a separate
+trainer island. The functions below keep their historical signatures for
+callers that talk in (tree, mesh) pairs:
 
-  stage 1 — opt state sharded on dim 0 over "data", params replicated.
-      Gradients are consumed shard-wise by the optimizer update, so XLA
+  stage 1 — opt state sharded over "data", params replicated. XLA
       lowers the gradient all-reduce to a reduce-scatter; the applied
       update is all-gathered back into the replicated params. (This also
       subsumes ZeRO stage 2: the full gradient never materializes
@@ -24,9 +26,9 @@ XLA's SPMD partitioner derives the collectives:
       a reduce-scatter, so gradients arrive already sharded. Per-chip
       residency for params + optimizer drops to ~1/N.
 
-Leaves whose leading dim does not divide the data-axis size (biases,
-scalars, step counters) stay replicated — the memory they hold is noise
-next to the kernels, and keeping them whole avoids padding.
+Leaves with no evenly-divisible dim (small biases, scalars, step
+counters) stay replicated — the memory they hold is noise next to the
+kernels, and keeping them whole avoids padding.
 """
 from __future__ import annotations
 
@@ -34,17 +36,22 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from deeplearning4j_tpu.parallel.mesh import DATA_AXIS, replicated_sharding
+from deeplearning4j_tpu.parallel.plan import overlay_data_spec
 
 VALID_STAGES = (0, 1, 3)
 
 
 def zero_spec(leaf, n_shards: int) -> P:
-    """PartitionSpec for one state leaf: dim-0 sharded over "data" when
-    evenly divisible, replicated otherwise."""
-    shape = getattr(leaf, "shape", ())
-    if len(shape) >= 1 and shape[0] >= n_shards and shape[0] % n_shards == 0:
-        return P(DATA_AXIS)
-    return P()
+    """PartitionSpec for one state leaf: the FIRST evenly-divisible dim
+    sharded over "data", replicated when none divides (the plan's
+    data-overlay rule applied to an unconstrained leaf). NB since PR 10
+    this generalizes the historical dim-0-only rule — a leaf whose dim 0
+    does not divide but whose dim 1 does now shards dim 1 instead of
+    replicating (any dim serves ZeRO's memory goal, and the shim must
+    agree with plan.state_spec so wrapper and net.fit(plan=) place
+    identically)."""
+    return overlay_data_spec(P(), tuple(getattr(leaf, "shape", ())),
+                             n_shards)
 
 
 def zero_place(tree, mesh: Mesh):

@@ -125,7 +125,7 @@ class ServedModel:
                  buckets: Sequence[int] = DEFAULT_BUCKETS,
                  max_delay_ms: float = 5.0,
                  queue_limit: int = 256,
-                 mesh=None):
+                 mesh=None, plan=None):
         from deeplearning4j_tpu.parallel.inference import (
             InferenceMode, ParallelInference,
         )
@@ -143,7 +143,10 @@ class ServedModel:
         #: lock-free snapshot of the active version's metadata for the
         #: request path (atomic attribute swap; never indexes live lists)
         self.active_info = self.versions[0].describe()
-        self.pi = ParallelInference(model, mesh=mesh,
+        # `plan` (parallel/plan.ShardingPlan): TP-sharded servable —
+        # kernels stay sharded over the mesh "model" axis per the SAME
+        # rule table training used (docs/PARALLELISM.md)
+        self.pi = ParallelInference(model, mesh=mesh, plan=plan,
                                     mode=InferenceMode.SEQUENTIAL)
         it = _input_type_of(model)
         self.input_shape: Tuple[int, ...] = tuple(it.shape)
@@ -275,7 +278,7 @@ class ModelRegistry:
                buckets: Sequence[int] = DEFAULT_BUCKETS,
                max_delay_ms: float = 5.0,
                queue_limit: int = 256,
-               mesh=None) -> ServedModel:
+               mesh=None, plan=None) -> ServedModel:
         """Load, warm, and publish a servable under `name`. Deploying an
         existing name is a swap (version bump), not a replacement — the
         live batcher keeps ITS configuration (undeploy first to change
@@ -298,7 +301,8 @@ class ModelRegistry:
             model = load_servable(source)
             served = ServedModel(name, model, str(source), buckets=buckets,
                                  max_delay_ms=max_delay_ms,
-                                 queue_limit=queue_limit, mesh=mesh)
+                                 queue_limit=queue_limit, mesh=mesh,
+                                 plan=plan)
             with self._lock:
                 self._models[name] = served
         log.info("serving: deployed %r v1 (%s), buckets %s, input %s",

@@ -85,6 +85,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "then drain gracefully")
     p.add_argument("--serve-buckets", default="1,8,32,128",
                    help="batch-size bucket ladder for --serve-port")
+    p.add_argument("--mesh", default=None, metavar="SPEC",
+                   help="GSPMD sharding plan for the whole run, e.g. "
+                        "'data=8' or 'data=4,model=2,rules=megatron,"
+                        "zero=1' — the plan compiles into the default "
+                        "fit() (DP all-reduce, Megatron TP, ZeRO "
+                        "reduce-scatter/all-gather as jit-inserted "
+                        "collectives; docs/PARALLELISM.md). Applies to "
+                        "--mode single|sync and the resilient path")
     return p
 
 
@@ -226,6 +234,27 @@ def main(argv=None) -> int:
 
     if args.resume and not args.checkpoint_dir:
         raise SystemExit("--resume requires --checkpoint-dir")
+    # --mesh: the whole training section runs under use_mesh so plain
+    # fit(), ParallelWrapper and ResilientTrainer all resolve the plan
+    # with zero further wiring (parallel/plan.active_plan)
+    mesh_ctx = None
+    if args.mesh:
+        from deeplearning4j_tpu.parallel.plan import parse_plan, use_mesh
+        try:
+            mesh_plan = parse_plan(args.mesh)
+            mesh_plan.mesh()    # validate extents against the REAL device
+            # count now — "data=16 on an 8-chip host" must be a clean
+            # SystemExit before the (possibly hours-long) fit, not a raw
+            # traceback mid-run
+        except ValueError as e:
+            raise SystemExit(f"--mesh: {e}")
+        if args.mode == "averaging":
+            raise SystemExit("--mesh applies to --mode single|sync "
+                             "(AVERAGING keeps per-worker replicas by "
+                             "definition)")
+        mesh_ctx = use_mesh(mesh_plan)
+        mesh_ctx.__enter__()        # exited in the finally below
+        print(f"mesh plan: {mesh_plan.describe()}", file=sys.stderr)
     # telemetry emits in a finally: a fit that dies mid-run (bad data,
     # retries exhausted, OOM) still leaves the trace/metrics record —
     # the crash case is exactly when it is most needed
@@ -283,6 +312,8 @@ def main(argv=None) -> int:
             ui_server.stop()
         return 0
     finally:
+        if mesh_ctx is not None:
+            mesh_ctx.__exit__(None, None, None)
         emit_telemetry()
 
 

@@ -351,6 +351,11 @@ class _NetDriver:
     #: ledger name of the compiled step this driver executes
     ledger_program = "mln/train_step"
 
+    #: whether this driver resolves the process-wide GSPMD plan
+    #: (parallel/plan.use_mesh) onto the net — the _WrapperDriver turns
+    #: this off because ParallelWrapper manages its own plan/placement
+    _uses_plan = True
+
     def __init__(self, net):
         self.net = net
         self._ledger_rec = None        # latest monitor.xla program record
@@ -378,10 +383,25 @@ class _NetDriver:
         if self.net.params is None:
             self.net.init()
         # donated-buffer safety for the initial state too (a model fresh
-        # from keras/dl4j import may hold numpy-aliased leaves)
-        self.net.params = param_util.own_tree(self.net.params)
-        self.net.state = param_util.own_tree(self.net.state)
-        self.net.opt_state = param_util.own_tree(self.net.opt_state)
+        # from keras/dl4j import may hold numpy-aliased leaves). With a
+        # process-wide GSPMD plan active (parallel/plan.use_mesh), the
+        # laundering is sharding-aware: the owned copies land on the
+        # plan placements and the net's compiled step compiles the
+        # plan's collectives — the same zero-code-change pickup fit()
+        # has.
+        plan = None
+        if self._uses_plan:
+            from deeplearning4j_tpu.parallel.plan import active_plan
+            plan = active_plan()
+        if self._uses_plan and (plan is not None
+                                or getattr(self.net, "_plan", None)
+                                is not None):
+            from deeplearning4j_tpu.nn.multilayer import _engage_plan_impl
+            _engage_plan_impl(self.net, plan)
+        else:
+            self.net.params = param_util.own_tree(self.net.params)
+            self.net.state = param_util.own_tree(self.net.state)
+            self.net.opt_state = param_util.own_tree(self.net.opt_state)
         if getattr(self.net.conf, "backprop_type", None) == "tbptt":
             raise NotImplementedError(
                 "ResilientTrainer does not support tbptt fits yet (chunk "
@@ -390,9 +410,21 @@ class _NetDriver:
     def finish(self):
         pass
 
+    def plan_describe(self):
+        """JSON descriptor of the active sharding plan (checkpoint
+        extras), or None."""
+        plan = getattr(self.net, "_plan", None)
+        return None if plan is None else plan.describe()
+
     def post_restore(self):
         """Called after a checkpoint was restored into the net (the
-        restored arrays live unsharded on the default device)."""
+        restored arrays live unsharded on the default device). Under an
+        active plan, re-launder them onto the plan placements — the
+        PR-3 own_tree contract, now sharding-aware — so a resumed step
+        never donates misplaced (or heap-aliased) restored leaves."""
+        if self._uses_plan and getattr(self.net, "_plan", None) is not None:
+            from deeplearning4j_tpu.nn.multilayer import _engage_plan_impl
+            _engage_plan_impl(self.net, self.net._plan)
 
     def make_source(self, data, batch_size):
         return self.net._as_iterator(data, batch_size)
@@ -432,6 +464,9 @@ class _NetDriver:
         ys = _as_jnp(ds.labels, n._compute_dtype)
         fm = _as_jnp(ds.features_mask)
         lm = _as_jnp(ds.labels_mask)
+        # under a GSPMD plan the batch shards over the mesh "data" axis
+        # exactly like MultiLayerNetwork._fit_epoch (no-op without one)
+        xs, ys, fm, lm = n._shard_batch(xs, ys, fm, lm)
         n.params, n.opt_state, n.state, loss, _ = fn(
             n.params, n.opt_state, n.state, xs, ys, fm, lm, sub, None)
         bs = int(np.shape(ds.features)[0])
@@ -465,12 +500,15 @@ class _GraphDriver(_NetDriver):
         n = self.net
         if n._train_step is None:
             n._train_step = n._make_train_step()
-        inputs = tuple(n._stage_x(f) for f in mds.features)
-        labels = tuple(_as_jnp(l, n._compute_dtype) for l in mds.labels)
-        fmasks = None if mds.features_masks is None else tuple(
-            _as_jnp(m) for m in mds.features_masks)
-        lmasks = None if mds.labels_masks is None else tuple(
-            _as_jnp(m) for m in mds.labels_masks)
+        inputs = n._shard_tuple(tuple(n._stage_x(f) for f in mds.features))
+        labels = n._shard_tuple(tuple(_as_jnp(l, n._compute_dtype)
+                                      for l in mds.labels))
+        fmasks = n._shard_tuple(
+            None if mds.features_masks is None else tuple(
+                _as_jnp(m) for m in mds.features_masks))
+        lmasks = n._shard_tuple(
+            None if mds.labels_masks is None else tuple(
+                _as_jnp(m) for m in mds.labels_masks))
         n.params, n.opt_state, n.state, loss, _ = n._train_step(
             n.params, n.opt_state, n.state, inputs, labels, fmasks,
             lmasks, sub, None)
@@ -492,6 +530,8 @@ class _WrapperDriver(_NetDriver):
 
     rng_mult = 65537
 
+    _uses_plan = False      # the wrapper manages its own plan/placement
+
     def __init__(self, wrapper):
         from deeplearning4j_tpu.parallel.wrapper import TrainingMode
         if wrapper.mode != TrainingMode.SYNC_GRADIENTS:
@@ -506,23 +546,25 @@ class _WrapperDriver(_NetDriver):
         super().prepare()
         w = self.wrapper
         if w._step_fn is None:
-            w._step_fn = w._build_zero_step() if w.zero_stage \
-                else w._build_sync_step()
-        if w.zero_stage:
+            w._step_fn = w._build_sync_step()
+        if w._needs_placement():
             w._zero_place()
         from jax.sharding import NamedSharding, PartitionSpec as P
         from deeplearning4j_tpu.parallel.mesh import DATA_AXIS
         self._shard = NamedSharding(w.mesh, P(DATA_AXIS))
 
     def finish(self):
-        if self.wrapper.zero_stage:
+        if self.wrapper.zero_stage == 3:
             self.wrapper._zero_gather()
+
+    def plan_describe(self):
+        return self.wrapper.plan.describe()
 
     def post_restore(self):
         # restore_into left unsharded default-device arrays; re-establish
-        # the ZeRO layout or stage-3 resume would run unsharded (OOM on
-        # models that only fit sharded)
-        if self.wrapper.zero_stage:
+        # the plan layout or a stage-3/TP resume would run unsharded
+        # (OOM on models that only fit sharded)
+        if self.wrapper._needs_placement():
             self.wrapper._zero_place()
 
     def make_source(self, data, batch_size):
@@ -654,6 +696,13 @@ class ResilientTrainer:
             "step_in_epoch": int(step_in_epoch),
             "dispatch_idx": int(self._dispatch_idx),
         }
+        plan_desc = self._driver.plan_describe()
+        if plan_desc is not None:
+            # bank the GSPMD plan the run trained under, so a resume
+            # onto a different mesh/zero_stage is detected and logged —
+            # never silently misplaced (placements are re-derived by
+            # post_restore either way)
+            extra["plan"] = plan_desc
         src = getattr(self, "_source", None)
         src = src() if src is not None else None
         if src is not None and hasattr(src, "stream_state"):
@@ -805,6 +854,18 @@ class ResilientTrainer:
                 if "normalizer" in extra and self.normalizer is None:
                     self.normalizer = self._restore_normalizer(
                         extra["normalizer"])
+                live_plan = self._driver.plan_describe()
+                if extra.get("plan") != live_plan:
+                    # resuming onto a different mesh layout is SUPPORTED
+                    # (checkpoints store whole host arrays; post_restore
+                    # re-launders them onto the live plan's placements)
+                    # but must be loud — a silent layout change is how
+                    # misplaced-restore bugs ship
+                    log.warning(
+                        "resuming onto a different sharding plan: "
+                        "checkpoint trained under %s, live plan is %s — "
+                        "placements re-derived from the live plan",
+                        extra.get("plan"), live_plan)
                 self._driver.post_restore()
                 log.info("resumed from %s (iteration %d, epoch %d, "
                          "step-in-epoch %d)", entry["path"],

@@ -40,24 +40,49 @@ def num_params(tree) -> int:
     return int(sum(np.prod(leaf.shape) for _, leaf in iter_leaves(tree)))
 
 
-def owned_leaf(a):
+def owned_leaf(a, sharding=None):
     """Host/array leaf -> XLA-owned device buffer. jnp.asarray on a numpy
     array can be ZERO-COPY on CPU backends: the jax array aliases
     numpy-owned memory, and DONATING it into a jitted train step
     (donate_argnums) frees/reuses memory XLA does not own — heap
     corruption that surfaces as garbage params or a segfault at a random
     later point (the historical serde-resume / keras-import crash
-    class). copy=True forces a buffer XLA owns outright."""
-    return jnp.array(a, copy=True)
+    class). copy=True forces a buffer XLA owns outright.
+
+    `sharding` (the GSPMD-plan variant of the same contract): the owned
+    copy is additionally placed on the given jax.sharding.Sharding.
+    Device-resident leaves copy first (preserves committed shardings;
+    the device_put is an identity when already placed). HOST leaves on a
+    non-CPU backend go straight through device_put — H2D is itself an
+    owning copy (host memory can never alias the device arena) and each
+    device receives only ITS shard's slice, so restoring a model that
+    only fits sharded never materializes whole arrays on one chip. On
+    the CPU backend "device" memory IS host memory — there zero-copy
+    aliasing is the PR-3 trap, so the explicit owned copy happens first
+    (and the transient whole-array copy is free: it's RAM either way)."""
+    if sharding is None:
+        return jnp.array(a, copy=True)
+    if isinstance(a, jax.Array) or jax.default_backend() == "cpu":
+        return jax.device_put(jnp.array(a, copy=True), sharding)
+    return jax.device_put(a, sharding)
 
 
-def own_tree(tree):
+def own_tree(tree, shardings=None):
     """owned_leaf over a whole pytree (params / optimizer state / layer
     state). Called once at every fit() entry so that params assigned from
     ANY host source (checkpoint restore, keras/dl4j import,
     set_params_flat, user numpy) are safe to donate — one extra copy per
-    fit call, not per step."""
-    return jax.tree_util.tree_map(owned_leaf, tree)
+    fit call, not per step.
+
+    `shardings`: optional congruent pytree of Shardings (a ShardingPlan's
+    param_shardings/opt_shardings) — restored host arrays land laundered
+    AND placed in one pass, so a checkpoint resumed under a plan never
+    runs a step on misplaced (or heap-aliased) leaves."""
+    if shardings is None:
+        return jax.tree_util.tree_map(owned_leaf, tree)
+    return jax.tree_util.tree_map(
+        lambda a, s: None if a is None else owned_leaf(a, s),
+        tree, shardings, is_leaf=lambda x: x is None)
 
 
 def params_to_flat(tree) -> jnp.ndarray:

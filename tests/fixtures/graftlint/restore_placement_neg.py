@@ -1,0 +1,46 @@
+"""graftlint fixture: unlaundered-restore-placement NEAR-MISSES.
+
+All of these must stay clean: the laundering helpers, explicit copies,
+placements of non-deserialized values, and device_puts without an
+explicit placement.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import serialization as fser
+
+from deeplearning4j_tpu.util.params import own_tree, owned_leaf
+
+
+def restore_laundered(blob, template, shardings):
+    # the blessed path: sharding-aware own_tree copies FIRST, then places
+    return own_tree(fser.from_bytes(template, blob), shardings)
+
+
+def restore_leaf_laundered(arr, sharding):
+    restored = np.load(arr)
+    return owned_leaf(restored, sharding)
+
+
+def restore_copied_then_placed(zf, sharding):
+    loaded = np.load(zf)
+    owned = jnp.array(loaded, copy=True)   # explicit copy clears taint
+    return jax.device_put(owned, sharding)
+
+
+def stage_batch(batch, sharding):
+    # plain batch staging: not deserialized, never donated — fine
+    arr = np.stack([b for b in batch])
+    return jax.device_put(arr, sharding)
+
+
+def plain_put_no_placement(blob, template):
+    # no explicit placement named: the donated-aliasing rule owns this
+    restored = fser.from_bytes(template, blob)
+    return jax.device_put(restored)
+
+
+def relaundered_name(path, dev):
+    tree = np.load(path)
+    tree = own_tree(tree)       # re-assignment clears the taint
+    return jax.device_put(tree, dev)

@@ -1,0 +1,30 @@
+"""graftlint fixture: unlaundered-restore-placement TRUE POSITIVES.
+
+Deserialized values device_put onto explicit placements without going
+through util/params.own_tree — the sharding-aware PR-3 segfault shape.
+Lines expected to be flagged carry an EXPECT marker comment.
+"""
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import serialization as fser
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def restore_params(zf, mesh, template):
+    loaded = np.load(zf)
+    return jax.device_put(loaded, NamedSharding(mesh, P("data")))  # EXPECT
+
+
+def restore_updater(blob, template, sharding):
+    opt_state = fser.from_bytes(template, blob)
+    return jax.device_put(opt_state, sharding)  # EXPECT
+
+
+def restore_via_alias(path, dev):
+    tree = pickle.load(open(path, "rb"))
+    placed = tree            # simple-name propagation keeps the taint
+    aliased = jnp.asarray(placed)   # zero-copy: transports the taint
+    return jax.device_put(aliased, dev)  # EXPECT

@@ -96,3 +96,7 @@ def test_zero_fsdp_training():
 
 def test_device_norm_image_pipeline():
     assert _load("17_device_norm_image_pipeline.py").main(epochs=10) > 0.9
+
+
+def test_gspmd_sharding_plan():
+    assert _load("18_gspmd_sharding_plan.py").main(epochs=8) > 0.9

@@ -45,6 +45,8 @@ FIXTURE_MATRIX = [
     ("donated-aliasing", "donated_aliasing_pos.py"),
     ("donated-aliasing", "donated_aliasing_pr3_pos.py"),
     ("donated-aliasing", "donated_aliasing_neg.py"),
+    ("unlaundered-restore-placement", "restore_placement_pos.py"),
+    ("unlaundered-restore-placement", "restore_placement_neg.py"),
     ("host-sync-in-hot-path", "host_sync_pos.py"),
     ("host-sync-in-hot-path", "host_sync_neg.py"),
     ("recompile-hazard", "recompile_hazard_pos.py"),
@@ -206,12 +208,12 @@ def test_cli_baseline_burn_down_workflow(tmp_path):
     assert json.loads(r.stdout)["stale_baseline_entries"]  # ...and visible
 
 
-def test_cli_list_rules_names_all_eight():
+def test_cli_list_rules_names_all_nine():
     r = _cli("--list-rules")
     assert r.returncode == 0
     for name in RULES_BY_NAME:
         assert name in r.stdout
-    assert len(RULES_BY_NAME) == 8
+    assert len(RULES_BY_NAME) == 9
 
 
 # --------------------------------------------------------- the tier-1 gate

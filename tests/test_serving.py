@@ -562,13 +562,19 @@ def test_drain_race_inflight_predict_and_swap_never_5xx_or_hang(registry):
                 if isinstance(kind, str) and kind.startswith("violation"):
                     violations.append(kind)
 
+    # pinned BEFORE the race: the registry pops the servable at drain
+    # start, so a late registry.get() would race get-vs-undeploy (None)
+    # instead of the swap-vs-drain contract under test — ServedModel.swap
+    # on a draining servable must raise ServerDrainingError either way
+    race_served = registry.get("race")
+
     def swapper():
         start.wait()
         time.sleep(0.02)
         # the same race the HTTP swap verb runs: losing to the drain must
         # surface as an explicit draining error (503), never a 500
         try:
-            registry.get("race").swap(_net(5))
+            race_served.swap(_net(5))
             with lock:
                 outcomes.append("swap:200")
         except Exception as e:  # noqa: BLE001

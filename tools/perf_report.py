@@ -37,11 +37,14 @@ import sys
 #: deliberately-slow per-sample-loop reference the pipeline's speedup is
 #: computed against) is NOT gated: it measures the path we replaced, and
 #: its run-to-run spread exceeds the regression threshold.
+#: mesh_imgs_sec is the GSPMD-plan scaling sweep (`bench.py --mode
+#: mesh`, banked as MULTICHIP_r*.json): one row per plan config
+#: (mesh-single / mesh-dp / mesh-dp_tp / mesh-zero1 / mesh-zero3).
 THROUGHPUT_KEYS = ("imgs_sec", "lenet_imgs_sec", "chars_sec", "pairs_sec",
                    "h2d_f32_mbytes_sec", "h2d_u8_mbytes_sec",
                    "fit_e2e_imgs_sec",
                    "fit_e2e_chars_sec", "fit_e2e_pairs_sec",
-                   "chaos_goodput_under_fault_rps")
+                   "chaos_goodput_under_fault_rps", "mesh_imgs_sec")
 
 #: lower-is-better series (latencies). Banked by tools/serve_chaos.py
 #: (CHAOS_r*.json): p99 while a replica is killed + another wedged, and
@@ -65,7 +68,11 @@ def load_rounds(directory: str):
     names = (sorted(glob.glob(os.path.join(directory, "BENCH_r*.json")))
              + sorted(glob.glob(os.path.join(directory,
                                              "BENCH_TPU_MEASURED_*.json")))
-             + sorted(glob.glob(os.path.join(directory, "CHAOS_r*.json"))))
+             + sorted(glob.glob(os.path.join(directory, "CHAOS_r*.json")))
+             # GSPMD-plan scaling sweeps; pre-r06 MULTICHIP artifacts
+             # are driver dryrun stamps without a sweep and skip below
+             + sorted(glob.glob(os.path.join(directory,
+                                             "MULTICHIP_r*.json"))))
     for path in names:
         try:
             with open(path) as f:
@@ -170,7 +177,11 @@ def roofline(ledger: dict):
                "flops": prog.get("flops"),
                "arithmetic_intensity": ai,
                "hbm_peak_bytes": prog.get("hbm_peak_bytes"),
-               "compile_seconds": prog.get("compile_seconds")}
+               "compile_seconds": prog.get("compile_seconds"),
+               # sharded (GSPMD plan) vs replicated programs roofline
+               # differently — per-chip flops and HBM are 1/N figures
+               "sharded": bool(prog.get("sharded", False)),
+               "arg_shardings": prog.get("arg_shardings")}
         if ai and peak and bw:
             ridge = peak / bw
             attainable = min(peak, ai * bw)

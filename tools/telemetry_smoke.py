@@ -39,6 +39,13 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+# the plan-sharded ledger check (arg_shardings) needs a mesh to shard
+# over: force the 8-virtual-device CPU topology (no-op when the caller
+# already forced a count; only affects the CPU platform)
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
 # CPU has no tabulated device peak: a nominal override keeps the MFU
 # accountant live (the gauge's absolute value is synthetic on CPU — the
 # smoke asserts liveness, not truth)
@@ -66,7 +73,8 @@ PROGRAM_KEYS = ("fingerprint", "name", "domain", "arg_shapes", "hlo_hash",
                 "compile_seconds", "compiles", "flops", "bytes_accessed",
                 "arithmetic_intensity", "hbm", "hbm_peak_bytes",
                 "examples_per_call", "steps_per_call",
-                "total_flops_per_call", "first_captured_unix")
+                "total_flops_per_call", "arg_shardings", "sharded",
+                "first_captured_unix")
 
 
 def _net(seed=0):
@@ -160,6 +168,27 @@ def main(argv=None) -> int:
                       "checkpoints": report.checkpoints_written}
     if report.skipped_steps < 1:
         failures.append("injected NaN step was not skipped")
+
+    # ---- GSPMD plan-sharded fit: arg_shardings lands in the ledger -----
+    import jax
+    from deeplearning4j_tpu.parallel.plan import ShardingPlan
+    if len(jax.devices()) >= 2:
+        pnet = _net(seed=3)
+        Xp = rs.randn(128, 6).astype("float32")
+        Yp = np.eye(3, dtype="float32")[rs.randint(0, 3, 128)]
+        pnet.fit(ArrayDataSetIterator(Xp, Yp, batch_size=32), epochs=1,
+                 plan=ShardingPlan(data=len(jax.devices())))
+        sharded = [r for r in monitor.xla.records()
+                   if r.is_sharded and any("'data'" in s
+                                           for s in r.arg_shardings)]
+        if not sharded:
+            failures.append(
+                "plan-sharded fit produced no ledger record carrying a "
+                "'data' PartitionSpec in arg_shardings")
+        summary["plan_sharded_programs"] = len(sharded)
+    else:
+        failures.append("no multi-device mesh for the plan-sharded "
+                        "ledger check (device-count flag not applied?)")
 
     # ---- inference -----------------------------------------------------
     with ParallelInference(net, mode=InferenceMode.BATCHED,

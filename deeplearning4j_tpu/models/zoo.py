@@ -783,13 +783,16 @@ class UNet(ZooModel):
 
 # ------------------------------------------------------------ name registry
 def zoo_models() -> dict:
-    """Name -> ZooModel subclass map (every concrete arch in this module),
-    the resolver behind `zoo:<Name>` servable sources and CLI flags."""
+    """Name -> ZooModel subclass map (every concrete arch in this module
+    plus the transformer LM family), the resolver behind `zoo:<Name>`
+    servable sources and CLI flags."""
+    from deeplearning4j_tpu.models import transformer
     out = {}
-    for obj in globals().values():
-        if isinstance(obj, type) and issubclass(obj, ZooModel) \
-                and obj is not ZooModel:
-            out[obj.__name__] = obj
+    for mod_globals in (globals(), vars(transformer)):
+        for obj in mod_globals.values():
+            if isinstance(obj, type) and issubclass(obj, ZooModel) \
+                    and obj is not ZooModel:
+                out[obj.__name__] = obj
     return out
 
 

@@ -19,7 +19,13 @@ Three parts (docs/SERVING.md):
   budget, drain+replace after K failed probes;
 - **router** — ResilientRouter: power-of-two-choices spread, per-
   (replica, model) circuit breakers, priority-class load shedding,
-  hedged retries for stragglers; RouterServer is its HTTP face.
+  hedged retries for stragglers; RouterServer is its HTTP face — token
+  streams proxy through unbuffered with the same breaker/shed semantics;
+- **decode** — token-level continuous batching for LLM generation:
+  an in-flight scheduler over a paged KV cache (`kvcache`), prefill/
+  decode phase split, in-graph sampling, SSE streaming over
+  ``POST /v1/models/{name}/generate``, and int8/bf16 post-training-
+  quantized servable variants (`quantize`).
 
 Quickstart:
 
@@ -34,6 +40,13 @@ CLI: ``python -m deeplearning4j_tpu.serving --model lenet=zoo:LeNet``.
 from deeplearning4j_tpu.serving.batcher import (
     DEFAULT_BUCKETS, DeadlineExceededError, ServerDrainingError,
     ServerOverloadedError, ServingError, ShapeBucketedBatcher,
+)
+from deeplearning4j_tpu.serving.decode import (
+    DecodeConfig, DecodeEngine, DecodeScheduler, GenerateRequest, ServedLM,
+)
+from deeplearning4j_tpu.serving.kvcache import KVCacheState
+from deeplearning4j_tpu.serving.quantize import (
+    QTensor, quality_delta, quantize_params,
 )
 from deeplearning4j_tpu.serving.fleet import (
     InProcessReplica, Replica, ReplicaSpec, ReplicaSupervisor,
@@ -52,10 +65,12 @@ from deeplearning4j_tpu.serving.server import (
 
 __all__ = [
     "CircuitBreaker", "DEFAULT_BUCKETS", "DeadlineExceededError",
-    "InProcessReplica", "ModelLoadError", "ModelRegistry", "ModelServer",
-    "Replica", "ReplicaSpec", "ReplicaSupervisor", "ResilientRouter",
-    "RouterServer", "ServableVersion", "ServedModel",
-    "ServerDrainingError", "ServerOverloadedError", "ServingError",
-    "ShapeBucketedBatcher", "SubprocessReplica", "load_servable",
-    "retry_after_seconds",
+    "DecodeConfig", "DecodeEngine", "DecodeScheduler", "GenerateRequest",
+    "InProcessReplica", "KVCacheState", "ModelLoadError", "ModelRegistry",
+    "ModelServer", "QTensor", "Replica", "ReplicaSpec",
+    "ReplicaSupervisor", "ResilientRouter", "RouterServer",
+    "ServableVersion", "ServedLM", "ServedModel", "ServerDrainingError",
+    "ServerOverloadedError", "ServingError", "ShapeBucketedBatcher",
+    "SubprocessReplica", "load_servable", "quality_delta",
+    "quantize_params", "retry_after_seconds",
 ]

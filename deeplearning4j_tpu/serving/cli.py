@@ -41,11 +41,41 @@ def build_parser() -> argparse.ArgumentParser:
         description="Production model server: versioned registry, "
                     "shape-bucketed AOT-warmed batching, admission "
                     "control, zero-downtime hot-swap (docs/SERVING.md)")
-    p.add_argument("--model", action="append", required=True,
+    p.add_argument("--model", action="append", default=[],
                    metavar="NAME=SOURCE",
-                   help="servable to deploy; SOURCE is a checkpoint dir "
-                        "(manifest.json), a model zip, a Keras .h5, or "
-                        "zoo:<Arch>. Repeatable.")
+                   help="predict servable to deploy; SOURCE is a "
+                        "checkpoint dir (manifest.json), a model zip, a "
+                        "Keras .h5, or zoo:<Arch> (constructor kwargs "
+                        "ride a query string: zoo:LeNet?num_classes=10). "
+                        "Repeatable.")
+    # ----------------------------------------------------- decode (LM) mode
+    dec = p.add_argument_group(
+        "LM decode servables (docs/SERVING.md 'LLM decode')")
+    dec.add_argument("--lm", action="append", default=[],
+                     metavar="NAME=SOURCE",
+                     help="decode servable (continuous-batching token "
+                          "generation, POST .../generate). Same SOURCE "
+                          "forms as --model; an @int8 / @bf16 suffix "
+                          "serves a post-training-quantized variant "
+                          "(e.g. zoo:TransformerLM?n_layers=2@int8). "
+                          "Repeatable.")
+    dec.add_argument("--decode-slots", type=int, default=4,
+                     help="fixed in-flight decode batch positions")
+    dec.add_argument("--decode-page-size", type=int, default=16,
+                     help="tokens per KV-cache page")
+    dec.add_argument("--decode-max-context", type=int, default=None,
+                     help="KV capacity per sequence (default: the "
+                          "model's seq_length)")
+    dec.add_argument("--decode-pool-pages", type=int, default=None,
+                     help="physical KV pages in the pool (default "
+                          "slots*max_context/page_size: no "
+                          "oversubscription)")
+    dec.add_argument("--decode-queue-limit", type=int, default=64,
+                     help="pending-join bound (full -> 429)")
+    dec.add_argument("--prefill-buckets", default=None,
+                     help="prefill sequence-length ladder (comma ints, "
+                          "page-aligned; default: geometric up to "
+                          "max_context)")
     p.add_argument("--host", default="127.0.0.1",
                    help="bind address (0.0.0.0 behind a load balancer)")
     p.add_argument("--port", type=int, default=8500)
@@ -125,15 +155,30 @@ def main(argv=None) -> int:
     except ValueError:
         raise SystemExit(f"--buckets must be comma-separated ints, got "
                          f"{args.buckets!r}")
-    specs = []
-    for spec in args.model:
-        name, sep, source = spec.partition("=")
-        if not sep or not name or not source:
-            raise SystemExit(f"--model expects NAME=SOURCE, got {spec!r}")
-        specs.append((name, source))
+
+    def parse_specs(values, flag):
+        out = []
+        for spec in values:
+            name, sep, source = spec.partition("=")
+            if not sep or not name or not source:
+                raise SystemExit(f"{flag} expects NAME=SOURCE, got "
+                                 f"{spec!r}")
+            out.append((name, source))
+        return out
+
+    specs = parse_specs(args.model, "--model")
+    lm_specs = parse_specs(args.lm, "--lm")
+    if not specs and not lm_specs:
+        raise SystemExit("deploy at least one servable (--model/--lm)")
+    seen = set()
+    for name, _ in specs + lm_specs:
+        if name in seen:
+            raise SystemExit(f"duplicate servable name {name!r}")
+        seen.add(name)
+    decode_cfg = _decode_config(args)
 
     if args.replicas > 1:
-        return _main_fleet(args, specs, buckets)
+        return _main_fleet(args, specs, lm_specs, buckets, decode_cfg)
 
     registry = ModelRegistry()
     for name, source in specs:
@@ -146,6 +191,15 @@ def main(argv=None) -> int:
         print(json.dumps({"deployed": name,
                           "input_shape": list(served.input_shape),
                           "buckets": list(served.batcher.buckets)}),
+              file=sys.stderr)
+    for name, source in lm_specs:
+        try:
+            served = registry.deploy_lm(name, source, decode=decode_cfg)
+        except ModelLoadError as e:
+            raise SystemExit(f"cannot deploy LM {name!r}: {e}")
+        print(json.dumps({"deployed": name, "kind": "lm",
+                          "vocab_size": served.vocab,
+                          "max_context": served.max_context}),
               file=sys.stderr)
 
     server = ModelServer(registry, host=args.host, port=args.port,
@@ -171,13 +225,33 @@ def main(argv=None) -> int:
     return 0
 
 
-def _main_fleet(args, specs, buckets) -> int:
+def _decode_config(args):
+    """CLI decode knobs -> DecodeConfig (shared by all --lm servables)."""
+    from deeplearning4j_tpu.serving.decode import DecodeConfig
+    prefill = None
+    if args.prefill_buckets:
+        try:
+            prefill = tuple(int(b) for b in args.prefill_buckets.split(",")
+                            if b)
+        except ValueError:
+            raise SystemExit("--prefill-buckets must be comma-separated "
+                             f"ints, got {args.prefill_buckets!r}")
+    return DecodeConfig(slots=args.decode_slots,
+                        page_size=args.decode_page_size,
+                        max_context=args.decode_max_context,
+                        pool_pages=args.decode_pool_pages,
+                        prefill_buckets=prefill,
+                        queue_limit=args.decode_queue_limit)
+
+
+def _main_fleet(args, specs, lm_specs, buckets, decode_cfg) -> int:
     """--replicas N: supervisor + router. --port is the router's port."""
     import os
 
     from deeplearning4j_tpu.serving.fleet import (
         InProcessReplica, ReplicaSpec, ReplicaSupervisor, SubprocessReplica,
     )
+    from deeplearning4j_tpu.serving.quantize import parse_variant
     from deeplearning4j_tpu.serving.router import (
         ResilientRouter, RouterServer,
     )
@@ -190,10 +264,12 @@ def _main_fleet(args, specs, buckets) -> int:
                        max_delay_ms=args.max_delay_ms,
                        queue_limit=args.queue_limit,
                        default_deadline_s=args.deadline_s,
-                       enable_faults=args.enable_fault_injection)
+                       enable_faults=args.enable_fault_injection,
+                       lms=lm_specs, decode=decode_cfg)
     if args.replica_mode == "subprocess":
-        for _, source in specs:
-            if source.startswith("zoo:") or os.path.exists(source):
+        for _, source in specs + lm_specs:
+            base, _variant = parse_variant(source)
+            if base.startswith("zoo:") or os.path.exists(base):
                 continue
             raise SystemExit(f"fleet replicas cannot serve {source!r} "
                              "(need a path or zoo: name)")

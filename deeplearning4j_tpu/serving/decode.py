@@ -1,0 +1,1072 @@
+"""Token-level continuous batching — the LLM decode runtime.
+
+The shape-bucketed predict batcher (`serving/batcher.py`) assumes one
+request = one forward. Autoregressive decode breaks that: a request is a
+*sequence* of forwards with state (the KV cache), lengths vary per
+request, and batching at request granularity (wait for the whole batch to
+finish, then admit the next) idles slots behind the longest sequence.
+This module implements the Orca/vLLM answer — iteration-level scheduling
+over a paged KV cache — under this tree's serving invariants:
+
+- **Fixed shapes, AOT-warmed.** Decode runs as ONE compiled program over
+  ``slots`` fixed batch positions with an active mask; prefill compiles
+  per bucket of a page-aligned ladder (`kvcache.default_prefill_buckets`).
+  Every program is executed at load/swap time by `DecodeEngine.warm()`,
+  and `serving_decode_compiles_total == serving_decode_warmup_runs_total`
+  on /metrics is the ledger proof that no request ever waited on XLA —
+  the exact contract `serving/batcher.py` established for predict.
+- **Continuous batching.** `DecodeScheduler` admits queued requests into
+  free slots *between token steps*: a late-joining request's first token
+  (its prefill) lands while other sequences keep decoding — it never
+  waits for the running batch to drain. Finished sequences free their
+  slot and pages at the same granularity.
+- **Prefill/decode phase split.** Prefill (compute-bound, whole prompt)
+  and decode (memory-bound, one token) are separate compiled programs
+  with separate metric families, so the roofline ledger sees each phase's
+  real arithmetic intensity.
+- **Sampling in-graph.** Greedy / temperature / top-k run inside the
+  decode program (per-slot temperature and k operands), so the host sees
+  only one int32 per slot per step.
+- **Rolling hot swap.** A swap warms a complete replacement engine off
+  the request path, then new admissions go to the new engine while
+  in-flight sequences finish on the old one (their KV pages are only
+  meaningful under the params that wrote them); the old engine retires
+  when its last sequence ends. Zero 5xx, zero request-path compiles,
+  bounded double-residency documented in docs/SERVING.md.
+
+`ServedLM` packages an engine + scheduler + version history behind the
+same servable surface `ServedModel` exposes (status / describe / swap /
+rollback / shutdown), so the registry, HTTP server, fleet supervisor and
+router treat LM servables like any other — per-variant routing of the
+quantized servables (`quantize.py`) falls out of plain model naming.
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+import queue
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu import monitor
+from deeplearning4j_tpu.nn.activations import get_activation
+from deeplearning4j_tpu.nn.layers.attention import (
+    EmbeddingSequenceLayer, LayerNormLayer, MoEFeedForward,
+    MultiHeadAttention, PositionalEmbeddingLayer, TransformerBlock,
+    _merge_heads, _split_heads, dot_product_attention, rope,
+)
+from deeplearning4j_tpu.nn.layers.recurrent import RnnOutputLayer
+from deeplearning4j_tpu.serving import kvcache
+from deeplearning4j_tpu.serving.batcher import (
+    DeadlineExceededError, ServerDrainingError, ServerOverloadedError,
+)
+from deeplearning4j_tpu.serving.quantize import (
+    parse_variant, qdot, qtake, quantize_params,
+)
+from deeplearning4j_tpu.util.params import own_tree
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+_LN = LayerNormLayer()          # the block-internal LN (default epsilon)
+
+#: static ceiling for the in-graph top-k gate (per-request k is clipped)
+TOP_K_MAX = 64
+
+_TTFT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10)
+_ITL_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 1)
+
+
+@dataclasses.dataclass(frozen=True)
+class DecodeConfig:
+    """Decode-runtime sizing, fixed at servable load time (every knob
+    here shapes a compiled program or the page pool)."""
+    slots: int = 4                       # fixed decode batch positions
+    page_size: int = 16                  # tokens per KV page
+    max_context: Optional[int] = None    # default: the model's seq_length
+    pool_pages: Optional[int] = None     # default: no oversubscription
+    prefill_buckets: Optional[Sequence[int]] = None
+    quantize: Optional[str] = None       # None | "int8" | "bf16"
+    queue_limit: int = 64                # pending-join bound (full -> 429)
+    max_new_tokens_cap: int = 1024       # server-side generation ceiling
+    seed: int = 0                        # sampling PRNG stream
+
+
+class GenerateRequest:
+    """One in-flight generation: token events stream out through a queue
+    (("token", id) / ("done", info) / ("error", exc))."""
+
+    def __init__(self, prompt, max_new_tokens: int = 32,
+                 temperature: float = 0.0, top_k: int = 0,
+                 eos_id: Optional[int] = None,
+                 deadline: Optional[float] = None):
+        self.prompt = np.asarray(prompt, np.int32).reshape(-1)
+        self.max_new_tokens = int(max_new_tokens)
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.eos_id = None if eos_id is None else int(eos_id)
+        #: absolute time.monotonic() budget for the WHOLE generation
+        self.deadline = deadline
+        self.events: "queue.Queue" = queue.Queue()
+        self.enqueued = time.monotonic()
+        self.first_token_at: Optional[float] = None
+        self.last_emit_at: Optional[float] = None
+        self.n_emitted = 0
+        self.version: Optional[int] = None
+        self.finish_reason: Optional[str] = None
+        self.cancelled = threading.Event()
+        self.done = threading.Event()
+
+    # ------------------------------------------------------------- events
+    def emit(self, token: int):
+        self.n_emitted += 1
+        now = time.monotonic()
+        if self.first_token_at is None:
+            self.first_token_at = now
+        self.last_emit_at = now
+        self.events.put(("token", int(token)))
+
+    def finish(self, reason: str):
+        if self.done.is_set():
+            return
+        self.finish_reason = reason
+        self.done.set()
+        self.events.put(("done", {
+            "finish_reason": reason,
+            "tokens": self.n_emitted,
+            "version": self.version,
+        }))
+
+    def fail(self, exc: Exception):
+        if self.done.is_set():
+            return
+        self.finish_reason = "error"
+        self.done.set()
+        self.events.put(("error", exc))
+
+    def cancel(self):
+        """Client went away: the scheduler frees the slot at the next
+        token boundary."""
+        self.cancelled.set()
+
+
+# ==========================================================================
+# The engine: compiled prefill / decode / scoring programs + cache state
+# ==========================================================================
+class DecodeEngine:
+    """Paged-KV decode runtime for one model version.
+
+    Builds fixed-shape jitted programs from a MultiLayerNetwork whose
+    stack is an LM the runtime understands (EmbeddingSequenceLayer,
+    TransformerBlock / MoEFeedForward / LayerNormLayer /
+    PositionalEmbeddingLayer bodies, RnnOutputLayer head — i.e. the
+    models/transformer.py family). Params are laundered through
+    `own_tree` at build (they may be numpy-backed from a checkpoint
+    restore and the KV pools ARE donated alongside them every step) and
+    optionally quantized (`quantize.py`).
+    """
+
+    def __init__(self, model, cfg: DecodeConfig, name: str = "lm"):
+        from deeplearning4j_tpu.serving.registry import ModelLoadError
+        self.cfg = cfg
+        self.name = name
+        conf = model.conf
+        it = getattr(conf, "input_type", None)
+        if it is None or not model.layers:
+            raise ModelLoadError(
+                f"decode[{name}]: model has no recurrent input_type; not "
+                "an LM this runtime can drive")
+        self.max_context = int(cfg.max_context or it.shape[0])
+        if cfg.page_size < 1 or self.max_context % cfg.page_size:
+            raise ModelLoadError(
+                f"decode[{name}]: max_context {self.max_context} must be "
+                f"a positive multiple of page_size {cfg.page_size}")
+        # ---------------------------------------------- layer extraction
+        self._plan: List[Tuple[str, object, str]] = []
+        self._block_index: Dict[str, int] = {}
+        self.vocab: Optional[int] = None
+        self.n_heads = self.head_dim = None
+        for i, layer in enumerate(model.layers):
+            key = str(i)
+            last = i == len(model.layers) - 1
+            if isinstance(layer, EmbeddingSequenceLayer):
+                self._plan.append(("embed", layer, key))
+                self.vocab = int(layer.n_in)
+            elif isinstance(layer, PositionalEmbeddingLayer):
+                if layer.max_length < self.max_context:
+                    raise ModelLoadError(
+                        f"decode[{name}]: positional table "
+                        f"({layer.max_length}) shorter than max_context "
+                        f"({self.max_context})")
+                self._plan.append(("posembed", layer, key))
+            elif isinstance(layer, TransformerBlock):
+                if not layer.causal:
+                    raise ModelLoadError(
+                        f"decode[{name}]: layer {i} is a non-causal "
+                        "TransformerBlock; autoregressive decode needs "
+                        "causal attention")
+                h = layer.n_heads
+                d = layer.n_out // layer.n_heads
+                if self.n_heads not in (None, h) or \
+                        self.head_dim not in (None, d):
+                    raise ModelLoadError(
+                        f"decode[{name}]: non-uniform head geometry "
+                        "across blocks is not supported")
+                self.n_heads, self.head_dim = h, d
+                self._block_index[key] = len(self._block_index)
+                self._plan.append(("block", layer, key))
+            elif isinstance(layer, (LayerNormLayer, MoEFeedForward)):
+                self._plan.append(("pertoken", layer, key))
+            elif isinstance(layer, RnnOutputLayer) and last:
+                self._plan.append(("head", layer, key))
+                if self.vocab is None:
+                    self.vocab = int(layer.n_out)
+            elif isinstance(layer, MultiHeadAttention):
+                raise ModelLoadError(
+                    f"decode[{name}]: bare MultiHeadAttention at layer "
+                    f"{i}; wrap it in a TransformerBlock for decode")
+            else:
+                raise ModelLoadError(
+                    f"decode[{name}]: layer {i} "
+                    f"({type(layer).__name__}) has no incremental decode "
+                    "path")
+        if not self._block_index or self.vocab is None:
+            raise ModelLoadError(
+                f"decode[{name}]: need at least one TransformerBlock and "
+                "a vocabulary head")
+        self.n_layers = len(self._block_index)
+        # ------------------------------------------------------- buffers
+        # laundered: restored checkpoints hand us numpy-backed leaves and
+        # these params ride in every donating step call (PR-3 contract)
+        params = own_tree(model.params)
+        self._params = quantize_params(params, cfg.quantize)
+        self._dtype = jnp.bfloat16 if cfg.quantize == "bf16" \
+            else jnp.float32
+        self.cache = kvcache.KVCacheState(
+            cfg.slots, cfg.page_size, self.max_context,
+            pool_pages=cfg.pool_pages, name=name)
+        pool_shape = (self.n_layers, self.cache.pool_pages,
+                      cfg.page_size, self.n_heads, self.head_dim)
+        self._kpool = jnp.zeros(pool_shape, self._dtype)
+        self._vpool = jnp.zeros(pool_shape, self._dtype)
+        self.prefill_buckets = tuple(sorted(set(
+            int(b) for b in (cfg.prefill_buckets
+                             or kvcache.default_prefill_buckets(
+                                 cfg.page_size, self.max_context)))))
+        for b in self.prefill_buckets:
+            if b < 1 or b % cfg.page_size or b > self.max_context:
+                raise ModelLoadError(
+                    f"decode[{name}]: prefill bucket {b} must be a "
+                    f"page-aligned size <= max_context")
+        # per-slot host state
+        self._temps = np.zeros((cfg.slots,), np.float32)
+        self._topks = np.zeros((cfg.slots,), np.int32)
+        self._last_tokens = np.zeros((cfg.slots,), np.int32)
+        self._counter = 0
+        self._base_key = jax.random.PRNGKey(cfg.seed)
+        self._compiled: set = set()
+        self._closed = False
+        self._decode_jit = jax.jit(self._decode_fn, donate_argnums=(1, 2))
+        self._prefill_jit = jax.jit(self._prefill_fn, donate_argnums=(1, 2))
+        self._logits_jit = jax.jit(self._logits_fn)
+
+    # --------------------------------------------------------- the forward
+    def _forward_tokens(self, params, tokens, mask):
+        """(B, T) ids -> ((B, T, V) pre-softmax logits, per-block roped
+        (K, V) lists). The same primitive calls as the stock layers'
+        apply() so full-sequence logits are bitwise those of
+        net.output() at valid positions."""
+        x = None
+        kvs = []
+        t = tokens.shape[1]
+        pos = jnp.arange(t)[None]
+        for kind, layer, key in self._plan:
+            p = params[key]
+            if kind == "embed":
+                x = qtake(p["W"], tokens)
+                if mask is not None:
+                    x = x * mask[..., None].astype(x.dtype)
+            elif kind == "posembed":
+                x = x + p["P"][:t][None]
+            elif kind == "pertoken":
+                x, _ = layer.apply(p, {}, x, train=False, rng=None,
+                                   mask=mask)
+            elif kind == "block":
+                x, k, v = self._block_full(layer, p, x, mask, pos)
+                kvs.append((k, v))
+            else:                                           # head
+                z = qdot(x, p["W"])
+                if "b" in p:
+                    z = z + p["b"]
+                x = z
+        return x, kvs
+
+    def _block_full(self, conf, p, x, mask, pos):
+        """TransformerBlock full-sequence forward, returning the roped
+        K / raw V the cache stores. Mirrors TransformerBlock.apply's
+        dense path operation-for-operation."""
+        h, _ = _LN.apply(p["ln1"], {}, x)
+        a = p["attn"]
+        q = _split_heads(qdot(h, a["Wq"]), conf.n_heads)
+        k = _split_heads(qdot(h, a["Wk"]), conf.n_heads)
+        v = _split_heads(qdot(h, a["Wv"]), conf.n_heads)
+        if conf.use_rope:
+            q = rope(q, pos)
+            k = rope(k, pos)
+        out = dot_product_attention(q, k, v, mask=mask, causal=conf.causal)
+        y = qdot(_merge_heads(out), a["Wo"])
+        if mask is not None:
+            y = y * mask[..., None].astype(y.dtype)
+        x = x + y
+        h, _ = _LN.apply(p["ln2"], {}, x)
+        h = get_activation(conf.activation)(qdot(h, p["W1"]) + p["b1"])
+        h = qdot(h, p["W2"]) + p["b2"]
+        y = x + h
+        if mask is not None:
+            y = y * mask[..., None].astype(y.dtype)
+        return y, k, v
+
+    def _block_decode(self, conf, p, li, x, kpool, vpool, page_table,
+                      seq_lens, active, pos):
+        """One-token incremental block forward against the paged cache."""
+        s = x.shape[0]
+        h, _ = _LN.apply(p["ln1"], {}, x)
+        a = p["attn"]
+        q = _split_heads(qdot(h, a["Wq"]), conf.n_heads)
+        k = _split_heads(qdot(h, a["Wk"]), conf.n_heads)
+        v = _split_heads(qdot(h, a["Wv"]), conf.n_heads)
+        if conf.use_rope:
+            q = rope(q, pos)
+            k = rope(k, pos)
+        ps = self.cfg.page_size
+        page_idx = seq_lens // ps
+        phys = page_table[jnp.arange(s), page_idx]
+        # inactive slots write their garbage row to the dump page
+        phys = jnp.where(active, phys, kvcache.DUMP_PAGE)
+        kpool, vpool = kvcache.append_token_kv(
+            kpool, vpool, li, k[:, 0], v[:, 0], phys, seq_lens % ps)
+        keys, vals = kvcache.gather_kv(kpool, vpool, li, page_table,
+                                       self.max_context)
+        # validity: cached positions 0..seq_len INCLUSIVE (the row this
+        # step just appended is position seq_len)
+        mask = (jnp.arange(self.max_context)[None, :]
+                <= seq_lens[:, None]).astype(jnp.float32)
+        out = dot_product_attention(q, keys, vals, mask=mask, causal=False)
+        y = qdot(_merge_heads(out), a["Wo"])
+        x = x + y
+        h, _ = _LN.apply(p["ln2"], {}, x)
+        h = get_activation(conf.activation)(qdot(h, p["W1"]) + p["b1"])
+        h = qdot(h, p["W2"]) + p["b2"]
+        return x + h, kpool, vpool
+
+    # ----------------------------------------------------------- sampling
+    def _sample(self, logits, temps, topks, counter):
+        """Greedy / temperature / top-k, per slot, in-graph (Gumbel-max:
+        one argmax regardless of temperature)."""
+        lg = logits.astype(jnp.float32)
+        s, v = lg.shape
+        greedy = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+        kmax = min(TOP_K_MAX, v)
+        top_vals, _ = jax.lax.top_k(lg, kmax)
+        kth = top_vals[jnp.arange(s), jnp.clip(topks, 1, kmax) - 1]
+        keep = (topks <= 0)[:, None] | (lg >= kth[:, None])
+        filt = jnp.where(keep, lg, -jnp.inf)
+        g = jax.random.gumbel(jax.random.fold_in(self._base_key, counter),
+                              lg.shape, jnp.float32)
+        safe_t = jnp.where(temps > 0, temps, 1.0)[:, None]
+        sampled = jnp.argmax(filt / safe_t + g, axis=-1).astype(jnp.int32)
+        return jnp.where(temps > 0, sampled, greedy)
+
+    # ------------------------------------------------------- jitted bodies
+    def _prefill_fn(self, params, kpool, vpool, tokens, length, page_row,
+                    temp, topk, counter):
+        """tokens (1, Tb); length (); page_row (pages_per_slot,). Returns
+        (kpool, vpool, first sampled token (), last-position logits (V,))."""
+        tb = tokens.shape[1]
+        mask = (jnp.arange(tb)[None] < length).astype(jnp.float32)
+        logits, kvs = self._forward_tokens(params, tokens, mask)
+        for li, (k, v) in enumerate(kvs):
+            kpool, vpool = kvcache.write_prompt_kv(
+                kpool, vpool, li, k[0], v[0], page_row, self.cfg.page_size)
+        last = jnp.take(logits[0], length - 1, axis=0)
+        tok = self._sample(last[None], temp[None], topk[None], counter)[0]
+        return kpool, vpool, tok, last
+
+    def _decode_fn(self, params, kpool, vpool, page_table, seq_lens,
+                   tokens, active, temps, topks, counter):
+        """One token for every slot (inactive slots compute masked
+        garbage into the dump page). Returns (kpool, vpool, sampled (S,),
+        logits (S, V))."""
+        pos = seq_lens[:, None]
+        x = None
+        for kind, layer, key in self._plan:
+            p = params[key]
+            if kind == "embed":
+                x = qtake(p["W"], tokens)[:, None, :]
+            elif kind == "posembed":
+                idx = jnp.clip(seq_lens, 0, layer.max_length - 1)
+                x = x + jnp.take(p["P"], idx, axis=0)[:, None, :]
+            elif kind == "pertoken":
+                x, _ = layer.apply(p, {}, x, train=False, rng=None,
+                                   mask=None)
+            elif kind == "block":
+                x, kpool, vpool = self._block_decode(
+                    layer, p, self._block_index[key], x, kpool, vpool,
+                    page_table, seq_lens, active, pos)
+            else:
+                z = qdot(x, p["W"])
+                if "b" in p:
+                    z = z + p["b"]
+                x = z
+        logits = x[:, 0, :]
+        toks = self._sample(logits, temps, topks, counter)
+        return kpool, vpool, toks, logits
+
+    def _logits_fn(self, params, tokens):
+        """(B, T) -> (B, T, V) full-sequence pre-softmax logits (parity /
+        quality scoring; never on the request path)."""
+        return self._forward_tokens(params, tokens, None)[0]
+
+    # ----------------------------------------------------- compile ledger
+    def _meter_program(self, program: str, warmup: bool):
+        if program in self._compiled:
+            return
+        self._compiled.add(program)
+        monitor.counter(
+            "serving_decode_compiles_total",
+            "First executions of a decode-runtime program per engine "
+            "generation (each implies one XLA compile)",
+            labels=("model", "program")).inc(model=self.name,
+                                             program=program)
+        if not warmup:
+            log.warning(
+                "decode[%s]: program %s first executed on the REQUEST "
+                "path (compile latency hit a live stream) — warm() was "
+                "skipped or the ladder changed", self.name, program)
+
+    def warm(self):
+        """AOT-execute every prefill bucket and the decode step so no
+        live stream ever waits on XLA. Installed counters satisfy
+        compiles == warmups on /metrics (the generation ledger)."""
+        t0 = time.perf_counter()
+        dump_row = np.full((self.cache.pages_per_slot,),
+                           kvcache.DUMP_PAGE, np.int32)
+        for tb in self.prefill_buckets:
+            self._meter_program(f"prefill_{tb}", warmup=True)
+            with monitor.span("serving/prefill", model=self.name,
+                              bucket=tb, warmup=1):
+                self._kpool, self._vpool, _, _ = self._prefill_jit(
+                    self._params, self._kpool, self._vpool,
+                    np.zeros((1, tb), np.int32), np.int32(1), dump_row,
+                    np.float32(0), np.int32(0), np.uint32(0))
+            monitor.counter("serving_decode_warmup_runs_total",
+                            "AOT decode-runtime warmup executions (one "
+                            "per program per engine generation)",
+                            labels=("model",)).inc(model=self.name)
+        self._meter_program("decode", warmup=True)
+        with monitor.span("serving/decode_step", model=self.name, warmup=1):
+            s = self.cfg.slots
+            self._kpool, self._vpool, _, _ = self._decode_jit(
+                self._params, self._kpool, self._vpool,
+                np.asarray(self.cache.page_table),
+                np.zeros((s,), np.int32), np.zeros((s,), np.int32),
+                np.zeros((s,), bool), np.zeros((s,), np.float32),
+                np.zeros((s,), np.int32), np.uint32(0))
+        monitor.counter("serving_decode_warmup_runs_total",
+                        "AOT decode-runtime warmup executions (one per "
+                        "program per engine generation)",
+                        labels=("model",)).inc(model=self.name)
+        monitor.histogram(
+            "serving_decode_warmup_seconds",
+            "Full decode-runtime warmup duration (buckets + step)",
+            labels=("model",),
+            buckets=(0.1, 0.5, 1, 2.5, 5, 10, 30, 60, 120)).observe(
+            time.perf_counter() - t0, model=self.name)
+
+    # ------------------------------------------------------------ host API
+    def bucket_for(self, n: int) -> int:
+        for b in self.prefill_buckets:
+            if n <= b:
+                return b
+        return self.prefill_buckets[-1]
+
+    def prefill(self, slot: int, prompt: np.ndarray, temperature: float,
+                top_k: int) -> Tuple[int, np.ndarray]:
+        """Run the prompt through a bucket-padded prefill into `slot`'s
+        pages; returns (first sampled token, last-position logits)."""
+        n = int(len(prompt))
+        tb = self.bucket_for(n)
+        toks = np.zeros((1, tb), np.int32)
+        toks[0, :n] = prompt
+        self._temps[slot] = temperature
+        self._topks[slot] = top_k
+        self._counter += 1
+        self._meter_program(f"prefill_{tb}", warmup=False)
+        with monitor.span("serving/prefill", model=self.name, bucket=tb):
+            self._kpool, self._vpool, tok, logits = self._prefill_jit(
+                self._params, self._kpool, self._vpool, toks,
+                np.int32(n), self.cache.page_table[slot].copy(),
+                np.float32(temperature), np.int32(top_k),
+                np.uint32(self._counter & 0xFFFFFFFF))
+        monitor.counter("serving_decode_prefills_total",
+                        "Prompt prefills by bucket size",
+                        labels=("model", "bucket")).inc(
+            model=self.name, bucket=str(tb))
+        tok = int(tok)
+        self._last_tokens[slot] = tok
+        return tok, np.asarray(logits, np.float32)
+
+    def step(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """One decode iteration over every runnable slot. Returns
+        (sampled tokens (S,), runnable mask (S,), logits (S, V)); slots
+        not in the mask were inactive, page-stalled, or at the context
+        cap and produced garbage."""
+        act = np.zeros((self.cfg.slots,), bool)
+        n_runnable = 0
+        for s in self.cache.active_slots():
+            if self.cache.ensure_page(s):
+                act[s] = True
+                n_runnable += 1
+        self._counter += 1
+        self._meter_program("decode", warmup=False)
+        with monitor.span("serving/decode_step", model=self.name,
+                          active=n_runnable):
+            self._kpool, self._vpool, toks, logits = self._decode_jit(
+                self._params, self._kpool, self._vpool,
+                np.asarray(self.cache.page_table),
+                np.asarray(self.cache.seq_lens), self._last_tokens.copy(),
+                act, self._temps.copy(), self._topks.copy(),
+                np.uint32(self._counter & 0xFFFFFFFF))
+        toks_np = np.asarray(toks)
+        for s in np.nonzero(act)[0]:
+            self.cache.advance(int(s))
+            self._last_tokens[s] = toks_np[s]
+        monitor.counter("serving_decode_steps_total",
+                        "Compiled decode iterations executed",
+                        labels=("model",)).inc(model=self.name)
+        return toks_np, act, np.asarray(logits, np.float32)
+
+    def logits_full(self, tokens) -> np.ndarray:
+        """(B, T) -> (B, T, V) float32 logits by full-sequence recompute
+        (the parity oracle and the quantization-quality probe)."""
+        out = self._logits_jit(self._params,
+                               jnp.asarray(np.asarray(tokens, np.int32)))
+        return np.asarray(out, np.float32)
+
+    def close(self):
+        """Release the page pools (the engine is retired; ~2 * L * P *
+        page_size * H * D * dtype bytes come back)."""
+        self._closed = True
+        self._kpool = self._vpool = None
+        self._params = None
+
+    def describe(self) -> dict:
+        d = self.cache.describe()
+        d.update({"prefill_buckets": list(self.prefill_buckets),
+                  "quantize": self.cfg.quantize,
+                  "vocab_size": self.vocab,
+                  "n_layers": self.n_layers})
+        return d
+
+
+# ==========================================================================
+# The scheduler: iteration-level admission over one or more engines
+# ==========================================================================
+class _EngineRun:
+    """A live engine + the requests bound to its slots. `admitting` is
+    True only for the newest engine; older runs drain and retire."""
+
+    __slots__ = ("engine", "version", "admitting", "slot_req")
+
+    def __init__(self, engine: DecodeEngine, version: int):
+        self.engine = engine
+        self.version = version
+        self.admitting = True
+        self.slot_req: Dict[int, GenerateRequest] = {}
+
+
+class DecodeScheduler:
+    """The continuous-batching loop: admit between steps, step every
+    engine with live slots, retire drained engines. One daemon thread;
+    every device interaction happens on it."""
+
+    def __init__(self, name: str, queue_limit: int = 64):
+        self.name = name
+        self.queue_limit = int(queue_limit)
+        self._pending: deque = deque()
+        self._plock = threading.Lock()
+        self._runs: List[_EngineRun] = []
+        self._rlock = threading.Lock()
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._draining = False
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name=f"DecodeScheduler-{name}")
+        self._started = False
+
+    # -------------------------------------------------------------- control
+    def install(self, engine: DecodeEngine, version: int):
+        """Make `engine` the admitting engine; older runs stop admitting
+        and retire once their in-flight sequences finish."""
+        with self._rlock:
+            for run in self._runs:
+                run.admitting = False
+            self._runs.append(_EngineRun(engine, version))
+        if not self._started:
+            self._started = True
+            self._thread.start()
+        self._wake.set()
+
+    def submit(self, req: GenerateRequest):
+        if self._draining or self._stop.is_set():
+            raise ServerDrainingError(
+                f"decode[{self.name}] is shutting down")
+        with self._plock:
+            if len(self._pending) >= self.queue_limit:
+                monitor.counter("serving_decode_rejected_total",
+                                "Generation requests rejected by "
+                                "admission control",
+                                labels=("model", "reason")).inc(
+                    model=self.name, reason="queue_full")
+                raise ServerOverloadedError(
+                    f"decode[{self.name}]: join queue full "
+                    f"({self.queue_limit} pending)")
+            self._pending.append(req)
+            depth = len(self._pending)
+        monitor.gauge("serving_decode_queue_depth",
+                      "Generation requests waiting for a decode slot",
+                      labels=("model",)).set(depth, model=self.name)
+        self._wake.set()
+
+    def queue_state(self) -> Tuple[int, int]:
+        with self._plock:
+            return len(self._pending), self.queue_limit
+
+    def inflight(self) -> int:
+        with self._rlock:
+            return sum(len(r.slot_req) for r in self._runs)
+
+    def admitting_engine(self) -> Optional[DecodeEngine]:
+        with self._rlock:
+            if self._runs and self._runs[-1].admitting:
+                return self._runs[-1].engine
+            return None
+
+    # --------------------------------------------------------------- loop
+    def _loop(self):
+        crash: Optional[Exception] = None
+        while not self._stop.is_set():
+            try:
+                worked = self._admit()
+                worked = self._step_all() or worked
+                self._retire()
+            except Exception as e:      # noqa: BLE001 — the scheduler
+                # thread is the only place slots are reclaimed: an
+                # unguarded exception here would strand every stream
+                # forever while the servable still reported "ready".
+                # Fail everything loudly and stop instead.
+                crash = e
+                log.exception("decode[%s]: scheduler crashed; failing "
+                              "all streams", self.name)
+                self._stop.set()
+                break
+            if not worked:
+                self._wake.wait(0.005)
+                self._wake.clear()
+        # teardown: everything still live gets a terminal error
+        exc = crash if crash is not None else ServerDrainingError(
+            f"decode[{self.name}] shut down mid-stream")
+        with self._rlock:
+            runs = list(self._runs)
+            self._runs.clear()
+        for run in runs:
+            for slot, req in run.slot_req.items():
+                run.engine.cache.release(slot)
+                req.fail(exc)
+            run.engine.close()
+        self._fail_pending(crash if crash is not None
+                           else ServerDrainingError(
+                               f"decode[{self.name}] shut down"))
+
+    def _fail_pending(self, exc: Exception):
+        while True:
+            with self._plock:
+                if not self._pending:
+                    return
+                req = self._pending.popleft()
+            req.fail(exc)
+
+    def _admit(self) -> bool:
+        with self._rlock:
+            run = self._runs[-1] if self._runs and self._runs[-1].admitting \
+                else None
+        if run is None:
+            return False
+        worked = False
+        while True:
+            with self._plock:
+                req = self._pending[0] if self._pending else None
+            if req is None:
+                break
+            if req.cancelled.is_set():
+                self._pop(req)
+                req.finish("cancelled")
+                continue
+            if req.deadline is not None \
+                    and time.monotonic() > req.deadline:
+                self._pop(req)
+                monitor.counter("serving_decode_rejected_total",
+                                "Generation requests rejected by "
+                                "admission control",
+                                labels=("model", "reason")).inc(
+                    model=self.name, reason="deadline")
+                req.fail(DeadlineExceededError(
+                    f"decode[{self.name}]: deadline expired after "
+                    f"{time.monotonic() - req.enqueued:.3f}s in queue"))
+                continue
+            if len(req.prompt) >= run.engine.max_context:
+                # the admitting engine changed under the request (a swap
+                # to a shorter-context model raced generate()'s check):
+                # fail it cleanly, never let admit() overrun a page table
+                self._pop(req)
+                req.fail(ValueError(
+                    f"decode[{self.name}]: prompt length "
+                    f"{len(req.prompt)} leaves no room to generate "
+                    f"(live max_context {run.engine.max_context})"))
+                continue
+            slot = run.engine.cache.admit(len(req.prompt))
+            if slot is None:
+                break                       # no slot/pages; retry next tick
+            self._pop(req)
+            joined_running = bool(run.slot_req) or self.inflight() > 0
+            try:
+                tok, _ = run.engine.prefill(slot, req.prompt,
+                                            req.temperature, req.top_k)
+            except Exception as e:          # noqa: BLE001 — surfaced to req
+                run.engine.cache.release(slot)
+                log.exception("decode[%s]: prefill failed", self.name)
+                req.fail(e)
+                continue
+            req.version = run.version
+            run.slot_req[slot] = req
+            if joined_running:
+                monitor.counter(
+                    "serving_decode_preempted_joins_total",
+                    "Requests admitted into an already-running batch "
+                    "between token steps (continuous batching)",
+                    labels=("model",)).inc(model=self.name)
+            self._emit(run, slot, req, tok)
+            worked = True
+        with self._plock:
+            depth = len(self._pending)
+        monitor.gauge("serving_decode_queue_depth",
+                      "Generation requests waiting for a decode slot",
+                      labels=("model",)).set(depth, model=self.name)
+        return worked
+
+    def _pop(self, req: GenerateRequest):
+        with self._plock:
+            if self._pending and self._pending[0] is req:
+                self._pending.popleft()
+
+    def _emit(self, run: _EngineRun, slot: int, req: GenerateRequest,
+              tok: int):
+        """Deliver one sampled token; finish/free the slot on EOS, the
+        token budget, cancellation or the deadline."""
+        if req.cancelled.is_set():
+            self._finish(run, slot, req, "cancelled")
+            return
+        if req.deadline is not None and time.monotonic() > req.deadline:
+            self._finish(run, slot, req, "deadline")
+            return
+        if req.eos_id is not None and tok == req.eos_id:
+            self._finish(run, slot, req, "eos")
+            return
+        if req.last_emit_at is not None:
+            monitor.histogram(
+                "serving_decode_inter_token_seconds",
+                "Gap between consecutive streamed tokens of one request",
+                labels=("model",), buckets=_ITL_BUCKETS).observe(
+                time.monotonic() - req.last_emit_at, model=self.name)
+        elif req.n_emitted == 0:
+            # TTFT observed only for generations that actually deliver a
+            # first token — cancelled/deadline admissions (checked above)
+            # must not pollute the gated decode_ttft_p99_ms series
+            monitor.histogram(
+                "serving_decode_ttft_seconds",
+                "Time from request arrival to its first generated token",
+                labels=("model",), buckets=_TTFT_BUCKETS).observe(
+                time.monotonic() - req.enqueued, model=self.name)
+        req.emit(tok)
+        monitor.counter("serving_decode_tokens_total",
+                        "Generated tokens streamed to clients",
+                        labels=("model",)).inc(model=self.name)
+        if req.n_emitted >= req.max_new_tokens:
+            self._finish(run, slot, req, "length")
+
+    def _finish(self, run: _EngineRun, slot: int, req: GenerateRequest,
+                reason: str):
+        run.engine.cache.release(slot)
+        run.slot_req.pop(slot, None)
+        req.finish(reason)
+        monitor.counter("serving_decode_finished_total",
+                        "Finished generations by reason",
+                        labels=("model", "reason")).inc(
+            model=self.name, reason=reason)
+
+    def _step_all(self) -> bool:
+        with self._rlock:
+            runs = [r for r in self._runs if r.slot_req]
+        worked = False
+        for run in runs:
+            toks, act, _ = run.engine.step()
+            for slot, req in list(run.slot_req.items()):
+                if act[slot]:
+                    self._emit(run, slot, req, int(toks[slot]))
+                elif int(run.engine.cache.seq_lens[slot]) \
+                        >= run.engine.max_context:
+                    self._finish(run, slot, req, "length_cap")
+                elif req.cancelled.is_set():
+                    # a page-stalled slot must still honor cancellation/
+                    # deadline: releasing it is what refills the pool —
+                    # otherwise an oversubscribed pool where EVERY slot
+                    # stalls deadlocks forever with all pages leaked
+                    self._finish(run, slot, req, "cancelled")
+                elif req.deadline is not None \
+                        and time.monotonic() > req.deadline:
+                    self._finish(run, slot, req, "deadline")
+                # else: page-stalled this step; metered by the cache
+            worked = True
+        return worked
+
+    def _retire(self):
+        with self._rlock:
+            keep = []
+            for run in self._runs:
+                if not run.admitting and not run.slot_req:
+                    run.engine.close()
+                    log.info("decode[%s]: retired engine v%d (drained)",
+                             self.name, run.version)
+                else:
+                    keep.append(run)
+            self._runs = keep
+
+    # -------------------------------------------------------------- drain
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Stop admitting, let in-flight sequences finish (bounded), then
+        stop the loop. Queued joins fail with a draining error."""
+        self._draining = True
+        self._fail_pending(ServerDrainingError(
+            f"decode[{self.name}] is draining"))
+        deadline = time.monotonic() + timeout
+        while self.inflight() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        flushed = self.inflight() == 0
+        self._stop.set()
+        self._wake.set()
+        if self._started:
+            self._thread.join(timeout=max(0.1,
+                                          deadline - time.monotonic() + 5))
+        return flushed
+
+
+# ==========================================================================
+# The servable: versions + engine lifecycle behind the registry surface
+# ==========================================================================
+class ServedLM:
+    """One named decode servable: version history + engine + scheduler.
+
+    The LM sibling of registry.ServedModel — same lifecycle surface
+    (status/describe/swap/rollback/shutdown), so ModelRegistry, the HTTP
+    server, the fleet supervisor and the router drive both kinds without
+    caring which is which."""
+
+    kind = "lm"
+
+    def __init__(self, name: str, model, source: str,
+                 decode: Optional[DecodeConfig] = None):
+        from deeplearning4j_tpu.serving.registry import ServableVersion
+        self.name = name
+        self.cfg = decode if decode is not None else DecodeConfig()
+        self.status = "loading"
+        self._swap_lock = threading.Lock()
+        self._state_lock = threading.Lock()
+        engine = DecodeEngine(model, self.cfg, name=name)
+        engine.warm()
+        self.vocab = engine.vocab
+        self.max_context = engine.max_context
+        self.scheduler = DecodeScheduler(name,
+                                         queue_limit=self.cfg.queue_limit)
+        self.scheduler.install(engine, version=1)
+        self.versions: List[ServableVersion] = [
+            ServableVersion(1, str(source), model)]
+        self.active = 0
+        self.active_info = self.versions[0].describe()
+        self._engines: Dict[int, DecodeEngine] = {1: engine}
+        self.status = "ready"
+        monitor.gauge("serving_model_ready",
+                      "1 while the servable is warmed and live",
+                      labels=("model",)).set(1, model=name)
+
+    # ---------------------------------------------------------- generation
+    def generate(self, prompt, max_new_tokens: int = 32,
+                 temperature: float = 0.0, top_k: int = 0,
+                 eos_id: Optional[int] = None,
+                 deadline: Optional[float] = None) -> GenerateRequest:
+        """Validate + enqueue one generation; returns the live request
+        whose `events` queue streams tokens. Raises ValueError (400),
+        ServerOverloadedError (429) or ServerDrainingError (503)."""
+        if self.status == "stopping":
+            raise ServerDrainingError(
+                f"decode[{self.name}] is draining")
+        prompt = np.asarray(prompt, np.int64).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("prompt must contain at least one token id")
+        if prompt.size >= self.max_context:
+            raise ValueError(
+                f"prompt length {prompt.size} leaves no room to generate "
+                f"(max_context {self.max_context})")
+        if prompt.min() < 0 or prompt.max() >= self.vocab:
+            raise ValueError(
+                f"prompt ids must be in [0, {self.vocab}); got "
+                f"[{int(prompt.min())}, {int(prompt.max())}]")
+        if max_new_tokens < 1:
+            raise ValueError("max_tokens must be >= 1")
+        max_new = min(int(max_new_tokens), self.cfg.max_new_tokens_cap,
+                      self.max_context - int(prompt.size))
+        req = GenerateRequest(
+            prompt, max_new_tokens=max_new, temperature=temperature,
+            top_k=top_k, eos_id=eos_id,
+            deadline=None if deadline is None
+            else time.monotonic() + float(deadline))
+        self.scheduler.submit(req)
+        return req
+
+    # ------------------------------------------------------------ lifecycle
+    def _activate(self, sv, quantize: Optional[str]):
+        """Warm a full replacement engine off-path, then roll admissions
+        onto it; in-flight sequences finish on their own engine (KV pages
+        are only meaningful under the params that wrote them)."""
+        from deeplearning4j_tpu.serving.registry import ModelLoadError
+        cfg = dataclasses.replace(self.cfg, quantize=quantize)
+        t0 = time.perf_counter()
+        engine = DecodeEngine(sv.model, cfg, name=self.name)
+        if engine.vocab != self.vocab:
+            engine.close()
+            raise ModelLoadError(
+                f"swap rejected: {sv.source!r} has vocab "
+                f"{engine.vocab}, live servable {self.name!r} serves "
+                f"{self.vocab} (deploy under a new name)")
+        with monitor.span("serving/swap", model=self.name,
+                          version=sv.version):
+            engine.warm()
+            self.scheduler.install(engine, version=sv.version)
+        self._engines[sv.version] = engine
+        if engine.max_context != self.max_context:
+            # a swap may change KV capacity (cfg.max_context=None derives
+            # it from the model); generate() must validate against the
+            # LIVE admitting engine, and the scheduler re-checks at
+            # admission for requests that raced this update
+            log.warning("decode[%s]: max_context %d -> %d across swap",
+                        self.name, self.max_context, engine.max_context)
+            self.max_context = engine.max_context
+        monitor.histogram("serving_swap_seconds",
+                          "Load+warm+swap duration (off the request path)",
+                          labels=("model",),
+                          buckets=(0.1, 0.5, 1, 2.5, 5, 10, 30, 60, 120)
+                          ).observe(time.perf_counter() - t0,
+                                    model=self.name)
+
+    def swap(self, source, keep_versions: int = 3) -> dict:
+        from deeplearning4j_tpu.serving.registry import (
+            ServableVersion, load_servable,
+        )
+        base, variant = parse_variant(str(source))
+        model = load_servable(base)
+        with self._swap_lock:
+            if self.status == "stopping":
+                raise ServerDrainingError(
+                    f"decode[{self.name}] is draining; swap rejected")
+            with self._state_lock:
+                next_version = self.versions[-1].version + 1
+            sv = ServableVersion(next_version, str(source), model)
+            self._activate(sv, variant if variant is not None
+                           else self.cfg.quantize)
+            with self._state_lock:
+                self.versions.append(sv)
+                self.active = len(self.versions) - 1
+                while len(self.versions) > keep_versions:
+                    dropped = self.versions.pop(0)
+                    self.active -= 1
+                    self._engines.pop(dropped.version, None)
+                    log.info("decode[%s]: retired v%d (%s) from memory",
+                             self.name, dropped.version, dropped.source)
+                self.active_info = sv.describe()
+            monitor.counter("serving_swaps_total",
+                            "Zero-downtime model hot-swaps",
+                            labels=("model",)).inc(model=self.name)
+        log.info("decode[%s]: now admitting on v%d (%s); older versions "
+                 "drain in place", self.name, sv.version, sv.source)
+        return sv.describe()
+
+    def rollback(self) -> dict:
+        from deeplearning4j_tpu.serving.registry import ModelLoadError
+        with self._swap_lock:
+            if self.status == "stopping":
+                raise ServerDrainingError(
+                    f"decode[{self.name}] is draining; rollback rejected")
+            with self._state_lock:
+                if self.active == 0:
+                    raise ModelLoadError(
+                        f"decode[{self.name}]: no previous version in "
+                        "memory to roll back to")
+                sv = self.versions[self.active - 1]
+            # the rolled-back-to version gets a FRESH warmed engine (its
+            # old one may already be retired); the same rolling handoff
+            base, variant = parse_variant(str(sv.source))
+            self._activate(sv, variant if variant is not None
+                           else self.cfg.quantize)
+            with self._state_lock:
+                self.active -= 1
+                self.active_info = sv.describe()
+            monitor.counter("serving_rollbacks_total",
+                            "One-step version rollbacks",
+                            labels=("model",)).inc(model=self.name)
+        log.warning("decode[%s]: rolled back to v%d (%s)", self.name,
+                    sv.version, sv.source)
+        return sv.describe()
+
+    # --------------------------------------------------------------- admin
+    def queue_state(self) -> Tuple[int, int]:
+        """(depth, limit) of the join queue — the Retry-After input."""
+        return self.scheduler.queue_state()
+
+    def describe(self) -> dict:
+        with self._state_lock:
+            newest = self.scheduler.admitting_engine()
+            d = {
+                "name": self.name,
+                "kind": self.kind,
+                "status": self.status,
+                "vocab_size": self.vocab,
+                "max_context": self.max_context,
+                "active_version": self.versions[self.active].version,
+                "versions": [v.describe() for v in self.versions],
+                "pending": self.scheduler.queue_state()[0],
+                "inflight": self.scheduler.inflight(),
+            }
+            if newest is not None:
+                d["decode"] = newest.describe()
+            return d
+
+    def shutdown(self, drain: bool = True, timeout: float = 30.0):
+        self.status = "stopping"
+        monitor.gauge("serving_model_ready",
+                      "1 while the servable is warmed and live",
+                      labels=("model",)).set(0, model=self.name)
+        self.scheduler.drain(timeout=timeout if drain else 0.1)

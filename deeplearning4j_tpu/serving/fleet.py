@@ -70,7 +70,9 @@ class ReplicaSpec:
                  max_delay_ms: float = 5.0, queue_limit: int = 256,
                  default_deadline_s: float = 30.0,
                  host: str = "127.0.0.1",
-                 enable_faults: bool = False):
+                 enable_faults: bool = False,
+                 lms: Sequence[Tuple[str, object]] = (),
+                 decode=None):
         self.models = list(models)              # [(name, source), ...]
         self.buckets = tuple(int(b) for b in buckets)
         self.max_delay_ms = float(max_delay_ms)
@@ -78,6 +80,10 @@ class ReplicaSpec:
         self.default_deadline_s = float(default_deadline_s)
         self.host = host
         self.enable_faults = bool(enable_faults)
+        #: decode (LM) servables: [(name, source), ...] + one shared
+        #: DecodeConfig (serving/decode.py); None decode = library default
+        self.lms = list(lms)
+        self.decode = decode
 
 
 class Replica:
@@ -151,6 +157,8 @@ class InProcessReplica(Replica):
             registry.deploy(model_name, source, buckets=self.spec.buckets,
                             max_delay_ms=self.spec.max_delay_ms,
                             queue_limit=self.spec.queue_limit)
+        for model_name, source in self.spec.lms:
+            registry.deploy_lm(model_name, source, decode=self.spec.decode)
         self._registry = registry
         self._server = ModelServer(
             registry, host=self.spec.host, port=0,
@@ -205,6 +213,24 @@ class SubprocessReplica(Replica):
                     f"subprocess replica {self.name}: model source must be "
                     f"a path/zoo name string, got {type(source).__name__}")
             argv += ["--model", f"{model_name}={source}"]
+        for model_name, source in self.spec.lms:
+            if not isinstance(source, str):
+                raise TypeError(
+                    f"subprocess replica {self.name}: LM source must be "
+                    f"a path/zoo name string, got {type(source).__name__}")
+            argv += ["--lm", f"{model_name}={source}"]
+        if self.spec.lms and self.spec.decode is not None:
+            d = self.spec.decode
+            argv += ["--decode-slots", str(d.slots),
+                     "--decode-page-size", str(d.page_size),
+                     "--decode-queue-limit", str(d.queue_limit)]
+            if d.max_context is not None:
+                argv += ["--decode-max-context", str(d.max_context)]
+            if d.pool_pages is not None:
+                argv += ["--decode-pool-pages", str(d.pool_pages)]
+            if d.prefill_buckets:
+                argv += ["--prefill-buckets",
+                         ",".join(str(b) for b in d.prefill_buckets)]
         if self.spec.enable_faults:
             argv.append("--enable-fault-injection")
         return argv

@@ -1,0 +1,256 @@
+"""Paged KV cache — fixed-shape attention memory for continuous batching.
+
+Autoregressive decode is memory-shaped like virtual memory, not like a
+tensor: each in-flight sequence grows one (key, value) row per layer per
+token, sequences join and leave at arbitrary times, and naive per-sequence
+contiguous buffers either fragment HBM or force worst-case preallocation
+per request. The PagedAttention design (Kwon et al., SOSP'23) maps the OS
+page table onto the KV cache, and this module is that design sized for the
+AOT-warm serving contract of `serving/batcher.py`:
+
+- **One physical pool, fixed at load time.** Per layer, keys and values
+  live in ``(L, P, page_size, H, D)`` arrays allocated once when the
+  servable loads. Every compiled program sees these exact shapes forever —
+  no growth, no reallocation, no shape-driven recompiles.
+- **Per-slot page tables.** Each decode *slot* (a fixed position in the
+  running batch) owns a row of ``max_context // page_size`` physical page
+  ids. Logical position ``t`` of a sequence lives at physical page
+  ``table[t // page_size]``, offset ``t % page_size`` — pages are
+  allocated on demand as the sequence crosses page boundaries and returned
+  the moment the sequence finishes.
+- **The dump page.** Physical page 0 is never allocated. Fixed-shape
+  scatter writes from *inactive* slots and from prompt-padding positions
+  are steered to page 0, so the compiled step never needs a dynamic shape
+  or a conditional write — garbage goes to a page nobody reads.
+
+The host side (`KVCacheState`) is plain numpy + a free list: allocation
+decisions happen between compiled steps, and the page table crosses to the
+device as a small int32 operand each step. The device side is two pure
+gather/scatter helpers used inside the jitted prefill/decode programs.
+"""
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from deeplearning4j_tpu import monitor
+
+#: physical page 0 — the write sink for inactive slots / padded positions.
+DUMP_PAGE = 0
+
+
+class PagePoolExhaustedError(RuntimeError):
+    """No free physical page for a sequence that must grow (the caller
+    stalls the slot or sheds the join; this never crashes a step)."""
+
+
+class KVCacheState:
+    """Host-side bookkeeping for one engine's paged KV cache.
+
+    Owns the slot free list, the physical-page free list and the numpy
+    page table / sequence lengths mirrored into every compiled step.
+    Thread-safe (admissions come from the scheduler thread, releases can
+    race drains), but the expected driver is a single scheduler loop.
+    """
+
+    def __init__(self, slots: int, page_size: int, max_context: int,
+                 pool_pages: Optional[int] = None, name: str = "lm"):
+        if page_size < 1 or slots < 1:
+            raise ValueError(f"slots/page_size must be >= 1 "
+                             f"(got {slots}/{page_size})")
+        if max_context % page_size:
+            raise ValueError(
+                f"max_context {max_context} must be a multiple of "
+                f"page_size {page_size}")
+        self.slots = int(slots)
+        self.page_size = int(page_size)
+        self.max_context = int(max_context)
+        self.pages_per_slot = self.max_context // self.page_size
+        # +1: the dump page. The default pool is NOT oversubscribed (every
+        # slot can reach max_context); size it smaller to oversubscribe
+        # memory against typical sequence lengths — joins then wait on the
+        # free list when the pool runs dry.
+        self.pool_pages = int(pool_pages) if pool_pages is not None \
+            else 1 + self.slots * self.pages_per_slot
+        if self.pool_pages < 1 + self.pages_per_slot:
+            raise ValueError(
+                f"pool_pages {self.pool_pages} cannot hold even one "
+                f"max-context sequence ({1 + self.pages_per_slot} needed)")
+        self.name = name
+        self._lock = threading.Lock()
+        #: logical->physical page map per slot; unallocated entries point
+        #: at the dump page so fixed-shape gathers/scatters stay safe
+        self.page_table = np.full((self.slots, self.pages_per_slot),
+                                  DUMP_PAGE, np.int32)
+        self.seq_lens = np.zeros((self.slots,), np.int32)
+        self.active = np.zeros((self.slots,), bool)
+        self._free_slots: List[int] = list(range(self.slots))
+        self._free_pages: List[int] = list(range(1, self.pool_pages))
+        self._pages_per_slot_live = [0] * self.slots
+        self._gauges()
+
+    # ------------------------------------------------------------- metrics
+    def _gauges(self):
+        used = self.pool_pages - 1 - len(self._free_pages)
+        monitor.gauge("serving_decode_page_pool_used",
+                      "Allocated KV-cache pages (of the fixed pool)",
+                      labels=("model",)).set(used, model=self.name)
+        monitor.gauge("serving_decode_page_pool_pages",
+                      "Total allocatable KV-cache pages in the pool",
+                      labels=("model",)).set(self.pool_pages - 1,
+                                             model=self.name)
+        monitor.gauge("serving_decode_slot_occupancy",
+                      "Active decode slots (in-flight sequences)",
+                      labels=("model",)).set(int(self.active.sum()),
+                                             model=self.name)
+
+    # ----------------------------------------------------------- lifecycle
+    def pages_for(self, length: int) -> int:
+        """Physical pages needed to hold `length` cached positions."""
+        return (int(length) + self.page_size - 1) // self.page_size
+
+    def admit(self, prompt_len: int) -> Optional[int]:
+        """Claim a slot + the pages covering the prompt; None when either
+        resource is exhausted (the join waits — never an error)."""
+        need = self.pages_for(prompt_len)
+        if need > self.pages_per_slot:
+            raise ValueError(
+                f"kvcache[{self.name}]: {prompt_len} cached positions "
+                f"exceed per-slot capacity ({self.max_context}); the "
+                "caller must validate prompt length first")
+        with self._lock:
+            if not self._free_slots or len(self._free_pages) < need:
+                return None
+            slot = self._free_slots.pop()
+            pages = [self._free_pages.pop() for _ in range(need)]
+            self.page_table[slot, :] = DUMP_PAGE
+            self.page_table[slot, :need] = pages
+            self._pages_per_slot_live[slot] = need
+            self.seq_lens[slot] = prompt_len
+            self.active[slot] = True
+            self._gauges()
+            return slot
+
+    def ensure_page(self, slot: int) -> bool:
+        """Guarantee a physical page exists for this slot's NEXT position
+        (``seq_lens[slot]``). Returns False when the pool is dry — the
+        caller masks the slot out of this step and retries later."""
+        with self._lock:
+            pos = int(self.seq_lens[slot])
+            if pos >= self.max_context:
+                return False            # context cap; caller finishes it
+            idx = pos // self.page_size
+            if idx < self._pages_per_slot_live[slot]:
+                return True
+            if not self._free_pages:
+                monitor.counter(
+                    "serving_decode_page_stalls_total",
+                    "Decode steps a slot sat out waiting for a free "
+                    "KV page (pool oversubscribed)",
+                    labels=("model",)).inc(model=self.name)
+                return False
+            self.page_table[slot, idx] = self._free_pages.pop()
+            self._pages_per_slot_live[slot] = idx + 1
+            self._gauges()
+            return True
+
+    def advance(self, slot: int):
+        """One token appended at ``seq_lens[slot]`` by the decode step."""
+        self.seq_lens[slot] += 1
+
+    def release(self, slot: int):
+        """Sequence finished: return its pages and the slot."""
+        with self._lock:
+            if not self.active[slot]:
+                return
+            n = self._pages_per_slot_live[slot]
+            self._free_pages.extend(int(p) for p in self.page_table[slot, :n])
+            self.page_table[slot, :] = DUMP_PAGE
+            self._pages_per_slot_live[slot] = 0
+            self.seq_lens[slot] = 0
+            self.active[slot] = False
+            self._free_slots.append(slot)
+            self._gauges()
+
+    # -------------------------------------------------------------- status
+    def active_slots(self) -> List[int]:
+        return [i for i in range(self.slots) if self.active[i]]
+
+    def free_pages(self) -> int:
+        with self._lock:
+            return len(self._free_pages)
+
+    def utilization(self) -> float:
+        total = self.pool_pages - 1
+        return (total - self.free_pages()) / max(1, total)
+
+    def describe(self) -> dict:
+        with self._lock:
+            return {
+                "slots": self.slots,
+                "active_slots": int(self.active.sum()),
+                "page_size": self.page_size,
+                "max_context": self.max_context,
+                "pool_pages": self.pool_pages - 1,
+                "pages_used": self.pool_pages - 1 - len(self._free_pages),
+            }
+
+
+# --------------------------------------------------------------------------
+# Device-side helpers (pure; used INSIDE the jitted prefill/decode programs)
+# --------------------------------------------------------------------------
+def append_token_kv(kpool, vpool, layer: int, k, v, phys, off):
+    """Scatter one new (key, value) row per slot into the pools.
+
+    kpool/vpool: (L, P, page_size, H, D); k/v: (S, H, D); phys/off: (S,)
+    physical page id and in-page offset per slot (inactive slots steered
+    to DUMP_PAGE by the caller). Returns the updated pools."""
+    kpool = kpool.at[layer, phys, off].set(k)
+    vpool = vpool.at[layer, phys, off].set(v)
+    return kpool, vpool
+
+
+def write_prompt_kv(kpool, vpool, layer: int, k_seq, v_seq, page_row,
+                    page_size: int):
+    """Blast a prefilled prompt's (key, value) rows into this slot's pages.
+
+    k_seq/v_seq: (T, H, D) with T a static multiple of page_size (the
+    prefill bucket); page_row: (pages_per_slot,) — entries past the
+    prompt's allocation point at DUMP_PAGE, so the fixed-count scatter
+    can't touch another sequence's pages. Returns the updated pools."""
+    t, h, d = k_seq.shape
+    npages = t // page_size
+    kp = k_seq.reshape(npages, page_size, h, d)
+    vp = v_seq.reshape(npages, page_size, h, d)
+    kpool = kpool.at[layer, page_row[:npages]].set(kp)
+    vpool = vpool.at[layer, page_row[:npages]].set(vp)
+    return kpool, vpool
+
+
+def gather_kv(kpool, vpool, layer: int, page_table, max_context: int):
+    """Page-table gather back to dense per-slot key/value sequences.
+
+    page_table: (S, pages_per_slot) int32. Returns (keys, values) shaped
+    (S, max_context, H, D); positions past a slot's live length hold
+    stale/dump garbage — the attention mask (``pos <= seq_len``) is the
+    single source of validity."""
+    s = page_table.shape[0]
+    h, d = kpool.shape[-2], kpool.shape[-1]
+    keys = kpool[layer][page_table].reshape(s, max_context, h, d)
+    vals = vpool[layer][page_table].reshape(s, max_context, h, d)
+    return keys, vals
+
+
+def default_prefill_buckets(page_size: int, max_context: int
+                            ) -> Sequence[int]:
+    """Prefill bucket ladder: page-aligned, geometric (x4), capped by and
+    always including max_context — same philosophy as the predict
+    batcher's 1/8/32/128 ladder (few compiles, bounded padding waste)."""
+    buckets, b = [], page_size
+    while b < max_context:
+        buckets.append(b)
+        b *= 4
+    buckets.append(max_context)
+    return tuple(sorted(set(buckets)))

@@ -1,0 +1,201 @@
+"""Post-training quantization for decode servables.
+
+Token-by-token decode is memory-bandwidth bound: every generated token
+re-reads every weight once, so at batch sizes the decode slots actually
+reach, the roofline (docs/OBSERVABILITY.md, PR 6) puts the step firmly
+left of the ridge — tokens/sec is proportional to bytes moved, and weight
+bytes dominate. Quantization is therefore the single biggest decode lever:
+
+- **int8** — symmetric per-output-channel weight-only PTQ. Each weight
+  matrix W is stored as ``int8 q`` plus a float32 per-channel ``scale``
+  (``W ≈ q * scale``), computed over the contraction axis so each output
+  channel keeps its own dynamic range (the standard LLM.int8()-family
+  recipe for weight-only PTQ). Activations stay float; the dequantize is
+  fused into the matmul by XLA. 4x smaller weight reads.
+- **bf16** — a straight cast of params (and the KV cache, which the
+  engine keys off the compute dtype): 2x smaller reads, near-zero quality
+  cost, and the MXU-native dtype on TPU.
+
+Quality is MEASURED, not assumed: `quality_delta()` scores base and
+variant engines on the same token set (next-token perplexity + mean
+absolute logit error) and `tools/decode_smoke.py` banks the numbers per
+variant in DECODE_r*.json, where perf_report can see them next to the
+tokens/sec they bought.
+
+`QTensor` is a registered pytree so quantized params flow through jit
+exactly like float params; `qdot`/`qtake` are the two consumption sites
+(matmul and embedding lookup) the decode engine routes every quantizable
+weight through.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+#: registry-variant names accepted as a ``@<mode>`` source suffix
+QUANT_MODES = ("int8", "bf16")
+
+
+@jax.tree_util.register_pytree_node_class
+class QTensor:
+    """Symmetric per-channel int8 weight: ``dequant = q * scale``.
+
+    q: int8, original weight shape. scale: float32, shape broadcastable
+    against q with the contraction (second-to-last) axis reduced — one
+    scale per output channel (and per expert for stacked 3D weights)."""
+
+    __slots__ = ("q", "scale")
+
+    def __init__(self, q, scale):
+        self.q = q
+        self.scale = scale
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def dtype(self):            # reported dtype = storage dtype
+        return self.q.dtype
+
+    def tree_flatten(self):
+        return (self.q, self.scale), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    def dequant(self, dtype=jnp.float32):
+        return self.q.astype(dtype) * self.scale.astype(dtype)
+
+    def __repr__(self):
+        return f"QTensor(int8 {tuple(self.q.shape)})"
+
+
+def quantize_leaf(w) -> QTensor:
+    """W (float, ndim >= 2) -> per-output-channel symmetric int8."""
+    w = jnp.asarray(w)
+    if w.ndim < 2:
+        raise ValueError(f"quantize_leaf needs a matrix, got {w.shape}")
+    amax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=-2, keepdims=True)
+    scale = jnp.maximum(amax, 1e-12) / 127.0
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale), -127, 127
+                 ).astype(jnp.int8)
+    return QTensor(q, scale.astype(jnp.float32))
+
+
+def qdot(x, w):
+    """x @ w where w is a float array or a QTensor (weight-only int8:
+    the int8->float convert fuses into the matmul, so the weight is READ
+    as int8 — the bandwidth win — and accumulated in float)."""
+    if isinstance(w, QTensor):
+        y = x @ w.q.astype(x.dtype)
+        return y * w.scale.astype(x.dtype)
+    return x @ w
+
+
+def qtake(w, idx):
+    """Embedding-row gather from a float array or QTensor table."""
+    if isinstance(w, QTensor):
+        rows = jnp.take(w.q, idx, axis=0).astype(jnp.float32)
+        return rows * w.scale.astype(jnp.float32)
+    return jnp.take(w, idx, axis=0)
+
+
+def is_quantized(w) -> bool:
+    return isinstance(w, QTensor)
+
+
+def cast_tree_bf16(params):
+    """bf16 servable variant: every float leaf -> bfloat16 (weights AND
+    the activations/KV cache downstream, via the engine compute dtype)."""
+    def cast(a):
+        if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating):
+            return a.astype(jnp.bfloat16)
+        return a
+    return jax.tree_util.tree_map(cast, params)
+
+
+def parse_variant(source: str):
+    """Split a servable source's ``@int8`` / ``@bf16`` variant suffix.
+
+    ``zoo:TransformerLM?n_layers=2@int8`` -> (``zoo:...?n_layers=2``,
+    ``"int8"``); plain sources come back with variant None."""
+    if isinstance(source, str) and "@" in source:
+        base, _, suffix = source.rpartition("@")
+        if suffix in QUANT_MODES:
+            return base, suffix
+    return source, None
+
+
+# --------------------------------------------------------------- quality
+def _log_softmax(z: np.ndarray) -> np.ndarray:
+    m = z.max(axis=-1, keepdims=True)
+    s = z - m
+    return s - np.log(np.exp(s).sum(axis=-1, keepdims=True))
+
+
+def perplexity_from_logits(logits: np.ndarray, tokens: np.ndarray) -> float:
+    """Next-token perplexity of (B, T, V) logits against (B, T) ids."""
+    lp = _log_softmax(np.asarray(logits, np.float64))[:, :-1]
+    tgt = np.asarray(tokens)[:, 1:].astype(int)
+    nll = -np.take_along_axis(lp, tgt[..., None], axis=-1).mean()
+    return float(np.exp(nll))
+
+
+def quality_delta(base_engine, variant_engine, tokens) -> dict:
+    """Measured accuracy cost of a quantized variant vs its base engine
+    on one token batch: perplexity both ways, relative delta, and mean
+    absolute logit error. This is the number decode_smoke banks per
+    variant — quantization in this tree is never shipped unmeasured."""
+    tokens = np.asarray(tokens, np.int32)
+    base_logits = np.asarray(base_engine.logits_full(tokens), np.float32)
+    var_logits = np.asarray(variant_engine.logits_full(tokens), np.float32)
+    ppl_base = perplexity_from_logits(base_logits, tokens)
+    ppl_var = perplexity_from_logits(var_logits, tokens)
+    return {
+        "ppl_base": round(ppl_base, 6),
+        "ppl_variant": round(ppl_var, 6),
+        "ppl_delta_pct": round(100.0 * (ppl_var - ppl_base)
+                               / max(ppl_base, 1e-12), 4),
+        "logit_mae": round(float(np.mean(np.abs(var_logits - base_logits))),
+                           6),
+    }
+
+
+def quantize_params(params: dict, mode: Optional[str]):
+    """Apply a variant mode to an extracted LM param tree.
+
+    int8 quantizes exactly the leaves the decode engine consumes through
+    qdot/qtake (attention projections, MLP matrices, the LM head, the
+    embedding table); biases, layer norms and delegated per-token layers
+    (MoE) stay float — they are bandwidth-irrelevant and some are consumed
+    by stock layer.apply which expects plain arrays. bf16 casts the whole
+    tree. None returns the tree untouched."""
+    if mode is None:
+        return params
+    if mode == "bf16":
+        return cast_tree_bf16(params)
+    if mode != "int8":
+        raise ValueError(f"unknown quantize mode {mode!r}; "
+                         f"known: {QUANT_MODES}")
+
+    def q2d(d, keys):
+        for k in keys:
+            if k in d:
+                d[k] = quantize_leaf(d[k])
+
+    out = jax.tree_util.tree_map(lambda a: a, params)   # shallow-ish copy
+    for key, sub in out.items():
+        if not isinstance(sub, dict):
+            continue
+        if "attn" in sub:                         # TransformerBlock
+            q2d(sub["attn"], ("Wq", "Wk", "Wv", "Wo"))
+            q2d(sub, ("W1", "W2"))
+        elif set(sub) == {"W"} or set(sub) == {"W", "b"}:
+            # embedding table or LM head projection
+            q2d(sub, ("W",))
+    return out

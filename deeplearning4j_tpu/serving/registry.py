@@ -62,12 +62,43 @@ def _input_type_of(model):
         "serving input shape")
 
 
+def _coerce_kwarg(v: str):
+    """Query-string value -> python: int, float, true/false, else str."""
+    low = v.lower()
+    if low in ("true", "false"):
+        return low == "true"
+    for cast in (int, float):
+        try:
+            return cast(v)
+        except ValueError:
+            continue
+    return v
+
+
+def parse_zoo_source(spec: str):
+    """``TransformerLM?n_layers=2&vocab_size=512`` -> (arch name,
+    constructor kwargs). Comma-joined values become tuples (e.g.
+    ``input_shape=48,48,3``), so loadgen/smoke can size models without a
+    checkpoint."""
+    from urllib.parse import parse_qs
+    arch, _, query = spec.partition("?")
+    kwargs = {}
+    if query:
+        for k, vs in parse_qs(query, keep_blank_values=False).items():
+            v = vs[-1]
+            kwargs[k] = tuple(_coerce_kwarg(p) for p in v.split(",")) \
+                if "," in v else _coerce_kwarg(v)
+    return arch, kwargs
+
+
 def load_servable(source, cache_dir: Optional[str] = None):
     """Resolve a servable source to an initialized model.
 
     Accepted sources:
     - live model object (MultiLayerNetwork / ComputationGraph)
-    - ``zoo:<ClassName>`` (e.g. ``zoo:LeNet``) — untrained zoo arch
+    - ``zoo:<ClassName>`` (e.g. ``zoo:LeNet``) — untrained zoo arch;
+      constructor kwargs ride a query string
+      (``zoo:TransformerLM?n_layers=2&vocab_size=512``)
     - checkpoint directory with a ResilientTrainer ``manifest.json``
       (newest SHA-256-verified entry; corrupt entries fall back)
     - ``.zip`` — save_model / CheckpointListener / dl4j-import zip
@@ -83,7 +114,14 @@ def load_servable(source, cache_dir: Optional[str] = None):
     src = str(source)
     if src.startswith("zoo:"):
         from deeplearning4j_tpu.models import zoo
-        return zoo.model_by_name(src[4:]).init()
+        arch, kwargs = parse_zoo_source(src[4:])
+        try:
+            return zoo.model_by_name(arch, **kwargs).init()
+        except KeyError as e:
+            raise ModelLoadError(str(e))
+        except TypeError as e:
+            raise ModelLoadError(
+                f"{src}: bad constructor kwargs for {arch}: {e}")
     if os.path.isdir(src):
         from deeplearning4j_tpu.train.resilience import CheckpointManager
         from deeplearning4j_tpu.util.serialization import load_model
@@ -287,8 +325,14 @@ class ModelRegistry:
             with self._lock:
                 existing = self._models.get(name)
             if existing is not None:
-                if tuple(buckets) != existing.batcher.buckets \
-                        or queue_limit != existing.batcher._queue.maxsize:
+                if hasattr(existing, "generate"):
+                    raise ModelLoadError(
+                        f"{name!r} is live as a DECODE servable; a "
+                        "predict servable cannot swap over it — undeploy "
+                        "first or pick a new name")
+                if hasattr(existing, "batcher") and (
+                        tuple(buckets) != existing.batcher.buckets
+                        or queue_limit != existing.batcher._queue.maxsize):
                     log.warning(
                         "serving[%s]: redeploy is a version swap — the "
                         "requested batcher config (buckets %s, queue %d) "
@@ -307,6 +351,46 @@ class ModelRegistry:
                 self._models[name] = served
         log.info("serving: deployed %r v1 (%s), buckets %s, input %s",
                  name, source, served.batcher.buckets, served.input_shape)
+        return served
+
+    def deploy_lm(self, name: str, source, decode=None):
+        """Load, warm, and publish a DECODE servable (serving/decode.py:
+        continuous-batching generation over a paged KV cache) under
+        `name`. `decode` is a DecodeConfig; a ``@int8`` / ``@bf16``
+        suffix on a string source selects a post-training-quantized
+        variant (serving/quantize.py). Redeploying an existing name is a
+        rolling swap — new streams admit on the new engine while
+        in-flight streams finish on the old one."""
+        from deeplearning4j_tpu.serving.decode import DecodeConfig, ServedLM
+        from deeplearning4j_tpu.serving.quantize import parse_variant
+        with self._deploy_lock:
+            with self._lock:
+                existing = self._models.get(name)
+            if existing is not None:
+                if not hasattr(existing, "generate"):
+                    raise ModelLoadError(
+                        f"{name!r} is live as a PREDICT servable; a "
+                        "decode servable cannot swap over it — undeploy "
+                        "first or pick a new name")
+                if decode is not None and decode != existing.cfg:
+                    log.warning(
+                        "serving[%s]: redeploy is a version swap — the "
+                        "requested DecodeConfig is IGNORED; the live "
+                        "engine keeps %s (undeploy first to change it)",
+                        name, existing.cfg)
+                existing.swap(source)
+                return existing
+            base, variant = parse_variant(str(source))
+            if variant is not None:
+                decode = dataclasses.replace(
+                    decode if decode is not None else DecodeConfig(),
+                    quantize=variant)
+            model = load_servable(base)
+            served = ServedLM(name, model, str(source), decode=decode)
+            with self._lock:
+                self._models[name] = served
+        log.info("serving: deployed LM %r v1 (%s), decode %s", name,
+                 source, served.describe().get("decode"))
         return served
 
     def get(self, name: str) -> Optional[ServedModel]:

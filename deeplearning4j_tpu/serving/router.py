@@ -590,6 +590,140 @@ class ResilientRouter:
                 retry_after=retry_after_seconds(1, 1, draining=True,
                                                 rng=self._rng))
 
+    # ------------------------------------------------------------ streaming
+    def route_generate(self, model: str, body: bytes,
+                       headers: Dict[str, str],
+                       timeout: Optional[float] = None):
+        """Route one token-streaming generate call. Shedding, breakers
+        and priority classes apply exactly as for predict; hedging does
+        NOT (a duplicate stream doubles decode work and the winner is
+        ambiguous mid-stream), and failover only happens BEFORE the
+        replica has answered — once bytes flow, the stream is committed.
+
+        Returns either ``("relay", code, headers, body)`` for terminal
+        outcomes the handler sends as-is, or
+        ``("stream", code, headers, resp, done_cb)`` where `resp` is the
+        replica's live chunked response to copy through and `done_cb(ok)`
+        MUST be called when the copy ends (breaker + in-flight
+        accounting)."""
+        t0 = time.perf_counter()
+        cls = self._classify(headers)
+        timeout = self.timeout_s if timeout is None else float(timeout)
+        code_box = {"code": 500}
+
+        def meter(code: int):
+            code_box["code"] = code
+            monitor.counter("serving_router_stream_requests_total",
+                            "Routed generate (token-stream) requests",
+                            labels=("model", "code", "cls")).inc(
+                model=model, code=str(code), cls=cls)
+
+        def relay(code, hdrs, payload):
+            meter(code)
+            return ("relay", code, hdrs, payload)
+
+        with monitor.span("serving/route", model=model, cls=cls, stream=1):
+            healthy = list(self._replicas_fn())
+            if not healthy:
+                monitor.counter("serving_router_no_backend_total",
+                                "Requests refused for lack of a routable "
+                                "replica (none healthy or all breakers "
+                                "open)").inc()
+                c, h, b = self._json_response(
+                    503, {"error": "no healthy replica available"},
+                    retry_after=retry_after_seconds(1, 1, draining=True,
+                                                    rng=self._rng))
+                return relay(c, h, b)
+            if self._shed_check(cls, healthy):
+                monitor.counter("serving_router_shed_total",
+                                "Requests shed by priority class",
+                                labels=("cls",)).inc(cls=cls)
+                used = sum(r.inflight() for r in healthy)
+                cap = self.per_replica_inflight * max(1, len(healthy))
+                c, h, b = self._json_response(
+                    429, {"error": f"fleet saturated; class {cls!r} is "
+                                   "being shed", "class": cls},
+                    retry_after=retry_after_seconds(used, cap,
+                                                    rng=self._rng))
+                return relay(c, h, b)
+            path = f"/v1/models/{model}/generate"
+            if headers.get("__query__"):
+                path += "?" + headers.pop("__query__")
+            remaining = [r for r in healthy
+                         if self.breaker(r, model).would_allow()]
+            backpressure = None
+            while remaining:
+                replica = self._pick(remaining)
+                remaining.remove(replica)
+                breaker = self.breaker(replica, model)
+                if not breaker.allow():
+                    continue
+                replica.inflight_add(1)
+                try:
+                    resp = urllib.request.urlopen(urllib.request.Request(
+                        replica.url + path, data=body,
+                        headers=dict(headers)), timeout=timeout)
+                except urllib.error.HTTPError as e:
+                    replica.inflight_add(-1)
+                    if e.code in (429, 503, 504):
+                        # backpressure, not brokenness; keep the LOWEST
+                        # code seen as the fallback relay — 429/503 carry
+                        # Retry-After guidance polite clients act on, a
+                        # bare 504 would read as a hard failure
+                        breaker.release()
+                        if backpressure is None or e.code < backpressure[0]:
+                            backpressure = (e.code,
+                                            list(e.headers.items()),
+                                            e.read())
+                        else:
+                            e.read()
+                        continue
+                    breaker.record_failure()
+                    monitor.counter(
+                        "serving_router_replica_errors_total",
+                        "Replica-level failures seen by the router",
+                        labels=("replica", "kind")).inc(
+                        replica=replica.name, kind=f"http_{e.code}")
+                    e.read()
+                    continue
+                except Exception as e:              # noqa: BLE001 — wire
+                    replica.inflight_add(-1)
+                    breaker.record_failure()
+                    monitor.counter(
+                        "serving_router_replica_errors_total",
+                        "Replica-level failures seen by the router",
+                        labels=("replica", "kind")).inc(
+                        replica=replica.name, kind="transport")
+                    log.warning("router: generate connect to %s failed: "
+                                "%s", replica.name, e)
+                    continue
+
+                def done(ok: bool, _r=replica, _b=breaker):
+                    _r.inflight_add(-1)
+                    if ok:
+                        _b.record_success()
+                        self._note_latency(model,
+                                           time.perf_counter() - t0)
+                    else:
+                        _b.record_failure()
+
+                keep = [(k, v) for k, v in resp.headers.items()
+                        if k.lower() in ("content-type", "retry-after",
+                                         "x-model-version")]
+                keep.append(("X-Served-By", replica.name))
+                meter(resp.status)
+                return ("stream", resp.status, keep, resp, done)
+            if backpressure is not None:
+                code, hdrs, payload = backpressure
+                keep = [(k, v) for k, v in hdrs
+                        if k.lower() in ("content-type", "retry-after")]
+                return relay(code, keep, payload)
+            c, h, b = self._json_response(
+                503, {"error": "all candidate replicas failed"},
+                retry_after=retry_after_seconds(1, 1, draining=True,
+                                                rng=self._rng))
+            return relay(c, h, b)
+
     # --------------------------------------------------------------- admin
     def fan_out(self, verb_path: str, body: Optional[bytes],
                 headers: Dict[str, str], timeout: float = 300.0) -> dict:
@@ -723,6 +857,55 @@ class _RouterHandler(BaseHTTPRequestHandler):
             code, hdrs, payload = self._rs.router.route_predict(
                 name, body, headers)
             self._reply(code, hdrs, payload)
+            return
+        if verb == "generate":
+            headers = {k: v for k, v in self.headers.items()
+                       if k.lower() in ("content-type", "accept",
+                                        "x-priority")}
+            if url.query:
+                headers["__query__"] = url.query
+            out = self._rs.router.route_generate(name, body, headers)
+            if out[0] == "relay":
+                _, code, hdrs, payload = out
+                self._reply(code, hdrs, payload)
+                return
+            _, code, hdrs, resp, done = out
+            # live token stream: re-chunk the replica's SSE bytes through
+            # as they arrive — the router adds no buffering, so TTFT and
+            # inter-token latency survive the proxy hop
+            self.send_response(code)
+            for k, v in hdrs:
+                self.send_header(k, v)
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+            ok, client_gone = True, False
+            while True:
+                try:
+                    piece = resp.read1(65536)
+                except OSError as e:        # replica died mid-stream
+                    ok = False
+                    log.warning("router: replica stream for %s broke: %s",
+                                name, e)
+                    break
+                if not piece:
+                    break
+                try:
+                    self.wfile.write(f"{len(piece):X}\r\n".encode())
+                    self.wfile.write(piece)
+                    self.wfile.write(b"\r\n")
+                    self.wfile.flush()
+                except OSError:             # client hung up — NOT the
+                    client_gone = True      # replica's fault; closing
+                    break                   # resp cancels its slot
+            if not client_gone:
+                try:
+                    self.wfile.write(b"0\r\n\r\n")
+                except OSError:
+                    client_gone = True
+            try:
+                resp.close()
+            finally:
+                done(ok)
             return
         if verb in ("swap", "rollback"):
             results = self._rs.router.fan_out(

@@ -4,6 +4,9 @@ A threaded stdlib HTTP server (no new dependencies — the same
 ThreadingHTTPServer pattern as ui/server.py) in front of a ModelRegistry:
 
     POST /v1/models/{name}/predict    JSON {"inputs": [...]} or raw .npy
+    POST /v1/models/{name}/generate   LM token generation; SSE stream
+                                      (chunked text/event-stream) or
+                                      buffered JSON (``stream: false``)
     GET  /v1/models                   all servables, versions, status
     GET  /v1/models/{name}            one servable
     POST /v1/models/{name}/swap       {"source": <path|zoo:Arch>}
@@ -184,6 +187,9 @@ class _Handler(BaseHTTPRequestHandler):
             if verb == "predict":
                 self._predict(name, url)
                 return
+            if verb == "generate":
+                self._generate(name, url)
+                return
             if verb in ("swap", "rollback"):
                 self._admin(name, verb)
                 return
@@ -285,6 +291,173 @@ class _Handler(BaseHTTPRequestHandler):
         finally:
             self._meter(name, code, t0)
 
+    # ---------------------------------------------------------- generation
+    def _sse(self, obj) -> bytes:
+        return b"data: " + json.dumps(obj).encode() + b"\n\n"
+
+    def _chunk(self, data: bytes):
+        """One HTTP/1.1 chunked-transfer frame (we stream without a
+        Content-Length, so chunking is mandatory on a keep-alive wire)."""
+        self.wfile.write(f"{len(data):X}\r\n".encode())
+        self.wfile.write(data)
+        self.wfile.write(b"\r\n")
+        self.wfile.flush()
+
+    def _generate(self, name: str, url):
+        """POST /v1/models/{name}/generate — token-level generation on a
+        decode servable (serving/decode.py). JSON body::
+
+            {"prompt": [ids...], "max_tokens": 32, "temperature": 0.0,
+             "top_k": 0, "eos_id": null, "stream": true}
+
+        stream=true (default) answers ``text/event-stream`` over chunked
+        transfer — one ``data: {"token": id, "index": i}`` event per
+        generated token as it is sampled, closed by a ``done`` event
+        with the finish reason. stream=false buffers the full generation
+        into one JSON response. Status mapping matches predict: 429
+        (join queue full, Retry-After), 503 (draining), 504 (deadline
+        before the first token), 400 (bad prompt/params)."""
+        t0 = time.perf_counter()
+        q = parse_qs(url.query)
+        served = self._srv.registry.get(name)
+        if served is None:
+            if self._srv.draining:
+                self._meter(name, 503, t0)
+                self._json({"error": "server draining"}, code=503,
+                           extra=(("Retry-After", self._srv.retry_after()),))
+                return
+            self._meter(name, 404, t0)
+            self._json({"error": f"unknown model {name!r}"}, code=404)
+            return
+        code = 500
+        self._gen_started = False
+        req = None
+        try:
+            if not hasattr(served, "generate"):
+                raise ValueError(
+                    f"model {name!r} is a predict servable; generation "
+                    "needs an LM deployed via --lm / deploy_lm")
+            payload = json.loads(self._body() or b"{}")
+            if not isinstance(payload, dict) or "prompt" not in payload:
+                raise ValueError('JSON body must be {"prompt": [ids...]}')
+            stream = bool(payload.get("stream", True))
+            try:
+                deadline = float(q["deadline_ms"][0]) / 1e3 \
+                    if "deadline_ms" in q else self._srv.default_deadline
+            except ValueError:
+                raise ValueError("deadline_ms must be a number")
+            self._srv.faults.on_predict()
+            stream_attr = 1 if stream else 0
+            with monitor.span("serving/generate", model=name,
+                              stream=stream_attr):
+                req = served.generate(
+                    payload["prompt"],
+                    max_new_tokens=int(payload.get("max_tokens", 32)),
+                    temperature=float(payload.get("temperature", 0.0)),
+                    top_k=int(payload.get("top_k", 0)),
+                    eos_id=payload.get("eos_id"),
+                    deadline=deadline)
+                code = self._relay_generation(name, req, t0, deadline,
+                                              stream)
+        except ServerOverloadedError as e:
+            code = 429
+            self._json({"error": str(e)}, code=429,
+                       extra=(("Retry-After",
+                               self._srv.retry_after(served)),))
+        except DeadlineExceededError as e:
+            code = 504
+            self._json({"error": str(e)}, code=504)
+        except ServerDrainingError as e:
+            code = 503
+            self._json({"error": str(e)}, code=503,
+                       extra=(("Retry-After",
+                               self._srv.retry_after(served)),))
+        except (ValueError, TypeError) as e:
+            code = 400
+            self._json({"error": str(e)}, code=400)
+        except (BrokenPipeError, ConnectionResetError):
+            # client went away mid-stream: free the slot, nothing to send
+            code = 499
+            if req is not None:
+                req.cancel()
+        except Exception as e:          # noqa: BLE001 — never a traceback
+            code = 500
+            log.exception("serving[%s]: generate failed", name)
+            if req is not None:
+                req.cancel()
+            if not self._gen_started:   # headers not sent: clean JSON 500
+                self._json({"error": f"{type(e).__name__}: {e}"}, code=500)
+        finally:
+            self._meter(name, code, t0)
+
+    def _relay_generation(self, name: str, req, t0: float,
+                          deadline: float, stream: bool) -> int:
+        """Pump one GenerateRequest's event queue onto the wire. Returns
+        the HTTP status metered for the request; raises the serving
+        errors the caller maps (only BEFORE the first byte is sent)."""
+        wait = max(0.05, deadline) + 5.0
+        first = self._event(req, wait)
+        # first event decides the status line: an error before any token
+        # maps to a clean non-200 exactly like predict
+        if first[0] == "error":
+            raise first[1]
+        if not stream:
+            tokens = []
+            ev = first
+            while ev[0] == "token":
+                tokens.append(ev[1])
+                ev = self._event(req, wait)
+            if ev[0] == "error":
+                raise ev[1]
+            info = ev[1]
+            self._json({
+                "model": name, "version": info.get("version"),
+                "tokens": tokens,
+                "finish_reason": info.get("finish_reason"),
+                "ttft_ms": round((req.first_token_at - req.enqueued) * 1e3,
+                                 3) if req.first_token_at else None,
+                "latency_ms": round((time.perf_counter() - t0) * 1e3, 3),
+            })
+            return 200
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-cache")
+        self.send_header("Transfer-Encoding", "chunked")
+        if req.version is not None:
+            self.send_header("X-Model-Version", str(req.version))
+        self.end_headers()
+        self._gen_started = True
+        ev, index = first, 0
+        while True:
+            if ev[0] == "token":
+                self._chunk(self._sse({"token": ev[1], "index": index}))
+                index += 1
+            elif ev[0] == "done":
+                info = dict(ev[1])
+                info["done"] = True
+                self._chunk(self._sse(info))
+                break
+            else:                               # mid-stream failure
+                self._chunk(self._sse(
+                    {"error": f"{type(ev[1]).__name__}: {ev[1]}"}))
+                break
+            ev = self._event(req, wait)
+        self.wfile.write(b"0\r\n\r\n")
+        self.wfile.flush()
+        return 200
+
+    def _event(self, req, wait: float):
+        """Next scheduler event, or a synthesized deadline error if the
+        stream stalls past its budget."""
+        import queue as _queue
+        try:
+            return req.events.get(timeout=wait)
+        except _queue.Empty:
+            req.cancel()
+            return ("error", DeadlineExceededError(
+                "generation produced no event within "
+                f"{wait:.1f}s"))
+
     def _admin(self, name: str, verb: str):
         t0 = time.perf_counter()
         served = self._srv.registry.get(name)
@@ -373,22 +546,35 @@ class ModelServer:
     def ready(self) -> bool:
         return not self.draining and self.registry.all_ready()
 
+    @staticmethod
+    def _queue_state(served):
+        """(depth, limit) of a servable's admission queue — predict
+        servables expose the batcher queue, decode servables the join
+        queue (ServedLM.queue_state)."""
+        batcher = getattr(served, "batcher", None)
+        if batcher is not None:
+            return batcher._queue.qsize(), batcher._queue.maxsize or 1
+        state = getattr(served, "queue_state", None)
+        if state is not None:
+            depth, limit = state()
+            return depth, limit or 1
+        return 0, 1
+
     def retry_after(self, served=None) -> str:
         """Derived, jittered Retry-After header value for 429/503
-        responses (see retry_after_seconds). Uses the deepest batcher
+        responses (see retry_after_seconds). Uses the deepest admission
         queue when no specific servable is implicated."""
         depth, limit = 0, 1
         if served is not None:
-            depth = served.batcher._queue.qsize()
-            limit = served.batcher._queue.maxsize or 1
+            depth, limit = self._queue_state(served)
         else:
             for name in self.registry.names():
                 m = self.registry.get(name)
                 if m is None:
                     continue
-                q = m.batcher._queue
-                if q.maxsize and q.qsize() / q.maxsize >= depth / limit:
-                    depth, limit = q.qsize(), q.maxsize
+                d, lim = self._queue_state(m)
+                if lim and d / lim >= depth / limit:
+                    depth, limit = d, lim
         return str(retry_after_seconds(depth, limit,
                                        draining=self.draining,
                                        rng=self._retry_rng))

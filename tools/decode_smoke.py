@@ -1,0 +1,211 @@
+#!/usr/bin/env python
+"""Decode smoke: the acceptance gate for token-level continuous batching.
+
+    JAX_PLATFORMS=cpu python tools/decode_smoke.py [--out DECODE_r11.json]
+
+In one process (CI-friendly, CPU, no network egress):
+
+1. deploys a `zoo:TransformerLM?...`-sized decode servable (plus int8 and
+   bf16 post-training-quantized variants) behind a ModelServer — the zoo
+   kwargs source means no checkpoint is needed to size the model;
+2. drives N concurrent closed-loop token STREAMS through the generate
+   surface (tools/serve_loadgen.py --mode decode as a library) and, MID
+   TRAFFIC, hot-swaps the servable to a differently-seeded model —
+   asserts ZERO 5xx across every stream and that post-swap streams
+   answer from the new version while pre-swap streams finish cleanly on
+   the old one (the rolling-swap contract);
+3. scrapes /metrics and asserts the decode compile ledger balances:
+   ``serving_decode_compiles_total`` summed == ``serving_decode_warmup_
+   runs_total`` summed — every prefill bucket and the decode step
+   compiled during warmup, never on the request path — and that
+   ``serving_decode_preempted_joins_total`` > 0 (streams actually joined
+   a running batch: continuous batching happened, it wasn't sequential);
+4. measures the quantized variants against the base engine on a shared
+   token set (`quantize.quality_delta`): next-token perplexity delta and
+   mean absolute logit error per variant;
+5. banks a bench-style ``sweep`` with the decode throughput/latency row
+   (``decode_tokens_sec``, ``decode_ttft_p99_ms``, ``decode_itl_p99_ms``)
+   and one quality row per variant, as DECODE_r*.json for
+   tools/perf_report.py to gate.
+
+Exit 0 on success, 1 on failure; prints the JSON summary either way.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+
+
+def _metric_sum(metrics_text: str, family: str) -> float:
+    total = 0.0
+    for line in metrics_text.splitlines():
+        if line.startswith(family + "{") or line.startswith(family + " "):
+            try:
+                total += float(line.rsplit(" ", 1)[1])
+            except ValueError:
+                pass
+    return total
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--streams", type=int, default=4,
+                   help="concurrent closed-loop token streams")
+    p.add_argument("--requests", type=int, default=24,
+                   help="logical streams per traffic phase (2 phases)")
+    p.add_argument("--prompt-len", type=int, default=12)
+    p.add_argument("--max-new-tokens", type=int, default=24)
+    p.add_argument("--vocab", type=int, default=512)
+    p.add_argument("--n-layers", type=int, default=2)
+    p.add_argument("--n-embd", type=int, default=128)
+    p.add_argument("--n-heads", type=int, default=4)
+    p.add_argument("--seq-length", type=int, default=128)
+    p.add_argument("--slots", type=int, default=4)
+    p.add_argument("--page-size", type=int, default=16)
+    p.add_argument("--out", default=None, metavar="PATH",
+                   help="bank the summary JSON here (e.g. "
+                        "DECODE_r11.json at the repo root)")
+    args = p.parse_args(argv)
+
+    import numpy as np
+
+    from deeplearning4j_tpu.serving import ModelRegistry, ModelServer
+    from deeplearning4j_tpu.serving.decode import DecodeConfig
+    from deeplearning4j_tpu.serving.quantize import quality_delta
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from serve_loadgen import LoadGen, parse_priority_mix
+
+    failures = []
+    summary = {}
+    arch = (f"zoo:TransformerLM?vocab_size={args.vocab}"
+            f"&n_layers={args.n_layers}&n_embd={args.n_embd}"
+            f"&n_heads={args.n_heads}&seq_length={args.seq_length}")
+    cfg = DecodeConfig(slots=args.slots, page_size=args.page_size)
+
+    registry = ModelRegistry()
+    t0 = time.perf_counter()
+    registry.deploy_lm("lm", arch, decode=cfg)
+    registry.deploy_lm("lm_int8", arch + "@int8", decode=cfg)
+    registry.deploy_lm("lm_bf16", arch + "@bf16", decode=cfg)
+    summary["warmup_s"] = round(time.perf_counter() - t0, 2)
+    server = ModelServer(registry, port=0, default_deadline_s=120.0)
+
+    # ------------------------------------------------- quantized variants
+    # measured BEFORE the swap phase: the variants were built from the
+    # same weights the base currently serves — after the mid-traffic swap
+    # the base answers from a different seed and the delta means nothing
+    rs = np.random.RandomState(7)
+    qa_tokens = rs.randint(0, args.vocab, (4, min(64, args.seq_length)))
+    base_eng = registry.get("lm").scheduler.admitting_engine()
+    quality = {}
+    for variant in ("int8", "bf16"):
+        eng = registry.get(f"lm_{variant}").scheduler.admitting_engine()
+        quality[variant] = quality_delta(base_eng, eng, qa_tokens)
+        if not np.isfinite(quality[variant]["ppl_variant"]):
+            failures.append(f"{variant}: non-finite perplexity")
+    # the head-to-head row: what does int8 cost RELATIVE to the bf16
+    # variant an operator would otherwise deploy
+    quality["int8_vs_bf16"] = quality_delta(
+        registry.get("lm_bf16").scheduler.admitting_engine(),
+        registry.get("lm_int8").scheduler.admitting_engine(), qa_tokens)
+    summary["quant_quality"] = quality
+
+    # ------------------------------------------------------ traffic + swap
+    gen_args = argparse.Namespace(
+        url=server.url, model="lm", mode="decode",
+        prompt_len=args.prompt_len, max_new_tokens=args.max_new_tokens,
+        temperature=0.0, top_k=0, vocab=args.vocab,
+        requests=args.requests, concurrency=args.streams, rate=None,
+        batch_sizes=[1], max_retries=4, retry_cap_s=2.0,
+        deadline_ms=None, timeout_s=120.0, seed=0,
+        priority_mix=parse_priority_mix("interactive=2,batch=1"))
+    gen = LoadGen(gen_args, ())
+
+    swap_state = {}
+
+    def swapper():
+        # wait for traffic to be genuinely mid-flight, then hot-swap
+        time.sleep(0.5)
+        body = json.dumps({"source": arch + "&seed=777"}).encode()
+        t = time.perf_counter()
+        r = urllib.request.urlopen(urllib.request.Request(
+            server.url + "/v1/models/lm/swap", data=body,
+            headers={"Content-Type": "application/json"}), timeout=300)
+        swap_state["code"] = r.status
+        swap_state["swap_s"] = round(time.perf_counter() - t, 2)
+        swap_state["body"] = json.loads(r.read())
+
+    swap_thread = threading.Thread(target=swapper, daemon=True)
+    swap_thread.start()
+    wall1, ok1 = gen.run_closed()
+    swap_thread.join(timeout=300)
+    if swap_state.get("code") != 200:
+        failures.append(f"mid-traffic swap failed: {swap_state}")
+    # phase 2: post-swap traffic (proves the new engine admits cleanly)
+    wall2, ok2 = gen.run_closed()
+    report = gen.report(wall1 + wall2, ok1 + ok2)
+    summary["loadgen"] = report
+    summary["swap"] = swap_state
+
+    five_xx = sum(v for k, v in report["codes"].items()
+                  if k.isdigit() and 500 <= int(k) < 600)
+    if five_xx:
+        failures.append(f"{five_xx} 5xx responses under decode traffic")
+    if report["errors"]:
+        failures.append(f"{report['errors']} streams failed "
+                        f"({report['error_classes']})")
+
+    # ----------------------------------------------- compile-ledger proof
+    metrics = urllib.request.urlopen(server.url + "/metrics",
+                                     timeout=10).read().decode()
+    compiles = _metric_sum(metrics, "serving_decode_compiles_total")
+    warmups = _metric_sum(metrics, "serving_decode_warmup_runs_total")
+    summary["ledger"] = {"compiles": compiles, "warmups": warmups}
+    if compiles != warmups or compiles <= 0:
+        failures.append(f"compile ledger imbalance: {compiles} compiles "
+                        f"vs {warmups} warmups (a stream paid for XLA)")
+    joins = _metric_sum(metrics, "serving_decode_preempted_joins_total")
+    summary["preempted_joins"] = joins
+    if joins <= 0:
+        failures.append("no preempted joins recorded — streams never "
+                        "joined a running batch (continuous batching "
+                        "did not engage)")
+
+    server.drain(timeout=30)
+
+    dec = report.get("decode", {})
+    summary["ok"] = not failures
+    summary["failures"] = failures
+    # bench-style rows: the decode throughput/latency series plus one
+    # quality row per quantized variant, gated by tools/perf_report.py
+    summary["sweep"] = [{
+        "mode": "decode", "on_tpu": False, "batch": args.streams,
+        "decode_tokens_sec": dec.get("decode_tokens_sec"),
+        "decode_ttft_p99_ms": (dec.get("ttft_ms") or {}).get("p99"),
+        "decode_itl_p99_ms": (dec.get("inter_token_ms") or {}).get("p99"),
+        "streams": args.requests * 2,
+        "zero_5xx": five_xx == 0,
+        "compiles": compiles, "warmups": warmups,
+    }] + [{
+        "mode": f"decode_quant_{variant}", "on_tpu": False, "batch": None,
+        **quality[variant],
+    } for variant in sorted(quality)]
+    print(json.dumps(summary, indent=1))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(summary, f, indent=1)
+    return 0 if not failures else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

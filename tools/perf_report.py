@@ -40,17 +40,25 @@ import sys
 #: mesh_imgs_sec is the GSPMD-plan scaling sweep (`bench.py --mode
 #: mesh`, banked as MULTICHIP_r*.json): one row per plan config
 #: (mesh-single / mesh-dp / mesh-dp_tp / mesh-zero1 / mesh-zero3).
+#: decode_tokens_sec is the continuous-batching generate surface
+#: (`tools/decode_smoke.py`, banked as DECODE_r*.json): generated tokens
+#: per wall second across concurrent streams through a mid-traffic swap.
 THROUGHPUT_KEYS = ("imgs_sec", "lenet_imgs_sec", "chars_sec", "pairs_sec",
                    "h2d_f32_mbytes_sec", "h2d_u8_mbytes_sec",
                    "fit_e2e_imgs_sec",
                    "fit_e2e_chars_sec", "fit_e2e_pairs_sec",
-                   "chaos_goodput_under_fault_rps", "mesh_imgs_sec")
+                   "chaos_goodput_under_fault_rps", "mesh_imgs_sec",
+                   "decode_tokens_sec")
 
 #: lower-is-better series (latencies). Banked by tools/serve_chaos.py
 #: (CHAOS_r*.json): p99 while a replica is killed + another wedged, and
-#: post-fault recovered p99. Gated inverted: baseline = best (lowest)
-#: earlier round, regression = latest above baseline by > threshold.
-LATENCY_KEYS = ("chaos_p99_under_fault_ms", "chaos_recovered_p99_ms")
+#: post-fault recovered p99. decode_* are the streaming-generation tail
+#: latencies from tools/decode_smoke.py (DECODE_r*.json): time-to-first-
+#: token p99 and inter-token p99. Gated inverted: baseline = best
+#: (lowest) earlier round, regression = latest above baseline by >
+#: threshold.
+LATENCY_KEYS = ("chaos_p99_under_fault_ms", "chaos_recovered_p99_ms",
+                "decode_ttft_p99_ms", "decode_itl_p99_ms")
 
 
 def _round_of(name: str) -> int:
@@ -72,7 +80,10 @@ def load_rounds(directory: str):
              # GSPMD-plan scaling sweeps; pre-r06 MULTICHIP artifacts
              # are driver dryrun stamps without a sweep and skip below
              + sorted(glob.glob(os.path.join(directory,
-                                             "MULTICHIP_r*.json"))))
+                                             "MULTICHIP_r*.json")))
+             # continuous-batching decode smokes (tokens/sec, TTFT, ITL)
+             + sorted(glob.glob(os.path.join(directory,
+                                             "DECODE_r*.json"))))
     for path in names:
         try:
             with open(path) as f:

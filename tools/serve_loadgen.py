@@ -17,6 +17,14 @@ with ``X-Priority`` headers in a deterministic weighted cycle and reports
 latency percentiles and an error breakdown *per class* — the view that
 shows shedding hitting the batch tier while interactive p99 holds.
 
+Decode mode: ``--mode decode`` drives the token-streaming generate
+surface instead (docs/SERVING.md "LLM decode"): each logical request is
+one SSE stream, consumed token-by-token, and the report adds TTFT
+p50/p99, inter-token p99, and tokens/sec goodput — overall and per
+priority class. The closed loop honors Retry-After on shed (429/503)
+streams exactly as for predicts; a stream truncated before its ``done``
+event counts as a transport failure, never as success.
+
     python tools/serve_loadgen.py --url http://127.0.0.1:8500 \
         --model lenet --requests 500 --concurrency 8 [--rate 200] \
         [--priority-mix interactive=3,batch=1]
@@ -78,8 +86,10 @@ def _latency_stats(lat_s):
 class LoadGen:
     def __init__(self, args, input_shape):
         self.args = args
-        self.input_shape = tuple(input_shape)
-        self.url = (f"{args.url}/v1/models/{args.model}/predict"
+        self.mode = getattr(args, "mode", "predict")
+        self.input_shape = tuple(input_shape or ())
+        verb = "generate" if self.mode == "decode" else "predict"
+        self.url = (f"{args.url}/v1/models/{args.model}/{verb}"
                     + (f"?deadline_ms={args.deadline_ms}"
                        if args.deadline_ms else ""))
         self.lock = threading.Lock()
@@ -90,11 +100,32 @@ class LoadGen:
         self.retry_wait_s = 0.0
         self.issued = 0        # logical requests, across every run_* call
         self.rs = np.random.RandomState(args.seed)
-        self.bodies = [
-            json.dumps({"inputs": self.rs.rand(
-                b, *self.input_shape).astype("float32").tolist()}).encode()
-            for b in (args.batch_sizes or [1])
-        ]
+        if self.mode == "decode":
+            self.vocab = int(getattr(args, "vocab", None) or 0)
+            if self.vocab < 2:
+                raise SystemExit("--mode decode needs --vocab (or a "
+                                 "servable describing vocab_size)")
+            self.bodies = [
+                json.dumps({
+                    "prompt": self.rs.randint(
+                        0, self.vocab, args.prompt_len).tolist(),
+                    "max_tokens": args.max_new_tokens,
+                    "temperature": args.temperature,
+                    "top_k": args.top_k,
+                    "stream": True,
+                }).encode()
+                for _ in range(16)      # a cycle of distinct prompts
+            ]
+            self.ttfts = {}             # class -> [seconds]
+            self.itls = {}              # class -> [seconds] between tokens
+            self.tokens = 0
+        else:
+            self.bodies = [
+                json.dumps({"inputs": self.rs.rand(
+                    b, *self.input_shape).astype(
+                    "float32").tolist()}).encode()
+                for b in (args.batch_sizes or [1])
+            ]
         # deterministic weighted cycle of priority classes (None = no
         # header) so runs are reproducible request-for-request
         mix = args.priority_mix or {}
@@ -128,7 +159,50 @@ class LoadGen:
             code = 0
         return code, time.perf_counter() - t0, retry_after
 
-    def _record(self, i: int, code, dt: float):
+    def _send_decode(self, i: int):
+        """One token-stream attempt: consume the SSE response as tokens
+        arrive, measuring TTFT and every inter-token gap. A stream that
+        never reaches its ``done`` event counts as a transport failure —
+        truncated generations must not read as success."""
+        body = self.bodies[i % len(self.bodies)]
+        headers = {"Content-Type": "application/json"}
+        cls = self._class_of(i)
+        if cls is not None:
+            headers["X-Priority"] = cls
+        t0 = time.perf_counter()
+        retry_after = None
+        ttft, itls, ntok, last, done = None, [], 0, None, False
+        try:
+            r = urllib.request.urlopen(urllib.request.Request(
+                self.url, data=body, headers=headers),
+                timeout=self.args.timeout_s)
+            for line in r:
+                if not line.startswith(b"data: "):
+                    continue
+                ev = json.loads(line[6:])
+                now = time.perf_counter()
+                if "token" in ev:
+                    ntok += 1
+                    if ttft is None:
+                        ttft = now - t0
+                    else:
+                        itls.append(now - last)
+                    last = now
+                elif ev.get("done"):
+                    done = True
+                elif "error" in ev:
+                    break
+            code = r.status if done else 0
+        except urllib.error.HTTPError as e:
+            code = e.code
+            retry_after = e.headers.get("Retry-After")
+            e.read()
+        except Exception:               # connection refused/reset, timeout
+            code = 0
+        return code, time.perf_counter() - t0, retry_after, ttft, itls, ntok
+
+    def _record(self, i: int, code, dt: float, ttft=None, itls=(),
+                ntok: int = 0):
         cls = self._class_of(i) or "default"
         kind = classify(code if code != 0 else "transport")
         with self.lock:
@@ -139,6 +213,23 @@ class LoadGen:
                 self.class_codes[cls].get(kind, 0) + 1
             if isinstance(code, int) and 200 <= code < 300:
                 self.latencies.setdefault(cls, []).append(dt)
+                if self.mode == "decode":
+                    self.tokens += ntok
+                    if ttft is not None:
+                        self.ttfts.setdefault(cls, []).append(ttft)
+                    if itls:
+                        self.itls.setdefault(cls, []).extend(itls)
+
+    def _attempt(self, i: int):
+        """One wire attempt in the configured workload; returns
+        (code, retry_after)."""
+        if self.mode == "decode":
+            code, dt, retry_after, ttft, itls, ntok = self._send_decode(i)
+            self._record(i, code, dt, ttft=ttft, itls=itls, ntok=ntok)
+        else:
+            code, dt, retry_after = self._send(i)
+            self._record(i, code, dt)
+        return code, retry_after
 
     def one_closed(self, i: int) -> bool:
         """One logical request, honoring Retry-After backpressure. Every
@@ -148,8 +239,7 @@ class LoadGen:
             self.issued += 1
         attempts = 0
         while True:
-            code, dt, retry_after = self._send(i)
-            self._record(i, code, dt)
+            code, retry_after = self._attempt(i)
             if isinstance(code, int) and 200 <= code < 300:
                 return True
             if code not in (429, 503) or attempts >= self.args.max_retries:
@@ -168,8 +258,7 @@ class LoadGen:
     def one_open(self, i: int) -> bool:
         with self.lock:
             self.issued += 1
-        code, dt, _ = self._send(i)
-        self._record(i, code, dt)
+        code, _ = self._attempt(i)
         return isinstance(code, int) and 200 <= code < 300
 
     def run_closed(self):
@@ -228,6 +317,7 @@ class LoadGen:
                 taxonomy[kind] = taxonomy.get(kind, 0) + cnt
         rep = {
             "mode": "open" if self.args.rate else "closed",
+            "workload": self.mode,
             # issued, not args.requests: callers (serve_chaos) accumulate
             # several run_closed() passes into one LoadGen/report
             "requests": self.issued,
@@ -242,12 +332,31 @@ class LoadGen:
             "goodput_rps": round(ok / wall, 2) if wall > 0 else None,
             "latency_ms": _latency_stats(all_lat),
         }
+        if self.mode == "decode":
+            all_ttft = [v for xs in self.ttfts.values() for v in xs]
+            all_itl = [v for xs in self.itls.values() for v in xs]
+            rep["decode"] = {
+                "streams_ok": ok,
+                "tokens": self.tokens,
+                # goodput in the unit decode is bought for: generated
+                # tokens per wall second across all concurrent streams
+                "decode_tokens_sec": round(self.tokens / wall, 2)
+                if wall > 0 else None,
+                "ttft_ms": _latency_stats(all_ttft),
+                "inter_token_ms": _latency_stats(all_itl),
+            }
         if len(self.class_cycle) > 1 or self.class_cycle[0] is not None:
             rep["per_class"] = {
                 cls: {"latency_ms": _latency_stats(
                           self.latencies.get(cls, [])),
                       "outcomes": dict(sorted(counts.items()))}
                 for cls, counts in sorted(self.class_codes.items())}
+            if self.mode == "decode":
+                for cls, sub in rep["per_class"].items():
+                    sub["ttft_ms"] = _latency_stats(
+                        self.ttfts.get(cls, []))
+                    sub["inter_token_ms"] = _latency_stats(
+                        self.itls.get(cls, []))
         return rep
 
 
@@ -275,6 +384,20 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     p.add_argument("--url", default="http://127.0.0.1:8500")
     p.add_argument("--model", default="model")
+    p.add_argument("--mode", choices=("predict", "decode"),
+                   default="predict",
+                   help="predict = HTTP predicts; decode = streaming "
+                        "token generation (SSE) with TTFT / inter-token "
+                        "/ tokens-per-second stats")
+    p.add_argument("--prompt-len", type=int, default=16,
+                   help="decode mode: random-prompt token count")
+    p.add_argument("--max-new-tokens", type=int, default=32,
+                   help="decode mode: tokens requested per stream")
+    p.add_argument("--temperature", type=float, default=0.0)
+    p.add_argument("--top-k", type=int, default=0)
+    p.add_argument("--vocab", type=int, default=None,
+                   help="decode mode: prompt id range; default asks "
+                        "GET /v1/models/{name} for vocab_size")
     p.add_argument("--requests", type=int, default=200)
     p.add_argument("--concurrency", type=int, default=8,
                    help="closed-loop worker threads")
@@ -299,7 +422,13 @@ def main(argv=None) -> int:
     args.batch_sizes = [int(b) for b in str(args.batch_sizes).split(",") if b]
     args.priority_mix = parse_priority_mix(args.priority_mix)
 
-    if args.input_shape:
+    shape = ()
+    if args.mode == "decode":
+        if args.vocab is None:
+            meta = json.loads(urllib.request.urlopen(
+                f"{args.url}/v1/models/{args.model}", timeout=10).read())
+            args.vocab = meta.get("vocab_size")
+    elif args.input_shape:
         shape = tuple(int(s) for s in args.input_shape.split(",") if s)
     else:
         meta = json.loads(urllib.request.urlopen(

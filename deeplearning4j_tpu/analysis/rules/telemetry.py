@@ -33,6 +33,12 @@ from deeplearning4j_tpu.analysis.rules._jax import (
 
 _METRIC_FNS = {"counter", "gauge", "histogram"}
 _SPAN_FNS = {"span", "add_span", "instant"}
+#: flight-recorder record calls (monitor/flight.py): same
+#: zero-cost-when-disabled contract, same compiled-region ban — a
+#: flight.note() traced into an XLA program records once at trace time.
+#: Generic base names, so these additionally require "flight" in the
+#: resolved dotted name (a random obj.note() must not match).
+_FLIGHT_FNS = {"begin", "note", "finish", "trip", "record"}
 #: calls allowed in span attrs without a tracing_enabled() guard: O(1),
 #: never a device sync (str/repr of host objects included — error paths
 #: stringify their exception)
@@ -48,6 +54,8 @@ def _monitor_call(mod: ModuleInfo, call: ast.Call, kinds) -> Optional[str]:
     base = name.split(".")[-1]
     if base not in kinds:
         return None
+    if base in _FLIGHT_FNS and base not in (_METRIC_FNS | _SPAN_FNS):
+        return base if "flight" in name else None
     if "monitor" in name or "metrics" in name or "trace" in name:
         return base
     return None
@@ -74,8 +82,9 @@ class TelemetryZeroCostRule(Rule):
         for fn, why in regions.items():
             for node in walk_region(fn):
                 if isinstance(node, ast.Call):
-                    kind = _monitor_call(mod, node,
-                                         _METRIC_FNS | _SPAN_FNS)
+                    kind = _monitor_call(
+                        mod, node,
+                        _METRIC_FNS | _SPAN_FNS | _FLIGHT_FNS)
                     if kind:
                         in_region.add(id(node))
                         yield self.finding(

@@ -31,6 +31,7 @@ Environment knobs of the default data plane — the one reference list
 """
 from __future__ import annotations
 
+import itertools
 import queue
 import threading
 import time
@@ -45,6 +46,9 @@ from deeplearning4j_tpu.data.dataset import DataSet
 from deeplearning4j_tpu.data.iterator import DataSetIterator
 
 _SENTINEL = object()
+#: monotonically numbered prefetch workers: the trace viewer needs a
+#: STABLE per-worker track name, not Python's default "Thread-N"
+_prefetch_seq = itertools.count()
 
 
 def prefetch_depth(default: int = 2) -> int:
@@ -149,7 +153,7 @@ def _prefetch_pump(source, transform, queue_size: int):
         put(_SENTINEL)
 
     t = threading.Thread(target=worker, daemon=True,
-                         name="etl-prefetch")
+                         name=f"etl-prefetch-{next(_prefetch_seq)}")
     t.start()
     try:
         while True:

@@ -40,18 +40,25 @@ from deeplearning4j_tpu.monitor.metrics import (
     counter, dump, gauge, histogram, prometheus_text, summary,
 )
 from deeplearning4j_tpu.monitor.trace import (
-    add_span, clear_trace, disable_tracing, enable_tracing, instant,
-    save_trace, span, trace_events, tracing_enabled,
+    TRACEPARENT_HEADER, TraceContext, add_span, bind_context, clear_trace,
+    current_context, disable_tracing, enable_tracing, instant,
+    mint_context, parse_traceparent, save_trace, span, trace_events,
+    tracing_enabled,
 )
 # the compiled-program ledger (xla_* families, MFU gauges, perf ledger
 # JSON) — namespaced as monitor.xla; see docs/OBSERVABILITY.md
 from deeplearning4j_tpu.monitor import xla  # noqa: E402,F401
+# the per-request flight recorder + SLO postmortems — namespaced as
+# monitor.flight; see docs/OBSERVABILITY.md "Tracing a single request"
+from deeplearning4j_tpu.monitor import flight  # noqa: E402,F401
 
 __all__ = [
     "DEFAULT_BUCKETS", "REGISTRY", "Counter", "Gauge", "Histogram",
     "MetricsRegistry", "counter", "dump", "gauge", "histogram",
     "prometheus_text", "summary",
-    "add_span", "clear_trace", "disable_tracing", "enable_tracing",
-    "instant", "save_trace", "span", "trace_events", "tracing_enabled",
-    "xla",
+    "TRACEPARENT_HEADER", "TraceContext", "add_span", "bind_context",
+    "clear_trace", "current_context", "disable_tracing", "enable_tracing",
+    "instant", "mint_context", "parse_traceparent", "save_trace", "span",
+    "trace_events", "tracing_enabled",
+    "xla", "flight",
 ]

@@ -104,6 +104,13 @@ class _Family:
     def _render_child(self, lines, key, child):
         raise NotImplementedError
 
+    def _dump_series_all(self) -> List[dict]:
+        """Every child's dump-series dict (the per-family slice of
+        MetricsRegistry.dump())."""
+        with self._lock:
+            return [self._dump_series(k, c)
+                    for k, c in sorted(self._children.items())]
+
 
 class Counter(_Family):
     """Monotonically increasing value (events, bytes, steps)."""
@@ -160,12 +167,18 @@ class Gauge(_Family):
 
 
 class _HistChild:
-    __slots__ = ("counts", "sum", "count")
+    __slots__ = ("counts", "sum", "count", "exemplars")
 
     def __init__(self, n_buckets: int):
         self.counts = [0] * n_buckets      # per-bucket (non-cumulative)
         self.sum = 0.0
         self.count = 0
+        #: bucket index -> (value, trace_id): the LAST exemplar observed
+        #: per bucket, so a p99 bucket links to a concrete request trace
+        #: (docs/OBSERVABILITY.md "Tracing a single request"). None until
+        #: an observation actually carries an exemplar — the plain
+        #: observe() path allocates nothing.
+        self.exemplars = None
 
 
 class Histogram(_Family):
@@ -191,7 +204,12 @@ class Histogram(_Family):
     def _new_child(self):
         return _HistChild(len(self.buckets) + 1)
 
-    def observe(self, value: float, **labels):
+    def observe(self, value: float, exemplar: Optional[str] = None,
+                **labels):
+        """Record one observation. `exemplar` (a trace_id) is stored as
+        the landing bucket's last exemplar — never rendered into the
+        v0.0.4 text exposition (classic scrapers would choke); read it
+        via dump() / exemplars()."""
         value = float(value)
         i = 0
         for b in self.buckets:          # tiny fixed list: linear is fine
@@ -203,6 +221,20 @@ class Histogram(_Family):
             c.counts[i] += 1
             c.sum += value
             c.count += 1
+            if exemplar is not None:
+                if c.exemplars is None:
+                    c.exemplars = {}
+                c.exemplars[i] = (value, str(exemplar))
+
+    def exemplars(self, **labels) -> dict:
+        """`le` bound -> {"value", "trace_id"} for every bucket that has
+        seen an exemplar-carrying observation."""
+        with self._lock:
+            c = self._child(labels)
+            ex = dict(c.exemplars) if c.exemplars else {}
+        bounds = tuple(_fmt(b) for b in self.buckets) + ("+Inf",)
+        return {bounds[i]: {"value": v, "trace_id": t}
+                for i, (v, t) in sorted(ex.items())}
 
     def snapshot(self, **labels) -> dict:
         """Cumulative bucket counts keyed by `le` string, plus sum/count."""
@@ -239,9 +271,15 @@ class Histogram(_Family):
             cum += cnt
             buckets[_fmt(b)] = cum
         buckets["+Inf"] = cum + child.counts[-1]
-        return {"labels": dict(zip(self.label_names, key)),
-                "buckets": buckets, "sum": float(child.sum),
-                "count": int(child.count)}
+        out = {"labels": dict(zip(self.label_names, key)),
+               "buckets": buckets, "sum": float(child.sum),
+               "count": int(child.count)}
+        if child.exemplars:
+            bounds = tuple(_fmt(b) for b in self.buckets) + ("+Inf",)
+            out["exemplars"] = {
+                bounds[i]: {"value": v, "trace_id": t}
+                for i, (v, t) in sorted(child.exemplars.items())}
+        return out
 
 
 class MetricsRegistry:

@@ -19,6 +19,19 @@ Optionally (`enable_tracing(jax_annotations=True)`) each span also
 enters a `jax.profiler.TraceAnnotation`, so the same names show up
 inside an XLA device profile captured with `jax.profiler.trace` /
 ProfilerListener.
+
+**Cross-process trace context** (docs/OBSERVABILITY.md "Tracing a
+single request"): a `TraceContext` is a W3C-``traceparent``-shaped
+(trace_id, span_id, parent_id) triple. The serving ingress mints one per
+request (or adopts the caller's ``traceparent`` header), forwards it on
+every hop as an HTTP header, and binds it to the handling thread with
+`bind_context` — every span recorded while a context is bound carries
+its ``trace_id`` in the event args, so one id stitches router, replica,
+batcher and decode-scheduler spans across processes
+(`tools/trace_report.py` merges the per-process files). Context
+binding follows the same zero-cost contract as `span()`: while tracing
+(and the flight recorder) are disabled no context exists, nothing is
+allocated, and `bind_context(None)` is a no-op.
 """
 from __future__ import annotations
 
@@ -34,6 +47,96 @@ _thread_names: dict = {}
 _enabled = False
 _jax_annotations = False
 _MAX_EVENTS = 1_000_000          # runaway-loop backstop (~hundreds of MB)
+
+#: the header every serving hop forwards (W3C trace-context shape)
+TRACEPARENT_HEADER = "traceparent"
+
+_tls = threading.local()         # .ctx: the thread's current TraceContext
+
+
+class TraceContext:
+    """One request's identity across processes: ``trace_id`` names the
+    whole request, ``span_id`` this process segment, ``parent_id`` the
+    segment that forwarded it (None at the origin)."""
+
+    __slots__ = ("trace_id", "span_id", "parent_id")
+
+    def __init__(self, trace_id: str, span_id: str,
+                 parent_id: Optional[str] = None):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+
+    def child(self) -> "TraceContext":
+        """Same trace, fresh segment id, parented to this one — what a
+        hop binds locally after adopting an incoming header."""
+        return TraceContext(self.trace_id, os.urandom(8).hex(),
+                            self.span_id)
+
+    def header(self) -> str:
+        """``traceparent`` wire form: 00-<trace_id>-<span_id>-01."""
+        return f"00-{self.trace_id}-{self.span_id}-01"
+
+    def __repr__(self):
+        return (f"TraceContext({self.trace_id!r}, {self.span_id!r}, "
+                f"parent={self.parent_id!r})")
+
+
+def mint_context() -> TraceContext:
+    """A fresh root context (new trace_id) — the ingress of a request
+    that arrived without a ``traceparent`` header."""
+    return TraceContext(os.urandom(16).hex(), os.urandom(8).hex())
+
+
+_HEX = frozenset("0123456789abcdef")
+
+
+def parse_traceparent(value: Optional[str]) -> Optional[TraceContext]:
+    """``00-<32 hex>-<16 hex>-<flags>`` -> TraceContext, or None for
+    anything malformed / absent / all-zero (per the W3C rules a zero id
+    is invalid — treat it as no context and mint fresh). Strict hex
+    check: ``int(x, 16)`` would accept underscores/signs/whitespace and
+    re-emit an invalid header downstream."""
+    if not value:
+        return None
+    parts = value.strip().split("-")
+    if len(parts) < 4:
+        return None
+    tid, sid = parts[1].lower(), parts[2].lower()
+    if len(tid) != 32 or len(sid) != 16:
+        return None
+    if not (set(tid) <= _HEX and set(sid) <= _HEX):
+        return None
+    if tid == "0" * 32 or sid == "0" * 16:
+        return None
+    return TraceContext(tid, sid)
+
+
+def current_context() -> Optional[TraceContext]:
+    """The context bound to this thread, or None."""
+    return getattr(_tls, "ctx", None)
+
+
+class bind_context:
+    """Install `ctx` as the thread's current trace context for the
+    extent of the ``with`` block (restores the previous one on exit).
+    ``bind_context(None)`` is a no-op passthrough, so call sites never
+    branch on whether a request carries a context."""
+
+    __slots__ = ("ctx", "_prev")
+
+    def __init__(self, ctx: Optional[TraceContext]):
+        self.ctx = ctx
+
+    def __enter__(self):
+        self._prev = getattr(_tls, "ctx", None)
+        if self.ctx is not None:
+            _tls.ctx = self.ctx
+        return self.ctx
+
+    def __exit__(self, *exc):
+        _tls.ctx = self._prev
+        return False
 
 
 def _now_us() -> float:
@@ -56,12 +159,13 @@ _NULL = _NullSpan()
 
 
 class _Span:
-    __slots__ = ("name", "args", "t0", "_ann")
+    __slots__ = ("name", "args", "t0", "_ann", "_ctx")
 
-    def __init__(self, name: str, args: dict):
+    def __init__(self, name: str, args: dict, ctx=None):
         self.name = name
         self.args = args
         self._ann = None
+        self._ctx = ctx
 
     def __enter__(self):
         if _jax_annotations:
@@ -82,22 +186,36 @@ class _Span:
             # graftlint: disable=bare-except-swallow -- best-effort jax profiler annotation exit: a profiler failure must never break the traced code path (zero-cost contract)
             except Exception:
                 pass
-        _record(self.name, self.t0, t1, self.args)
+        _record(self.name, self.t0, t1, self.args, ctx=self._ctx)
         return False
 
 
-def _record(name: str, t0_us: float, t1_us: float, args: dict):
+def _record(name: str, t0_us: float, t1_us: float, args: dict, ctx=None):
     tid = threading.get_ident()
     ev = {"name": name, "ph": "X", "ts": t0_us,
           "dur": max(t1_us - t0_us, 0.0), "pid": os.getpid(), "tid": tid}
-    if args:
-        ev["args"] = {k: _jsonable(v) for k, v in args.items()}
+    if ctx is None:
+        ctx = getattr(_tls, "ctx", None)
+    if args or ctx is not None:
+        a = ev["args"] = {k: _jsonable(v) for k, v in args.items()} \
+            if args else {}
+        if ctx is not None:
+            a.setdefault("trace_id", ctx.trace_id)
+            a.setdefault("ctx_span", ctx.span_id)
     tname = threading.current_thread().name
+    dropped = False
     with _lock:
         if len(_events) >= _MAX_EVENTS:
-            return
-        _events.append(ev)
-        _thread_names[tid] = tname
+            dropped = True
+        else:
+            _events.append(ev)
+            _thread_names[tid] = tname
+    if dropped:
+        from deeplearning4j_tpu.monitor import metrics
+        metrics.counter(
+            "trace_spans_dropped_total",
+            "Spans discarded after the in-memory event buffer filled "
+            "(save_trace/clear_trace to reclaim)").inc()
 
 
 def _jsonable(v):
@@ -106,32 +224,40 @@ def _jsonable(v):
     return str(v)
 
 
-def span(name: str, **attrs):
+def span(name: str, ctx: Optional[TraceContext] = None, **attrs):
     """Context manager timing one dynamic extent. No-op (shared null
-    object) while tracing is disabled."""
+    object) while tracing is disabled. `ctx` overrides the thread-bound
+    trace context (for recording on behalf of another thread's
+    request); by default the bound context, if any, is attached."""
     if not _enabled:
         return _NULL
-    return _Span(name, attrs)
+    return _Span(name, attrs, ctx)
 
 
-def add_span(name: str, start_s: float, end_s: float, **attrs):
+def add_span(name: str, start_s: float, end_s: float,
+             ctx: Optional[TraceContext] = None, **attrs):
     """Record a complete event from `time.perf_counter()` stamps already
     taken — for loops that measure a phase anyway (ETL timers in the fit
     loops) and shouldn't pay a second pair of clock reads."""
     if not _enabled:
         return
-    _record(name, start_s * 1e6, end_s * 1e6, attrs)
+    _record(name, start_s * 1e6, end_s * 1e6, attrs, ctx=ctx)
 
 
-def instant(name: str, **attrs):
+def instant(name: str, ctx: Optional[TraceContext] = None, **attrs):
     """Record an instant event (a point mark: preemption, resume, skip)."""
     if not _enabled:
         return
     tid = threading.get_ident()
     ev = {"name": name, "ph": "i", "ts": _now_us(), "pid": os.getpid(),
           "tid": tid, "s": "t"}
-    if attrs:
-        ev["args"] = {k: _jsonable(v) for k, v in attrs.items()}
+    if ctx is None:
+        ctx = getattr(_tls, "ctx", None)
+    if attrs or ctx is not None:
+        a = ev["args"] = {k: _jsonable(v) for k, v in attrs.items()} \
+            if attrs else {}
+        if ctx is not None:
+            a.setdefault("trace_id", ctx.trace_id)
     with _lock:
         if len(_events) < _MAX_EVENTS:
             _events.append(ev)

@@ -110,8 +110,9 @@ class SocketTransport:
         self._close_lock = threading.Lock()
         self._inbound: set = set()
         self._inbound_lock = threading.Lock()
-        self._accept_thread = threading.Thread(target=self._accept_loop,
-                                               daemon=True)
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True,
+            name=f"transport-accept-r{rank}")
         self._accept_thread.start()
 
     # ------------------------------------------------------------- receive
@@ -127,7 +128,8 @@ class SocketTransport:
                     return
                 self._inbound.add(conn)
             threading.Thread(target=self._reader, args=(conn,),
-                             daemon=True).start()
+                             daemon=True,
+                             name=f"transport-reader-r{self.rank}").start()
 
     def _reader(self, conn: socket.socket):
         try:

@@ -41,6 +41,7 @@ from typing import Callable, Optional, Sequence, Tuple
 import numpy as np
 
 from deeplearning4j_tpu import monitor
+from deeplearning4j_tpu.monitor import flight
 from deeplearning4j_tpu.monitor import xla as xla_ledger
 
 log = logging.getLogger("deeplearning4j_tpu")
@@ -67,7 +68,8 @@ class ServerDrainingError(ServingError):
 
 
 class _Request:
-    __slots__ = ("x", "deadline", "event", "result", "error", "enqueued")
+    __slots__ = ("x", "deadline", "event", "result", "error", "enqueued",
+                 "ctx", "t0")
 
     def __init__(self, x, deadline: Optional[float]):
         self.x = x
@@ -76,6 +78,10 @@ class _Request:
         self.result = None
         self.error = None
         self.enqueued = time.monotonic()
+        # the submitting thread's trace context (None while tracing and
+        # the flight recorder are off — one thread-local read, no alloc)
+        self.ctx = monitor.current_context()
+        self.t0 = time.perf_counter()   # queue-wait span start
 
 
 class ShapeBucketedBatcher:
@@ -156,6 +162,11 @@ class ShapeBucketedBatcher:
                     labels=("model", "bucket")).inc(
                         model=self.name, bucket=str(b))
                 if not warmup:
+                    # the flight timeline must show WHEN a live request
+                    # paid a compile (the ledger-hit event)
+                    flight.note(monitor.current_context(),
+                                "bucket_compile", bucket=b,
+                                model=self.name)
                     log.warning(
                         "serving[%s]: bucket %d first executed on the "
                         "REQUEST path (compile latency hits a live request) "
@@ -298,6 +309,20 @@ class ShapeBucketedBatcher:
                     live.append(r)
             if not live:
                 continue
+            if monitor.tracing_enabled():
+                # per-request queue-wait spans, recorded on behalf of
+                # the submitting threads (their ctx, this thread's track)
+                dispatch_pc = time.perf_counter()
+                for r in live:
+                    monitor.add_span("serving/queue_wait", r.t0,
+                                     dispatch_pc, ctx=r.ctx,
+                                     model=self.name)
+            if flight.enabled():
+                for r in live:
+                    flight.note(r.ctx, "dispatch",
+                                wait_ms=round(
+                                    (now - r.enqueued) * 1e3, 3),
+                                coalesced=len(live), model=self.name)
             try:
                 batch = np.concatenate([r.x for r in live], axis=0) \
                     if len(live) > 1 else live[0].x
@@ -318,10 +343,16 @@ class ShapeBucketedBatcher:
                     labels=("model",),
                     buckets=(0.0, 0.1, 0.25, 0.5, 1.0, 3.0, 7.0)
                 ).observe(padded / n - 1.0, model=self.name)
-                with monitor.span("serving/batch", model=self.name,
-                                  n=int(batch.shape[0]),
-                                  requests=len(live)):
-                    out = self._run_bucketed(batch, self.runner)
+                # bind the FIRST coalesced request's context to this
+                # worker for the batch extent: the batch span, the
+                # ledger capture inside the runner, and any first-compile
+                # note all land under one trace_id (the others are
+                # linked through their queue_wait spans above)
+                with monitor.bind_context(live[0].ctx):
+                    with monitor.span("serving/batch", model=self.name,
+                                      n=int(batch.shape[0]),
+                                      requests=len(live)):
+                        out = self._run_bucketed(batch, self.runner)
                 ofs = 0
                 for r in live:
                     r.result = out[ofs:ofs + r.x.shape[0]]

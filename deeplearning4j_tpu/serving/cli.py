@@ -93,6 +93,28 @@ def build_parser() -> argparse.ArgumentParser:
                    help="expose POST /v1/faults (chaos testing; wedge "
                         "probes / predicts of THIS process) and honor "
                         "$DL4J_TPU_SERVING_FAULTS. Never on by default.")
+    # -------------------------------------------- observability (tracing)
+    obs = p.add_argument_group(
+        "observability (docs/OBSERVABILITY.md 'Tracing a single request')")
+    obs.add_argument("--trace-out", default=None, metavar="PATH",
+                     help="enable span tracing and save the Chrome/"
+                          "Perfetto trace here on drain. In fleet mode "
+                          "the router writes PATH and each subprocess "
+                          "replica writes PATH-stem.<replica>.json — "
+                          "merge them with tools/trace_report.py")
+    obs.add_argument("--postmortem-dir", default=None, metavar="DIR",
+                     help="flight-recorder SLO postmortems (5xx, breaker "
+                          "open, wedge, p99 breach) are auto-dumped here "
+                          "as JSON")
+    obs.add_argument("--flight-records", type=int, default=512,
+                     help="per-request flight-recorder ring capacity")
+    obs.add_argument("--no-flight", action="store_true",
+                     help="disable the flight recorder (on by default "
+                          "for served processes; the ring is bounded "
+                          "host memory, never on the compiled path)")
+    obs.add_argument("--slo-p99-ms", type=float, default=None,
+                     help="fleet mode: router-tracked predict p99 above "
+                          "this trips an automatic postmortem")
     # ------------------------------------------------------ fleet mode
     fleet = p.add_argument_group(
         "fleet mode (docs/SERVING.md 'Fleet operations')")
@@ -145,10 +167,21 @@ def main(argv=None) -> int:
                           os.environ["JAX_COMPILATION_CACHE_DIR"])
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    from deeplearning4j_tpu import monitor
+    from deeplearning4j_tpu.monitor import flight as flight_recorder
     from deeplearning4j_tpu.serving.registry import (
         ModelLoadError, ModelRegistry,
     )
     from deeplearning4j_tpu.serving.server import ModelServer
+
+    # observability defaults for served processes: the flight recorder is
+    # ON (bounded host-side ring; the zero-cost contract only governs the
+    # library default), span tracing only when --trace-out asks for it
+    if not args.no_flight:
+        flight_recorder.enable_flight(capacity=args.flight_records,
+                                      dump_dir=args.postmortem_dir)
+    if args.trace_out:
+        monitor.enable_tracing()
 
     try:
         buckets = tuple(int(b) for b in args.buckets.split(",") if b)
@@ -222,6 +255,10 @@ def main(argv=None) -> int:
         signal.signal(s, _on_signal)
     stop.wait()
     server.drain(timeout=args.drain_timeout_s)
+    if args.trace_out:
+        n = monitor.save_trace(args.trace_out)
+        print(json.dumps({"trace_out": args.trace_out, "events": n}),
+              file=sys.stderr)
     return 0
 
 
@@ -265,7 +302,11 @@ def _main_fleet(args, specs, lm_specs, buckets, decode_cfg) -> int:
                        queue_limit=args.queue_limit,
                        default_deadline_s=args.deadline_s,
                        enable_faults=args.enable_fault_injection,
-                       lms=lm_specs, decode=decode_cfg)
+                       lms=lm_specs, decode=decode_cfg,
+                       trace_out=args.trace_out,
+                       postmortem_dir=args.postmortem_dir,
+                       flight=not args.no_flight,
+                       flight_records=args.flight_records)
     if args.replica_mode == "subprocess":
         for _, source in specs + lm_specs:
             base, _variant = parse_variant(source)
@@ -295,7 +336,8 @@ def _main_fleet(args, specs, lm_specs, buckets, decode_cfg) -> int:
         supervisor.healthy, classes=classes,
         shed_floor=args.shed_floor,
         per_replica_inflight=args.per_replica_inflight,
-        hedge=not args.no_hedge, timeout_s=args.deadline_s)
+        hedge=not args.no_hedge, timeout_s=args.deadline_s,
+        slo_p99_ms=args.slo_p99_ms)
     server = RouterServer(router, supervisor=supervisor,
                           host=args.host, port=args.port)
     print(json.dumps({"serving": server.url, "role": "router",
@@ -330,6 +372,16 @@ def _main_fleet(args, specs, lm_specs, buckets, decode_cfg) -> int:
         time.sleep(0.1)
     supervisor.stop()
     server.stop()
+    if args.trace_out:
+        # supervisor.stop() SIGTERMed the replicas: each drained and
+        # saved its own segment next to ours — trace_report merges them
+        from deeplearning4j_tpu import monitor
+        n = monitor.save_trace(args.trace_out)
+        print(json.dumps({"trace_out": args.trace_out, "events": n,
+                          "merge_hint": "tools/trace_report.py "
+                                        f"{args.trace_out} "
+                                        "<stem>.replica-*.json"}),
+              file=sys.stderr)
     return 0
 
 

@@ -55,6 +55,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu import monitor
+from deeplearning4j_tpu.monitor import flight
 from deeplearning4j_tpu.nn.activations import get_activation
 from deeplearning4j_tpu.nn.layers.attention import (
     EmbeddingSequenceLayer, LayerNormLayer, MoEFeedForward,
@@ -121,6 +122,12 @@ class GenerateRequest:
         self.finish_reason: Optional[str] = None
         self.cancelled = threading.Event()
         self.done = threading.Event()
+        # the submitting thread's trace context (the HTTP handler binds
+        # the request's ctx around generate()); the scheduler thread
+        # records this stream's spans under it
+        self.ctx = monitor.current_context()
+        self.t0_pc = time.perf_counter()
+        self._last_pc: Optional[float] = None
 
     # ------------------------------------------------------------- events
     def emit(self, token: int):
@@ -129,6 +136,7 @@ class GenerateRequest:
         if self.first_token_at is None:
             self.first_token_at = now
         self.last_emit_at = now
+        self._last_pc = time.perf_counter()
         self.events.put(("token", int(token)))
 
     def finish(self, reason: str):
@@ -641,6 +649,7 @@ class DecodeScheduler:
         monitor.gauge("serving_decode_queue_depth",
                       "Generation requests waiting for a decode slot",
                       labels=("model",)).set(depth, model=self.name)
+        flight.note(req.ctx, "queued", depth=depth, model=self.name)
         self._wake.set()
 
     def queue_state(self) -> Tuple[int, int]:
@@ -744,9 +753,25 @@ class DecodeScheduler:
                 break                       # no slot/pages; retry next tick
             self._pop(req)
             joined_running = bool(run.slot_req) or self.inflight() > 0
+            if flight.enabled():
+                # admission wait + the engine generation whose params
+                # will write this stream's KV (the swap-generation fact
+                # a postmortem needs)
+                flight.note(req.ctx, "admitted", slot=slot,
+                            engine_version=run.version,
+                            wait_ms=round(
+                                (time.monotonic() - req.enqueued) * 1e3,
+                                3),
+                            joined_running=joined_running,
+                            model=self.name)
             try:
-                tok, _ = run.engine.prefill(slot, req.prompt,
-                                            req.temperature, req.top_k)
+                # bind the stream's context so the prefill span (and any
+                # first-compile ledger capture inside it) carries its
+                # trace_id
+                with monitor.bind_context(req.ctx):
+                    tok, _ = run.engine.prefill(slot, req.prompt,
+                                                req.temperature,
+                                                req.top_k)
             except Exception as e:          # noqa: BLE001 — surfaced to req
                 run.engine.cache.release(slot)
                 log.exception("decode[%s]: prefill failed", self.name)
@@ -787,12 +812,21 @@ class DecodeScheduler:
         if req.eos_id is not None and tok == req.eos_id:
             self._finish(run, slot, req, "eos")
             return
+        exemplar = None if req.ctx is None else req.ctx.trace_id
         if req.last_emit_at is not None:
+            if monitor.tracing_enabled() and req._last_pc is not None:
+                # one span per inter-token gap, under the stream's ctx:
+                # the merged trace shows every ITL stall of a slow p99
+                # stream (the runbook's page-stall walk)
+                monitor.add_span("decode/itl_gap", req._last_pc,
+                                 time.perf_counter(), ctx=req.ctx,
+                                 model=self.name, index=req.n_emitted)
             monitor.histogram(
                 "serving_decode_inter_token_seconds",
                 "Gap between consecutive streamed tokens of one request",
                 labels=("model",), buckets=_ITL_BUCKETS).observe(
-                time.monotonic() - req.last_emit_at, model=self.name)
+                time.monotonic() - req.last_emit_at, model=self.name,
+                exemplar=exemplar)
         elif req.n_emitted == 0:
             # TTFT observed only for generations that actually deliver a
             # first token — cancelled/deadline admissions (checked above)
@@ -801,7 +835,8 @@ class DecodeScheduler:
                 "serving_decode_ttft_seconds",
                 "Time from request arrival to its first generated token",
                 labels=("model",), buckets=_TTFT_BUCKETS).observe(
-                time.monotonic() - req.enqueued, model=self.name)
+                time.monotonic() - req.enqueued, model=self.name,
+                exemplar=exemplar)
         req.emit(tok)
         monitor.counter("serving_decode_tokens_total",
                         "Generated tokens streamed to clients",
@@ -814,6 +849,16 @@ class DecodeScheduler:
         run.engine.cache.release(slot)
         run.slot_req.pop(slot, None)
         req.finish(reason)
+        if monitor.tracing_enabled():
+            # the whole stream as one span on the scheduler track, under
+            # the stream's trace_id — queue wait + prefill + every token
+            monitor.add_span("serving/stream", req.t0_pc,
+                             time.perf_counter(), ctx=req.ctx,
+                             model=self.name, reason=reason,
+                             tokens=req.n_emitted,
+                             engine_version=run.version)
+        flight.note(req.ctx, "finish", reason=reason,
+                    tokens=req.n_emitted, model=self.name)
         monitor.counter("serving_decode_finished_total",
                         "Finished generations by reason",
                         labels=("model", "reason")).inc(
@@ -840,7 +885,14 @@ class DecodeScheduler:
                 elif req.deadline is not None \
                         and time.monotonic() > req.deadline:
                     self._finish(run, slot, req, "deadline")
-                # else: page-stalled this step; metered by the cache
+                elif flight.enabled():
+                    # page-stalled this step (metered by the cache); the
+                    # per-stream timeline needs the stall itself — it is
+                    # THE explanation for an ITL-gap span in the trace
+                    flight.note(req.ctx, "page_stall", slot=slot,
+                                seq_len=int(
+                                    run.engine.cache.seq_lens[slot]),
+                                model=self.name)
             worked = True
         return worked
 

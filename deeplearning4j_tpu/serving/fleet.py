@@ -43,6 +43,7 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import queue as _queue
 import subprocess
 import sys
@@ -53,6 +54,7 @@ import urllib.request
 from typing import Callable, List, Optional, Sequence, Tuple
 
 from deeplearning4j_tpu import monitor
+from deeplearning4j_tpu.monitor import flight
 
 log = logging.getLogger("deeplearning4j_tpu")
 
@@ -72,7 +74,11 @@ class ReplicaSpec:
                  host: str = "127.0.0.1",
                  enable_faults: bool = False,
                  lms: Sequence[Tuple[str, object]] = (),
-                 decode=None):
+                 decode=None,
+                 trace_out: Optional[str] = None,
+                 postmortem_dir: Optional[str] = None,
+                 flight: bool = True,
+                 flight_records: int = 512):
         self.models = list(models)              # [(name, source), ...]
         self.buckets = tuple(int(b) for b in buckets)
         self.max_delay_ms = float(max_delay_ms)
@@ -84,6 +90,17 @@ class ReplicaSpec:
         #: DecodeConfig (serving/decode.py); None decode = library default
         self.lms = list(lms)
         self.decode = decode
+        #: base trace path: subprocess replicas save their own segment
+        #: to <stem>.<replica-name><ext> on graceful drain, so
+        #: tools/trace_report.py can merge the whole fleet
+        self.trace_out = trace_out
+        #: flight-recorder postmortem directory threaded to every replica
+        self.postmortem_dir = postmortem_dir
+        #: flight-recorder opt-out + ring size, threaded to every replica
+        #: (an operator's --no-flight must disable the WHOLE fleet's
+        #: recorder, not just the router's)
+        self.flight = bool(flight)
+        self.flight_records = int(flight_records)
 
 
 class Replica:
@@ -233,6 +250,15 @@ class SubprocessReplica(Replica):
                          ",".join(str(b) for b in d.prefill_buckets)]
         if self.spec.enable_faults:
             argv.append("--enable-fault-injection")
+        if self.spec.trace_out:
+            stem, ext = os.path.splitext(self.spec.trace_out)
+            argv += ["--trace-out", f"{stem}.{self.name}{ext or '.json'}"]
+        if self.spec.postmortem_dir:
+            argv += ["--postmortem-dir", self.spec.postmortem_dir]
+        if not self.spec.flight:
+            argv.append("--no-flight")
+        elif self.spec.flight_records != 512:
+            argv += ["--flight-records", str(self.spec.flight_records)]
         return argv
 
     def launch(self):
@@ -403,7 +429,8 @@ class ReplicaSupervisor:
                 errors.append(f"{r.name}: {type(e).__name__}: {e}")
                 r.state = "unhealthy"
 
-        threads = [threading.Thread(target=_launch, args=(r,), daemon=True)
+        threads = [threading.Thread(target=_launch, args=(r,), daemon=True,
+                                    name=f"launch-{r.name}")
                    for r in self.replicas]
         for t in threads:
             t.start()
@@ -495,6 +522,7 @@ class ReplicaSupervisor:
         default) so one slow or hung launch never stalls supervision of
         the rest of the fleet — or supervisor.stop()."""
         due: List[Replica] = []
+        wedged: List[Tuple[str, int, int]] = []   # postmortems after lock
         with self._lock:
             if self._stop.is_set():
                 return
@@ -565,6 +593,8 @@ class ReplicaSupervisor:
                         r.consecutive_probe_failures)
                     r.state = "unhealthy"
                     self._note_restart(r, "probe")
+                    wedged.append((r.name, r.generation,
+                                   r.consecutive_probe_failures))
                     try:
                         r.kill()
                     except Exception:         # noqa: BLE001
@@ -572,6 +602,12 @@ class ReplicaSupervisor:
                                       r.name)
                     self._schedule_restart(r, now)
             self._export_states()
+        for name, gen, probe_failures in wedged:
+            # OUTSIDE the tick lock (postmortems write a file): a wedge
+            # detection is an SLO event — dump the flight ring naming
+            # the replica incarnation that wedged
+            flight.trip("replica_wedged", replica=name, generation=gen,
+                        probe_failures=probe_failures)
         for r in due:
             r._launch_thread = self._spawn(
                 lambda r=r: self._relaunch(r), f"relaunch-{r.name}")

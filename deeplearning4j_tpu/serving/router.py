@@ -51,6 +51,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 from urllib.parse import urlparse
 
 from deeplearning4j_tpu import monitor
+from deeplearning4j_tpu.monitor import flight
 from deeplearning4j_tpu.serving.fleet import Replica
 from deeplearning4j_tpu.serving.server import retry_after_seconds
 
@@ -200,6 +201,25 @@ def _percentile(xs: Sequence[float], p: float) -> float:
     return ss[i]
 
 
+def _pop_traceparent(headers: Dict[str, str]) -> Optional[str]:
+    """Case-insensitively remove and return the incoming traceparent
+    (HTTP header names arrive in whatever casing the client/wire chose;
+    leaving the original key in place would forward TWO traceparent
+    headers after the router substitutes its own segment)."""
+    for k in list(headers):
+        if k.lower() == monitor.TRACEPARENT_HEADER:
+            return headers.pop(k)
+    return None
+
+
+def _outcome_of(code: int) -> str:
+    """HTTP status -> flight-record outcome tag (the loadgen taxonomy)."""
+    if 200 <= code < 300:
+        return "ok"
+    return {429: "shed_429", 503: "unavailable_503",
+            504: "deadline_504"}.get(code, f"http_{code}")
+
+
 class ResilientRouter:
     """Route predict requests across the healthy fleet with breakers,
     priority shedding and hedging. See the module docstring for policy.
@@ -227,7 +247,8 @@ class ResilientRouter:
                  breaker_half_open_probes: int = 1,
                  time_fn: Callable[[], float] = time.monotonic,
                  rng: Optional[_random.Random] = None,
-                 transport: Callable = http_transport):
+                 transport: Callable = http_transport,
+                 slo_p99_ms: Optional[float] = None):
         self._replicas_fn = replicas_fn
         # normalized to lowercase: _classify lowercases the header value,
         # so a class configured as "Interactive" must still match
@@ -268,6 +289,9 @@ class ResilientRouter:
             = {}
         #: model -> deque of recent successful latencies (hedge p99 input)
         self._latencies: Dict[str, deque] = {}
+        #: p99 SLO (ms): tracked p99 beyond it trips a flight postmortem
+        self.slo_p99_ms = None if slo_p99_ms is None else float(slo_p99_ms)
+        self._slo_notes = 0
 
     # ------------------------------------------------------------- breakers
     def breaker(self, replica: Replica, model: str) -> CircuitBreaker:
@@ -284,7 +308,7 @@ class ResilientRouter:
                     labels=("replica", "model"))
                 rname, mname = key
 
-                def on_transition(state: int):
+                def on_transition(state: int, _replica=replica):
                     gauge.set(state, replica=rname, model=mname)
                     monitor.counter(
                         "serving_router_breaker_transitions_total",
@@ -294,6 +318,23 @@ class ResilientRouter:
                         to=_BREAKER_NAMES[state])
                     log.warning("router: breaker (%s, %s) -> %s", rname,
                                 mname, _BREAKER_NAMES[state])
+                    if state == BREAKER_OPEN:
+                        # an opened breaker is an SLO event: snapshot the
+                        # flight ring while the evidence is still in it.
+                        # On a THREAD: on_transition runs under the
+                        # breaker's lock (record_failure), and the trip's
+                        # disk write must not stall every routing
+                        # decision through that breaker mid-incident.
+                        # generation is read at fire time — the postmortem
+                        # must name the CURRENT incarnation, not the one
+                        # alive when this breaker was first built.
+                        threading.Thread(
+                            target=lambda: flight.trip(
+                                "breaker_open", replica=rname,
+                                model=mname,
+                                generation=_replica.generation),
+                            daemon=True,
+                            name=f"flight-trip-{rname}").start()
 
                 br = CircuitBreaker(on_transition=on_transition,
                                     **self._breaker_kw)
@@ -324,11 +365,24 @@ class ResilientRouter:
 
     # -------------------------------------------------------------- hedging
     def _note_latency(self, model: str, seconds: float):
+        check = None
         with self._lock:
             dq = self._latencies.get(model)
             if dq is None:
                 dq = self._latencies[model] = deque(maxlen=512)
             dq.append(seconds)
+            if self.slo_p99_ms is not None:
+                self._slo_notes += 1
+                # check every 16th sample (p99 over <16 samples is
+                # noise, and sorting 512 floats per request is waste)
+                if self._slo_notes % 16 == 0 and len(dq) >= 32:
+                    check = list(dq)
+        if check is not None:
+            p99_ms = _percentile(check, 99) * 1e3
+            if p99_ms > self.slo_p99_ms:
+                flight.trip("p99_breach", model=model,
+                            p99_ms=round(p99_ms, 3),
+                            slo_ms=self.slo_p99_ms)
 
     def hedge_delay(self, model: str) -> Optional[float]:
         """Fire a hedge after the tracked p99 (never sooner than
@@ -366,12 +420,27 @@ class ResilientRouter:
         5xx of its own making."""
         t0 = time.perf_counter()
         cls = self._classify(headers)
+        # adopt the client's traceparent (or mint one) and forward OUR
+        # segment on the replica hop: one trace_id, router -> replica ->
+        # batcher, across process boundaries. With the router's tracing
+        # AND recorder off, the client's header still passes through
+        # untouched — replicas with recorders on keep the trace intact.
+        incoming = _pop_traceparent(headers)
+        ctx = flight.request_context(incoming, "router")
+        if ctx is not None:
+            headers[monitor.TRACEPARENT_HEADER] = ctx.header()
+        elif incoming is not None:
+            headers[monitor.TRACEPARENT_HEADER] = incoming
+        fr = flight.begin(ctx, "route", model=model, cls=cls)
         timeout = self.timeout_s if timeout is None else float(timeout)
         code = 500
         try:
-            with monitor.span("serving/route", model=model, cls=cls):
+            with monitor.bind_context(ctx), \
+                    monitor.span("serving/route", model=model, cls=cls):
                 code, hdrs, payload = self._route_predict(
                     model, cls, body, headers, timeout)
+            if ctx is not None:
+                hdrs = list(hdrs) + [("X-Trace-Id", ctx.trace_id)]
             return code, hdrs, payload
         finally:
             monitor.counter("serving_router_requests_total",
@@ -381,7 +450,9 @@ class ResilientRouter:
             monitor.histogram("serving_router_request_seconds",
                               "Router-side end-to-end predict latency",
                               labels=("model",)).observe(
-                time.perf_counter() - t0, model=model)
+                time.perf_counter() - t0, model=model,
+                exemplar=None if ctx is None else ctx.trace_id)
+            flight.finish(fr, _outcome_of(code), code=code)
 
     def _route_predict(self, model: str, cls: str, body: bytes,
                        headers: Dict[str, str], timeout: float):
@@ -401,6 +472,8 @@ class ResilientRouter:
                             labels=("cls",)).inc(cls=cls)
             used = sum(r.inflight() for r in healthy)
             cap = self.per_replica_inflight * max(1, len(healthy))
+            flight.note(monitor.current_context(), "shed", cls=cls,
+                        inflight=used, capacity=cap)
             return self._json_response(
                 429, {"error": f"fleet saturated; class {cls!r} is being "
                                "shed", "class": cls},
@@ -429,48 +502,55 @@ class ResilientRouter:
         in-flight bookkeeping regardless of whether anyone is still
         waiting (a hedge loser must still be accounted)."""
         replica.inflight_add(1)
+        ctx = monitor.current_context()     # the request's, for the worker
 
         def run():
             t0 = time.perf_counter()
-            try:
-                out = self._transport(replica, path, body, dict(headers),
-                                      timeout)
-            except ReplicaTransportError as e:
-                self.breaker(replica, model).record_failure()
-                monitor.counter("serving_router_replica_errors_total",
-                                "Replica-level failures seen by the "
-                                "router", labels=("replica", "kind")).inc(
-                    replica=replica.name, kind="transport")
-                resq.put((replica, "error", e))
-                return
-            finally:
-                replica.inflight_add(-1)
-            code = out[0]
-            if 500 <= code < 600 and code not in (503, 504):
-                self.breaker(replica, model).record_failure()
-                monitor.counter("serving_router_replica_errors_total",
-                                "Replica-level failures seen by the "
-                                "router", labels=("replica", "kind")).inc(
-                    replica=replica.name, kind=f"http_{code}")
-                resq.put((replica, "server_error", out))
-                return
-            if code in (429, 503, 504):
-                # an overloaded/draining replica is not a broken replica,
-                # and a 504 means the REQUEST's deadline expired (a tight
-                # client deadline must not open breakers on healthy
-                # backends): don't poison the breaker — but DO give back
-                # a half-open probe slot this send may have consumed —
-                # and relay the backpressure if no other candidate answers
-                self.breaker(replica, model).release()
-                resq.put((replica, "overloaded", out))
-                return
-            self.breaker(replica, model).record_success()
-            if 200 <= code < 300:
-                self._note_latency(model, time.perf_counter() - t0)
-            resq.put((replica, "ok", out))
+            with monitor.bind_context(ctx):
+                self._fire_one(replica, model, path, body, headers,
+                               timeout, resq, t0)
 
         threading.Thread(target=run, daemon=True,
                          name=f"route-{replica.name}").start()
+
+    def _fire_one(self, replica, model, path, body, headers, timeout,
+                  resq, t0):
+        try:
+            out = self._transport(replica, path, body, dict(headers),
+                                  timeout)
+        except ReplicaTransportError as e:
+            self.breaker(replica, model).record_failure()
+            monitor.counter("serving_router_replica_errors_total",
+                            "Replica-level failures seen by the "
+                            "router", labels=("replica", "kind")).inc(
+                replica=replica.name, kind="transport")
+            resq.put((replica, "error", e))
+            return
+        finally:
+            replica.inflight_add(-1)
+        code = out[0]
+        if 500 <= code < 600 and code not in (503, 504):
+            self.breaker(replica, model).record_failure()
+            monitor.counter("serving_router_replica_errors_total",
+                            "Replica-level failures seen by the "
+                            "router", labels=("replica", "kind")).inc(
+                replica=replica.name, kind=f"http_{code}")
+            resq.put((replica, "server_error", out))
+            return
+        if code in (429, 503, 504):
+            # an overloaded/draining replica is not a broken replica,
+            # and a 504 means the REQUEST's deadline expired (a tight
+            # client deadline must not open breakers on healthy
+            # backends): don't poison the breaker — but DO give back
+            # a half-open probe slot this send may have consumed —
+            # and relay the backpressure if no other candidate answers
+            self.breaker(replica, model).release()
+            resq.put((replica, "overloaded", out))
+            return
+        self.breaker(replica, model).record_success()
+        if 200 <= code < 300:
+            self._note_latency(model, time.perf_counter() - t0)
+        resq.put((replica, "ok", out))
 
     def _attempt_with_hedge(self, model: str, cls: str,
                             candidates: List[Replica], path: str,
@@ -531,6 +611,8 @@ class ResilientRouter:
                             "serving_router_hedges_total",
                             "Hedged (duplicate) predict sends",
                             labels=("model",)).inc(model=model)
+                        flight.note(monitor.current_context(), "hedge",
+                                    replica=spare.name, model=model)
                         with monitor.span("serving/hedge", model=model,
                                           replica=spare.name):
                             self._fire(spare, model, path, body, headers,
@@ -552,6 +634,8 @@ class ResilientRouter:
                 keep = [(k, v) for k, v in hdrs.items()
                         if k.lower() in ("content-type", "retry-after")]
                 keep.append(("X-Served-By", replica.name))
+                flight.note(monitor.current_context(), "served_by",
+                            replica=replica.name, hedged=hedged)
                 return code, keep, payload
             if kind == "overloaded":
                 last_overload = result
@@ -572,6 +656,8 @@ class ResilientRouter:
                                     "Failover re-sends after a replica "
                                     "failure", labels=("model",)).inc(
                         model=model)
+                    flight.note(monitor.current_context(), "failover",
+                                replica=nxt.name, model=model)
                     self._fire(nxt, model, path, body, headers, timeout,
                                resq)
                     launched += 1
@@ -608,6 +694,13 @@ class ResilientRouter:
         accounting)."""
         t0 = time.perf_counter()
         cls = self._classify(headers)
+        incoming = _pop_traceparent(headers)
+        ctx = flight.request_context(incoming, "router")
+        if ctx is not None:
+            headers[monitor.TRACEPARENT_HEADER] = ctx.header()
+        elif incoming is not None:
+            headers[monitor.TRACEPARENT_HEADER] = incoming
+        fr = flight.begin(ctx, "route_stream", model=model, cls=cls)
         timeout = self.timeout_s if timeout is None else float(timeout)
         code_box = {"code": 500}
 
@@ -620,9 +713,14 @@ class ResilientRouter:
 
         def relay(code, hdrs, payload):
             meter(code)
+            if ctx is not None:
+                hdrs = list(hdrs) + [("X-Trace-Id", ctx.trace_id)]
+            flight.finish(fr, _outcome_of(code), code=code)
             return ("relay", code, hdrs, payload)
 
-        with monitor.span("serving/route", model=model, cls=cls, stream=1):
+        with monitor.bind_context(ctx), \
+                monitor.span("serving/route", model=model, cls=cls,
+                             stream=1):
             healthy = list(self._replicas_fn())
             if not healthy:
                 monitor.counter("serving_router_no_backend_total",
@@ -640,6 +738,8 @@ class ResilientRouter:
                                 labels=("cls",)).inc(cls=cls)
                 used = sum(r.inflight() for r in healthy)
                 cap = self.per_replica_inflight * max(1, len(healthy))
+                flight.note(ctx, "shed", cls=cls, inflight=used,
+                            capacity=cap)
                 c, h, b = self._json_response(
                     429, {"error": f"fleet saturated; class {cls!r} is "
                                    "being shed", "class": cls},
@@ -698,7 +798,8 @@ class ResilientRouter:
                                 "%s", replica.name, e)
                     continue
 
-                def done(ok: bool, _r=replica, _b=breaker):
+                def done(ok: bool, _r=replica, _b=breaker,
+                         _code=resp.status):
                     _r.inflight_add(-1)
                     if ok:
                         _b.record_success()
@@ -706,11 +807,17 @@ class ResilientRouter:
                                            time.perf_counter() - t0)
                     else:
                         _b.record_failure()
+                    flight.finish(fr, "ok" if ok else "stream_broken",
+                                  code=_code, replica=_r.name)
 
+                flight.note(ctx, "stream_committed",
+                            replica=replica.name, model=model)
                 keep = [(k, v) for k, v in resp.headers.items()
                         if k.lower() in ("content-type", "retry-after",
                                          "x-model-version")]
                 keep.append(("X-Served-By", replica.name))
+                if ctx is not None:
+                    keep.append(("X-Trace-Id", ctx.trace_id))
                 meter(resp.status)
                 return ("stream", resp.status, keep, resp, done)
             if backpressure is not None:
@@ -824,6 +931,36 @@ class _RouterHandler(BaseHTTPRequestHandler):
             self._json(sup.describe() if sup is not None
                        else {"replicas": []})
             return
+        if url.path == "/v1/debug/flight":
+            # fleet-wide view: the router's own ring plus every healthy
+            # replica's — one endpoint answers "what happened to request
+            # X" regardless of which process served it. Fetched in
+            # PARALLEL (same pattern as fan_out): N slow/dead replicas
+            # cost one 5 s timeout total, not N of them.
+            doc = {"router": flight.snapshot(), "replicas": {}}
+            lock = threading.Lock()
+
+            def _one(r: Replica):
+                try:
+                    code, _, payload = self._rs.router._transport(
+                        r, "/v1/debug/flight", None, {}, 5.0)
+                    out = json.loads(payload) if code == 200 \
+                        else {"error": f"http_{code}"}
+                except (ReplicaTransportError, ValueError) as e:
+                    out = {"error": str(e)}
+                with lock:
+                    doc["replicas"][r.name] = out
+
+            threads = [threading.Thread(target=_one, args=(r,),
+                                        daemon=True,
+                                        name=f"flight-{r.name}")
+                       for r in self._rs.router._replicas_fn()]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            self._json(doc)
+            return
         if url.path.startswith("/v1/models"):
             # model metadata rides on any healthy replica
             healthy = self._rs.router._replicas_fn()
@@ -851,7 +988,7 @@ class _RouterHandler(BaseHTTPRequestHandler):
         if verb == "predict":
             headers = {k: v for k, v in self.headers.items()
                        if k.lower() in ("content-type", "accept",
-                                        "x-priority")}
+                                        "x-priority", "traceparent")}
             if url.query:
                 headers["__query__"] = url.query
             code, hdrs, payload = self._rs.router.route_predict(
@@ -861,7 +998,7 @@ class _RouterHandler(BaseHTTPRequestHandler):
         if verb == "generate":
             headers = {k: v for k, v in self.headers.items()
                        if k.lower() in ("content-type", "accept",
-                                        "x-priority")}
+                                        "x-priority", "traceparent")}
             if url.query:
                 headers["__query__"] = url.query
             out = self._rs.router.route_generate(name, body, headers)

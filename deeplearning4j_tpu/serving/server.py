@@ -14,6 +14,16 @@ ThreadingHTTPServer pattern as ui/server.py) in front of a ModelRegistry:
     GET  /healthz                     process liveness (always 200)
     GET  /readyz                      200 only when warmed and not draining
     GET  /metrics                     Prometheus exposition (monitor/)
+    GET  /v1/debug/flight             flight-recorder snapshot (monitor/
+                                      flight.py): recent request
+                                      timelines, postmortems, exemplars
+
+Every request adopts the caller's ``traceparent`` header (or mints a
+fresh trace context at ingress), binds it to the handling thread so the
+request/batch/decode spans carry one trace_id, opens a flight-recorder
+record, and answers with an ``X-Trace-Id`` response header — see
+docs/OBSERVABILITY.md "Tracing a single request". An unexpected 500
+trips an automatic flight postmortem.
 
 Failure discipline (the acceptance contract): admission control maps a
 full request queue to **429** with Retry-After (bounded queue -> explicit
@@ -45,6 +55,7 @@ from urllib.parse import parse_qs, urlparse
 import numpy as np
 
 from deeplearning4j_tpu import monitor
+from deeplearning4j_tpu.monitor import flight
 from deeplearning4j_tpu.serving.batcher import (
     DeadlineExceededError, ServerDrainingError, ServerOverloadedError,
 )
@@ -96,6 +107,9 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(code)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
+        ctx = getattr(self, "_trace_ctx", None)
+        if ctx is not None:
+            self.send_header("X-Trace-Id", ctx.trace_id)
         for k, v in extra:
             self.send_header(k, v)
         self.end_headers()
@@ -104,6 +118,15 @@ class _Handler(BaseHTTPRequestHandler):
     def _json(self, obj, code: int = 200, extra=()):
         self._reply(code, json.dumps(obj).encode(), "application/json",
                     extra)
+
+    def _ingress(self):
+        """Adopt/mint the request's trace context (None while tracing
+        and the flight recorder are both disabled) and remember it so
+        every response carries X-Trace-Id."""
+        ctx = flight.request_context(
+            self.headers.get(monitor.TRACEPARENT_HEADER), "server")
+        self._trace_ctx = ctx
+        return ctx
 
     def _meter(self, model: str, code: int, t0: float):
         if code == 404:
@@ -114,10 +137,12 @@ class _Handler(BaseHTTPRequestHandler):
                         "HTTP serving requests by model and status code",
                         labels=("model", "code")).inc(
             model=model, code=str(code))
+        ctx = getattr(self, "_trace_ctx", None)
         monitor.histogram("serving_request_seconds",
                           "End-to-end HTTP request latency",
                           labels=("model",)).observe(
-            time.perf_counter() - t0, model=model)
+            time.perf_counter() - t0, model=model,
+            exemplar=None if ctx is None else ctx.trace_id)
 
     def _body(self) -> bytes:
         try:
@@ -130,8 +155,12 @@ class _Handler(BaseHTTPRequestHandler):
 
     # ---------------------------------------------------------------- GET
     def do_GET(self):
+        self._trace_ctx = None          # keep-alive: no stale ids
         url = urlparse(self.path)
         parts = [p for p in url.path.split("/") if p]
+        if url.path == "/v1/debug/flight":
+            self._json(flight.snapshot())
+            return
         if url.path in ("/healthz", "/readyz"):
             try:
                 # fault point: a wedged replica answers probes slowly (or
@@ -180,6 +209,7 @@ class _Handler(BaseHTTPRequestHandler):
 
     # --------------------------------------------------------------- POST
     def do_POST(self):
+        self._trace_ctx = None          # keep-alive: no stale ids
         url = urlparse(self.path)
         parts = [p for p in url.path.split("/") if p]
         if parts[:2] == ["v1", "models"] and len(parts) == 4:
@@ -225,6 +255,7 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _predict(self, name: str, url):
         t0 = time.perf_counter()
+        ctx = self._ingress()
         q = parse_qs(url.query)
         served = self._srv.registry.get(name)
         if served is None:
@@ -238,9 +269,11 @@ class _Handler(BaseHTTPRequestHandler):
             self._meter(name, 404, t0)
             self._json({"error": f"unknown model {name!r}"}, code=404)
             return
+        fr = flight.begin(ctx, "predict", model=name)
         code = 500
         try:
-            with monitor.span("serving/request", model=name):
+            with monitor.bind_context(ctx), \
+                    monitor.span("serving/request", model=name):
                 x = self._parse_inputs(url)
                 batched = x.shape[1:] == served.input_shape
                 if not batched and x.shape == served.input_shape:
@@ -288,8 +321,13 @@ class _Handler(BaseHTTPRequestHandler):
             code = 500
             log.exception("serving[%s]: predict failed", name)
             self._json({"error": f"{type(e).__name__}: {e}"}, code=500)
+            flight.trip("http_5xx", model=name,
+                        error=type(e).__name__,
+                        trace_id=None if ctx is None else ctx.trace_id)
         finally:
             self._meter(name, code, t0)
+            flight.finish(fr, "ok" if code == 200 else f"http_{code}",
+                          code=code)
 
     # ---------------------------------------------------------- generation
     def _sse(self, obj) -> bytes:
@@ -318,6 +356,7 @@ class _Handler(BaseHTTPRequestHandler):
         (join queue full, Retry-After), 503 (draining), 504 (deadline
         before the first token), 400 (bad prompt/params)."""
         t0 = time.perf_counter()
+        ctx = self._ingress()
         q = parse_qs(url.query)
         served = self._srv.registry.get(name)
         if served is None:
@@ -329,6 +368,7 @@ class _Handler(BaseHTTPRequestHandler):
             self._meter(name, 404, t0)
             self._json({"error": f"unknown model {name!r}"}, code=404)
             return
+        fr = flight.begin(ctx, "stream", model=name)
         code = 500
         self._gen_started = False
         req = None
@@ -348,8 +388,9 @@ class _Handler(BaseHTTPRequestHandler):
                 raise ValueError("deadline_ms must be a number")
             self._srv.faults.on_predict()
             stream_attr = 1 if stream else 0
-            with monitor.span("serving/generate", model=name,
-                              stream=stream_attr):
+            with monitor.bind_context(ctx), \
+                    monitor.span("serving/generate", model=name,
+                                 stream=stream_attr):
                 req = served.generate(
                     payload["prompt"],
                     max_new_tokens=int(payload.get("max_tokens", 32)),
@@ -387,8 +428,16 @@ class _Handler(BaseHTTPRequestHandler):
                 req.cancel()
             if not self._gen_started:   # headers not sent: clean JSON 500
                 self._json({"error": f"{type(e).__name__}: {e}"}, code=500)
+            flight.trip("http_5xx", model=name,
+                        error=type(e).__name__,
+                        trace_id=None if ctx is None else ctx.trace_id)
         finally:
             self._meter(name, code, t0)
+            flight.finish(fr, "ok" if code == 200 else f"http_{code}",
+                          code=code,
+                          finish_reason=None if req is None
+                          else req.finish_reason,
+                          tokens=None if req is None else req.n_emitted)
 
     def _relay_generation(self, name: str, req, t0: float,
                           deadline: float, stream: bool) -> int:
@@ -423,6 +472,9 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Content-Type", "text/event-stream")
         self.send_header("Cache-Control", "no-cache")
         self.send_header("Transfer-Encoding", "chunked")
+        ctx = getattr(self, "_trace_ctx", None)
+        if ctx is not None:
+            self.send_header("X-Trace-Id", ctx.trace_id)
         if req.version is not None:
             self.send_header("X-Model-Version", str(req.version))
         self.end_headers()
@@ -460,6 +512,7 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _admin(self, name: str, verb: str):
         t0 = time.perf_counter()
+        self._ingress()
         served = self._srv.registry.get(name)
         if served is None:
             if self._srv.draining:
@@ -497,6 +550,8 @@ class _Handler(BaseHTTPRequestHandler):
             code = 500
             log.exception("serving[%s]: %s failed", name, verb)
             self._json({"error": f"{type(e).__name__}: {e}"}, code=500)
+            flight.trip("http_5xx", model=name, verb=verb,
+                        error=type(e).__name__)
         finally:
             self._meter(name, code, t0)
 

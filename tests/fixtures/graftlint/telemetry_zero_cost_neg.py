@@ -23,3 +23,13 @@ def fit_loop(batches, step_fn, net):
             # guarded: the sync costs only when someone is watching
             monitor.span("train/loss_probe", loss=float(loss)).__enter__()
         monitor.counter("steps_total", "steps").inc()
+
+
+def scheduler_loop(reqs, step_fn, ctx, log):
+    for r in reqs:
+        step_fn(r)
+        # flight events from the HOST loop are the correct placement
+        monitor.flight.note(ctx, "admitted", slot=0)
+        # a non-flight object's .note()/.record() must not match
+        log.note("admitted")
+        log.record("something")

@@ -16,6 +16,14 @@ def step(params, x):
     return y
 
 
+@jax.jit
+def decode_step(params, x, ctx):
+    # a flight-recorder event in a compiled region records once at
+    # trace time — the black box would be blind at runtime
+    monitor.flight.note(ctx, "page_stall", slot=0)  # EXPECT
+    return params @ x
+
+
 def fit_loop(batches, step_fn):
     for b in batches:
         loss = step_fn(b)

@@ -389,14 +389,25 @@ def test_health_ready_swap_under_traffic_round_trip(registry):
         results = {"ok": 0, "fail": []}
         lock = threading.Lock()
         versions = set()
+        # event-gated so the traffic deterministically SPANS the swap
+        # window: on a fast box all 80 predicts used to finish before
+        # the swap landed (versions == {1}, flaky). Workers hold half
+        # their requests until the swap returned, and the rollback
+        # waits until some predict actually observed v2.
+        swap_live = threading.Event()
+        seen_v2 = threading.Event()
 
         def worker(k):
             for i in range(20):
+                if i == 10:
+                    swap_live.wait(timeout=60)
                 try:
                     code, out = _post(predict, bodies[(k + i) % 3])
                     with lock:
                         results["ok"] += 1
                         versions.add(out["version"])
+                    if out["version"] == 2:
+                        seen_v2.set()
                 except Exception as e:  # noqa: BLE001
                     with lock:
                         results["fail"].append(repr(e))
@@ -413,7 +424,8 @@ def test_health_ready_swap_under_traffic_round_trip(registry):
         code, _ = _post(f"{url}/v1/models/rt/swap",
                         json.dumps({"source": v2}).encode(), timeout=60)
         assert code == 200
-        time.sleep(0.05)
+        swap_live.set()
+        assert seen_v2.wait(timeout=60), "no predict observed v2 live"
         code, _ = _post(f"{url}/v1/models/rt/rollback", b"{}", timeout=60)
         assert code == 200
         for t in threads:

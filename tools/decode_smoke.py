@@ -196,6 +196,9 @@ def main(argv=None) -> int:
         "streams": args.requests * 2,
         "zero_5xx": five_xx == 0,
         "compiles": compiles, "warmups": warmups,
+        # slowest streams per class by client-minted trace_id: the
+        # banked TTFT/ITL percentiles point at reproducible traces
+        "slow_trace_ids": report.get("slowest"),
     }] + [{
         "mode": f"decode_quant_{variant}", "on_tpu": False, "batch": None,
         **quality[variant],

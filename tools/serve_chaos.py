@@ -59,10 +59,17 @@ def _metric_total(metrics: str, prefix: str, contains: str = "") -> float:
     return total
 
 
-def main() -> int:
+def main(argv=None) -> int:
+    import argparse
+
     import numpy as np
 
     from bench import cache_dir
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--bank-postmortem", default=None, metavar="PATH",
+                    help="copy the fault-window flight postmortem here "
+                         "(banked next to CHAOS_r*.json)")
+    cli = ap.parse_args(argv)
     from deeplearning4j_tpu.nn.conf.base import InputType
     from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
     from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
@@ -90,11 +97,18 @@ def main() -> int:
     from deeplearning4j_tpu.util.serialization import save_model
     save_model(net, model_zip)
 
+    # the always-on flight recorder: postmortems auto-dump into pm_dir
+    # when the faults below trip an SLO (breaker open, wedge detection)
+    from deeplearning4j_tpu.monitor import flight
+    pm_dir = os.path.join(tmp, "postmortems")
+    flight.enable_flight(capacity=512, dump_dir=pm_dir)
+
     env = dict(os.environ)
     env.setdefault("JAX_COMPILATION_CACHE_DIR", cache_dir())
     spec = ReplicaSpec([("m", model_zip)], buckets=(1, 8),
                        max_delay_ms=2.0, queue_limit=64,
-                       default_deadline_s=30.0, enable_faults=True)
+                       default_deadline_s=30.0, enable_faults=True,
+                       postmortem_dir=pm_dir)
     supervisor = ReplicaSupervisor(
         lambda i: SubprocessReplica(f"replica-{i}", spec, env=env),
         n_replicas=3, probe_interval_s=0.5, probe_timeout_s=2.0,
@@ -191,6 +205,7 @@ def main() -> int:
             "p99_ms": chaos_rep["latency_ms"]["p99"],
             "goodput_rps": chaos_rep["goodput_rps"],
             "per_class": chaos_rep.get("per_class"),
+            "slowest": chaos_rep.get("slowest"),
         }
         bad = {c: n for c, n in chaos.codes.items()
                if isinstance(c, int) and c >= 500 and c not in (503,)}
@@ -249,6 +264,82 @@ def main() -> int:
                     "serving_router_requests_total"):
             if fam not in metrics:
                 failures.append(f"/metrics missing {fam}")
+        for fam in ("serving_flight_records_total",
+                    "serving_flight_postmortems_total"):
+            if fam not in metrics:
+                failures.append(f"/metrics missing {fam}")
+
+        # ---------------- flight-recorder postmortems --------------------
+        # the fault window must have auto-dumped at least one postmortem
+        # that (a) names the faulted replica's generation and (b) holds
+        # the full timeline of at least one shed and one hedged request
+        pms = []
+        for fn in sorted(os.listdir(pm_dir)) if os.path.isdir(pm_dir) \
+                else []:
+            if fn.startswith("postmortem-") and fn.endswith(".json"):
+                with open(os.path.join(pm_dir, fn)) as f:
+                    pms.append((fn, json.load(f)))
+        summary["postmortems_dumped"] = [
+            {"file": fn, "reason": doc["reason"], "meta": doc["meta"]}
+            for fn, doc in pms]
+        if not pms:
+            failures.append("no flight postmortem auto-dumped during the "
+                            f"fault window (dir {pm_dir})")
+        faulted = summary.get("faults", {})
+        named_gen = [
+            (fn, doc) for fn, doc in pms
+            if (doc["reason"] == "replica_wedged"
+                and doc["meta"].get("replica") == faulted.get("wedged")
+                and doc["meta"].get("generation")
+                == faulted.get("wedged_gen"))
+            or (doc["reason"] == "breaker_open"
+                and doc["meta"].get("replica") in (faulted.get("killed"),
+                                                   faulted.get("wedged")))]
+        if pms and not named_gen:
+            failures.append(
+                "no postmortem names the killed/wedged replica "
+                f"generation: {[d['meta'] for _, d in pms]}")
+
+        def pm_evidence(doc):
+            recs = doc.get("records", []) + doc.get("live", [])
+            shed = [r for r in recs if r.get("outcome") == "shed_429"
+                    or any(e.get("event") == "shed"
+                           for e in r.get("events", []))]
+            hedged = [r for r in recs
+                      if any(e.get("event") == "hedge"
+                             for e in r.get("events", []))]
+            return shed, hedged
+
+        banked_pm = None
+        for fn, doc in reversed(named_gen or pms):
+            shed, hedged = pm_evidence(doc)
+            if shed and hedged:
+                banked_pm = (fn, doc, shed, hedged)
+                break
+        if pms and banked_pm is None:
+            # fall back to ANY dump carrying both timelines
+            for fn, doc in reversed(pms):
+                shed, hedged = pm_evidence(doc)
+                if shed and hedged:
+                    banked_pm = (fn, doc, shed, hedged)
+                    break
+        if pms and banked_pm is None:
+            failures.append(
+                "no postmortem holds both a shed and a hedged request "
+                "timeline")
+        if banked_pm is not None:
+            fn, doc, shed, hedged = banked_pm
+            summary["postmortem"] = {
+                "file": fn, "reason": doc["reason"], "meta": doc["meta"],
+                "n_records": doc["n_records"],
+                "shed_records": len(shed), "hedged_records": len(hedged),
+                "example_shed_trace": shed[-1].get("trace_id"),
+                "example_hedged_trace": hedged[-1].get("trace_id"),
+            }
+            if cli.bank_postmortem:
+                with open(cli.bank_postmortem, "w") as f:
+                    json.dump(doc, f, indent=1)
+                summary["postmortem"]["banked_as"] = cli.bank_postmortem
     finally:
         supervisor.stop()
         server.stop()
@@ -265,6 +356,11 @@ def main() -> int:
             "under_fault", {}).get("goodput_rps"),
         "chaos_recovered_p99_ms": summary.get(
             "recovered", {}).get("p99_ms"),
+        # the K slowest under-fault requests per class, by trace_id —
+        # a banked percentile points at reproducible traces, not just a
+        # number (server-side histogram exemplars carry the same ids)
+        "slow_trace_ids": summary.get("under_fault", {}).get("slowest"),
+        "postmortem": summary.get("postmortem", {}).get("file"),
     }]
     print(json.dumps(summary, indent=1))
     return 0 if not failures else 1

@@ -39,6 +39,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import threading
 import time
@@ -46,6 +47,14 @@ import urllib.error
 import urllib.request
 
 import numpy as np
+
+
+def mint_traceparent():
+    """(trace_id, traceparent header) minted client-side — the origin of
+    the request's cross-process trace. No library import needed: the
+    header is just the W3C wire shape the serving ingress adopts."""
+    trace_id = os.urandom(16).hex()
+    return trace_id, f"00-{trace_id}-{os.urandom(8).hex()}-01"
 
 
 def percentile(xs, p):
@@ -94,6 +103,8 @@ class LoadGen:
                        if args.deadline_ms else ""))
         self.lock = threading.Lock()
         self.latencies = {}             # class -> [seconds], 2xx only
+        self.traced = {}                # class -> [(seconds, trace_id)]
+        self.slow_k = int(getattr(args, "slow_k", 3) or 0)
         self.codes = {}
         self.class_codes = {}           # class -> {taxonomy: count}
         self.retries = 0
@@ -135,7 +146,7 @@ class LoadGen:
     def _class_of(self, i: int):
         return self.class_cycle[i % len(self.class_cycle)]
 
-    def _send(self, i: int):
+    def _send(self, i: int, traceparent=None):
         """One HTTP attempt: (code_or_'transport', latency_s,
         retry_after_s_or_None)."""
         body = self.bodies[i % len(self.bodies)]
@@ -143,6 +154,8 @@ class LoadGen:
         cls = self._class_of(i)
         if cls is not None:
             headers["X-Priority"] = cls
+        if traceparent is not None:
+            headers["traceparent"] = traceparent
         t0 = time.perf_counter()
         retry_after = None
         try:
@@ -159,7 +172,7 @@ class LoadGen:
             code = 0
         return code, time.perf_counter() - t0, retry_after
 
-    def _send_decode(self, i: int):
+    def _send_decode(self, i: int, traceparent=None):
         """One token-stream attempt: consume the SSE response as tokens
         arrive, measuring TTFT and every inter-token gap. A stream that
         never reaches its ``done`` event counts as a transport failure —
@@ -169,6 +182,8 @@ class LoadGen:
         cls = self._class_of(i)
         if cls is not None:
             headers["X-Priority"] = cls
+        if traceparent is not None:
+            headers["traceparent"] = traceparent
         t0 = time.perf_counter()
         retry_after = None
         ttft, itls, ntok, last, done = None, [], 0, None, False
@@ -202,7 +217,7 @@ class LoadGen:
         return code, time.perf_counter() - t0, retry_after, ttft, itls, ntok
 
     def _record(self, i: int, code, dt: float, ttft=None, itls=(),
-                ntok: int = 0):
+                ntok: int = 0, trace_id=None):
         cls = self._class_of(i) or "default"
         kind = classify(code if code != 0 else "transport")
         with self.lock:
@@ -213,6 +228,8 @@ class LoadGen:
                 self.class_codes[cls].get(kind, 0) + 1
             if isinstance(code, int) and 200 <= code < 300:
                 self.latencies.setdefault(cls, []).append(dt)
+                if trace_id is not None:
+                    self.traced.setdefault(cls, []).append((dt, trace_id))
                 if self.mode == "decode":
                     self.tokens += ntok
                     if ttft is not None:
@@ -220,26 +237,31 @@ class LoadGen:
                     if itls:
                         self.itls.setdefault(cls, []).extend(itls)
 
-    def _attempt(self, i: int):
+    def _attempt(self, i: int, traceparent=None, trace_id=None):
         """One wire attempt in the configured workload; returns
         (code, retry_after)."""
         if self.mode == "decode":
-            code, dt, retry_after, ttft, itls, ntok = self._send_decode(i)
-            self._record(i, code, dt, ttft=ttft, itls=itls, ntok=ntok)
+            code, dt, retry_after, ttft, itls, ntok = self._send_decode(
+                i, traceparent)
+            self._record(i, code, dt, ttft=ttft, itls=itls, ntok=ntok,
+                         trace_id=trace_id)
         else:
-            code, dt, retry_after = self._send(i)
-            self._record(i, code, dt)
+            code, dt, retry_after = self._send(i, traceparent)
+            self._record(i, code, dt, trace_id=trace_id)
         return code, retry_after
 
     def one_closed(self, i: int) -> bool:
         """One logical request, honoring Retry-After backpressure. Every
         ATTEMPT is recorded in the code histogram; returns True iff the
-        request ultimately succeeded."""
+        request ultimately succeeded. All attempts of one logical
+        request share ONE client-minted trace id, so a retried-then-slow
+        request reads as one story server-side."""
         with self.lock:
             self.issued += 1
+        trace_id, traceparent = mint_traceparent()
         attempts = 0
         while True:
-            code, retry_after = self._attempt(i)
+            code, retry_after = self._attempt(i, traceparent, trace_id)
             if isinstance(code, int) and 200 <= code < 300:
                 return True
             if code not in (429, 503) or attempts >= self.args.max_retries:
@@ -258,7 +280,8 @@ class LoadGen:
     def one_open(self, i: int) -> bool:
         with self.lock:
             self.issued += 1
-        code, _ = self._attempt(i)
+        trace_id, traceparent = mint_traceparent()
+        code, _ = self._attempt(i, traceparent, trace_id)
         return isinstance(code, int) and 200 <= code < 300
 
     def run_closed(self):
@@ -332,6 +355,15 @@ class LoadGen:
             "goodput_rps": round(ok / wall, 2) if wall > 0 else None,
             "latency_ms": _latency_stats(all_lat),
         }
+        if self.slow_k > 0:
+            # the K slowest successful requests per class, by trace_id:
+            # a banked percentile now points at reproducible traces
+            # (histogram exemplars server-side carry the same ids)
+            rep["slowest"] = {
+                cls: [{"trace_id": t, "ms": round(l * 1e3, 3)}
+                      for l, t in sorted(pairs, reverse=True)
+                      [:self.slow_k]]
+                for cls, pairs in sorted(self.traced.items())}
         if self.mode == "decode":
             all_ttft = [v for xs in self.ttfts.values() for v in xs]
             all_itl = [v for xs in self.itls.values() for v in xs]
@@ -418,6 +450,10 @@ def main(argv=None) -> int:
     p.add_argument("--deadline-ms", type=float, default=None)
     p.add_argument("--timeout-s", type=float, default=30.0)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--slow-k", type=int, default=3,
+                   help="report the trace_ids of the K slowest "
+                        "successful requests per priority class "
+                        "(0 disables)")
     args = p.parse_args(argv)
     args.batch_sizes = [int(b) for b in str(args.batch_sizes).split(",") if b]
     args.priority_mix = parse_priority_mix(args.priority_mix)

@@ -24,7 +24,17 @@ then asserts:
   holds >= 1 captured program with a fingerprint and flops,
 - the Chrome trace JSON loads, spans nest (train/step inside
   resilience/fit), xla/compile spans appear, and at least two distinct
-  thread tracks appear.
+  thread tracks appear,
+- traceparent propagation holds end-to-end through a live in-process
+  fleet (router + 2 replicas over real HTTP): the router-minted
+  trace_id comes back as X-Trace-Id AND appears in both a router span
+  and a replica-side serving span; a client-supplied traceparent is
+  adopted; the flight recorder exposes the request on
+  GET /v1/debug/flight (router-aggregated) and the
+  serving_flight_* / trace_* metric families are live,
+- tools/trace_report.py merges per-process segments into one valid
+  Perfetto document with distinct process tracks (pid collisions
+  remapped).
 
 Exit code 0 on success, 1 on failure; prints a JSON summary either way.
 """
@@ -65,6 +75,11 @@ GROUPS = {
 #: acceptance families the compiled-step observatory must expose
 XLA_REQUIRED = ("xla_compile_seconds", "xla_program_flops",
                 "xla_hbm_peak_bytes", "train_mfu_pct")
+
+#: request-tracing + flight-recorder families (docs/OBSERVABILITY.md
+#: "Tracing a single request")
+TRACE_REQUIRED = ("trace_contexts_minted_total",
+                  "serving_flight_records_total")
 
 #: top-level + per-program keys of the persisted perf-ledger schema
 LEDGER_KEYS = ("version", "created_unix", "device_kind", "backend",
@@ -200,6 +215,88 @@ def main(argv=None) -> int:
     # ---- transport -----------------------------------------------------
     _transport_exchange(failures)
 
+    # ---- serving fleet: traceparent propagation + flight recorder ------
+    from deeplearning4j_tpu.monitor import flight
+    from deeplearning4j_tpu.serving.fleet import (
+        InProcessReplica, ReplicaSpec, ReplicaSupervisor,
+    )
+    from deeplearning4j_tpu.serving.router import (
+        ResilientRouter, RouterServer,
+    )
+    flight.enable_flight(capacity=64, dump_dir=os.path.join(
+        os.path.dirname(trace_path), "postmortems"))
+    serve_net = _net(seed=7)
+    spec = ReplicaSpec([("m", serve_net)], buckets=(1, 8),
+                       max_delay_ms=1.0)
+    supervisor = ReplicaSupervisor(
+        lambda i: InProcessReplica(f"replica-{i}", spec), n_replicas=2,
+        probe_interval_s=0.5)
+    supervisor.start()
+    router = ResilientRouter(supervisor.healthy, hedge=False)
+    rserver = RouterServer(router, supervisor=supervisor, port=0)
+    try:
+        body = json.dumps(
+            {"inputs": rs.rand(2, 6).astype("float32").tolist()}).encode()
+        # 1) no client header: the ROUTER mints the context
+        r = urllib.request.urlopen(urllib.request.Request(
+            rserver.url + "/v1/models/m/predict", data=body,
+            headers={"Content-Type": "application/json",
+                     "X-Priority": "interactive"}), timeout=30)
+        r.read()
+        minted = r.headers.get("X-Trace-Id")
+        summary["router_minted_trace_id"] = minted
+        if r.status != 200:
+            failures.append(f"fleet predict answered {r.status}")
+        if not minted:
+            failures.append("router response carries no X-Trace-Id")
+        # 2) client-supplied traceparent is ADOPTED, not replaced
+        client_tid = "ab" * 16
+        r = urllib.request.urlopen(urllib.request.Request(
+            rserver.url + "/v1/models/m/predict", data=body,
+            headers={"Content-Type": "application/json",
+                     "traceparent": f"00-{client_tid}-{'cd' * 8}-01"}),
+            timeout=30)
+        r.read()
+        if r.headers.get("X-Trace-Id") != client_tid:
+            failures.append(
+                "client traceparent not adopted: X-Trace-Id "
+                f"{r.headers.get('X-Trace-Id')} != {client_tid}")
+        # 3) ONE trace_id spans router AND replica-side serving spans
+        events = [e for e in monitor.trace_events()
+                  if e.get("ph") == "X" and minted
+                  and (e.get("args") or {}).get("trace_id") == minted]
+        names = {e["name"] for e in events}
+        summary["propagated_span_names"] = sorted(names)
+        if "serving/route" not in names:
+            failures.append("router-minted id missing from the "
+                            "serving/route span")
+        if not names & {"serving/request", "serving/batch",
+                        "serving/queue_wait"}:
+            failures.append(
+                "router-minted id never reached a replica-side span "
+                f"(got {sorted(names)}) — traceparent propagation broke")
+        # 4) the router-aggregated flight endpoint shows the request
+        fdoc = json.loads(urllib.request.urlopen(
+            rserver.url + "/v1/debug/flight", timeout=10).read())
+        router_recs = fdoc.get("router", {}).get("records", [])
+        if minted and not any(rec.get("trace_id") == minted
+                              for rec in router_recs):
+            failures.append("router flight ring has no record for the "
+                            "minted trace_id")
+        if len(fdoc.get("replicas", {})) != 2:
+            failures.append("router /v1/debug/flight did not aggregate "
+                            "both replicas")
+        elif minted and not any(
+                rec.get("trace_id") == minted
+                for rep in fdoc["replicas"].values()
+                for rec in rep.get("records", [])):
+            failures.append("no replica flight record carries the "
+                            "minted trace_id")
+        summary["flight_router_records"] = len(router_recs)
+    finally:
+        supervisor.stop()
+        rserver.stop()
+
     # ---- /metrics scrape ----------------------------------------------
     server = UIServer(port=0)
     try:
@@ -217,6 +314,9 @@ def main(argv=None) -> int:
         if not any(f.startswith(pre) for f in families for pre in prefixes):
             failures.append(f"no {group} metrics in /metrics exposition")
     for fam in XLA_REQUIRED:
+        if fam not in families:
+            failures.append(f"{fam} missing from /metrics exposition")
+    for fam in TRACE_REQUIRED:
         if fam not in families:
             failures.append(f"{fam} missing from /metrics exposition")
 
@@ -297,6 +397,38 @@ def main(argv=None) -> int:
                             "(train + prefetch/inference workers)")
     except (OSError, ValueError, KeyError) as e:
         failures.append(f"trace file invalid: {type(e).__name__}: {e}")
+
+    # ---- merged-trace validity (tools/trace_report.py) -----------------
+    # simulate the fleet layout: this process's saved trace plus a
+    # second "replica" segment whose pid COLLIDES — the merge must
+    # remap pids, name both process tracks, and stay JSON-valid
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import trace_report
+    seg2_path = os.path.join(os.path.dirname(trace_path), "segment2.json")
+    with open(seg2_path, "w") as f:
+        json.dump({"traceEvents": [
+            {"name": "serving/request", "ph": "X", "ts": 1.0, "dur": 5.0,
+             "pid": os.getpid(), "tid": 1,
+             "args": {"trace_id": "ff" * 16}}]}, f)
+    try:
+        merged = trace_report.merge_trace_files(
+            [("router", trace_path), ("replica", seg2_path)])
+        json.loads(json.dumps(merged))        # round-trip validity
+        procs = {e.get("pid") for e in merged["traceEvents"]}
+        pnames = [e for e in merged["traceEvents"]
+                  if e.get("ph") == "M" and e.get("name") == "process_name"]
+        summary["merged_process_tracks"] = len(pnames)
+        if len(procs) < 2 or len(pnames) < 2:
+            failures.append(
+                f"merged trace did not keep 2 process tracks apart "
+                f"(pids {sorted(procs)}, {len(pnames)} names) — pid "
+                "collision remap broke")
+        if not trace_report.events_for_trace(merged, "ff" * 16):
+            failures.append("merged trace lost the replica segment's "
+                            "trace_id-carrying span")
+    except (OSError, ValueError, KeyError) as e:
+        failures.append(f"trace_report merge failed: "
+                        f"{type(e).__name__}: {e}")
 
     summary["failures"] = failures
     summary["ok"] = not failures

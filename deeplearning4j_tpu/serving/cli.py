@@ -76,6 +76,18 @@ def build_parser() -> argparse.ArgumentParser:
                      help="prefill sequence-length ladder (comma ints, "
                           "page-aligned; default: geometric up to "
                           "max_context)")
+    dec.add_argument("--prefill-chunk-tokens", type=int, default=None,
+                     help="per-scheduler-tick prefill-token budget: long "
+                          "uncached prompt suffixes run in chunks of at "
+                          "most this many tokens BETWEEN decode steps, so "
+                          "one long prompt cannot stall every stream's "
+                          "inter-token latency (default: 4 pages; 0 "
+                          "disables chunking)")
+    dec.add_argument("--no-prefix-cache", action="store_true",
+                     help="disable copy-on-write KV prefix sharing "
+                          "(radix-indexed page reuse across requests "
+                          "with a common prompt prefix; on by default — "
+                          "greedy outputs are identical either way)")
     p.add_argument("--host", default="127.0.0.1",
                    help="bind address (0.0.0.0 behind a load balancer)")
     p.add_argument("--port", type=int, default=8500)
@@ -278,7 +290,9 @@ def _decode_config(args):
                         max_context=args.decode_max_context,
                         pool_pages=args.decode_pool_pages,
                         prefill_buckets=prefill,
-                        queue_limit=args.decode_queue_limit)
+                        queue_limit=args.decode_queue_limit,
+                        prefix_cache=not args.no_prefix_cache,
+                        prefill_chunk_tokens=args.prefill_chunk_tokens)
 
 
 def _main_fleet(args, specs, lm_specs, buckets, decode_cfg) -> int:

@@ -47,7 +47,7 @@ import logging
 import queue
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -96,6 +96,15 @@ class DecodeConfig:
     queue_limit: int = 64                # pending-join bound (full -> 429)
     max_new_tokens_cap: int = 1024       # server-side generation ceiling
     seed: int = 0                        # sampling PRNG stream
+    #: share KV pages across requests with a common prompt prefix
+    #: (radix-indexed, copy-on-write; released prefixes retained LRU)
+    prefix_cache: bool = True
+    #: per-scheduler-tick prefill-token budget: long uncached suffixes
+    #: split into chunks of at most this many tokens, executed BETWEEN
+    #: decode steps so one long prompt cannot stall every in-flight
+    #: stream's inter-token latency. None = auto (4 pages); 0 = off
+    #: (whole suffix in one program call, the pre-chunking behavior)
+    prefill_chunk_tokens: Optional[int] = None
 
 
 class GenerateRequest:
@@ -120,6 +129,11 @@ class GenerateRequest:
         self.n_emitted = 0
         self.version: Optional[int] = None
         self.finish_reason: Optional[str] = None
+        #: prompt positions served from the shared prefix cache (set at
+        #: admission) and prefill program executions it took to cover
+        #: the uncached suffix (set when prefill completes)
+        self.cached_tokens = 0
+        self.prefill_chunks = 0
         self.cancelled = threading.Event()
         self.done = threading.Event()
         # the submitting thread's trace context (the HTTP handler binds
@@ -148,6 +162,8 @@ class GenerateRequest:
             "finish_reason": reason,
             "tokens": self.n_emitted,
             "version": self.version,
+            "cached_tokens": self.cached_tokens,
+            "prefill_chunks": self.prefill_chunks,
         }))
 
     def fail(self, exc: Exception):
@@ -257,7 +273,20 @@ class DecodeEngine:
             else jnp.float32
         self.cache = kvcache.KVCacheState(
             cfg.slots, cfg.page_size, self.max_context,
-            pool_pages=cfg.pool_pages, name=name)
+            pool_pages=cfg.pool_pages, name=name,
+            prefix_cache=cfg.prefix_cache)
+        # per-tick prefill-token budget (page-aligned, rounded up): None
+        # = auto (4 pages), <= 0 = chunking off
+        if cfg.prefill_chunk_tokens is None:
+            self.prefill_chunk_tokens = min(4 * cfg.page_size,
+                                            self.max_context)
+        elif cfg.prefill_chunk_tokens <= 0:
+            self.prefill_chunk_tokens = 0
+        else:
+            self.prefill_chunk_tokens = min(
+                self.max_context,
+                ((int(cfg.prefill_chunk_tokens) + cfg.page_size - 1)
+                 // cfg.page_size) * cfg.page_size)
         pool_shape = (self.n_layers, self.cache.pool_pages,
                       cfg.page_size, self.n_heads, self.head_dim)
         self._kpool = jnp.zeros(pool_shape, self._dtype)
@@ -281,6 +310,8 @@ class DecodeEngine:
         self._closed = False
         self._decode_jit = jax.jit(self._decode_fn, donate_argnums=(1, 2))
         self._prefill_jit = jax.jit(self._prefill_fn, donate_argnums=(1, 2))
+        self._chunk_jit = jax.jit(self._chunk_fn, donate_argnums=(1, 2))
+        self._copy_jit = jax.jit(kvcache.copy_page, donate_argnums=(0, 1))
         self._logits_jit = jax.jit(self._logits_fn)
 
     # --------------------------------------------------------- the forward
@@ -372,6 +403,44 @@ class DecodeEngine:
         h = qdot(h, p["W2"]) + p["b2"]
         return x + h, kpool, vpool
 
+    def _block_chunk(self, conf, p, li, x, kpool, vpool, page_row, pos,
+                     valid, start, mask):
+        """Incremental block forward for a prefill CHUNK: Tb suffix
+        tokens of ONE slot at absolute positions `pos` (= start +
+        arange), attending the paged cache — cached prefix pages AND the
+        chunk's own rows, written first. Chunks may start mid-page (the
+        COW divergence recompute does), so rows scatter by absolute
+        (page, offset), padding rows steered to the dump page."""
+        h, _ = _LN.apply(p["ln1"], {}, x)
+        a = p["attn"]
+        q = _split_heads(qdot(h, a["Wq"]), conf.n_heads)
+        k = _split_heads(qdot(h, a["Wk"]), conf.n_heads)
+        v = _split_heads(qdot(h, a["Wv"]), conf.n_heads)
+        if conf.use_rope:
+            q = rope(q, pos[None])
+            k = rope(k, pos[None])
+        ps = self.cfg.page_size
+        page_idx = jnp.clip(pos // ps, 0, page_row.shape[0] - 1)
+        phys = jnp.where(valid, page_row[page_idx], kvcache.DUMP_PAGE)
+        kpool, vpool = kvcache.write_chunk_kv(
+            kpool, vpool, li, k[0], v[0], phys, pos % ps)
+        keys, vals = kvcache.gather_kv(kpool, vpool, li, page_row[None],
+                                       self.max_context)
+        # validity is pure causality: every cached position < a query's
+        # absolute position was written (by a donor prefill, an earlier
+        # chunk, or this chunk's own scatter above); positions >= end sit
+        # past every valid query and the causal mask excludes them
+        out = dot_product_attention(q, keys, vals, mask=None, causal=True,
+                                    q_offset=start)
+        y = qdot(_merge_heads(out), a["Wo"])
+        y = y * mask[..., None].astype(y.dtype)
+        x = x + y
+        h, _ = _LN.apply(p["ln2"], {}, x)
+        h = get_activation(conf.activation)(qdot(h, p["W1"]) + p["b1"])
+        h = qdot(h, p["W2"]) + p["b2"]
+        y = x + h
+        return y * mask[..., None].astype(y.dtype), kpool, vpool
+
     # ----------------------------------------------------------- sampling
     def _sample(self, logits, temps, topks, counter):
         """Greedy / temperature / top-k, per slot, in-graph (Gumbel-max:
@@ -402,6 +471,45 @@ class DecodeEngine:
             kpool, vpool = kvcache.write_prompt_kv(
                 kpool, vpool, li, k[0], v[0], page_row, self.cfg.page_size)
         last = jnp.take(logits[0], length - 1, axis=0)
+        tok = self._sample(last[None], temp[None], topk[None], counter)[0]
+        return kpool, vpool, tok, last
+
+    def _chunk_fn(self, params, kpool, vpool, tokens, start, end, page_row,
+                  temp, topk, counter):
+        """Suffix-chunk prefill: tokens (1, Tb) are prompt positions
+        [start, end) of one slot (bucket-padded past end - start), with
+        everything before `start` already cached in the slot's pages
+        (shared prefix and/or earlier chunks). start/end are traced
+        scalars — ONE compiled program per bucket serves every cache-hit
+        length and every chunk of the ladder. Returns (kpool, vpool,
+        sampled token (), last-valid-position logits (V,)) — the sample
+        is only meaningful on the final chunk (end == prompt length)."""
+        tb = tokens.shape[1]
+        pos = start + jnp.arange(tb)
+        valid = pos < end
+        mask = valid.astype(jnp.float32)[None]          # (1, Tb)
+        x = None
+        for kind, layer, key in self._plan:
+            p = params[key]
+            if kind == "embed":
+                x = qtake(p["W"], tokens)
+                x = x * mask[..., None].astype(x.dtype)
+            elif kind == "posembed":
+                idx = jnp.clip(pos, 0, layer.max_length - 1)
+                x = x + jnp.take(p["P"], idx, axis=0)[None]
+            elif kind == "pertoken":
+                x, _ = layer.apply(p, {}, x, train=False, rng=None,
+                                   mask=mask)
+            elif kind == "block":
+                x, kpool, vpool = self._block_chunk(
+                    layer, p, self._block_index[key], x, kpool, vpool,
+                    page_row, pos, valid, start, mask)
+            else:                                       # head
+                z = qdot(x, p["W"])
+                if "b" in p:
+                    z = z + p["b"]
+                x = z
+        last = jnp.take(x[0], jnp.clip(end - 1 - start, 0, tb - 1), axis=0)
         tok = self._sample(last[None], temp[None], topk[None], counter)[0]
         return kpool, vpool, tok, last
 
@@ -464,6 +572,13 @@ class DecodeEngine:
         t0 = time.perf_counter()
         dump_row = np.full((self.cache.pages_per_slot,),
                            kvcache.DUMP_PAGE, np.int32)
+        # one handle, one help string: the registry is first-caller-wins
+        # on help text, so retyping it per warmup site invites the
+        # /metrics-vs-docs drift this family's ledger exists to prevent
+        warmups = monitor.counter(
+            "serving_decode_warmup_runs_total",
+            "AOT decode-runtime warmup executions (one per program per "
+            "engine generation)", labels=("model",))
         for tb in self.prefill_buckets:
             self._meter_program(f"prefill_{tb}", warmup=True)
             with monitor.span("serving/prefill", model=self.name,
@@ -472,10 +587,27 @@ class DecodeEngine:
                     self._params, self._kpool, self._vpool,
                     np.zeros((1, tb), np.int32), np.int32(1), dump_row,
                     np.float32(0), np.int32(0), np.uint32(0))
-            monitor.counter("serving_decode_warmup_runs_total",
-                            "AOT decode-runtime warmup executions (one "
-                            "per program per engine generation)",
-                            labels=("model",)).inc(model=self.name)
+            warmups.inc(model=self.name)
+        # the chunk ladder: suffix prefill after a cache hit and budgeted
+        # chunks of a long prompt run through these — same buckets, one
+        # extra program each (start/end are operands, not shapes)
+        for tb in self.prefill_buckets:
+            self._meter_program(f"chunk_{tb}", warmup=True)
+            with monitor.span("serving/prefill_chunk", model=self.name,
+                              bucket=tb, warmup=1):
+                self._kpool, self._vpool, _, _ = self._chunk_jit(
+                    self._params, self._kpool, self._vpool,
+                    np.zeros((1, tb), np.int32), np.int32(0), np.int32(1),
+                    dump_row, np.float32(0), np.int32(0), np.uint32(0))
+            warmups.inc(model=self.name)
+        # the COW page copy (dump -> dump during warmup: page 0 is
+        # garbage by contract, so the no-op-shaped copy is safe)
+        self._meter_program("cow_copy", warmup=True)
+        with monitor.span("serving/kv_cow", model=self.name, warmup=1):
+            self._kpool, self._vpool = self._copy_jit(
+                self._kpool, self._vpool, np.int32(kvcache.DUMP_PAGE),
+                np.int32(kvcache.DUMP_PAGE))
+        warmups.inc(model=self.name)
         self._meter_program("decode", warmup=True)
         with monitor.span("serving/decode_step", model=self.name, warmup=1):
             s = self.cfg.slots
@@ -485,10 +617,7 @@ class DecodeEngine:
                 np.zeros((s,), np.int32), np.zeros((s,), np.int32),
                 np.zeros((s,), bool), np.zeros((s,), np.float32),
                 np.zeros((s,), np.int32), np.uint32(0))
-        monitor.counter("serving_decode_warmup_runs_total",
-                        "AOT decode-runtime warmup executions (one per "
-                        "program per engine generation)",
-                        labels=("model",)).inc(model=self.name)
+        warmups.inc(model=self.name)
         monitor.histogram(
             "serving_decode_warmup_seconds",
             "Full decode-runtime warmup duration (buckets + step)",
@@ -502,6 +631,62 @@ class DecodeEngine:
             if n <= b:
                 return b
         return self.prefill_buckets[-1]
+
+    def admit_prompt(self, prompt: np.ndarray
+                     ) -> Optional[kvcache.AdmitInfo]:
+        """Token-aware admission: claim a slot, map the longest cached
+        prefix read-shared, and resolve any copy-on-write divergence
+        on-device (the forced last-token recompute of a fully-cached
+        page-aligned prompt writes into a private page copy, never into
+        the shared one). None when slots/pages are exhausted."""
+        info = self.cache.admit_prompt(prompt)
+        if info is None:
+            return None
+        if info.cow_src is not None:
+            try:
+                self._meter_program("cow_copy", warmup=False)
+                with monitor.span("serving/kv_cow", model=self.name):
+                    self._kpool, self._vpool = self._copy_jit(
+                        self._kpool, self._vpool, np.int32(info.cow_src),
+                        np.int32(info.cow_dst))
+            except Exception:
+                # a failed copy must not leak the slot or the pinned
+                # source page — undo the admission before surfacing
+                self.cache.release(info.slot)
+                self.cache.unref_page(info.cow_src)
+                raise
+            self.cache.unref_page(info.cow_src)
+        return info
+
+    def prefill_chunk(self, slot: int, prompt: np.ndarray, start: int,
+                      n: int, temperature: float, top_k: int) -> int:
+        """Run prompt positions [start, start+n) through the paged-cache
+        chunk program into `slot`'s pages (everything before `start` is
+        already cached there). Returns the sampled token — meaningful
+        only when this was the final chunk (start+n == len(prompt))."""
+        tb = self.bucket_for(n)
+        toks = np.zeros((1, tb), np.int32)
+        toks[0, :n] = prompt[start:start + n]
+        self._temps[slot] = temperature
+        self._topks[slot] = top_k
+        self._counter += 1
+        self._meter_program(f"chunk_{tb}", warmup=False)
+        with monitor.span("serving/prefill_chunk", model=self.name,
+                          bucket=tb, tokens=n):
+            self._kpool, self._vpool, tok, _ = self._chunk_jit(
+                self._params, self._kpool, self._vpool, toks,
+                np.int32(start), np.int32(start + n),
+                self.cache.page_table[slot].copy(),
+                np.float32(temperature), np.int32(top_k),
+                np.uint32(self._counter & 0xFFFFFFFF))
+        monitor.counter("serving_decode_prefills_total",
+                        "Prefill program executions by bucket size "
+                        "(chunk_* buckets are suffix/chunked prefills)",
+                        labels=("model", "bucket")).inc(
+            model=self.name, bucket=f"chunk_{tb}")
+        tok = int(tok)
+        self._last_tokens[slot] = tok
+        return tok
 
     def prefill(self, slot: int, prompt: np.ndarray, temperature: float,
                 top_k: int) -> Tuple[int, np.ndarray]:
@@ -522,21 +707,26 @@ class DecodeEngine:
                 np.float32(temperature), np.int32(top_k),
                 np.uint32(self._counter & 0xFFFFFFFF))
         monitor.counter("serving_decode_prefills_total",
-                        "Prompt prefills by bucket size",
+                        "Prefill program executions by bucket size "
+                        "(chunk_* buckets are suffix/chunked prefills)",
                         labels=("model", "bucket")).inc(
             model=self.name, bucket=str(tb))
         tok = int(tok)
         self._last_tokens[slot] = tok
         return tok, np.asarray(logits, np.float32)
 
-    def step(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    def step(self, exclude=()) -> Tuple[np.ndarray, np.ndarray,
+                                        np.ndarray]:
         """One decode iteration over every runnable slot. Returns
         (sampled tokens (S,), runnable mask (S,), logits (S, V)); slots
-        not in the mask were inactive, page-stalled, or at the context
-        cap and produced garbage."""
+        not in the mask were inactive, excluded (mid-prefill), page-
+        stalled, or at the context cap and produced garbage."""
         act = np.zeros((self.cfg.slots,), bool)
+        excl = frozenset(int(s) for s in exclude)
         n_runnable = 0
         for s in self.cache.active_slots():
+            if s in excl:
+                continue                # prefill still in flight
             if self.cache.ensure_page(s):
                 act[s] = True
                 n_runnable += 1
@@ -578,24 +768,41 @@ class DecodeEngine:
         d.update({"prefill_buckets": list(self.prefill_buckets),
                   "quantize": self.cfg.quantize,
                   "vocab_size": self.vocab,
-                  "n_layers": self.n_layers})
+                  "n_layers": self.n_layers,
+                  "prefill_chunk_tokens": self.prefill_chunk_tokens})
         return d
 
 
 # ==========================================================================
 # The scheduler: iteration-level admission over one or more engines
 # ==========================================================================
+class _PrefillJob:
+    """Admission-to-first-token state for one slot: the uncached suffix
+    [pos, len(prompt)) still to prefill, executed in budgeted chunks
+    between decode steps (head-of-line-free prefill)."""
+
+    __slots__ = ("req", "pos", "chunks")
+
+    def __init__(self, req: GenerateRequest, pos: int):
+        self.req = req
+        self.pos = pos
+        self.chunks = 0
+
+
 class _EngineRun:
     """A live engine + the requests bound to its slots. `admitting` is
-    True only for the newest engine; older runs drain and retire."""
+    True only for the newest engine; older runs drain and retire.
+    `prefill` holds slots whose suffix prefill is still chunking (FIFO:
+    insertion order is admission order)."""
 
-    __slots__ = ("engine", "version", "admitting", "slot_req")
+    __slots__ = ("engine", "version", "admitting", "slot_req", "prefill")
 
     def __init__(self, engine: DecodeEngine, version: int):
         self.engine = engine
         self.version = version
         self.admitting = True
         self.slot_req: Dict[int, GenerateRequest] = {}
+        self.prefill: "OrderedDict[int, _PrefillJob]" = OrderedDict()
 
 
 class DecodeScheduler:
@@ -658,7 +865,8 @@ class DecodeScheduler:
 
     def inflight(self) -> int:
         with self._rlock:
-            return sum(len(r.slot_req) for r in self._runs)
+            return sum(len(r.slot_req) + len(r.prefill)
+                       for r in self._runs)
 
     def admitting_engine(self) -> Optional[DecodeEngine]:
         with self._rlock:
@@ -672,6 +880,7 @@ class DecodeScheduler:
         while not self._stop.is_set():
             try:
                 worked = self._admit()
+                worked = self._prefill_tick() or worked
                 worked = self._step_all() or worked
                 self._retire()
             except Exception as e:      # noqa: BLE001 — the scheduler
@@ -694,6 +903,9 @@ class DecodeScheduler:
             runs = list(self._runs)
             self._runs.clear()
         for run in runs:
+            for slot, job in run.prefill.items():
+                run.engine.cache.release(slot)
+                job.req.fail(exc)
             for slot, req in run.slot_req.items():
                 run.engine.cache.release(slot)
                 req.fail(exc)
@@ -748,44 +960,53 @@ class DecodeScheduler:
                     f"{len(req.prompt)} leaves no room to generate "
                     f"(live max_context {run.engine.max_context})"))
                 continue
-            slot = run.engine.cache.admit(len(req.prompt))
-            if slot is None:
+            try:
+                info = run.engine.admit_prompt(req.prompt)
+            except Exception as e:          # noqa: BLE001 — surfaced to req
+                self._pop(req)
+                log.exception("decode[%s]: admission failed", self.name)
+                req.fail(e)
+                continue
+            if info is None:
                 break                       # no slot/pages; retry next tick
             self._pop(req)
-            joined_running = bool(run.slot_req) or self.inflight() > 0
+            # admission is now CHEAP (page-table writes + at most one COW
+            # page copy; the suffix prefill runs in budgeted chunks on
+            # the next _prefill_tick), so this loop keeps draining the
+            # join queue until slots, pages or the queue are exhausted —
+            # when a token step frees several slots at once, a burst of
+            # queued joins lands in ONE tick, not one per step
+            slot = info.slot
+            req.cached_tokens = int(info.cached_len)
+            # "joined a RUNNING batch" counts decoding streams only —
+            # same-burst admissions still mid-prefill are not a batch
+            # this request preempted into (inflight() would count them
+            # and let the smoke's joins>0 gate pass on a workload where
+            # continuous batching never engaged)
+            with self._rlock:
+                joined_running = any(r.slot_req for r in self._runs)
             if flight.enabled():
                 # admission wait + the engine generation whose params
                 # will write this stream's KV (the swap-generation fact
-                # a postmortem needs)
+                # a postmortem needs) + how much prefill the prefix
+                # cache just made free
                 flight.note(req.ctx, "admitted", slot=slot,
                             engine_version=run.version,
                             wait_ms=round(
                                 (time.monotonic() - req.enqueued) * 1e3,
                                 3),
                             joined_running=joined_running,
+                            cached_tokens=int(info.cached_len),
+                            cow=info.cow_src is not None,
                             model=self.name)
-            try:
-                # bind the stream's context so the prefill span (and any
-                # first-compile ledger capture inside it) carries its
-                # trace_id
-                with monitor.bind_context(req.ctx):
-                    tok, _ = run.engine.prefill(slot, req.prompt,
-                                                req.temperature,
-                                                req.top_k)
-            except Exception as e:          # noqa: BLE001 — surfaced to req
-                run.engine.cache.release(slot)
-                log.exception("decode[%s]: prefill failed", self.name)
-                req.fail(e)
-                continue
             req.version = run.version
-            run.slot_req[slot] = req
+            run.prefill[slot] = _PrefillJob(req, int(info.cached_len))
             if joined_running:
                 monitor.counter(
                     "serving_decode_preempted_joins_total",
                     "Requests admitted into an already-running batch "
                     "between token steps (continuous batching)",
                     labels=("model",)).inc(model=self.name)
-            self._emit(run, slot, req, tok)
             worked = True
         with self._plock:
             depth = len(self._pending)
@@ -798,6 +1019,90 @@ class DecodeScheduler:
         with self._plock:
             if self._pending and self._pending[0] is req:
                 self._pending.popleft()
+
+    def _prefill_tick(self) -> bool:
+        """Advance every in-flight prefill by at most the engine's
+        per-tick token budget (FIFO across that engine's jobs), then
+        return to the loop so a decode step can interleave — a long
+        prompt costs the running streams one bounded chunk of ITL, never
+        its whole prefill. Chunking off (budget 0) completes each job in
+        a single program call. The final chunk yields the first token."""
+        with self._rlock:
+            runs = [r for r in self._runs if r.prefill]
+        worked = False
+        for run in runs:
+            budget = run.engine.prefill_chunk_tokens
+            spent = 0
+            for slot in list(run.prefill.keys()):
+                job = run.prefill.get(slot)
+                if job is None:
+                    continue
+                req = job.req
+                if req.cancelled.is_set():
+                    run.prefill.pop(slot, None)
+                    self._finish(run, slot, req, "cancelled")
+                    worked = True
+                    continue
+                if req.deadline is not None \
+                        and time.monotonic() > req.deadline:
+                    run.prefill.pop(slot, None)
+                    self._finish(run, slot, req, "deadline")
+                    worked = True
+                    continue
+                total = len(req.prompt)
+                tok = None
+                try:
+                    # bind the stream's context so prefill spans (and any
+                    # first-compile ledger capture inside) carry its
+                    # trace_id
+                    with monitor.bind_context(req.ctx):
+                        while job.pos < total:
+                            if budget > 0 and spent >= budget:
+                                break
+                            n = total - job.pos if budget <= 0 \
+                                else min(total - job.pos, budget - spent)
+                            if job.pos == 0 and n == total:
+                                # cold, whole prompt within budget: the
+                                # dense program (bitwise the pre-cache
+                                # path; also what cache-off runs)
+                                tok, _ = run.engine.prefill(
+                                    slot, req.prompt, req.temperature,
+                                    req.top_k)
+                            else:
+                                tok = run.engine.prefill_chunk(
+                                    slot, req.prompt, job.pos, n,
+                                    req.temperature, req.top_k)
+                            job.pos += n
+                            job.chunks += 1
+                            spent += n
+                            worked = True
+                except Exception as e:  # noqa: BLE001 — surfaced to req
+                    run.prefill.pop(slot, None)
+                    run.engine.cache.release(slot)
+                    log.exception("decode[%s]: prefill failed", self.name)
+                    req.fail(e)
+                    continue
+                if job.pos >= total:
+                    run.prefill.pop(slot, None)
+                    req.prefill_chunks = job.chunks
+                    # prefill complete: every mapped prompt page holds
+                    # final K/V — only now may the prefix index share it
+                    run.engine.cache.register_prefix(slot, req.prompt)
+                    run.slot_req[slot] = req
+                    monitor.histogram(
+                        "serving_decode_prefill_chunks",
+                        "Prefill program executions per admission "
+                        "(1 = unchunked; higher = budgeted chunking "
+                        "interleaved with decode steps)",
+                        labels=("model",),
+                        buckets=(1, 2, 3, 4, 6, 8, 12, 16, 24, 32)
+                    ).observe(job.chunks, model=self.name)
+                    flight.note(req.ctx, "prefill_done",
+                                chunks=job.chunks,
+                                cached_tokens=req.cached_tokens,
+                                model=self.name)
+                    self._emit(run, slot, req, tok)
+        return worked
 
     def _emit(self, run: _EngineRun, slot: int, req: GenerateRequest,
               tok: int):
@@ -869,7 +1174,7 @@ class DecodeScheduler:
             runs = [r for r in self._runs if r.slot_req]
         worked = False
         for run in runs:
-            toks, act, _ = run.engine.step()
+            toks, act, _ = run.engine.step(exclude=run.prefill.keys())
             for slot, req in list(run.slot_req.items()):
                 if act[slot]:
                     self._emit(run, slot, req, int(toks[slot]))
@@ -900,7 +1205,8 @@ class DecodeScheduler:
         with self._rlock:
             keep = []
             for run in self._runs:
-                if not run.admitting and not run.slot_req:
+                if not run.admitting and not run.slot_req \
+                        and not run.prefill:
                     run.engine.close()
                     log.info("decode[%s]: retired engine v%d (drained)",
                              self.name, run.version)

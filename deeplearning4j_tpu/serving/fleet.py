@@ -248,6 +248,11 @@ class SubprocessReplica(Replica):
             if d.prefill_buckets:
                 argv += ["--prefill-buckets",
                          ",".join(str(b) for b in d.prefill_buckets)]
+            if d.prefill_chunk_tokens is not None:
+                argv += ["--prefill-chunk-tokens",
+                         str(d.prefill_chunk_tokens)]
+            if not d.prefix_cache:
+                argv.append("--no-prefix-cache")
         if self.spec.enable_faults:
             argv.append("--enable-fault-injection")
         if self.spec.trace_out:

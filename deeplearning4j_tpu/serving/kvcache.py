@@ -16,22 +16,52 @@ AOT-warm serving contract of `serving/batcher.py`:
   running batch) owns a row of ``max_context // page_size`` physical page
   ids. Logical position ``t`` of a sequence lives at physical page
   ``table[t // page_size]``, offset ``t % page_size`` — pages are
-  allocated on demand as the sequence crosses page boundaries and returned
-  the moment the sequence finishes.
+  allocated on demand as the sequence crosses page boundaries.
 - **The dump page.** Physical page 0 is never allocated. Fixed-shape
   scatter writes from *inactive* slots and from prompt-padding positions
   are steered to page 0, so the compiled step never needs a dynamic shape
   or a conditional write — garbage goes to a page nobody reads.
 
-The host side (`KVCacheState`) is plain numpy + a free list: allocation
+Page sharing — carrying the virtual-memory analogy to completion
+(RadixAttention/SGLang over PagedAttention/vLLM):
+
+- **Refcounts + a radix index.** Every full, page-aligned block of prompt
+  tokens is keyed by its token bytes in a trie rooted at the empty prefix;
+  a trie node maps that block (in the context of its ancestors) to the one
+  canonical physical page holding its K/V. ``admit_prompt()`` walks the
+  trie over the new prompt's blocks and maps every matched page
+  *read-shared* into the new slot's page table (refcount + 1 per mapping);
+  only the uncached suffix still needs prefill compute. Repeated
+  system-prompt prefill collapses into page-table pointer writes.
+- **Copy-on-write.** Shared pages are never written: prompt blocks are
+  immutable once prefilled (decode appends land at ``seq_len >=
+  prompt_len``, past every full block), so sharing is read-only by
+  construction — except when a prompt is page-aligned and *fully* cached.
+  At least one token must still be recomputed to produce first-token
+  logits, and that write would land inside the last shared page, so admit
+  hands back a (src, dst) pair: the engine copies the page on-device and
+  the slot diverges on its private copy. The dump page is never indexed,
+  never shared, never a COW source.
+- **Release retains, pressure evicts.** ``release()`` decrements
+  refcounts; indexed pages that reach zero move to an LRU *retained set*
+  instead of the free list — a hot prefix's K/V survives across requests.
+  Allocation takes free pages first and evicts retained pages (LRU,
+  leaf-preferring so a chain's tail goes before its root; evicting a node
+  unindexes its whole subtree) only under pool pressure. Un-indexed pages
+  (partial prompt tails, generated tokens, token-less ``admit()``) free
+  immediately, exactly as before.
+
+The host side (`KVCacheState`) is plain numpy + free lists: allocation
 decisions happen between compiled steps, and the page table crosses to the
-device as a small int32 operand each step. The device side is two pure
+device as a small int32 operand each step. The device side is pure
 gather/scatter helpers used inside the jitted prefill/decode programs.
 """
 from __future__ import annotations
 
+import dataclasses
 import threading
-from typing import List, Optional, Sequence
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -46,17 +76,48 @@ class PagePoolExhaustedError(RuntimeError):
     stalls the slot or sheds the join; this never crashes a step)."""
 
 
+@dataclasses.dataclass(frozen=True)
+class AdmitInfo:
+    """Result of a token-aware admission (`admit_prompt`).
+
+    cached_len prompt positions are already present in pages mapped
+    read-shared into the slot; prefill only needs [cached_len, len).
+    When the whole (page-aligned) prompt was cached, cow_src/cow_dst name
+    the page the engine must copy before the forced last-token recompute
+    writes into it — the copy-on-write divergence point."""
+    slot: int
+    cached_len: int
+    cow_src: Optional[int] = None
+    cow_dst: Optional[int] = None
+
+
+class _RadixNode:
+    """One full token block in the context of its ancestors -> the
+    canonical physical page holding its K/V."""
+
+    __slots__ = ("key", "parent", "children", "page")
+
+    def __init__(self, key: Optional[bytes], parent: "Optional[_RadixNode]",
+                 page: int = DUMP_PAGE):
+        self.key = key
+        self.parent = parent
+        self.children: Dict[bytes, "_RadixNode"] = {}
+        self.page = page
+
+
 class KVCacheState:
     """Host-side bookkeeping for one engine's paged KV cache.
 
-    Owns the slot free list, the physical-page free list and the numpy
+    Owns the slot free list, the physical-page free list, per-page
+    refcounts, the radix prefix index + LRU retained set, and the numpy
     page table / sequence lengths mirrored into every compiled step.
     Thread-safe (admissions come from the scheduler thread, releases can
     race drains), but the expected driver is a single scheduler loop.
     """
 
     def __init__(self, slots: int, page_size: int, max_context: int,
-                 pool_pages: Optional[int] = None, name: str = "lm"):
+                 pool_pages: Optional[int] = None, name: str = "lm",
+                 prefix_cache: bool = True):
         if page_size < 1 or slots < 1:
             raise ValueError(f"slots/page_size must be >= 1 "
                              f"(got {slots}/{page_size})")
@@ -79,6 +140,7 @@ class KVCacheState:
                 f"pool_pages {self.pool_pages} cannot hold even one "
                 f"max-context sequence ({1 + self.pages_per_slot} needed)")
         self.name = name
+        self.prefix_cache = bool(prefix_cache)
         self._lock = threading.Lock()
         #: logical->physical page map per slot; unallocated entries point
         #: at the dump page so fixed-shape gathers/scatters stay safe
@@ -89,13 +151,24 @@ class KVCacheState:
         self._free_slots: List[int] = list(range(self.slots))
         self._free_pages: List[int] = list(range(1, self.pool_pages))
         self._pages_per_slot_live = [0] * self.slots
+        #: slot-mapping count per physical page (the dump page stays 0)
+        self._ref = np.zeros((self.pool_pages,), np.int64)
+        self._root = _RadixNode(None, None)
+        self._by_page: Dict[int, _RadixNode] = {}
+        #: indexed pages with refcount 0, insertion order == LRU order
+        self._retained: "OrderedDict[int, None]" = OrderedDict()
+        #: pages with refcount >= 2, maintained incrementally on ref
+        #: transitions — _gauges runs on the decode hot loop and must
+        #: not rescan the pool
+        self._shared_count = 0
         self._gauges()
 
     # ------------------------------------------------------------- metrics
     def _gauges(self):
-        used = self.pool_pages - 1 - len(self._free_pages)
+        used = self.pool_pages - 1 - len(self._free_pages) \
+            - len(self._retained)
         monitor.gauge("serving_decode_page_pool_used",
-                      "Allocated KV-cache pages (of the fixed pool)",
+                      "KV-cache pages referenced by live slots",
                       labels=("model",)).set(used, model=self.name)
         monitor.gauge("serving_decode_page_pool_pages",
                       "Total allocatable KV-cache pages in the pool",
@@ -105,33 +178,257 @@ class KVCacheState:
                       "Active decode slots (in-flight sequences)",
                       labels=("model",)).set(int(self.active.sum()),
                                              model=self.name)
+        monitor.gauge("serving_decode_kv_shared_pages",
+                      "KV pages currently mapped by more than one slot "
+                      "(prefix sharing engaged)",
+                      labels=("model",)).set(self._shared_count,
+                                             model=self.name)
+        monitor.gauge("serving_decode_kv_retained_pages",
+                      "Released prefix pages held in the LRU retained "
+                      "set for future reuse (reclaimed under pressure)",
+                      labels=("model",)).set(len(self._retained),
+                                             model=self.name)
+
+    # ------------------------------------------------- page accounting
+    def _unref_locked(self, page: int):
+        """One slot mapping gone: route a zero-ref page to the retained
+        set (still indexed — future prompts can share it) or free it."""
+        if page == DUMP_PAGE:
+            return
+        if self._ref[page] > 0:
+            if self._ref[page] == 2:
+                self._shared_count -= 1
+            self._ref[page] -= 1
+        if self._ref[page] == 0:
+            if page in self._by_page:
+                # MRU on release: the prefix was just used end-to-end
+                self._retained[page] = None
+                self._retained.move_to_end(page)
+            else:
+                self._free_pages.append(page)
+
+    def _ref_locked(self, page: int):
+        self._ref[page] += 1
+        if self._ref[page] == 2:
+            self._shared_count += 1
+        self._retained.pop(page, None)
+
+    def _drop_subtree_locked(self, node: _RadixNode) -> int:
+        """Unindex `node` and every descendant; retained pages free,
+        in-use pages merely lose future shareability. Returns the number
+        of cache entries evicted."""
+        if node.parent is not None:
+            node.parent.children.pop(node.key, None)
+        stack, evicted = [node], 0
+        while stack:
+            n = stack.pop()
+            stack.extend(n.children.values())
+            n.children = {}
+            if self._by_page.get(n.page) is n:
+                del self._by_page[n.page]
+                evicted += 1
+                if n.page in self._retained:
+                    del self._retained[n.page]
+                    self._free_pages.append(n.page)
+        return evicted
+
+    def _evict_locked(self) -> bool:
+        """Reclaim one LRU retained entry (leaf-preferred: drop a chain's
+        tail before its root so the hot head of a prefix survives
+        longest). Returns False when nothing is evictable."""
+        victim = None
+        # oldest first; skipped entries are internal nodes of a chain
+        # released root-before-tail, so the first leaf surfaces within
+        # one chain depth (<= pages_per_slot probes), not O(retained)
+        for page in self._retained:
+            node = self._by_page.get(page)
+            if node is not None and not node.children:
+                victim = page
+                break
+        if victim is None:                          # every retained node
+            victim = next(iter(self._retained), None)   # has in-use kids
+        if victim is None:
+            return False
+        evicted = self._drop_subtree_locked(self._by_page[victim])
+        monitor.counter(
+            "serving_decode_kv_cache_evictions_total",
+            "Prefix-cache entries evicted under pool pressure (LRU over "
+            "the retained set; a subtree goes with its root)",
+            labels=("model",)).inc(evicted, model=self.name)
+        return True
+
+    def _take_page_locked(self) -> Optional[int]:
+        """One fresh page: free list first, then LRU eviction of the
+        retained set; None when the pool is genuinely dry."""
+        while True:
+            if self._free_pages:
+                return self._free_pages.pop()
+            if not self._retained or not self._evict_locked():
+                return None
+
+    # ------------------------------------------------------ radix walking
+    def _blocks(self, tokens) -> Tuple[np.ndarray, List[bytes]]:
+        """Canonical (flat, contiguous int32) token view + the trie key
+        of every FULL page-aligned block. The ONE definition indexing
+        and lookup share: the trie matches raw token bytes, so a dtype
+        or layout tweak applied to only one side would silently zero
+        the hit rate instead of erroring."""
+        tokens = np.ascontiguousarray(np.asarray(tokens, np.int32)
+                                      .reshape(-1))
+        ps = self.page_size
+        keys = [tokens[i * ps:(i + 1) * ps].tobytes()
+                for i in range(int(tokens.size) // ps)]
+        return tokens, keys
+
+    def _walk_locked(self, keys: List[bytes]
+                     ) -> Tuple[_RadixNode, List[int]]:
+        """Longest indexed prefix of `keys`: (deepest matched node, its
+        canonical pages root-to-match)."""
+        node, pages = self._root, []
+        for key in keys:
+            child = node.children.get(key)
+            if child is None:
+                break
+            pages.append(child.page)
+            node = child
+        return node, pages
 
     # ----------------------------------------------------------- lifecycle
     def pages_for(self, length: int) -> int:
         """Physical pages needed to hold `length` cached positions."""
         return (int(length) + self.page_size - 1) // self.page_size
 
-    def admit(self, prompt_len: int) -> Optional[int]:
-        """Claim a slot + the pages covering the prompt; None when either
-        resource is exhausted (the join waits — never an error)."""
-        need = self.pages_for(prompt_len)
-        if need > self.pages_per_slot:
+    def _check_capacity(self, prompt_len: int):
+        if self.pages_for(prompt_len) > self.pages_per_slot:
             raise ValueError(
                 f"kvcache[{self.name}]: {prompt_len} cached positions "
                 f"exceed per-slot capacity ({self.max_context}); the "
                 "caller must validate prompt length first")
+
+    def admit(self, prompt_len: int) -> Optional[int]:
+        """Token-less admission: claim a slot + fresh pages covering the
+        prompt, no sharing and no later retention. None when either
+        resource is exhausted (the join waits — never an error)."""
+        self._check_capacity(prompt_len)
+        need = self.pages_for(prompt_len)
         with self._lock:
-            if not self._free_slots or len(self._free_pages) < need:
+            slot = self._admit_locked(prompt_len, [], need)
+            return None if slot is None else slot
+
+    def admit_prompt(self, tokens) -> Optional[AdmitInfo]:
+        """Token-aware admission: resolve the longest cached prefix of
+        full page-aligned blocks, map those pages read-shared, allocate
+        fresh pages for the rest. None when out of slots/pages.
+
+        A fully-cached page-aligned prompt still must recompute its last
+        token (first-token logits come from prefill), and that write
+        would diverge the last shared page — the returned AdmitInfo then
+        carries a (cow_src, cow_dst) copy-on-write pair the engine
+        resolves on-device before the suffix prefill."""
+        tokens, keys = self._blocks(tokens)
+        prompt_len = int(tokens.size)
+        if prompt_len < 1:
+            raise ValueError("admit_prompt needs at least one token")
+        self._check_capacity(prompt_len)
+        need = self.pages_for(prompt_len)
+        ps = self.page_size
+        with self._lock:
+            matched = self._walk_locked(keys)[1] if self.prefix_cache \
+                else []
+            cached_len = len(matched) * ps
+            cow_src = None
+            if cached_len and cached_len >= prompt_len:
+                # fully covered: leave the last token to prefill; its
+                # write diverges the final shared page -> copy-on-write
+                cached_len = prompt_len - 1
+                cow_src = matched[-1]
+                shared = matched[:-1]
+            else:
+                shared = matched
+            slot = self._admit_locked(prompt_len, shared, need,
+                                      pin=cow_src)
+            if slot is None:
                 return None
-            slot = self._free_slots.pop()
-            pages = [self._free_pages.pop() for _ in range(need)]
-            self.page_table[slot, :] = DUMP_PAGE
-            self.page_table[slot, :need] = pages
-            self._pages_per_slot_live[slot] = need
-            self.seq_lens[slot] = prompt_len
-            self.active[slot] = True
+            cow_dst = None if cow_src is None \
+                else int(self.page_table[slot, len(shared)])
+            if self.prefix_cache:
+                hit = cached_len > 0
+                monitor.counter(
+                    "serving_decode_kv_cache_hits_total",
+                    "Admissions that reused a cached prompt prefix "
+                    "(>= one full page of KV skipped prefill)",
+                    labels=("model",)).inc(int(hit), model=self.name)
+                monitor.counter(
+                    "serving_decode_kv_cache_misses_total",
+                    "Admissions with no cached prefix (full prefill)",
+                    labels=("model",)).inc(int(not hit), model=self.name)
+            return AdmitInfo(slot, cached_len, cow_src, cow_dst)
+
+    def _admit_locked(self, prompt_len: int, shared: Sequence[int],
+                      need: int, pin: Optional[int] = None
+                      ) -> Optional[int]:
+        """Map `shared` read-shared + allocate the remaining fresh pages
+        into a free slot; all-or-nothing (rolls back on pool pressure).
+        `pin` ref-pins an extra page (the COW source) so eviction cannot
+        reach it between admission and the on-device copy."""
+        if not self._free_slots:
+            return None
+        for p in shared:
+            self._ref_locked(p)
+        if pin is not None:
+            self._ref_locked(pin)
+        fresh: List[int] = []
+        for _ in range(need - len(shared)):
+            p = self._take_page_locked()
+            if p is None:
+                for q in fresh:
+                    self._ref[q] = 0
+                    self._free_pages.append(q)
+                for q in shared:
+                    self._unref_locked(q)
+                if pin is not None:
+                    self._unref_locked(pin)
+                return None
+            self._ref[p] = 1
+            fresh.append(p)
+        slot = self._free_slots.pop()
+        self.page_table[slot, :] = DUMP_PAGE
+        for i, p in enumerate(list(shared) + fresh):
+            self.page_table[slot, i] = p
+        self._pages_per_slot_live[slot] = need
+        self.seq_lens[slot] = prompt_len
+        self.active[slot] = True
+        self._gauges()
+        return slot
+
+    def unref_page(self, page: int):
+        """Drop a temporary pin (the engine calls this once the COW copy
+        has executed; the source page goes back to shared/retained
+        accounting)."""
+        with self._lock:
+            self._unref_locked(page)
             self._gauges()
-            return slot
+
+    def register_prefix(self, slot: int, tokens):
+        """Index this slot's full prompt blocks (prefill is complete —
+        every mapped prompt page now holds final K/V). Blocks already
+        indexed keep their existing canonical page; a racing duplicate
+        prompt simply fails to index and frees on release."""
+        if not self.prefix_cache:
+            return
+        _, keys = self._blocks(tokens)
+        with self._lock:
+            node = self._root
+            for i, key in enumerate(keys):
+                child = node.children.get(key)
+                if child is None:
+                    page = int(self.page_table[slot, i])
+                    if page == DUMP_PAGE or page in self._by_page:
+                        return          # defensive: never index the dump
+                    child = _RadixNode(key, node, page)
+                    node.children[key] = child
+                    self._by_page[page] = child
+                node = child
 
     def ensure_page(self, slot: int) -> bool:
         """Guarantee a physical page exists for this slot's NEXT position
@@ -144,14 +441,16 @@ class KVCacheState:
             idx = pos // self.page_size
             if idx < self._pages_per_slot_live[slot]:
                 return True
-            if not self._free_pages:
+            page = self._take_page_locked()
+            if page is None:
                 monitor.counter(
                     "serving_decode_page_stalls_total",
                     "Decode steps a slot sat out waiting for a free "
                     "KV page (pool oversubscribed)",
                     labels=("model",)).inc(model=self.name)
                 return False
-            self.page_table[slot, idx] = self._free_pages.pop()
+            self._ref[page] = 1
+            self.page_table[slot, idx] = page
             self._pages_per_slot_live[slot] = idx + 1
             self._gauges()
             return True
@@ -161,12 +460,14 @@ class KVCacheState:
         self.seq_lens[slot] += 1
 
     def release(self, slot: int):
-        """Sequence finished: return its pages and the slot."""
+        """Sequence finished: unreference its pages (indexed ones join
+        the retained set, the rest free) and return the slot."""
         with self._lock:
             if not self.active[slot]:
                 return
             n = self._pages_per_slot_live[slot]
-            self._free_pages.extend(int(p) for p in self.page_table[slot, :n])
+            for p in self.page_table[slot, :n]:
+                self._unref_locked(int(p))
             self.page_table[slot, :] = DUMP_PAGE
             self._pages_per_slot_live[slot] = 0
             self.seq_lens[slot] = 0
@@ -179,8 +480,25 @@ class KVCacheState:
         return [i for i in range(self.slots) if self.active[i]]
 
     def free_pages(self) -> int:
+        """Allocatable pages: truly free + retained (the retained set is
+        reclaimable cache, not working memory)."""
         with self._lock:
-            return len(self._free_pages)
+            return len(self._free_pages) + len(self._retained)
+
+    def retained_pages(self) -> int:
+        with self._lock:
+            return len(self._retained)
+
+    def ref_count(self, page: int) -> int:
+        with self._lock:
+            return int(self._ref[page])
+
+    def cached_prefix_len(self, tokens) -> int:
+        """Longest currently-indexed prefix (full blocks) of `tokens` in
+        tokens — a read-only probe, no LRU touch."""
+        _, keys = self._blocks(tokens)
+        with self._lock:
+            return len(self._walk_locked(keys)[1]) * self.page_size
 
     def utilization(self) -> float:
         total = self.pool_pages - 1
@@ -194,7 +512,11 @@ class KVCacheState:
                 "page_size": self.page_size,
                 "max_context": self.max_context,
                 "pool_pages": self.pool_pages - 1,
-                "pages_used": self.pool_pages - 1 - len(self._free_pages),
+                "pages_used": (self.pool_pages - 1 - len(self._free_pages)
+                               - len(self._retained)),
+                "prefix_cache": self.prefix_cache,
+                "retained_pages": len(self._retained),
+                "shared_pages": self._shared_count,
             }
 
 
@@ -229,6 +551,19 @@ def write_prompt_kv(kpool, vpool, layer: int, k_seq, v_seq, page_row,
     return kpool, vpool
 
 
+def write_chunk_kv(kpool, vpool, layer: int, k_seq, v_seq, phys, off):
+    """Scatter a prefill *chunk*'s (key, value) rows by absolute position.
+
+    Unlike `write_prompt_kv` this makes no page-alignment assumption —
+    the chunk may start mid-page (the COW divergence recompute does).
+    k_seq/v_seq: (T, H, D); phys/off: (T,) physical page and in-page
+    offset per row, with invalid (padding / past-end) rows steered to
+    DUMP_PAGE by the caller. Returns the updated pools."""
+    kpool = kpool.at[layer, phys, off].set(k_seq)
+    vpool = vpool.at[layer, phys, off].set(v_seq)
+    return kpool, vpool
+
+
 def gather_kv(kpool, vpool, layer: int, page_table, max_context: int):
     """Page-table gather back to dense per-slot key/value sequences.
 
@@ -241,6 +576,15 @@ def gather_kv(kpool, vpool, layer: int, page_table, max_context: int):
     keys = kpool[layer][page_table].reshape(s, max_context, h, d)
     vals = vpool[layer][page_table].reshape(s, max_context, h, d)
     return keys, vals
+
+
+def copy_page(kpool, vpool, src, dst):
+    """Copy one physical page across every layer (the COW divergence).
+    src/dst are traced int32 scalars, so ONE compiled program serves
+    every copy-on-write regardless of which pages diverge."""
+    kpool = kpool.at[:, dst].set(kpool[:, src])
+    vpool = vpool.at[:, dst].set(vpool[:, src])
+    return kpool, vpool
 
 
 def default_prefill_buckets(page_size: int, max_context: int

@@ -437,7 +437,9 @@ class _Handler(BaseHTTPRequestHandler):
                           code=code,
                           finish_reason=None if req is None
                           else req.finish_reason,
-                          tokens=None if req is None else req.n_emitted)
+                          tokens=None if req is None else req.n_emitted,
+                          cached_tokens=None if req is None
+                          else req.cached_tokens)
 
     def _relay_generation(self, name: str, req, t0: float,
                           deadline: float, stream: bool) -> int:
@@ -463,6 +465,11 @@ class _Handler(BaseHTTPRequestHandler):
                 "model": name, "version": info.get("version"),
                 "tokens": tokens,
                 "finish_reason": info.get("finish_reason"),
+                # prefix-cache telemetry per generation: prompt positions
+                # served from shared KV pages + prefill chunk count (the
+                # SSE path carries the same fields on its done event)
+                "cached_tokens": info.get("cached_tokens"),
+                "prefill_chunks": info.get("prefill_chunks"),
                 "ttft_ms": round((req.first_token_at - req.enqueued) * 1e3,
                                  3) if req.first_token_at else None,
                 "latency_ms": round((time.perf_counter() - t0) * 1e3, 3),

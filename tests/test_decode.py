@@ -25,9 +25,9 @@ from deeplearning4j_tpu.serving import (
     ModelRegistry, ModelServer, ServerOverloadedError,
 )
 from deeplearning4j_tpu.serving.decode import (
-    DecodeConfig, DecodeEngine, ServedLM,
+    DecodeConfig, DecodeEngine, DecodeScheduler, GenerateRequest, ServedLM,
 )
-from deeplearning4j_tpu.serving.kvcache import KVCacheState
+from deeplearning4j_tpu.serving.kvcache import DUMP_PAGE, KVCacheState
 from deeplearning4j_tpu.serving.quantize import (
     QTensor, quality_delta, quantize_leaf,
 )
@@ -87,6 +87,109 @@ def test_kvcache_exhaustion_blocks_admission_and_growth():
 def test_kvcache_rejects_unaligned_context():
     with pytest.raises(ValueError):
         KVCacheState(slots=1, page_size=8, max_context=20)
+
+
+# ------------------------------------------------- kv prefix cache (CoW)
+def test_kvcache_prefix_reuse_refcount_lifecycle():
+    """Full-block prefix sharing: a second prompt with a common prefix
+    maps the SAME physical pages (ref 2), release retains indexed pages
+    instead of freeing, and a later identical prefix still hits."""
+    c = KVCacheState(slots=4, page_size=4, max_context=16, name="kvp")
+    t = np.arange(12, dtype=np.int32)             # 3 full blocks
+    a = c.admit_prompt(t)
+    assert a.cached_len == 0 and a.cow_src is None  # cold
+    c.register_prefix(a.slot, t)
+    # shares the first 2 blocks, diverges in the third
+    b = c.admit_prompt(np.concatenate([t[:8], [99, 98]]).astype(np.int32))
+    assert b.cached_len == 8
+    assert (c.page_table[b.slot, :2] == c.page_table[a.slot, :2]).all()
+    shared_page = int(c.page_table[a.slot, 0])
+    assert c.ref_count(shared_page) == 2
+    c.release(a.slot)
+    assert c.ref_count(shared_page) == 1          # b still maps it
+    c.release(b.slot)
+    assert c.ref_count(shared_page) == 0
+    # indexed pages went to the retained set, not the free list (b's
+    # partial third page was never indexed and freed immediately): the
+    # prefix is still hot for the next admission
+    assert c.retained_pages() == 3                # a's 3 indexed blocks
+    assert c.cached_prefix_len(t) == 12
+    d = c.admit_prompt(np.concatenate([t, [7]]).astype(np.int32))
+    assert d.cached_len == 12                     # full retained chain hit
+    c.release(d.slot)
+    hits = monitor.counter("serving_decode_kv_cache_hits_total", "x",
+                           labels=("model",)).value(model="kvp")
+    misses = monitor.counter("serving_decode_kv_cache_misses_total", "x",
+                             labels=("model",)).value(model="kvp")
+    assert hits == 2 and misses == 1
+
+
+def test_kvcache_cow_on_full_prefix_and_dump_page_never_shared():
+    """A page-aligned prompt whose every block is cached still must
+    recompute its last token — admit hands back a copy-on-write pair so
+    the recompute writes a private copy, never the shared page. The dump
+    page is never indexed, shared, or a COW endpoint."""
+    c = KVCacheState(slots=4, page_size=4, max_context=16, name="kvcow")
+    t = np.arange(8, dtype=np.int32)              # exactly 2 blocks
+    a = c.admit_prompt(t)
+    c.register_prefix(a.slot, t)
+    b = c.admit_prompt(t)                         # identical, fully cached
+    assert b.cached_len == 7                      # forced last-token redo
+    assert b.cow_src == int(c.page_table[a.slot, 1])
+    assert b.cow_dst == int(c.page_table[b.slot, 1])
+    assert b.cow_dst not in (b.cow_src, DUMP_PAGE)
+    # block 1 shared read-only; block 2 diverged onto the private copy
+    assert c.page_table[b.slot, 0] == c.page_table[a.slot, 0]
+    assert c.page_table[b.slot, 1] != c.page_table[a.slot, 1]
+    # the source is pinned until the engine's on-device copy completes
+    assert c.ref_count(b.cow_src) == 2            # a's mapping + the pin
+    c.unref_page(b.cow_src)
+    assert c.ref_count(b.cow_src) == 1
+    c.release(a.slot)
+    c.release(b.slot)
+    assert c.ref_count(DUMP_PAGE) == 0
+    assert DUMP_PAGE not in c._by_page            # never indexed
+    # no live table maps the dump page as an allocated entry
+    assert all(c._pages_per_slot_live[s] == 0 for s in range(c.slots))
+
+
+def test_kvcache_lru_eviction_under_pool_pressure():
+    """Retained prefixes are cache, not working memory: when the free
+    list runs dry, the LRU chain is evicted (and unindexed) to satisfy
+    new admissions; fresher chains survive."""
+    c = KVCacheState(slots=2, page_size=4, max_context=8, pool_pages=5,
+                     name="kvev")                 # 4 usable pages
+    a_t = np.arange(8, dtype=np.int32)
+    b_t = np.arange(8, dtype=np.int32) + 100
+    c_t = np.arange(8, dtype=np.int32) + 200
+    a = c.admit_prompt(a_t)
+    c.register_prefix(a.slot, a_t)
+    c.release(a.slot)
+    b = c.admit_prompt(b_t)
+    c.register_prefix(b.slot, b_t)
+    c.release(b.slot)
+    assert c.retained_pages() == 4 and c.free_pages() == 4
+    ev0 = monitor.counter("serving_decode_kv_cache_evictions_total", "x",
+                          labels=("model",)).value(model="kvev")
+    d = c.admit_prompt(c_t)                       # needs 2 fresh pages
+    assert d is not None and d.cached_len == 0
+    ev1 = monitor.counter("serving_decode_kv_cache_evictions_total", "x",
+                          labels=("model",)).value(model="kvev")
+    assert ev1 - ev0 == 2                         # a's chain went, LRU
+    assert c.cached_prefix_len(a_t) == 0          # evicted
+    assert c.cached_prefix_len(b_t) == 8          # fresher chain survived
+    c.release(d.slot)
+
+
+def test_kvcache_tokenless_admit_keeps_legacy_semantics():
+    """admit(int) (no tokens) must neither share nor retain: release
+    frees everything immediately, exactly the pre-cache behavior."""
+    c = KVCacheState(slots=2, page_size=4, max_context=16, name="kvleg")
+    s = c.admit(10)
+    assert s is not None
+    c.release(s)
+    assert c.retained_pages() == 0
+    assert c.free_pages() == c.pool_pages - 1
 
 
 # ------------------------------------------------------------ zoo kwargs
@@ -324,6 +427,156 @@ def test_deploy_kind_collision_is_loud():
     registry.shutdown(drain=False)
 
 
+# -------------------------------------- prefix cache + chunked prefill
+def _greedy(lm, prompt, n=8):
+    """(tokens, done-info) for one greedy generation."""
+    req = lm.generate(prompt, max_new_tokens=n)
+    evs = drain_events(req)
+    assert evs[-1][0] == "done", evs[-1]
+    return [p for k, p, _ in evs if k == "token"], evs[-1][1]
+
+
+@pytest.fixture(scope="module")
+def parity_lms():
+    """The same model behind three decode configs: prefix cache on
+    (default), prefix cache off, and cache off + tiny chunk budget."""
+    net_src = ZOO_SRC
+    lms = {
+        "on": ServedLM("par-on", load_servable(net_src), net_src,
+                       decode=DecodeConfig(slots=2, page_size=8)),
+        "off": ServedLM("par-off", load_servable(net_src), net_src,
+                        decode=DecodeConfig(slots=2, page_size=8,
+                                            prefix_cache=False)),
+        "chunk": ServedLM("par-chunk", load_servable(net_src), net_src,
+                          decode=DecodeConfig(slots=2, page_size=8,
+                                              prefix_cache=False,
+                                              prefill_chunk_tokens=8)),
+    }
+    yield lms
+    for lm in lms.values():
+        lm.shutdown(drain=False, timeout=5)
+
+
+def test_prefix_cache_greedy_parity_cold_hot_and_cow(parity_lms):
+    """THE parity contract: greedy tokens bitwise-identical with the
+    prefix cache on vs off — cold (miss), hot (shared-prefix hit), and
+    the copy-on-write divergence case (page-aligned fully-cached
+    prompt). The cache may only change WHERE KV comes from, never what
+    gets sampled."""
+    on, off = parity_lms["on"], parity_lms["off"]
+    prefix = list(range(16))                      # 2 full pages
+    # cold: identical programs either way, nothing cached yet
+    t_on, i_on = _greedy(on, prefix + [17, 18, 19])
+    t_off, i_off = _greedy(off, prefix + [17, 18, 19])
+    assert t_on == t_off
+    assert i_on["cached_tokens"] == 0 and i_off["cached_tokens"] == 0
+    # hot: same prefix, divergent suffix -> 16 tokens of KV reused
+    t_on, i_on = _greedy(on, prefix + [20, 21])
+    t_off, _ = _greedy(off, prefix + [20, 21])
+    assert t_on == t_off, (t_on, t_off)
+    assert i_on["cached_tokens"] == 16
+    # COW: page-aligned fully-cached prompt — the forced last-token
+    # recompute diverges onto a private page copy
+    t_on, i_on = _greedy(on, prefix)
+    t_off, _ = _greedy(off, prefix)
+    assert t_on == t_off, (t_on, t_off)
+    assert i_on["cached_tokens"] == 15            # prompt_len - 1
+
+
+def test_chunked_prefill_parity_and_chunk_accounting(parity_lms):
+    """Chunking on vs off: identical greedy tokens, and the done event
+    reports the budgeted chunk count (20-token prompt / 8-token budget
+    -> 3 chunks)."""
+    off, chunk = parity_lms["off"], parity_lms["chunk"]
+    prompt = list(np.random.RandomState(5).randint(0, 48, 20))
+    t_c, i_c = _greedy(chunk, prompt)
+    t_o, i_o = _greedy(off, prompt)
+    assert t_c == t_o, (t_c, t_o)
+    assert i_c["prefill_chunks"] == 3             # 8 + 8 + 4
+    assert i_o["prefill_chunks"] == 1             # whole prompt, one shot
+
+
+def test_chunked_and_cow_traffic_never_compiles_on_request_path(
+        parity_lms):
+    """compiles == warmups per model AFTER hot/COW/chunked traffic: the
+    chunk ladder and the COW copy were all AOT-warmed, so none of the
+    new code paths paid for XLA on a live stream."""
+    def fam_sum(family, model):
+        total = 0.0
+        for line in monitor.prometheus_text().splitlines():
+            if line.startswith(family + "{") and f'model="{model}"' in line:
+                total += float(line.rsplit(" ", 1)[1])
+        return total
+
+    for model in ("par-on", "par-off", "par-chunk"):
+        csum = fam_sum("serving_decode_compiles_total", model)
+        wsum = fam_sum("serving_decode_warmup_runs_total", model)
+        assert csum == wsum and csum > 0, (model, csum, wsum)
+
+
+def test_burst_admissions_drain_queue_in_one_tick():
+    """When several slots free in one token step, the next admission
+    pass must drain the join queue until slots or queue are exhausted —
+    not trickle one admission per step. Driven tick-by-tick (no
+    scheduler thread) so the assertion is on a single _admit pass."""
+    eng = DecodeEngine(load_servable(ZOO_SRC),
+                       DecodeConfig(slots=4, page_size=8), name="burst")
+    eng.warm()
+    sched = DecodeScheduler("burst", queue_limit=16)
+    sched._started = True                 # keep the loop thread off
+    sched.install(eng, version=1)
+    reqs = [GenerateRequest([1, 2, 3], max_new_tokens=1)
+            for _ in range(6)]
+    for r in reqs:
+        sched.submit(r)
+    run = sched._runs[-1]
+    assert sched._admit() is True
+    # ONE pass filled every free slot from the queue
+    assert len(run.prefill) == 4
+    assert sched.queue_state()[0] == 2
+    # prefill completes all four; max_new_tokens=1 finishes them at the
+    # first token, freeing all four slots within the same tick
+    assert sched._prefill_tick() is True
+    assert len(run.prefill) == 0 and len(run.slot_req) == 0
+    # the next pass admits the whole remainder at once
+    assert sched._admit() is True
+    assert len(run.prefill) == 2 and sched.queue_state()[0] == 0
+    sched._prefill_tick()
+    for r in reqs:
+        assert r.done.is_set() and r.finish_reason == "length"
+    sched._stop.set()
+    eng.close()
+
+
+def test_prefill_budget_caps_tokens_per_tick():
+    """The per-tick prefill budget bounds how much prefill runs between
+    decode steps: a 24-token prompt under an 8-token budget takes three
+    ticks, one page-aligned chunk each — the head-of-line guarantee an
+    in-flight stream's ITL rests on."""
+    eng = DecodeEngine(load_servable(ZOO_SRC),
+                       DecodeConfig(slots=2, page_size=8,
+                                    prefill_chunk_tokens=8),
+                       name="budget")
+    eng.warm()
+    sched = DecodeScheduler("budget", queue_limit=4)
+    sched._started = True
+    sched.install(eng, version=1)
+    req = GenerateRequest(list(range(24)), max_new_tokens=2)
+    sched.submit(req)
+    assert sched._admit() is True
+    run = sched._runs[-1]
+    job = next(iter(run.prefill.values()))
+    for expect_pos in (8, 16, 24):
+        sched._prefill_tick()
+        assert job.pos == expect_pos
+    assert not run.prefill and len(run.slot_req) == 1
+    assert req.n_emitted == 1                     # first token delivered
+    sched._step_all()
+    assert req.done.is_set()
+    sched._stop.set()
+    eng.close()
+
+
 # ----------------------------------------------------------- HTTP + swap
 @pytest.fixture(scope="module")
 def lm_server():
@@ -514,3 +767,14 @@ def test_decode_smoke_gate(tmp_path):
     doc = json.loads(out.read_text())
     assert doc["ok"] and doc["sweep"][0]["zero_5xx"]
     assert doc["sweep"][0]["decode_tokens_sec"] > 0
+    # prefix-cache + chunked-prefill acceptance, re-asserted here so the
+    # gate fails loudly even if the tool's own failure list regresses:
+    # the cache engaged, compiles==warmups held WITH chunking enabled,
+    # hot TTFT >= 2x better than cold, chunking improved interferer ITL
+    assert doc["prefix_loadgen"]["prefix"]["cache_hit_rate"] > 0
+    assert doc["kv_cache"]["hits"] > 0
+    assert doc["ledger"]["compiles"] == doc["ledger"]["warmups"] > 0
+    assert doc["prefix_ttft"]["hot_p99_ms"] * 2 \
+        <= doc["prefix_ttft"]["cold_p99_ms"]
+    assert doc["interferer_itl"]["chunked_p99_ms"] \
+        < doc["interferer_itl"]["nochunk_p99_ms"]

@@ -293,21 +293,32 @@ class _FakeCache:
     def release(self, s):
         self._active.discard(s)
 
+    def register_prefix(self, slot, tokens):
+        pass
+
 
 class _FakeEngine:
     max_context = 128
+    prefill_chunk_tokens = 0          # chunking off: one-shot prefill
 
     def __init__(self, slots=2):
         self.cache = _FakeCache(slots)
         self.closed = False
 
+    def admit_prompt(self, prompt):
+        from deeplearning4j_tpu.serving.kvcache import AdmitInfo
+        slot = self.cache.admit(len(prompt))
+        return None if slot is None else AdmitInfo(slot, 0)
+
     def prefill(self, slot, prompt, temperature, top_k):
         with monitor.span("serving/prefill", model="fake", bucket=8):
             return 1, None
 
-    def step(self):
+    def step(self, exclude=()):
         act = np.zeros((self.cache.slots,), bool)
         for s in self.cache.active_slots():
+            if s in set(exclude):
+                continue
             act[s] = True
             self.cache.seq_lens[s] += 1
         return np.full((self.cache.slots,), 2, np.int32), act, None

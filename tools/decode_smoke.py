@@ -23,10 +23,21 @@ In one process (CI-friendly, CPU, no network egress):
 4. measures the quantized variants against the base engine on a shared
    token set (`quantize.quality_delta`): next-token perplexity delta and
    mean absolute logit error per variant;
-5. banks a bench-style ``sweep`` with the decode throughput/latency row
-   (``decode_tokens_sec``, ``decode_ttft_p99_ms``, ``decode_itl_p99_ms``)
-   and one quality row per variant, as DECODE_r*.json for
-   tools/perf_report.py to gate.
+5. drives a deterministic SHARED-PREFIX workload (serve_loadgen
+   --prefix-mix as a library) against a longer-context servable and
+   asserts the KV prefix cache engaged (cache_hit_rate > 0), then
+   measures cold-vs-hot TTFT on a controlled sequential pass — the
+   acceptance bar is hot p99 at least 2x better than cold;
+6. measures short-stream inter-token p99 while a LONG-PROMPT INTERFERER
+   continuously admits, with chunked prefill on vs off — chunking must
+   improve the interferer ITL p99 (head-of-line-free prefill);
+7. banks a bench-style ``sweep`` with the decode throughput/latency row
+   (``decode_tokens_sec``, ``decode_ttft_p99_ms``, ``decode_itl_p99_ms``),
+   the prefix-cache row (``decode_cache_hit_rate``,
+   ``decode_ttft_hot_p99_ms``, ``decode_ttft_cold_p99_ms``), the
+   interferer row (``decode_itl_interferer_p99_ms`` + the ungated
+   chunking-off reference) and one quality row per variant, as
+   DECODE_r*.json for tools/perf_report.py to gate.
 
 Exit 0 on success, 1 on failure; prints the JSON summary either way.
 """
@@ -55,6 +66,87 @@ def _metric_sum(metrics_text: str, family: str) -> float:
             except ValueError:
                 pass
     return total
+
+
+def _p99_ms(samples) -> float:
+    from serve_loadgen import percentile
+    return round((percentile(sorted(samples), 99) or 0.0) * 1e3, 3)
+
+
+def _drain(req, timeout=120.0):
+    """Consume one library GenerateRequest; returns (token count,
+    [inter-token gaps s])."""
+    import time as _t
+    ntok, last, itls = 0, None, []
+    deadline = _t.monotonic() + timeout
+    while True:
+        ev = req.events.get(timeout=max(0.1, deadline - _t.monotonic()))
+        if ev[0] == "token":
+            now = _t.perf_counter()
+            if last is not None:
+                itls.append(now - last)
+            last = now
+            ntok += 1
+        elif ev[0] == "done":
+            return ntok, itls
+        else:
+            raise ev[1]
+
+
+def _interferer_itl_p99(lm, vocab: int, rs, n_streams: int = 2,
+                        gen_tokens: int = 48) -> float:
+    """Short-stream inter-token p99 while a long-prompt interferer
+    continuously admits (each interferer prompt is unique, so its whole
+    suffix really prefills). The chunking A/B isolates head-of-line
+    blocking: with chunking off every interferer admission stalls the
+    running streams for one monolithic prefill."""
+    import threading
+    import time as _t
+
+    import numpy as np
+    itls, errs = [], []
+    done = threading.Event()
+    # per-thread RNG streams derived from the caller's seed: the chunked
+    # and nochunk phases are seeded identically, and thread interleaving
+    # must not reorder draws between them — the A/B compares the same
+    # prompt sets
+    seeds = rs.randint(0, 2 ** 31 - 1, n_streams + 1)
+
+    def short(i):
+        try:
+            srs = np.random.RandomState(seeds[i])
+            req = lm.generate(srs.randint(0, vocab, 8).tolist(),
+                              max_new_tokens=gen_tokens)
+            _, gaps = _drain(req)
+            itls.extend(gaps)
+        except Exception as e:          # noqa: BLE001 — asserted below
+            errs.append(repr(e))
+
+    def interferer():
+        irs = np.random.RandomState(seeds[-1])
+        while not done.is_set():
+            try:
+                req = lm.generate(irs.randint(0, vocab, 448).tolist(),
+                                  max_new_tokens=1)
+                _drain(req)
+            except Exception as e:      # noqa: BLE001
+                errs.append(repr(e))
+                return
+            _t.sleep(0.001)
+
+    threads = [threading.Thread(target=short, args=(i,), daemon=True)
+               for i in range(n_streams)]
+    intf = threading.Thread(target=interferer, daemon=True)
+    for t in threads:
+        t.start()
+    intf.start()
+    for t in threads:
+        t.join(timeout=300)
+    done.set()
+    intf.join(timeout=300)
+    if errs:
+        raise RuntimeError(f"interferer phase errors: {errs}")
+    return _p99_ms(itls)
 
 
 def main(argv=None) -> int:
@@ -97,6 +189,19 @@ def main(argv=None) -> int:
     registry.deploy_lm("lm", arch, decode=cfg)
     registry.deploy_lm("lm_int8", arch + "@int8", decode=cfg)
     registry.deploy_lm("lm_bf16", arch + "@bf16", decode=cfg)
+    # the prefix/interferer phases need room for long prompts: a fixed
+    # 512-token-context sizing (independent of the CLI sizing knobs) so
+    # cold prefill is genuinely heavier than a cache-hit suffix — on CPU
+    # with tiny models, per-program dispatch overhead flattens the ratio
+    # unless the prompt is long enough for compute to dominate. Same
+    # model + config except the chunking knob — the interferer A/B.
+    arch_long = (f"zoo:TransformerLM?vocab_size={args.vocab}"
+                 f"&n_layers=2&n_embd=64&n_heads=4&seq_length=512")
+    registry.deploy_lm("lm_prefix", arch_long,
+                       decode=DecodeConfig(slots=args.slots, page_size=16))
+    registry.deploy_lm("lm_nochunk", arch_long,
+                       decode=DecodeConfig(slots=args.slots, page_size=16,
+                                           prefill_chunk_tokens=0))
     summary["warmup_s"] = round(time.perf_counter() - t0, 2)
     server = ModelServer(registry, port=0, default_deadline_s=120.0)
 
@@ -165,6 +270,77 @@ def main(argv=None) -> int:
         failures.append(f"{report['errors']} streams failed "
                         f"({report['error_classes']})")
 
+    # -------------------------------------- shared-prefix workload (HTTP)
+    # the production shape prefix caching exists for: most prompts open
+    # with one shared system prefix. Asserts the cache actually engaged.
+    prefix_args = argparse.Namespace(
+        url=server.url, model="lm_prefix", mode="decode",
+        prompt_len=192, max_new_tokens=8, temperature=0.0, top_k=0,
+        vocab=args.vocab, requests=max(16, args.requests),
+        concurrency=3, rate=None, batch_sizes=[1], max_retries=4,
+        retry_cap_s=2.0, deadline_ms=None, timeout_s=120.0, seed=3,
+        priority_mix={}, prefix_mix={"shared": 3, "unique": 1},
+        shared_prefix_len=160)
+    pgen = LoadGen(prefix_args, ())
+    pwall, pok = pgen.run_closed()
+    preport = pgen.report(pwall, pok)
+    summary["prefix_loadgen"] = preport
+    hit_rate = (preport.get("prefix") or {}).get("cache_hit_rate")
+    if preport["errors"]:
+        failures.append(f"{preport['errors']} shared-prefix streams "
+                        f"failed ({preport['error_classes']})")
+    if not hit_rate or hit_rate <= 0:
+        failures.append(f"prefix cache never hit on the shared-prefix "
+                        f"workload (hit_rate={hit_rate})")
+
+    # ------------------------------- cold-vs-hot TTFT (controlled, library)
+    # sequential on an idle servable so the split measures prefill
+    # compute, not queueing: cold = unique 448-token prompt (full
+    # prefill under the default chunk budget), hot = 416 shared-prefix
+    # tokens served from cached pages + a 32-token suffix chunk
+    lmp = registry.get("lm_prefix")
+    rs2 = np.random.RandomState(11)
+    hot_prefix = rs2.randint(0, args.vocab, 416).tolist()
+    _drain(lmp.generate(hot_prefix + rs2.randint(0, args.vocab, 32)
+                        .tolist(), max_new_tokens=2))     # prime the cache
+    cold_ttft, hot_ttft = [], []
+    for _ in range(12):
+        req = lmp.generate(rs2.randint(0, args.vocab, 448).tolist(),
+                           max_new_tokens=2)
+        _drain(req)
+        cold_ttft.append(req.first_token_at - req.enqueued)
+        req = lmp.generate(hot_prefix + rs2.randint(0, args.vocab, 32)
+                           .tolist(), max_new_tokens=2)
+        _drain(req)
+        if req.cached_tokens != 416:
+            failures.append(f"hot admission cached {req.cached_tokens} "
+                            "of 416 shared-prefix tokens")
+            break
+        hot_ttft.append(req.first_token_at - req.enqueued)
+    cold_p99, hot_p99 = _p99_ms(cold_ttft), _p99_ms(hot_ttft)
+    summary["prefix_ttft"] = {"cold_p99_ms": cold_p99,
+                              "hot_p99_ms": hot_p99,
+                              "speedup": round(cold_p99 / hot_p99, 2)
+                              if hot_p99 else None}
+    if not hot_ttft or hot_p99 * 2 > cold_p99:
+        failures.append(f"hot TTFT p99 {hot_p99}ms not >= 2x better "
+                        f"than cold {cold_p99}ms")
+
+    # ---------------------- long-prompt interferer ITL: chunking on vs off
+    itl_chunked = _interferer_itl_p99(lmp, args.vocab,
+                                      np.random.RandomState(13))
+    itl_nochunk = _interferer_itl_p99(registry.get("lm_nochunk"),
+                                      args.vocab,
+                                      np.random.RandomState(13))
+    summary["interferer_itl"] = {
+        "chunked_p99_ms": itl_chunked, "nochunk_p99_ms": itl_nochunk,
+        "chunk_tokens":
+            lmp.scheduler.admitting_engine().prefill_chunk_tokens}
+    if itl_chunked >= itl_nochunk:
+        failures.append(
+            f"chunked prefill did not improve interferer ITL p99 "
+            f"({itl_chunked}ms chunked vs {itl_nochunk}ms monolithic)")
+
     # ----------------------------------------------- compile-ledger proof
     metrics = urllib.request.urlopen(server.url + "/metrics",
                                      timeout=10).read().decode()
@@ -176,6 +352,17 @@ def main(argv=None) -> int:
                         f"vs {warmups} warmups (a stream paid for XLA)")
     joins = _metric_sum(metrics, "serving_decode_preempted_joins_total")
     summary["preempted_joins"] = joins
+    summary["kv_cache"] = {
+        "hits": _metric_sum(metrics,
+                            "serving_decode_kv_cache_hits_total"),
+        "misses": _metric_sum(metrics,
+                              "serving_decode_kv_cache_misses_total"),
+        "evictions": _metric_sum(
+            metrics, "serving_decode_kv_cache_evictions_total"),
+    }
+    if summary["kv_cache"]["hits"] <= 0:
+        failures.append("serving_decode_kv_cache_hits_total never "
+                        "incremented — prefix sharing did not engage")
     if joins <= 0:
         failures.append("no preempted joins recorded — streams never "
                         "joined a running batch (continuous batching "
@@ -199,6 +386,21 @@ def main(argv=None) -> int:
         # slowest streams per class by client-minted trace_id: the
         # banked TTFT/ITL percentiles point at reproducible traces
         "slow_trace_ids": report.get("slowest"),
+    }] + [{
+        # the prefix-cache series: hit rate on the mixed shared/unique
+        # HTTP workload, hot/cold TTFT from the controlled split (cold
+        # banked for the ratio; only hot + hit rate are perf-gated)
+        "mode": "decode_prefix", "on_tpu": False, "batch": 3,
+        "decode_cache_hit_rate": hit_rate,
+        "decode_ttft_hot_p99_ms": hot_p99,
+        "decode_ttft_cold_p99_ms": cold_p99,
+        "streams": preport["requests"],
+    }, {
+        # head-of-line: short-stream ITL under a long-prompt interferer;
+        # nochunk is the ungated reference the improvement is against
+        "mode": "decode_interferer", "on_tpu": False, "batch": 2,
+        "decode_itl_interferer_p99_ms": itl_chunked,
+        "decode_itl_interferer_nochunk_p99_ms": itl_nochunk,
     }] + [{
         "mode": f"decode_quant_{variant}", "on_tpu": False, "batch": None,
         **quality[variant],

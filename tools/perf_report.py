@@ -43,12 +43,15 @@ import sys
 #: decode_tokens_sec is the continuous-batching generate surface
 #: (`tools/decode_smoke.py`, banked as DECODE_r*.json): generated tokens
 #: per wall second across concurrent streams through a mid-traffic swap.
+#: decode_cache_hit_rate is the shared-prefix workload's KV prefix-cache
+#: hit fraction (DECODE_r*.json, r14+): higher = more prefill compute
+#: skipped, gated like a throughput so a cache regression trips CI.
 THROUGHPUT_KEYS = ("imgs_sec", "lenet_imgs_sec", "chars_sec", "pairs_sec",
                    "h2d_f32_mbytes_sec", "h2d_u8_mbytes_sec",
                    "fit_e2e_imgs_sec",
                    "fit_e2e_chars_sec", "fit_e2e_pairs_sec",
                    "chaos_goodput_under_fault_rps", "mesh_imgs_sec",
-                   "decode_tokens_sec")
+                   "decode_tokens_sec", "decode_cache_hit_rate")
 
 #: lower-is-better series (latencies). Banked by tools/serve_chaos.py
 #: (CHAOS_r*.json): p99 while a replica is killed + another wedged, and
@@ -57,8 +60,15 @@ THROUGHPUT_KEYS = ("imgs_sec", "lenet_imgs_sec", "chars_sec", "pairs_sec",
 #: token p99 and inter-token p99. Gated inverted: baseline = best
 #: (lowest) earlier round, regression = latest above baseline by >
 #: threshold.
+#: decode_ttft_hot_p99_ms is time-to-first-token p99 for prefix-cache
+#: HITS on the shared-prefix workload; decode_itl_interferer_p99_ms is
+#: short-stream inter-token p99 while a long-prompt interferer admits
+#: (chunked prefill keeps it bounded). Both r14+. The cold-TTFT and
+#: chunking-off interferer numbers are banked for the ratio but NOT
+#: gated (they measure the path the cache/chunking replaced).
 LATENCY_KEYS = ("chaos_p99_under_fault_ms", "chaos_recovered_p99_ms",
-                "decode_ttft_p99_ms", "decode_itl_p99_ms")
+                "decode_ttft_p99_ms", "decode_itl_p99_ms",
+                "decode_ttft_hot_p99_ms", "decode_itl_interferer_p99_ms")
 
 
 def _round_of(name: str) -> int:

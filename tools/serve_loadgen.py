@@ -116,20 +116,55 @@ class LoadGen:
             if self.vocab < 2:
                 raise SystemExit("--mode decode needs --vocab (or a "
                                  "servable describing vocab_size)")
+            # shared/unique-prefix workload: a deterministic weighted
+            # cycle of prefix classes; "shared" prompts open with ONE
+            # common prefix (the system-prompt shape the server's KV
+            # prefix cache exists for) + a per-request unique suffix,
+            # every other class gets a fully unique prompt
+            self.prefix_mix = dict(getattr(args, "prefix_mix", None)
+                                   or {})
+            self.prefix_cycle = [c for c, w in sorted(
+                self.prefix_mix.items()) for _ in range(w)] or [None]
+            shared_len = int(getattr(args, "shared_prefix_len", None)
+                             or (2 * args.prompt_len) // 3)
+            if self.prefix_mix:
+                if not 0 < shared_len < args.prompt_len:
+                    raise SystemExit(
+                        f"--shared-prefix-len must be in (0, "
+                        f"{args.prompt_len}); got {shared_len}")
+                shared_prefix = self.rs.randint(
+                    0, self.vocab, shared_len).tolist()
+
+                def prompt_for(i):
+                    if self.prefix_cycle[i % len(self.prefix_cycle)] \
+                            == "shared":
+                        return shared_prefix + self.rs.randint(
+                            0, self.vocab,
+                            args.prompt_len - shared_len).tolist()
+                    return self.rs.randint(
+                        0, self.vocab, args.prompt_len).tolist()
+
+                n_bodies = args.requests
+            else:
+                def prompt_for(i):
+                    return self.rs.randint(
+                        0, self.vocab, args.prompt_len).tolist()
+
+                n_bodies = 16           # a cycle of distinct prompts
             self.bodies = [
                 json.dumps({
-                    "prompt": self.rs.randint(
-                        0, self.vocab, args.prompt_len).tolist(),
+                    "prompt": prompt_for(i),
                     "max_tokens": args.max_new_tokens,
                     "temperature": args.temperature,
                     "top_k": args.top_k,
                     "stream": True,
                 }).encode()
-                for _ in range(16)      # a cycle of distinct prompts
+                for i in range(n_bodies)
             ]
             self.ttfts = {}             # class -> [seconds]
             self.itls = {}              # class -> [seconds] between tokens
             self.tokens = 0
+            self.prefix_stats = {}      # prefix class -> counters/ttfts
         else:
             self.bodies = [
                 json.dumps({"inputs": self.rs.rand(
@@ -187,6 +222,7 @@ class LoadGen:
         t0 = time.perf_counter()
         retry_after = None
         ttft, itls, ntok, last, done = None, [], 0, None, False
+        cached = None
         try:
             r = urllib.request.urlopen(urllib.request.Request(
                 self.url, data=body, headers=headers),
@@ -205,6 +241,7 @@ class LoadGen:
                     last = now
                 elif ev.get("done"):
                     done = True
+                    cached = ev.get("cached_tokens")
                 elif "error" in ev:
                     break
             code = r.status if done else 0
@@ -214,10 +251,11 @@ class LoadGen:
             e.read()
         except Exception:               # connection refused/reset, timeout
             code = 0
-        return code, time.perf_counter() - t0, retry_after, ttft, itls, ntok
+        return (code, time.perf_counter() - t0, retry_after, ttft, itls,
+                ntok, cached)
 
     def _record(self, i: int, code, dt: float, ttft=None, itls=(),
-                ntok: int = 0, trace_id=None):
+                ntok: int = 0, trace_id=None, cached=None):
         cls = self._class_of(i) or "default"
         kind = classify(code if code != 0 else "transport")
         with self.lock:
@@ -236,15 +274,30 @@ class LoadGen:
                         self.ttfts.setdefault(cls, []).append(ttft)
                     if itls:
                         self.itls.setdefault(cls, []).extend(itls)
+                    if self.prefix_mix and cached is not None:
+                        # hot = the server's prefix cache served >= one
+                        # full page of this prompt's KV; split TTFT by
+                        # it so the report shows what a cache hit buys
+                        pcls = self.prefix_cycle[
+                            i % len(self.prefix_cycle)] or "unique"
+                        st = self.prefix_stats.setdefault(
+                            pcls, {"requests": 0, "hits": 0,
+                                   "ttft_hot": [], "ttft_cold": []})
+                        st["requests"] += 1
+                        hot = cached > 0
+                        st["hits"] += int(hot)
+                        if ttft is not None:
+                            st["ttft_hot" if hot
+                               else "ttft_cold"].append(ttft)
 
     def _attempt(self, i: int, traceparent=None, trace_id=None):
         """One wire attempt in the configured workload; returns
         (code, retry_after)."""
         if self.mode == "decode":
-            code, dt, retry_after, ttft, itls, ntok = self._send_decode(
-                i, traceparent)
+            (code, dt, retry_after, ttft, itls, ntok,
+             cached) = self._send_decode(i, traceparent)
             self._record(i, code, dt, ttft=ttft, itls=itls, ntok=ntok,
-                         trace_id=trace_id)
+                         trace_id=trace_id, cached=cached)
         else:
             code, dt, retry_after = self._send(i, traceparent)
             self._record(i, code, dt, trace_id=trace_id)
@@ -377,6 +430,31 @@ class LoadGen:
                 "ttft_ms": _latency_stats(all_ttft),
                 "inter_token_ms": _latency_stats(all_itl),
             }
+            if self.prefix_mix:
+                total = sum(s["requests"]
+                            for s in self.prefix_stats.values())
+                hits = sum(s["hits"] for s in self.prefix_stats.values())
+                hot = [t for s in self.prefix_stats.values()
+                       for t in s["ttft_hot"]]
+                cold = [t for s in self.prefix_stats.values()
+                        for t in s["ttft_cold"]]
+                rep["prefix"] = {
+                    "cache_hit_rate": round(hits / total, 4)
+                    if total else None,
+                    "ttft_hot_ms": _latency_stats(hot),
+                    "ttft_cold_ms": _latency_stats(cold),
+                    "per_class": {
+                        pcls: {
+                            "requests": s["requests"],
+                            "cache_hit_rate": round(
+                                s["hits"] / s["requests"], 4)
+                            if s["requests"] else None,
+                            "ttft_hot_ms": _latency_stats(s["ttft_hot"]),
+                            "ttft_cold_ms": _latency_stats(
+                                s["ttft_cold"]),
+                        } for pcls, s in sorted(
+                            self.prefix_stats.items())},
+                }
         if len(self.class_cycle) > 1 or self.class_cycle[0] is not None:
             rep["per_class"] = {
                 cls: {"latency_ms": _latency_stats(
@@ -442,6 +520,16 @@ def main(argv=None) -> int:
     p.add_argument("--priority-mix", default=None,
                    help="weighted X-Priority cycle, e.g. "
                         "interactive=3,batch=1 (default: no header)")
+    p.add_argument("--prefix-mix", default=None,
+                   help="decode mode: weighted prompt-prefix class "
+                        "cycle, e.g. shared=3,unique=1 — 'shared' "
+                        "prompts open with one common prefix (the KV "
+                        "prefix-cache workload), everything else is "
+                        "fully unique; the report adds per-class cache "
+                        "hit rate and hot/cold TTFT splits")
+    p.add_argument("--shared-prefix-len", type=int, default=None,
+                   help="token length of the common prefix for the "
+                        "'shared' class (default: 2/3 of --prompt-len)")
     p.add_argument("--max-retries", type=int, default=3,
                    help="closed-loop retries of a 429/503 (honoring "
                         "Retry-After) before the request counts failed")
@@ -457,6 +545,9 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
     args.batch_sizes = [int(b) for b in str(args.batch_sizes).split(",") if b]
     args.priority_mix = parse_priority_mix(args.priority_mix)
+    args.prefix_mix = parse_priority_mix(args.prefix_mix)
+    if args.prefix_mix and args.mode != "decode":
+        raise SystemExit("--prefix-mix is a decode-mode workload knob")
 
     shape = ()
     if args.mode == "decode":

@@ -22,8 +22,9 @@ Entry points:
 Rule catalog + how to add a rule: docs/STATIC_ANALYSIS.md.
 """
 from deeplearning4j_tpu.analysis.core import (
-    Finding, ModuleInfo, PRAGMA_RULE, Rule, RunResult, apply_baseline,
-    iter_py_files, load_module, run as _run, write_baseline,
+    Finding, ModuleInfo, PRAGMA_RULE, Project, ProjectRule, Rule,
+    RunResult, apply_baseline, iter_py_files, load_module, run as _run,
+    write_baseline,
 )
 from deeplearning4j_tpu.analysis.rules import ALL_RULES
 from deeplearning4j_tpu.analysis.rules.telemetry import (
@@ -31,15 +32,17 @@ from deeplearning4j_tpu.analysis.rules.telemetry import (
 )
 
 
-def run(paths, rules=None, select=None) -> RunResult:
-    """Run the full registered suite (or `rules`) over `paths`."""
+def run(paths, rules=None, select=None, module_findings=None) -> RunResult:
+    """Run the full registered suite (or `rules`) over `paths`.
+    `module_findings` feeds the CLI's multiprocess per-module pass
+    (core.run docstring)."""
     return _run(paths, ALL_RULES if rules is None else rules,
-                select=select)
+                select=select, module_findings=module_findings)
 
 
 __all__ = [
-    "ALL_RULES", "Finding", "ModuleInfo", "PRAGMA_RULE", "Rule",
-    "RunResult", "apply_baseline", "extract_metric_families",
-    "iter_py_files", "load_module", "metric_families_in", "run",
-    "write_baseline",
+    "ALL_RULES", "Finding", "ModuleInfo", "PRAGMA_RULE", "Project",
+    "ProjectRule", "Rule", "RunResult", "apply_baseline",
+    "extract_metric_families", "iter_py_files", "load_module",
+    "metric_families_in", "run", "write_baseline",
 ]

@@ -209,6 +209,41 @@ class Rule:
                        col=getattr(node, "col_offset", 0), message=message)
 
 
+class Project:
+    """Everything project-wide rules share for one run: the parsed
+    modules plus lazily-built, cached cross-module models (call graph,
+    concurrency facts). Built once by `run()` so four rules don't build
+    four call graphs."""
+
+    def __init__(self, modules: Sequence[ModuleInfo]):
+        self.modules = list(modules)
+        self._concurrency = None
+
+    def concurrency(self):
+        """The shared ConcurrencyModel (analysis/concurrency.py) —
+        imported lazily to keep core.py's import graph acyclic."""
+        if self._concurrency is None:
+            from deeplearning4j_tpu.analysis.concurrency import (
+                ConcurrencyModel,
+            )
+            self._concurrency = ConcurrencyModel(self.modules)
+        return self._concurrency
+
+
+class ProjectRule(Rule):
+    """A rule that needs the WHOLE analyzed tree — the interprocedural
+    concurrency family. `check()` is a per-module no-op; the runner
+    calls `check_project(project)` once and routes each finding back to
+    its module for pragma suppression."""
+
+    def check(self, module: ModuleInfo) -> Iterable[Finding]:
+        return ()
+
+    def check_project(self, project: Project
+                      ) -> Iterable[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+
 # ---------------------------------------------------------------- runner
 def iter_py_files(paths: Sequence[str]) -> List[str]:
     out: List[str] = []
@@ -241,6 +276,9 @@ class RunResult:
     suppressed: List[Finding]          # pragma-silenced
     pragma_findings: List[Finding]     # bad/unused pragmas
     files: int = 0
+    #: the Project built for this run (lock-graph export reuses its
+    #: already-built concurrency model instead of re-analyzing)
+    project: Optional[Project] = None
 
     @property
     def all_unsuppressed(self) -> List[Finding]:
@@ -249,12 +287,23 @@ class RunResult:
 
 
 def run(paths: Sequence[str], rules: Sequence[Rule],
-        select: Optional[Set[str]] = None) -> RunResult:
+        select: Optional[Set[str]] = None,
+        module_findings: Optional[Dict[str, List[Finding]]] = None
+        ) -> RunResult:
     """Run `rules` over every .py under `paths`, applying pragma
-    suppression and pragma hygiene checks."""
+    suppression and pragma hygiene checks.
+
+    `module_findings` (path -> raw findings) lets a caller supply the
+    per-module rules' output computed elsewhere — the CLI's multiprocess
+    pass (tools/graftlint.py) farms exactly that part out to workers;
+    project-wide rules, pragmas and parse-error reporting always run
+    here (they need every module in one address space)."""
     active = [r for r in rules if select is None or r.name in select]
+    mod_rules = [r for r in active if not isinstance(r, ProjectRule)]
+    proj_rules = [r for r in active if isinstance(r, ProjectRule)]
     known = {r.name for r in rules} | {PRAGMA_RULE, PARSE_RULE}
     res = RunResult([], [], [])
+    modules: List[ModuleInfo] = []
     for path in iter_py_files(paths):
         mod = load_module(path)
         if mod is None:
@@ -265,11 +314,24 @@ def run(paths: Sequence[str], rules: Sequence[Rule],
                 message="file could not be read/parsed — the analyzer "
                         "inspected none of it"))
             continue
-        res.files += 1
-        raw: List[Finding] = []
-        for rule in active:
-            raw.extend(rule.check(mod))
-        _apply_pragmas(mod, raw, known, res, select)
+        modules.append(mod)
+    res.files = len(modules)
+    raw_by_path: Dict[str, List[Finding]] = {m.path: [] for m in modules}
+    if module_findings is not None:
+        for path, fs in module_findings.items():
+            if path in raw_by_path:
+                raw_by_path[path].extend(fs)
+    else:
+        for mod in modules:
+            for rule in mod_rules:
+                raw_by_path[mod.path].extend(rule.check(mod))
+    project = Project(modules)
+    res.project = project       # lock-graph export reuses the build
+    for rule in proj_rules:
+        for f in rule.check_project(project):
+            raw_by_path.setdefault(f.path, []).append(f)
+    for mod in modules:
+        _apply_pragmas(mod, raw_by_path[mod.path], known, res, select)
     res.findings.sort(key=lambda f: (f.path, f.line, f.rule))
     return res
 

@@ -13,12 +13,17 @@ from deeplearning4j_tpu.analysis.rules.hotpath import (
     HostSyncInHotPathRule, RecompileHazardRule,
 )
 from deeplearning4j_tpu.analysis.rules.locks import BlockingUnderLockRule
+from deeplearning4j_tpu.analysis.rules.lockorder import (
+    LockOrderInversionRule, TransitiveBlockingUnderLockRule,
+)
+from deeplearning4j_tpu.analysis.rules.pairing import ResourcePairingRule
 from deeplearning4j_tpu.analysis.rules.restore import (
     UnlaunderedRestorePlacementRule,
 )
 from deeplearning4j_tpu.analysis.rules.telemetry import (
     MetricFamilyRegistrationRule, TelemetryZeroCostRule,
 )
+from deeplearning4j_tpu.analysis.rules.threads import ThreadLifecycleRule
 
 ALL_RULES = [
     DonatedAliasingRule(),
@@ -27,6 +32,10 @@ ALL_RULES = [
     RecompileHazardRule(),
     EnvKnobContractRule(),
     BlockingUnderLockRule(),
+    LockOrderInversionRule(),
+    TransitiveBlockingUnderLockRule(),
+    ThreadLifecycleRule(),
+    ResourcePairingRule(),
     TelemetryZeroCostRule(),
     BareExceptSwallowRule(),
     MetricFamilyRegistrationRule(),

@@ -143,7 +143,7 @@ class NearestNeighborsServer:
         self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port), Handler)
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(target=self._httpd.serve_forever,
-                                        daemon=True)
+                                        daemon=True, name="nn-server")
         self._thread.start()
         return self
 

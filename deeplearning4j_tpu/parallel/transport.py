@@ -242,6 +242,7 @@ class SocketTransport:
                                     labels=("rank",)).inc(rank=self.rank)
                     continue
                 if peer not in self._out:
+                    # graftlint: disable=transitive-blocking-under-lock -- lazy reconnect under the serialize-writes lock is deadline-bounded (_connect's jittered backoff has a hard connect deadline); connecting outside it would let a racing send interleave wire frames on the fresh socket
                     self._out[peer] = self._connect(peer)
                 # graftlint: disable=blocking-under-lock -- serializing frame writes on the shared socket IS this lock's purpose — concurrent sendall would interleave wire frames; sends are bounded by the socket timeout
                 self._out[peer].sendall(data)

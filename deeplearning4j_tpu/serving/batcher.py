@@ -43,6 +43,7 @@ import numpy as np
 from deeplearning4j_tpu import monitor
 from deeplearning4j_tpu.monitor import flight
 from deeplearning4j_tpu.monitor import xla as xla_ledger
+from deeplearning4j_tpu.util.locks import DiagnosedLock
 
 log = logging.getLogger("deeplearning4j_tpu")
 
@@ -114,7 +115,8 @@ class ShapeBucketedBatcher:
         self.name = name
         self._queue: "queue.Queue[_Request]" = queue.Queue(maxsize=queue_limit)
         self._compiled: set = set()     # bucket sizes run in this generation
-        self._gen_lock = threading.Lock()
+        self._gen_lock = DiagnosedLock(
+            "deeplearning4j_tpu.serving.batcher.ShapeBucketedBatcher._gen_lock")
         self._stop = threading.Event()
         self._draining = threading.Event()
         self._worker = threading.Thread(target=self._serve_loop, daemon=True,

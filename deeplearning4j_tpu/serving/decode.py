@@ -71,6 +71,7 @@ from deeplearning4j_tpu.serving.quantize import (
     parse_variant, qdot, qtake, quantize_params,
 )
 from deeplearning4j_tpu.util.params import own_tree
+from deeplearning4j_tpu.util.locks import DiagnosedLock
 
 log = logging.getLogger("deeplearning4j_tpu")
 
@@ -814,9 +815,11 @@ class DecodeScheduler:
         self.name = name
         self.queue_limit = int(queue_limit)
         self._pending: deque = deque()
-        self._plock = threading.Lock()
+        self._plock = DiagnosedLock(
+            "deeplearning4j_tpu.serving.decode.DecodeScheduler._plock")
         self._runs: List[_EngineRun] = []
-        self._rlock = threading.Lock()
+        self._rlock = DiagnosedLock(
+            "deeplearning4j_tpu.serving.decode.DecodeScheduler._rlock")
         self._wake = threading.Event()
         self._stop = threading.Event()
         self._draining = False
@@ -1252,8 +1255,10 @@ class ServedLM:
         self.name = name
         self.cfg = decode if decode is not None else DecodeConfig()
         self.status = "loading"
-        self._swap_lock = threading.Lock()
-        self._state_lock = threading.Lock()
+        self._swap_lock = DiagnosedLock(
+            "deeplearning4j_tpu.serving.decode.ServedLM._swap_lock")
+        self._state_lock = DiagnosedLock(
+            "deeplearning4j_tpu.serving.decode.ServedLM._state_lock")
         engine = DecodeEngine(model, self.cfg, name=name)
         engine.warm()
         self.vocab = engine.vocab

@@ -55,6 +55,7 @@ from typing import Callable, List, Optional, Sequence, Tuple
 
 from deeplearning4j_tpu import monitor
 from deeplearning4j_tpu.monitor import flight
+from deeplearning4j_tpu.util.locks import DiagnosedLock
 
 log = logging.getLogger("deeplearning4j_tpu")
 
@@ -117,7 +118,8 @@ class Replica:
         self.consecutive_probe_failures = 0
         # router-maintained queue-depth signal (power-of-two-choices input)
         self._inflight = 0
-        self._inflight_lock = threading.Lock()
+        self._inflight_lock = DiagnosedLock(
+            "deeplearning4j_tpu.serving.fleet.Replica._inflight_lock")
         # supervisor restart bookkeeping
         self.restart_attempt = 0             # backoff exponent
         self.restart_at: Optional[float] = None
@@ -277,9 +279,15 @@ class SubprocessReplica(Replica):
         proc, lineq = self.proc, _queue.Queue()
 
         def _read_stdout():
-            for out_line in proc.stdout:
-                lineq.put(out_line)
-            lineq.put(None)                   # EOF marker
+            try:
+                for out_line in proc.stdout:
+                    lineq.put(out_line)
+            except Exception:                 # noqa: BLE001 — fail loud:
+                # a dead reader must not leave launch() waiting out its
+                # whole deadline on a queue nobody will ever feed
+                log.exception("fleet: %s stdout reader failed", self.name)
+            finally:
+                lineq.put(None)               # EOF/failure marker
 
         threading.Thread(target=_read_stdout, daemon=True,
                          name=f"{self.name}-stdout").start()
@@ -396,7 +404,9 @@ class ReplicaSupervisor:
         self._spawn = spawn_fn
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        self._lock = threading.Lock()        # serializes tick vs stop
+        self._lock = DiagnosedLock(
+            "deeplearning4j_tpu.serving.fleet.ReplicaSupervisor._lock"
+        )                                    # serializes tick vs stop
 
     # ------------------------------------------------------------- metrics
     def _note_restart(self, replica: Replica, reason: str):

@@ -66,6 +66,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from deeplearning4j_tpu import monitor
+from deeplearning4j_tpu.util.locks import DiagnosedLock
 
 #: physical page 0 — the write sink for inactive slots / padded positions.
 DUMP_PAGE = 0
@@ -141,7 +142,8 @@ class KVCacheState:
                 f"max-context sequence ({1 + self.pages_per_slot} needed)")
         self.name = name
         self.prefix_cache = bool(prefix_cache)
-        self._lock = threading.Lock()
+        self._lock = DiagnosedLock(
+            "deeplearning4j_tpu.serving.kvcache.KVCacheState._lock")
         #: logical->physical page map per slot; unallocated entries point
         #: at the dump page so fixed-shape gathers/scatters stay safe
         self.page_table = np.full((self.slots, self.pages_per_slot),

@@ -35,6 +35,7 @@ from deeplearning4j_tpu import monitor
 from deeplearning4j_tpu.serving.batcher import (
     DEFAULT_BUCKETS, ServerDrainingError, ShapeBucketedBatcher,
 )
+from deeplearning4j_tpu.util.locks import DiagnosedLock
 
 log = logging.getLogger("deeplearning4j_tpu")
 
@@ -173,8 +174,10 @@ class ServedModel:
         # multi-second warmup); _state_lock guards only brief mutations of
         # versions/active, so describe() and the predict hot path never
         # block behind a warming swap
-        self._swap_lock = threading.Lock()
-        self._state_lock = threading.Lock()
+        self._swap_lock = DiagnosedLock(
+            "deeplearning4j_tpu.serving.registry.ServedModel._swap_lock")
+        self._state_lock = DiagnosedLock(
+            "deeplearning4j_tpu.serving.registry.ServedModel._state_lock")
         self.versions: List[ServableVersion] = [
             ServableVersion(1, source, model)]
         self.active = 0                     # index into versions
@@ -305,11 +308,13 @@ class ModelRegistry:
     """Thread-safe name -> ServedModel registry (the servable manager)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = DiagnosedLock(
+            "deeplearning4j_tpu.serving.registry.ModelRegistry._lock")
         # deploys are rare admin ops: serializing them end-to-end (incl.
         # load+warm) closes the check-then-act race where two concurrent
         # deploys of one name would both build ServedModels and leak one
-        self._deploy_lock = threading.Lock()
+        self._deploy_lock = DiagnosedLock(
+            "deeplearning4j_tpu.serving.registry.ModelRegistry._deploy_lock")
         self._models: Dict[str, ServedModel] = {}
 
     def deploy(self, name: str, source,

@@ -54,6 +54,7 @@ from deeplearning4j_tpu import monitor
 from deeplearning4j_tpu.monitor import flight
 from deeplearning4j_tpu.serving.fleet import Replica
 from deeplearning4j_tpu.serving.server import retry_after_seconds
+from deeplearning4j_tpu.util.locks import DiagnosedLock
 
 log = logging.getLogger("deeplearning4j_tpu")
 
@@ -95,7 +96,8 @@ class CircuitBreaker:
         self.half_open_probes = int(half_open_probes)
         self._time = time_fn
         self._on_transition = on_transition
-        self._lock = threading.Lock()
+        self._lock = DiagnosedLock(
+            "deeplearning4j_tpu.serving.router.CircuitBreaker._lock")
         self._events: deque = deque(maxlen=self.window)   # 1=failure
         self.state = BREAKER_CLOSED
         self._opened_at = 0.0
@@ -283,7 +285,8 @@ class ResilientRouter:
         self._time = time_fn
         self._rng = rng if rng is not None else _random.Random()
         self._transport = transport
-        self._lock = threading.Lock()
+        self._lock = DiagnosedLock(
+            "deeplearning4j_tpu.serving.router.ResilientRouter._lock")
         #: (replica_name, model) -> (generation, CircuitBreaker)
         self._breakers: Dict[Tuple[str, str], Tuple[int, CircuitBreaker]] \
             = {}
@@ -506,9 +509,27 @@ class ResilientRouter:
 
         def run():
             t0 = time.perf_counter()
-            with monitor.bind_context(ctx):
-                self._fire_one(replica, model, path, body, headers,
-                               timeout, resq, t0)
+            try:
+                with monitor.bind_context(ctx):
+                    self._fire_one(replica, model, path, body, headers,
+                                   timeout, resq, t0)
+            except Exception as e:            # noqa: BLE001 — fail loud:
+                # a silently-dead send thread would make the caller wait
+                # out its whole deadline for an outcome that never comes
+                # (the PR-11 silent-thread-death class); surface the
+                # crash as an error outcome so failover can proceed now.
+                # Give back any half-open probe slot this send consumed:
+                # this crash path records neither success nor failure,
+                # and an unreturned slot wedges the breaker half-open
+                # forever (the PR-8 leak class). release() is a no-op
+                # outside half-open, so a crash AFTER _fire_one already
+                # recorded an outcome (state then left half-open) cannot
+                # double-account. inflight is NOT re-decremented here:
+                # _fire_one's finally owns it for every crash inside the
+                # transport call, the overwhelmingly dominant source.
+                self.breaker(replica, model).release()
+                log.exception("router: send to %s crashed", replica.name)
+                resq.put((replica, "error", e))
 
         threading.Thread(target=run, daemon=True,
                          name=f"route-{replica.name}").start()

@@ -131,3 +131,15 @@ def pytest_collection_modifyitems(config, items):
             item.add_marker(pytest.mark.slow)
         if base in _DISTRIBUTED:
             item.add_marker(pytest.mark.distributed)
+
+
+# ------------------------------------------------------ deadlock sentinel
+# A wedged test used to be a MUTE hang: the tier-1 `timeout` kill left
+# no evidence of who held what. Importing the hook arms the sentinel
+# (util/sentinel.py): per-test wall-time watchdog that dumps every
+# thread's stack + the DiagnosedLock holder table, then exits 3.
+# Knobs: DL4J_TPU_DEADLOCK_SENTINEL (only "0" disables),
+# DL4J_TPU_SENTINEL_TIMEOUT (seconds, default 300).
+from deeplearning4j_tpu.util.sentinel import (  # noqa: E402,F401
+    pytest_runtest_protocol,
+)

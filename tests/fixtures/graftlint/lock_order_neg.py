@@ -1,0 +1,41 @@
+"""Negative fixture: lock-order-inversion near-misses that must stay
+clean — a globally consistent order, condition variables, and
+re-entrant self-acquisition."""
+import threading
+
+_lock_a = threading.Lock()
+_lock_b = threading.Lock()
+_cv = threading.Condition()
+
+
+def consistent_one():
+    # a -> b here AND below: one global order, no cycle
+    with _lock_a:
+        with _lock_b:
+            pass
+
+
+def consistent_two():
+    with _lock_a:
+        with _lock_b:
+            pass
+
+
+def condition_wait():
+    # `with cv: cv.wait()` is the correct idiom — cv is not lock-ish
+    with _cv:
+        _cv.wait()
+
+
+class Reentrant:
+    def __init__(self):
+        self._lock = threading.RLock()
+
+    def _inner(self):
+        with self._lock:
+            pass
+
+    def outer(self):
+        # re-entrant self-acquire is not an ORDER between two locks
+        with self._lock:
+            self._inner()

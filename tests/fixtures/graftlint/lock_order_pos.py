@@ -1,0 +1,39 @@
+"""Positive fixture: lock-order-inversion — AB/BA acquisition cycle.
+
+`forward()` takes a then b; `backward()` takes b then a. Run
+concurrently, each thread can hold one lock and wait forever on the
+other. `indirect()` shows the interprocedural half: the a->b edge via a
+helper call participates in the same cycle.
+"""
+import threading
+
+_lock_a = threading.Lock()
+_lock_b = threading.Lock()
+
+
+def forward():
+    with _lock_a:
+        with _lock_b:  # EXPECT
+            pass
+
+
+def forward_multi():
+    # `with a, b:` acquires left to right — same a->b order as nesting
+    with _lock_a, _lock_b:  # EXPECT
+        pass
+
+
+def backward():
+    with _lock_b:
+        with _lock_a:  # EXPECT
+            pass
+
+
+def _helper_takes_b():
+    with _lock_b:
+        pass
+
+
+def indirect():
+    with _lock_a:
+        _helper_takes_b()  # EXPECT

@@ -1,0 +1,67 @@
+"""Negative fixture: resource-pairing near-misses that must stay clean.
+
+- releases inside try/finally pay every path at once;
+- denied-acquire branches hold nothing (`if not allow(): return`,
+  `info is None` admission failures);
+- a release-before-exit on the same branch;
+- a pure cross-function protocol (acquire here, release in finish())
+  is out of scope and stays silent.
+"""
+from multiprocessing.shared_memory import SharedMemory
+
+
+class Router:
+    def send(self):
+        return 200
+
+    def route_finally(self, breaker):
+        if not breaker.allow():
+            return None
+        try:
+            code = self.send()
+            if code in (429, 503):
+                return code
+            return code
+        finally:
+            breaker.release()
+
+    def route_released_branch(self, breaker):
+        if not breaker.allow():
+            return None
+        code = self.send()
+        if code in (429, 503):
+            breaker.release()
+            return code
+        breaker.record_success()
+        return code
+
+
+class Engine:
+    def admit(self, cache, prompt):
+        info = cache.admit_prompt(prompt)
+        if info is None:
+            return None       # denied admission: nothing held
+        cache.release(info)
+        return info
+
+
+class Scheduler:
+    """Cross-function protocol: admit here, release in finish() — the
+    per-function rule deliberately stays silent."""
+
+    def admit(self, cache, n):
+        self.slot = cache.admit(n)
+        return self.slot
+
+    def finish(self, cache):
+        cache.release(self.slot)
+
+
+def stage_batch(arr, limit):
+    shm = SharedMemory(create=True, size=arr.nbytes)
+    try:
+        if arr.nbytes > limit:
+            return None
+        return bytes(shm.buf[:arr.nbytes])
+    finally:
+        shm.unlink()

@@ -1,0 +1,33 @@
+"""Positive fixture: resource-pairing — the literal PR-8 half-open-slot
+leak. `route()` consumes a breaker probe slot with allow(); the
+backpressure branch (429/503) returns with NEITHER release() nor
+record_*() — the slot leaks and the breaker wedges half-open forever.
+The shared-memory variant leaks the segment on an early size bailout."""
+from multiprocessing.shared_memory import SharedMemory
+
+
+class Router:
+    def send(self):
+        return 200
+
+    def route(self, breaker):
+        if not breaker.allow():
+            return None
+        code = self.send()
+        if code in (429, 503):
+            return code  # EXPECT
+        if code >= 500:
+            breaker.record_failure()
+            return code
+        breaker.record_success()
+        return code
+
+
+def stage_batch(arr, limit):
+    shm = SharedMemory(create=True, size=arr.nbytes)
+    if arr.nbytes > limit:
+        return None  # EXPECT
+    shm.buf[:arr.nbytes] = arr.tobytes()
+    out = bytes(shm.buf[:arr.nbytes])
+    shm.unlink()
+    return out

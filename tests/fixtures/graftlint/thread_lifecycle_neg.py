@@ -1,0 +1,74 @@
+"""Negative fixture: thread-lifecycle near-misses that must stay clean.
+
+- a guarded loop target (the PR-11 FIX shape), named, daemonized;
+- a non-daemon thread joined in close();
+- a spawn helper given the name positionally (fleet's _threaded_spawn
+  convention);
+- an opaque stdlib target (serve_forever) that cannot be analyzed —
+  named, so nothing fires.
+"""
+import threading
+
+
+def _threaded_spawn(fn, name):
+    t = threading.Thread(target=fn, daemon=True, name=name)
+    t.start()
+    return t
+
+
+class Scheduler:
+    def __init__(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="decode-scheduler")
+        self._thread.start()
+
+    def _loop(self):
+        try:
+            while True:
+                self._admit()
+        except Exception:
+            self._fail_all()
+
+    def _admit(self):
+        pass
+
+    def _fail_all(self):
+        pass
+
+
+class Writer:
+    def __init__(self):
+        self._writer = threading.Thread(target=self._run, name="writer")
+        self._writer.start()
+
+    def _run(self):
+        try:
+            self._write()
+        except Exception:
+            pass
+
+    def _write(self):
+        pass
+
+    def close(self):
+        self._writer.join(timeout=5)
+
+
+class Helper:
+    def relaunch(self, replica):
+        return _threaded_spawn(lambda: self._do(replica),
+                               f"relaunch-{replica}")
+
+    def _do(self, replica):
+        try:
+            pass
+        except Exception:
+            pass
+
+
+class Server:
+    def __init__(self, httpd):
+        self._httpd = httpd
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True, name="http-server")
+        self._thread.start()

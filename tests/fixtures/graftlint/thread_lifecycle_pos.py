@@ -1,0 +1,68 @@
+"""Positive fixture: thread-lifecycle — three spawn sites, one defect
+each (one finding per line, so the EXPECT golden stays exact).
+
+`Scheduler` is the literal PR-11 shape: the decode scheduler's loop —
+the only thread that reclaims slots — with NO top-level exception
+guard; one admission error kills it silently while the servable keeps
+answering /readyz 200. The lexical PR-9 rules have nothing to say about
+it (pinned by test_thread_fixture_invisible_to_lexical_rules).
+"""
+import threading
+
+
+class Scheduler:
+    def __init__(self):
+        self._thread = threading.Thread(target=self._loop,  # EXPECT
+                                        daemon=True,
+                                        name="decode-scheduler")
+        self._thread.start()
+
+    def _loop(self):
+        while True:
+            self._admit()
+            self._step_all()
+
+    def _admit(self):
+        pass
+
+    def _step_all(self):
+        pass
+
+
+class Prober:
+    def __init__(self):
+        self._t = threading.Thread(target=self._probe, daemon=True)  # EXPECT
+        self._t.start()
+
+    def _probe(self):
+        while True:
+            try:
+                self._one()
+            except Exception:
+                return
+
+    def _one(self):
+        pass
+
+
+class Flusher:
+    """Non-daemon, stored on self, and no teardown method ever joins
+    it: interpreter exit blocks forever on a forgotten flush loop."""
+
+    def __init__(self):
+        self._flusher = threading.Thread(target=self._run,  # EXPECT
+                                         name="flusher")
+        self._flusher.start()
+
+    def _run(self):
+        while True:
+            try:
+                self._flush()
+            except Exception:
+                return
+
+    def _flush(self):
+        pass
+
+    def stop(self):
+        pass          # forgets self._flusher.join()

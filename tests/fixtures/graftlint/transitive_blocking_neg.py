@@ -1,0 +1,42 @@
+"""Negative fixture: transitive-blocking-under-lock near-misses.
+
+- the blocking work moved OUTSIDE the critical section (the PR-8 fix
+  shape: collect under the lock, act after it);
+- a nested def inside the region (runs on its own thread, not under
+  the lock);
+- `with cv: cv.wait()` (condition variables are not lock-ish);
+- a helper that only does cheap dict work.
+"""
+import subprocess
+import threading
+
+
+class Supervisor:
+    def __init__(self):
+        self._tick_lock = threading.Lock()
+        self.due = []
+        self.proc = None
+
+    def _boot(self):
+        self.proc = subprocess.Popen(["sleep", "5"])
+
+    def _bookkeep(self):
+        self.due.append(1)
+
+    def tick(self):
+        due = []
+        with self._tick_lock:
+            self._bookkeep()          # cheap: no blocking reachable
+            due.extend(self.due)
+
+            def _spawned_later():
+                # nested def: runs on its own activation, not under
+                # the lock the enclosing frame holds
+                self._boot()
+        for _ in due:
+            self._boot()              # blocking, but the lock is gone
+
+
+def condition_wait(cv=threading.Condition()):
+    with cv:
+        cv.wait()

@@ -1,0 +1,37 @@
+"""Positive fixture: transitive-blocking-under-lock — the literal PR-8
+supervisor shape. tick() holds the tick lock and calls _restart();
+_restart() calls _boot(); _boot() blocks on a subprocess spawn + wait.
+Nothing blocking is LEXICALLY inside the `with` — the pre-PR lexical
+blocking-under-lock rule sees nothing here (pinned by
+test_transitive_fixture_invisible_to_lexical_rule); only the call-graph
+walk finds it."""
+import subprocess
+import threading
+
+
+class Supervisor:
+    def __init__(self):
+        self._tick_lock = threading.Lock()
+        self.proc = None
+
+    def _boot(self):
+        self.proc = subprocess.Popen(["sleep", "5"])
+
+    def _restart(self):
+        self._boot()
+
+    def tick(self):
+        with self._tick_lock:
+            self._restart()  # EXPECT
+
+    def tick_two_hops(self):
+        with self._tick_lock:
+            probe_and_restart(self)  # EXPECT
+
+
+def probe_and_restart(sup):
+    _spawn_process()
+
+
+def _spawn_process():
+    subprocess.Popen(["sleep", "5"])

@@ -1,0 +1,436 @@
+"""Interprocedural concurrency analysis + runtime witness + sentinel.
+
+Three layers under test:
+
+1. the static models (analysis/callgraph.py + analysis/concurrency.py):
+   call resolution, lock identity, cross-module acquisition-order
+   edges, cycle detection, the --lock-graph artifact — including THE
+   acceptance pin: the live tree's graph covers the serving fleet's
+   lock population (>= 20 locks) with zero cycles;
+2. the runtime witness (util/locks.DiagnosedLock): drop-in lock
+   behavior, acquisition-order recording, the holder table, and the
+   static-vs-runtime cross-check — edges observed while driving the
+   real registry/batcher must keep the combined (static ∪ observed)
+   graph acyclic, with at least one static edge actually witnessed;
+3. the pytest deadlock sentinel (util/sentinel.py): a deliberately
+   deadlocked test run dumps BOTH threads' stacks and the lock-holder
+   table, then exits 3 instead of hanging mute (slow test: subprocess
+   pytest).
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from deeplearning4j_tpu.analysis import core as lint_core
+from deeplearning4j_tpu.analysis.callgraph import CallGraph
+from deeplearning4j_tpu.analysis.concurrency import (
+    ConcurrencyModel, find_cycles, lock_identity,
+)
+from deeplearning4j_tpu.analysis.rules.lockorder import (
+    LockOrderInversionRule,
+)
+from deeplearning4j_tpu.util import locks as locks_mod
+from deeplearning4j_tpu.util.locks import DiagnosedLock
+
+PKG = os.path.join(REPO, "deeplearning4j_tpu")
+
+
+def _load(tmp_path, name, body):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(body), encoding="utf-8")
+    mod = lint_core.load_module(str(p))
+    assert mod is not None
+    return mod
+
+
+# ------------------------------------------------------------- call graph
+def test_callgraph_resolves_self_methods_imports_and_nested(tmp_path):
+    a = _load(tmp_path, "alpha.py", """\
+        def helper():
+            pass
+
+        class C:
+            def m(self):
+                self.n()
+                helper()
+
+            def n(self):
+                def inner():
+                    helper()
+                inner()
+        """)
+    b = _load(tmp_path, "beta.py", """\
+        import alpha
+        from alpha import helper as h
+
+        def caller():
+            alpha.helper()
+            h()
+        """)
+    g = CallGraph([a, b])
+    assert g.edges["alpha.C.m"] == {"alpha.C.n", "alpha.helper"}
+    # plain-name resolution prefers the nested def chain
+    assert "alpha.C.n.inner" in g.edges["alpha.C.n"]
+    assert g.edges["alpha.C.n.inner"] == {"alpha.helper"}
+    # dotted + aliased from-import both land on the same function
+    assert g.edges["beta.caller"] == {"alpha.helper"}
+
+
+def test_callgraph_reach_chains_depth_limited(tmp_path):
+    m = _load(tmp_path, "chainmod.py", """\
+        def a():
+            b()
+        def b():
+            c()
+        def c():
+            d()
+        def d():
+            pass
+        """)
+    g = CallGraph([m])
+    one = g.reach_chains("chainmod.a", 1)
+    assert set(one) == {"chainmod.a", "chainmod.b"}
+    three = g.reach_chains("chainmod.a", 3)
+    assert three["chainmod.d"] == [
+        "chainmod.a", "chainmod.b", "chainmod.c", "chainmod.d"]
+
+
+def test_lock_identity_scopes(tmp_path):
+    mod = _load(tmp_path, "lockid.py", """\
+        import threading
+
+        _global_lock = threading.Lock()
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def use(self):
+                local_lock = threading.Lock()
+                with self._lock:
+                    pass
+                with local_lock:
+                    pass
+                with _global_lock:
+                    pass
+        """)
+    model = ConcurrencyModel([mod])
+    assert "lockid.C._lock" in model.locks
+    assert "lockid._global_lock" in model.locks
+    assert "lockid.C.use.<local>local_lock" in model.locks
+
+
+# ------------------------------------------------------ order graph/cycles
+def test_cross_module_lock_cycle_detected(tmp_path):
+    m1 = _load(tmp_path, "mod_one.py", """\
+        import threading
+        import mod_two
+
+        _lock = threading.Lock()
+
+        def take_ours_then_theirs():
+            with _lock:
+                mod_two.grab()
+
+        def grab():
+            with _lock:
+                pass
+        """)
+    m2 = _load(tmp_path, "mod_two.py", """\
+        import threading
+        import mod_one
+
+        _lock = threading.Lock()
+
+        def take_ours_then_theirs():
+            with _lock:
+                mod_one.grab()
+
+        def grab():
+            with _lock:
+                pass
+        """)
+    model = ConcurrencyModel([m1, m2])
+    pairs = {(e.src, e.dst) for e in model.order_edges}
+    assert ("mod_one._lock", "mod_two._lock") in pairs
+    assert ("mod_two._lock", "mod_one._lock") in pairs
+    assert model.cycles() == [["mod_one._lock", "mod_two._lock"]]
+    # the rule reports the cycle in BOTH modules, at the call sites
+    findings = list(LockOrderInversionRule().check_project(
+        lint_core.Project([m1, m2])))
+    assert {os.path.basename(f.path) for f in findings} == \
+        {"mod_one.py", "mod_two.py"}
+    assert all("cycle" in f.message for f in findings)
+
+
+def test_find_cycles_is_order_insensitive():
+    assert find_cycles([("a", "b"), ("b", "c")]) == []
+    assert find_cycles([("a", "b"), ("b", "a"), ("x", "y")]) == [
+        ["a", "b"]]
+
+
+# --------------------------------------------- THE live-tree acceptance
+def test_live_lock_graph_covers_fleet_and_is_acyclic():
+    """Acceptance: the acquisition-order graph over the shipped package
+    names >= 20 locks, carries real edges, and has ZERO cycles — the
+    fleet has one global lock order."""
+    files = lint_core.iter_py_files([PKG])
+    mods = [m for m in (lint_core.load_module(f) for f in files) if m]
+    model = ConcurrencyModel(mods)
+    doc = model.lock_graph_doc()
+    assert len(doc["locks"]) >= 20, sorted(doc["locks"])
+    assert len(doc["edges"]) >= 5
+    assert doc["cycles"] == []
+    # the serving stack's adopted DiagnosedLocks appear under their
+    # static identities (the runtime witness joins on these names)
+    for expected in (
+            "deeplearning4j_tpu.serving.registry.ModelRegistry._lock",
+            "deeplearning4j_tpu.serving.registry.ServedModel._swap_lock",
+            "deeplearning4j_tpu.serving.kvcache.KVCacheState._lock",
+            "deeplearning4j_tpu.serving.fleet.ReplicaSupervisor._lock"):
+        assert expected in doc["locks"], expected
+    # schema: every edge names its evidence
+    for e in doc["edges"]:
+        assert e["from"] in doc["locks"] or e["to"] in doc["locks"]
+        assert ":" in e["site"]
+
+
+# ------------------------------------------------------------ DiagnosedLock
+@pytest.fixture
+def recording():
+    was = locks_mod.recording_enabled()
+    locks_mod.enable_recording(True)
+    locks_mod.reset()
+    yield
+    locks_mod.reset()
+    locks_mod.enable_recording(was)
+
+
+def test_diagnosed_lock_is_a_drop_in_lock(recording):
+    lk = DiagnosedLock("t.a")
+    assert not lk.locked()
+    with lk:
+        assert lk.locked()
+        assert not lk.acquire(blocking=False)
+    assert not lk.locked()
+    rl = DiagnosedLock("t.r", reentrant=True)
+    with rl:
+        with rl:                      # re-entrant: no deadlock
+            assert rl.locked()
+    assert not rl.locked()
+
+
+def test_diagnosed_lock_records_edges_and_holders(recording):
+    a, b = DiagnosedLock("t.a"), DiagnosedLock("t.b")
+    with a:
+        table = locks_mod.holder_table()
+        assert table["t.a"][0] == threading.current_thread().name
+        with b:
+            pass
+    assert ("t.a", "t.b") in locks_mod.observed_edges()
+    assert ("t.b", "t.a") not in locks_mod.observed_edges()
+    assert "t.a" not in locks_mod.holder_table()
+    # re-entrant self-acquire is not an order edge
+    r = DiagnosedLock("t.r", reentrant=True)
+    with r:
+        with r:
+            pass
+    assert ("t.r", "t.r") not in locks_mod.observed_edges()
+    locks_mod.reset()
+    assert locks_mod.observed_edges() == set()
+
+
+def test_recording_off_is_free_of_bookkeeping():
+    locks_mod.enable_recording(False)
+    locks_mod.reset()
+    a, b = DiagnosedLock("off.a"), DiagnosedLock("off.b")
+    with a:
+        with b:
+            pass
+    assert locks_mod.observed_edges() == set()
+    assert locks_mod.holder_table() == {}
+
+
+# ------------------------------------------------- runtime witness check
+def test_runtime_witness_agrees_with_static_lock_graph(recording):
+    """Drive the REAL serving registry (deploy + swap + predict-warm
+    paths) with lock recording on, then cross-check: at least one
+    statically-derived edge is witnessed live, and adding every
+    observed edge to the static graph introduces NO cycle — runtime
+    execution never takes a lock order the static model calls
+    inverted."""
+    from deeplearning4j_tpu.nn.conf.base import InputType
+    from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.updaters import Adam
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.serving import ModelRegistry
+
+    def net(seed=0):
+        conf = (NeuralNetConfiguration.Builder().seed(seed)
+                .updater(Adam(1e-2)).list()
+                .layer(DenseLayer(n_out=8, activation="relu"))
+                .layer(OutputLayer(n_out=3, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(6)).build())
+        return MultiLayerNetwork(conf).init()
+
+    reg = ModelRegistry()
+    try:
+        reg.deploy("witness", net(0), buckets=(1, 4), max_delay_ms=1.0)
+        reg.get("witness").swap(net(1))
+    finally:
+        reg.shutdown(drain=False)
+
+    observed = locks_mod.observed_edges()
+    qualified = {(s, d) for s, d in observed
+                 if s.startswith("deeplearning4j_tpu.")
+                 and d.startswith("deeplearning4j_tpu.")}
+    assert qualified, "no DiagnosedLock edges observed — witness dead"
+
+    files = lint_core.iter_py_files([os.path.join(PKG, "serving")])
+    mods = [m for m in (lint_core.load_module(f) for f in files) if m]
+    model = ConcurrencyModel(mods)
+    static_pairs = {(e.src, e.dst) for e in model.order_edges}
+    witnessed_static = static_pairs & qualified
+    assert witnessed_static, (
+        f"no static edge witnessed live; observed={sorted(qualified)}")
+    # the combined graph must stay acyclic: if live execution added the
+    # reverse of any static edge, that's a latent AB/BA deadlock the
+    # static pass alone could not see
+    combined = static_pairs | qualified
+    assert find_cycles(combined) == [], (
+        f"static ∪ observed has a cycle; observed={sorted(qualified)}")
+
+
+# ------------------------------------------------------------------- CLI
+def _cli(*args):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "graftlint.py"),
+         *args],
+        capture_output=True, text=True, cwd=REPO, timeout=300)
+
+
+def test_cli_lock_graph_export(tmp_path):
+    out = str(tmp_path / "lockgraph.json")
+    r = _cli("--lock-graph", out, os.path.join(PKG, "serving"))
+    assert r.returncode == 0, r.stdout + r.stderr
+    doc = json.loads(open(out).read())
+    assert doc["version"] == 1
+    assert len(doc["locks"]) >= 10
+    assert doc["cycles"] == []
+    assert "lock graph" in r.stdout
+
+
+def test_cli_changed_only_is_clean_or_noop():
+    """--changed-only lints exactly the git-diff scope: on a clean tree
+    it reports a no-op; on a dirty-but-lint-clean tree it exits 0. (A
+    dirty tree with findings fails test_live_tree_is_clean too, so this
+    stays green exactly when the gate does.)"""
+    r = _cli("--changed-only", "--jobs", "1")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert ("nothing to lint" in r.stdout) or ("0 finding" in r.stdout)
+
+
+def test_cli_jobs_parallel_matches_serial(tmp_path):
+    dirty = tmp_path / "d"
+    dirty.mkdir()
+    (dirty / "one.py").write_text(
+        "import os\nv = os.environ.get('DL4J_TPU_X')\n")
+    (dirty / "two.py").write_text("x = 1\n")
+    for extra in range(6):
+        (dirty / f"pad{extra}.py").write_text("y = 2\n")
+    serial = _cli("--json", "--jobs", "1", str(dirty))
+    parallel = _cli("--json", "--jobs", "2", str(dirty))
+    assert serial.returncode == parallel.returncode == 2
+    sf = json.loads(serial.stdout)["findings"]
+    pf = json.loads(parallel.stdout)["findings"]
+    assert sf == pf and len(sf) == 1
+
+
+# ------------------------------------------------------- deadlock sentinel
+DEADLOCK_TEST = """\
+import threading
+import time
+
+from deeplearning4j_tpu.util.locks import DiagnosedLock
+
+A = DiagnosedLock("sentinel_fixture.A")
+B = DiagnosedLock("sentinel_fixture.B")
+
+
+def test_deliberate_ab_ba_deadlock():
+    ready = threading.Barrier(2)
+
+    def one():
+        with A:
+            ready.wait()
+            with B:
+                pass
+
+    def two():
+        with B:
+            ready.wait()
+            with A:
+                pass
+
+    t1 = threading.Thread(target=one, name="deadlock-one", daemon=True)
+    t2 = threading.Thread(target=two, name="deadlock-two", daemon=True)
+    t1.start()
+    t2.start()
+    t1.join()                      # hangs forever: the sentinel fires
+"""
+
+
+@pytest.mark.slow
+def test_deadlock_sentinel_dumps_both_stacks_and_holders(tmp_path):
+    """The runtime half of the acceptance: a deliberately deadlocked
+    test run exits 3 (not a mute hang) and the dump names BOTH
+    deadlocked threads, their stacks, and the DiagnosedLock holder
+    table."""
+    test_file = tmp_path / "test_deliberate_deadlock.py"
+    test_file.write_text(DEADLOCK_TEST, encoding="utf-8")
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               DL4J_TPU_DEADLOCK_SENTINEL="1",
+               DL4J_TPU_SENTINEL_TIMEOUT="4")
+    t0 = time.monotonic()
+    r = subprocess.run(
+        [sys.executable, "-m", "pytest", "-q", "-s",
+         "-p", "deeplearning4j_tpu.util.sentinel",
+         "-p", "no:cacheprovider", str(test_file)],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=300)
+    elapsed = time.monotonic() - t0
+    out = r.stdout + r.stderr
+    assert r.returncode == 3, f"rc={r.returncode}\n{out[-4000:]}"
+    assert elapsed < 240, "sentinel did not fire promptly"
+    assert "deadlock sentinel" in out
+    # the holder table names both locks and both holder threads
+    assert "sentinel_fixture.A" in out and "sentinel_fixture.B" in out
+    assert "deadlock-one" in out and "deadlock-two" in out
+    assert "held by" in out
+    # both stacks are present, pointing into the fixture's waiters
+    assert out.count("test_deliberate_deadlock.py") >= 2
+    assert "end sentinel dump" in out
+
+
+def test_sentinel_env_kill_switch_contract():
+    """DL4J_TPU_DEADLOCK_SENTINEL follows the =='0'-only-disables
+    contract (util/env.py): unset/''/true/'2' keep it armed."""
+    from deeplearning4j_tpu.util import sentinel
+    from deeplearning4j_tpu.util.env import scoped
+    for val, want in ((None, True), ("", True), ("1", True),
+                      ("true", True), ("2", True), ("0", False)):
+        with scoped("DL4J_TPU_DEADLOCK_SENTINEL", val):
+            assert sentinel._enabled() is want, (val, want)
+    with scoped("DL4J_TPU_SENTINEL_TIMEOUT", "17.5"):
+        assert sentinel._timeout_s() == 17.5

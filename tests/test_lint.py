@@ -38,7 +38,11 @@ def run_rule(rule_name, fixture, rule=None):
     rule = rule or RULES_BY_NAME[rule_name]
     mod = lint_core.load_module(os.path.join(FIXTURES, fixture))
     assert mod is not None, f"fixture {fixture} failed to parse"
-    return sorted(f.line for f in rule.check(mod))
+    if isinstance(rule, analysis.ProjectRule):
+        findings = rule.check_project(lint_core.Project([mod]))
+    else:
+        findings = rule.check(mod)
+    return sorted(f.line for f in findings)
 
 
 FIXTURE_MATRIX = [
@@ -59,7 +63,37 @@ FIXTURE_MATRIX = [
     ("telemetry-zero-cost", "telemetry_zero_cost_neg.py"),
     ("bare-except-swallow", os.path.join("parallel", "bare_except_pos.py")),
     ("bare-except-swallow", os.path.join("parallel", "bare_except_neg.py")),
+    ("lock-order-inversion", "lock_order_pos.py"),
+    ("lock-order-inversion", "lock_order_neg.py"),
+    ("transitive-blocking-under-lock", "transitive_blocking_pos.py"),
+    ("transitive-blocking-under-lock", "transitive_blocking_neg.py"),
+    ("thread-lifecycle", "thread_lifecycle_pos.py"),
+    ("thread-lifecycle", "thread_lifecycle_neg.py"),
+    ("resource-pairing", "resource_pairing_pos.py"),
+    ("resource-pairing", "resource_pairing_neg.py"),
 ]
+
+
+def test_pr8_and_pr11_shapes_invisible_to_lexical_rules():
+    """THE acceptance pin: the literal PR-8 transitive-blocking and
+    PR-11 silent-thread-death regression shapes are caught ONLY by the
+    new interprocedural rules — every pre-PR lexical rule reports
+    nothing on those fixtures."""
+    lexical = [r for r in analysis.ALL_RULES
+               if not isinstance(r, analysis.ProjectRule)
+               and r.name != "resource-pairing"]
+    for fixture in ("transitive_blocking_pos.py",
+                    "thread_lifecycle_pos.py"):
+        mod = lint_core.load_module(os.path.join(FIXTURES, fixture))
+        for rule in lexical:
+            hits = list(rule.check(mod))
+            assert hits == [], (
+                f"{rule.name} unexpectedly fires on {fixture}: {hits}")
+    # ...and the new rules DO catch them (the fixture goldens pin the
+    # exact lines; this is the cross-check that both halves exist)
+    assert run_rule("transitive-blocking-under-lock",
+                    "transitive_blocking_pos.py")
+    assert run_rule("thread-lifecycle", "thread_lifecycle_pos.py")
 
 
 @pytest.mark.parametrize("rule_name,fixture", FIXTURE_MATRIX,
@@ -208,12 +242,15 @@ def test_cli_baseline_burn_down_workflow(tmp_path):
     assert json.loads(r.stdout)["stale_baseline_entries"]  # ...and visible
 
 
-def test_cli_list_rules_names_all_nine():
+def test_cli_list_rules_names_all_thirteen():
     r = _cli("--list-rules")
     assert r.returncode == 0
     for name in RULES_BY_NAME:
         assert name in r.stdout
-    assert len(RULES_BY_NAME) == 9
+    assert len(RULES_BY_NAME) == 13
+    for new in ("lock-order-inversion", "transitive-blocking-under-lock",
+                "thread-lifecycle", "resource-pairing"):
+        assert new in RULES_BY_NAME
 
 
 # --------------------------------------------------------- the tier-1 gate
@@ -221,6 +258,14 @@ def test_live_tree_is_clean():
     """THE gate: zero unsuppressed findings over the shipped tree. If
     this fails, either fix the finding or suppress it with a justified
     `# graftlint: disable=<rule> -- <why>` pragma."""
+    # the gate runs the FULL registry — including the PR-15
+    # interprocedural concurrency rules (a select= or trimmed registry
+    # would silently narrow the invariant)
+    active = {r.name for r in analysis.ALL_RULES}
+    for required in ("lock-order-inversion",
+                     "transitive-blocking-under-lock",
+                     "thread-lifecycle", "resource-pairing"):
+        assert required in active
     res = analysis.run([os.path.join(REPO, "deeplearning4j_tpu"),
                         os.path.join(REPO, "tools"),
                         os.path.join(REPO, "bench.py")])

@@ -134,9 +134,11 @@ def _interferer_itl_p99(lm, vocab: int, rs, n_streams: int = 2,
                 return
             _t.sleep(0.001)
 
-    threads = [threading.Thread(target=short, args=(i,), daemon=True)
+    threads = [threading.Thread(target=short, args=(i,), daemon=True,
+                                name=f"smoke-short-{i}")
                for i in range(n_streams)]
-    intf = threading.Thread(target=interferer, daemon=True)
+    intf = threading.Thread(target=interferer, daemon=True,
+                            name="smoke-interferer")
     for t in threads:
         t.start()
     intf.start()
@@ -239,18 +241,24 @@ def main(argv=None) -> int:
     swap_state = {}
 
     def swapper():
-        # wait for traffic to be genuinely mid-flight, then hot-swap
-        time.sleep(0.5)
-        body = json.dumps({"source": arch + "&seed=777"}).encode()
-        t = time.perf_counter()
-        r = urllib.request.urlopen(urllib.request.Request(
-            server.url + "/v1/models/lm/swap", data=body,
-            headers={"Content-Type": "application/json"}), timeout=300)
-        swap_state["code"] = r.status
-        swap_state["swap_s"] = round(time.perf_counter() - t, 2)
-        swap_state["body"] = json.loads(r.read())
+        try:
+            # wait for traffic to be genuinely mid-flight, then hot-swap
+            time.sleep(0.5)
+            body = json.dumps({"source": arch + "&seed=777"}).encode()
+            t = time.perf_counter()
+            r = urllib.request.urlopen(urllib.request.Request(
+                server.url + "/v1/models/lm/swap", data=body,
+                headers={"Content-Type": "application/json"}), timeout=300)
+            swap_state["code"] = r.status
+            swap_state["swap_s"] = round(time.perf_counter() - t, 2)
+            swap_state["body"] = json.loads(r.read())
+        except Exception as e:              # noqa: BLE001 — fail loud:
+            # a silently-dead swapper reads as "swap never returned 200"
+            # with no cause; the gate below reports swap_state verbatim
+            swap_state["error"] = repr(e)
 
-    swap_thread = threading.Thread(target=swapper, daemon=True)
+    swap_thread = threading.Thread(target=swapper, daemon=True,
+                                   name="smoke-swapper")
     swap_thread.start()
     wall1, ok1 = gen.run_closed()
     swap_thread.join(timeout=300)

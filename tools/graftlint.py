@@ -4,6 +4,9 @@
     python tools/graftlint.py deeplearning4j_tpu tools bench.py
     python tools/graftlint.py --json ... | jq .
     python tools/graftlint.py --list-rules
+    python tools/graftlint.py --changed-only            # git-diff scope
+    python tools/graftlint.py --lock-graph lock.json    # order-graph dump
+    python tools/graftlint.py --jobs 8 ...              # parallel pass
     python tools/graftlint.py --write-baseline lint_baseline.json ...
     python tools/graftlint.py --baseline lint_baseline.json ...
 
@@ -22,8 +25,10 @@ findings fail; stale entries are reported so the file shrinks with the
 debt. See docs/STATIC_ANALYSIS.md.
 """
 import argparse
+import concurrent.futures
 import json
 import os
+import subprocess
 import sys
 import time
 import types
@@ -43,6 +48,65 @@ if "deeplearning4j_tpu" not in sys.modules:
     sys.modules["deeplearning4j_tpu"] = _pkg
 
 from deeplearning4j_tpu import analysis  # noqa: E402
+from deeplearning4j_tpu.analysis import core as _core  # noqa: E402
+
+
+def _worker(chunk, select_list):
+    """Per-module rule pass over one chunk of files — runs in a pool
+    worker. Project-wide rules, pragmas and parse-error reporting stay
+    in the parent (core.run); workers return plain Finding lists, which
+    pickle (no AST attached). Fork inherits this process's package STUB,
+    and under spawn the re-imported ``__mp_main__`` re-runs the stub
+    lines above before any analysis import — either way workers never
+    pay the heavy framework import."""
+    select = set(select_list) if select_list is not None else None
+    rules = [r for r in analysis.ALL_RULES
+             if not isinstance(r, analysis.ProjectRule)
+             and (select is None or r.name in select)]
+    out = []
+    for path in chunk:
+        mod = _core.load_module(path)
+        if mod is None:
+            continue              # the parent reports parse errors itself
+        findings = []
+        for rule in rules:
+            findings.extend(rule.check(mod))
+        out.append((path, findings))
+    return out
+
+
+def _parallel_module_pass(files, select, jobs):
+    """Fan the per-module rules out over `jobs` processes; returns the
+    path -> findings map core.run accepts, or None to run serially."""
+    if jobs <= 1 or len(files) < 3 * jobs:
+        return None
+    select_list = sorted(select) if select is not None else None
+    chunks = [files[i::jobs] for i in range(jobs)]
+    merged = {}
+    with concurrent.futures.ProcessPoolExecutor(max_workers=jobs) as ex:
+        for result in ex.map(_worker, chunks,
+                             [select_list] * len(chunks)):
+            for path, findings in result:
+                merged[path] = findings
+    return merged
+
+
+def _changed_files():
+    """Repo-relative .py files that differ from HEAD (staged, unstaged,
+    untracked) — the dev-loop scope for --changed-only."""
+    out = set()
+    for args in (["git", "diff", "--name-only", "HEAD"],
+                 ["git", "ls-files", "--others", "--exclude-standard"]):
+        proc = subprocess.run(args, capture_output=True, text=True,
+                              cwd=ROOT, timeout=30)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"{' '.join(args)} failed: {proc.stderr.strip()}")
+        for line in proc.stdout.splitlines():
+            line = line.strip()
+            if line.endswith(".py"):
+                out.add(os.path.abspath(os.path.join(ROOT, line)))
+    return out
 
 
 def _parser() -> argparse.ArgumentParser:
@@ -66,6 +130,18 @@ def _parser() -> argparse.ArgumentParser:
                         "and exit 0 (the burn-down workflow)")
     p.add_argument("--list-rules", action="store_true",
                    help="print the rule catalog and exit")
+    p.add_argument("--lock-graph", metavar="PATH",
+                   help="export the cross-module lock acquisition-order "
+                        "graph (locks, held->acquired edges with call "
+                        "chains, cycles) as JSON to PATH")
+    p.add_argument("--changed-only", action="store_true",
+                   help="lint only files changed vs HEAD (staged + "
+                        "unstaged + untracked). Dev-loop scope: the "
+                        "interprocedural rules see only the changed "
+                        "subset; CI runs the full tree")
+    p.add_argument("--jobs", type=int, default=None, metavar="N",
+                   help="processes for the per-module rule pass "
+                        "(default: min(8, cpu count); 1 = serial)")
     return p
 
 
@@ -97,8 +173,36 @@ def main(argv=None) -> int:
                   file=sys.stderr)
             return 1
     t0 = time.time()
+    paths = list(args.paths)
+    if args.changed_only:
+        try:
+            changed = _changed_files()
+        except (OSError, RuntimeError, subprocess.SubprocessError) as e:
+            print(f"graftlint: --changed-only needs git: {e}",
+                  file=sys.stderr)
+            return 1
+        paths = [f for f in analysis.iter_py_files(paths) if f in changed]
+        if not paths:
+            # clean working tree: a no-op scope is legitimately green
+            # (unlike a typo'd path, which still errors below)
+            print("graftlint: no changed Python files vs HEAD — "
+                  "nothing to lint")
+            if args.lock_graph:
+                # loud, not silent: the requested artifact was NOT
+                # (re)written — a consumer must not read a stale graph
+                # behind a green exit
+                print(f"graftlint: lock graph NOT written to "
+                      f"{args.lock_graph} (no files analyzed; run "
+                      "without --changed-only for the artifact)",
+                      file=sys.stderr)
+            return 0
+    jobs = args.jobs if args.jobs is not None else min(
+        8, os.cpu_count() or 1)
     try:
-        result = analysis.run(args.paths, select=select)
+        files = analysis.iter_py_files(paths)
+        module_findings = _parallel_module_pass(files, select, jobs)
+        result = analysis.run(paths, select=select,
+                              module_findings=module_findings)
     except OSError as e:
         print(f"graftlint: {e}", file=sys.stderr)
         return 1
@@ -108,6 +212,18 @@ def main(argv=None) -> int:
               f"{', '.join(args.paths)} — nothing was linted",
               file=sys.stderr)
         return 1
+    if args.lock_graph:
+        doc = result.project.concurrency().lock_graph_doc()
+        tmp = args.lock_graph + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(doc, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+        os.replace(tmp, args.lock_graph)
+        # stderr under --json: stdout is the machine-readable stream
+        print(f"graftlint: lock graph ({len(doc['locks'])} locks, "
+              f"{len(doc['edges'])} edges, {len(doc['cycles'])} "
+              f"cycle(s)) -> {args.lock_graph}",
+              file=sys.stderr if args.json else sys.stdout)
 
     if args.write_baseline:
         if select is not None:

@@ -177,7 +177,8 @@ def main(argv=None) -> int:
                                  "wedged_gen": wedged_gen}
             faults_done.set()
 
-        injector = threading.Thread(target=inject, daemon=True)
+        injector = threading.Thread(target=inject, daemon=True,
+                                    name="chaos-injector")
         injector.start()
         fault_wall, fault_ok = chaos.run_closed()
         injector.join(timeout=30)

@@ -344,17 +344,25 @@ class LoadGen:
         ok = [0]
 
         def worker():
-            while True:
-                with counter_lock:
-                    i = next(counter, None)
-                if i is None:
-                    return
-                if self.one_closed(i):
-                    with self.lock:
-                        ok[0] += 1
+            try:
+                while True:
+                    with counter_lock:
+                        i = next(counter, None)
+                    if i is None:
+                        return
+                    if self.one_closed(i):
+                        with self.lock:
+                            ok[0] += 1
+            except Exception as e:          # noqa: BLE001 — fail loud:
+                # a crashed worker silently shrinks concurrency and
+                # undercounts; the report must say why
+                print(f"serve_loadgen: worker crashed: {e!r}",
+                      file=sys.stderr)
+                raise
 
-        threads = [threading.Thread(target=worker, daemon=True)
-                   for _ in range(self.args.concurrency)]
+        threads = [threading.Thread(target=worker, daemon=True,
+                                    name=f"loadgen-worker-{w}")
+                   for w in range(self.args.concurrency)]
         t0 = time.perf_counter()
         for t in threads:
             t.start()
@@ -368,9 +376,14 @@ class LoadGen:
         ok = [0]
 
         def fire(i):
-            if self.one_open(i):
-                with self.lock:
-                    ok[0] += 1
+            try:
+                if self.one_open(i):
+                    with self.lock:
+                        ok[0] += 1
+            except Exception as e:          # noqa: BLE001 — fail loud
+                print(f"serve_loadgen: open-loop request {i} crashed: "
+                      f"{e!r}", file=sys.stderr)
+                raise
 
         t0 = time.perf_counter()
         for i in range(self.args.requests):
@@ -378,7 +391,8 @@ class LoadGen:
             delay = target - time.perf_counter()
             if delay > 0:
                 time.sleep(delay)
-            t = threading.Thread(target=fire, args=(i,), daemon=True)
+            t = threading.Thread(target=fire, args=(i,), daemon=True,
+                                 name=f"loadgen-fire-{i}")
             t.start()
             threads.append(t)
         for t in threads:

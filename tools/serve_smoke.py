@@ -98,8 +98,9 @@ def main() -> int:
                 if ver is not None:
                     versions_seen.add(ver)
 
-    threads = [threading.Thread(target=worker, daemon=True)
-               for _ in range(WORKERS)]
+    threads = [threading.Thread(target=worker, daemon=True,
+                                name=f"smoke-worker-{w}")
+               for w in range(WORKERS)]
     for t in threads:
         t.start()
 
@@ -168,9 +169,15 @@ def main() -> int:
     served.batcher.runner = lambda x: (time.sleep(0.4), real_runner(x))[1]
     got_429 = 0
     try:
-        stalled = [threading.Thread(
-            target=lambda: _post(predict_url, bodies[-1]), daemon=True)
-            for _ in range(4)]
+        def _stall():
+            try:
+                _post(predict_url, bodies[-1])
+            except Exception:               # noqa: BLE001 — sacrificial
+                pass                        # stall request; outcome unused
+
+        stalled = [threading.Thread(target=_stall, daemon=True,
+                                    name=f"smoke-stall-{s}")
+                   for s in range(4)]
         for t in stalled:
             t.start()
         time.sleep(0.1)

@@ -18,6 +18,12 @@ slow and is it compute, ETL, or comms"). Two dependency-free halves:
   families, live `train_mfu_pct` / `serving_mfu_pct` gauges, and a JSON
   perf-ledger artifact gated by tools/perf_report.py. Zero-cost while
   disabled (the default), same contract as `span()`.
+- **Time-series + SLO engine** (monitor/timeseries.py, monitor/slo.py):
+  a bounded ring of registry snapshots turning counters/histograms into
+  windowed rates and percentiles, and declarative SLO objectives
+  evaluated as multi-window burn-rate alerts whose firings call
+  `flight.trip()` — served at ``GET /v1/slo`` / ``GET /v1/timeseries``
+  by the serving stack. Zero-cost while disabled, same contract.
 
 Everything in-tree records into the default registry: the fit loops
 (step wall time, host sync, examples/sec, score), the async ETL pipeline
@@ -37,7 +43,8 @@ Quickstart:
 """
 from deeplearning4j_tpu.monitor.metrics import (
     DEFAULT_BUCKETS, REGISTRY, Counter, Gauge, Histogram, MetricsRegistry,
-    counter, dump, gauge, histogram, prometheus_text, summary,
+    counter, dump, gauge, histogram, openmetrics_text, prometheus_text,
+    summary,
 )
 from deeplearning4j_tpu.monitor.trace import (
     TRACEPARENT_HEADER, TraceContext, add_span, bind_context, clear_trace,
@@ -51,14 +58,21 @@ from deeplearning4j_tpu.monitor import xla  # noqa: E402,F401
 # the per-request flight recorder + SLO postmortems — namespaced as
 # monitor.flight; see docs/OBSERVABILITY.md "Tracing a single request"
 from deeplearning4j_tpu.monitor import flight  # noqa: E402,F401
+# the in-process metrics time-series ring (windowed rates/percentiles)
+# — namespaced as monitor.timeseries; docs/OBSERVABILITY.md "SLOs and
+# burn-rate alerting"
+from deeplearning4j_tpu.monitor import timeseries  # noqa: E402,F401
+# the SLO engine (objectives, multi-window burn-rate alerts, fleet
+# verdicts on GET /v1/slo) — namespaced as monitor.slo
+from deeplearning4j_tpu.monitor import slo  # noqa: E402,F401
 
 __all__ = [
     "DEFAULT_BUCKETS", "REGISTRY", "Counter", "Gauge", "Histogram",
     "MetricsRegistry", "counter", "dump", "gauge", "histogram",
-    "prometheus_text", "summary",
+    "openmetrics_text", "prometheus_text", "summary",
     "TRACEPARENT_HEADER", "TraceContext", "add_span", "bind_context",
     "clear_trace", "current_context", "disable_tracing", "enable_tracing",
     "instant", "mint_context", "parse_traceparent", "save_trace", "span",
     "trace_events", "tracing_enabled",
-    "xla", "flight",
+    "xla", "flight", "timeseries", "slo",
 ]

@@ -9,6 +9,12 @@ fixed-bucket histograms, exposed two ways:
   telemetry without extra dependencies;
 - `dump()` / `summary()` — plain dict views for tests and CLI tools.
 
+Two more faces serve specific consumers: `openmetrics_text()` is the
+opt-in OpenMetrics 1.0 exposition (``GET /metrics?format=openmetrics``)
+that renders histogram trace exemplars scrapably, and `raw_sample()` is
+the compact numeric snapshot `monitor/timeseries.py` rings buffer to
+compute windowed rates, percentiles and SLO burn rates.
+
 Design notes:
 
 - Metric *families* (name + label names) hold *children* (one per label
@@ -111,6 +117,32 @@ class _Family:
             return [self._dump_series(k, c)
                     for k, c in sorted(self._children.items())]
 
+    def _raw_value(self, child):
+        """The child's compact numeric state for raw_sample() — floats
+        for counters/gauges, (bucket_counts, sum, count) for
+        histograms. Must be immutable-by-copy: the time-series ring
+        stores it verbatim."""
+        raise NotImplementedError
+
+    # OpenMetrics rendering -----------------------------------------------
+    def _om_name(self) -> str:
+        """The family's OpenMetrics metric name (counters drop the
+        _total suffix on HELP/TYPE lines; samples keep it)."""
+        return self.name
+
+    def _render_om(self, lines: List[str]):
+        base = self._om_name()
+        lines.append(f"# HELP {base} {self.help}")
+        lines.append(f"# TYPE {base} {self.type_name}")
+        with self._lock:
+            for key, child in sorted(self._children.items()):
+                self._render_om_child(lines, key, child)
+
+    def _render_om_child(self, lines, key, child):
+        # identical to v0.0.4 for scalars; Histogram overrides to carry
+        # exemplars
+        self._render_child(lines, key, child)
+
 
 class Counter(_Family):
     """Monotonically increasing value (events, bytes, steps)."""
@@ -138,6 +170,16 @@ class Counter(_Family):
         return {"labels": dict(zip(self.label_names, key)),
                 "value": float(child[0])}
 
+    def _raw_value(self, child):
+        return float(child[0])
+
+    def _om_name(self) -> str:
+        # OpenMetrics: a counter family is named without the _total
+        # suffix; the sample lines keep it
+        if self.name.endswith("_total"):
+            return self.name[:-len("_total")]
+        return self.name
+
 
 class Gauge(_Family):
     """Point-in-time value (queue depth, last score, examples/sec)."""
@@ -164,6 +206,7 @@ class Gauge(_Family):
 
     _render_child = Counter._render_child
     _dump_series = Counter._dump_series
+    _raw_value = Counter._raw_value
 
 
 class _HistChild:
@@ -265,6 +308,31 @@ class Histogram(_Family):
         lines.append(f"{self.name}_sum{ls} {_fmt(child.sum)}")
         lines.append(f"{self.name}_count{ls} {child.count}")
 
+    def _raw_value(self, child):
+        return (tuple(child.counts), float(child.sum), int(child.count))
+
+    def _render_om_child(self, lines, key, child):
+        """Bucket lines as in v0.0.4 plus OpenMetrics exemplar syntax
+        (`... # {trace_id="..."} value`) on buckets that saw an
+        exemplar-carrying observation — the scrapeable face of the
+        PR-13 trace exemplars."""
+        ex = child.exemplars or {}
+        bounds = tuple(_fmt(b) for b in self.buckets) + ("+Inf",)
+        cum = 0
+        for i, bound in enumerate(bounds):
+            cum += child.counts[i]
+            line = (f"{self.name}_bucket"
+                    f"{_label_str(self.label_names + ('le',), key + (bound,))}"
+                    f" {cum}")
+            if i in ex:
+                value, trace_id = ex[i]
+                line += (f' # {{trace_id="{_escape_label(trace_id)}"}}'
+                         f" {_fmt(value)}")
+            lines.append(line)
+        ls = _label_str(self.label_names, key)
+        lines.append(f"{self.name}_sum{ls} {_fmt(child.sum)}")
+        lines.append(f"{self.name}_count{ls} {child.count}")
+
     def _dump_series(self, key, child):
         cum, buckets = 0, {}
         for b, cnt in zip(self.buckets, child.counts):
@@ -338,6 +406,44 @@ class MetricsRegistry:
             fam._render(lines)
         return "\n".join(lines) + ("\n" if lines else "")
 
+    def openmetrics_text(self) -> str:
+        """The registry in OpenMetrics 1.0 text format — the opt-in
+        exposition behind ``GET /metrics?format=openmetrics``. Three
+        deliberate differences from `prometheus_text()` (which stays
+        byte-identical): counter families are declared without the
+        ``_total`` suffix (samples keep it), histogram bucket lines
+        carry ``# {trace_id="..."} value`` exemplars where one was
+        observed, and the stream ends with ``# EOF``."""
+        with self._lock:
+            fams = sorted(self._families.items())
+        lines: List[str] = []
+        for _, fam in fams:
+            fam._render_om(lines)
+        lines.append("# EOF")
+        return "\n".join(lines) + "\n"
+
+    def raw_sample(self) -> Tuple[dict, dict]:
+        """Compact numeric snapshot for monitor/timeseries.py's ring.
+
+        Returns ``(meta, values)``: ``meta`` maps family name ->
+        ``(type_name, label_names, buckets_or_None)``; ``values`` maps
+        ``(family, label_values)`` -> the child's raw state (float for
+        counters/gauges, ``(bucket_counts, sum, count)`` for
+        histograms). Cheaper than dump() — no cumulative re-render, no
+        per-series dicts — because the ring stores hundreds of these.
+        """
+        with self._lock:
+            fams = list(self._families.items())
+        meta: Dict[str, tuple] = {}
+        values: Dict[Tuple[str, Tuple[str, ...]], object] = {}
+        for name, fam in fams:
+            meta[name] = (fam.type_name, fam.label_names,
+                          getattr(fam, "buckets", None))
+            with fam._lock:
+                for key, child in fam._children.items():
+                    values[(name, key)] = fam._raw_value(child)
+        return meta, values
+
     def dump(self) -> dict:
         """Full structured view: {name: {type, help, series: [...]}}.
         Histogram series carry cumulative buckets plus sum/count."""
@@ -402,6 +508,10 @@ def histogram(name: str, help: str = "", labels: Sequence[str] = (),
 
 def prometheus_text() -> str:
     return REGISTRY.prometheus_text()
+
+
+def openmetrics_text() -> str:
+    return REGISTRY.openmetrics_text()
 
 
 def dump() -> dict:

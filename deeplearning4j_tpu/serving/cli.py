@@ -125,8 +125,24 @@ def build_parser() -> argparse.ArgumentParser:
                           "for served processes; the ring is bounded "
                           "host memory, never on the compiled path)")
     obs.add_argument("--slo-p99-ms", type=float, default=None,
-                     help="fleet mode: router-tracked predict p99 above "
-                          "this trips an automatic postmortem")
+                     help="latency SLO: 99%% of requests must finish "
+                          "under this; breaches fire the SLO engine's "
+                          "burn-rate alert (reason p99_breach) and an "
+                          "automatic postmortem")
+    obs.add_argument("--slo-availability", type=float, default=None,
+                     metavar="TARGET",
+                     help="availability SLO target (e.g. 0.999). Any "
+                          "--slo-* flag enables the in-process time-"
+                          "series ring + multi-window burn-rate "
+                          "alerting; verdicts on GET /v1/slo, firings "
+                          "trip flight postmortems")
+    obs.add_argument("--slo-sample-interval-s", type=float, default=5.0,
+                     help="time-series sampling interval while an "
+                          "--slo-* objective is active")
+    obs.add_argument("--slo-windows", default=None, metavar="FL,FS,SL,SS",
+                     help="override the burn-rate windows (seconds): "
+                          "fast-long,fast-short,slow-long,slow-short "
+                          "(default 3600,300,21600,1800)")
     # ------------------------------------------------------ fleet mode
     fleet = p.add_argument_group(
         "fleet mode (docs/SERVING.md 'Fleet operations')")
@@ -247,13 +263,20 @@ def main(argv=None) -> int:
                           "max_context": served.max_context}),
               file=sys.stderr)
 
+    from deeplearning4j_tpu.monitor import slo as slo_mod
+    slo_engine = _slo_setup(args, slo_mod.server_objectives(
+        slo_p99_ms=args.slo_p99_ms,
+        availability_target=args.slo_availability))
     server = ModelServer(registry, host=args.host, port=args.port,
                          default_deadline_s=args.deadline_s,
-                         enable_faults=args.enable_fault_injection)
+                         enable_faults=args.enable_fault_injection,
+                         slo_engine=slo_engine)
+    endpoints = ["/v1/models", "/healthz", "/readyz", "/metrics"]
+    if slo_engine is not None:
+        endpoints += ["/v1/slo", "/v1/timeseries"]
     print(json.dumps({"serving": server.url,
                       "models": registry.names(),
-                      "endpoints": ["/v1/models", "/healthz", "/readyz",
-                                    "/metrics"]}))
+                      "endpoints": endpoints}))
     sys.stdout.flush()
 
     stop = threading.Event()
@@ -272,6 +295,35 @@ def main(argv=None) -> int:
         print(json.dumps({"trace_out": args.trace_out, "events": n}),
               file=sys.stderr)
     return 0
+
+
+def _slo_enabled(args) -> bool:
+    return (args.slo_availability is not None
+            or args.slo_p99_ms is not None)
+
+
+def _slo_setup(args, objectives):
+    """Enable the time-series ring + SLO engine from --slo-* flags.
+    Returns the engine (None when no --slo-* flag was given)."""
+    if not objectives:
+        return None
+    from deeplearning4j_tpu.monitor import slo, timeseries
+    rules = slo.DEFAULT_RULES
+    if args.slo_windows:
+        try:
+            fl, fs, sl, ss = (float(x)
+                              for x in args.slo_windows.split(","))
+        except ValueError:
+            raise SystemExit("--slo-windows expects 4 comma-separated "
+                             f"seconds, got {args.slo_windows!r}")
+        # keep the workbook burn thresholds, scale the flap-suppression
+        # hold with the short windows
+        rules = (slo.BurnRule("page", fl, fs, 14.4,
+                              keep_firing_s=max(2.0, fs / 2)),
+                 slo.BurnRule("ticket", sl, ss, 6.0,
+                              keep_firing_s=max(2.0, ss / 2)))
+    timeseries.enable_timeseries(interval_s=args.slo_sample_interval_s)
+    return slo.enable_slo(objectives, rules=rules)
 
 
 def _decode_config(args):
@@ -320,7 +372,11 @@ def _main_fleet(args, specs, lm_specs, buckets, decode_cfg) -> int:
                        trace_out=args.trace_out,
                        postmortem_dir=args.postmortem_dir,
                        flight=not args.no_flight,
-                       flight_records=args.flight_records)
+                       flight_records=args.flight_records,
+                       slo_availability=args.slo_availability,
+                       slo_p99_ms=args.slo_p99_ms,
+                       slo_sample_interval_s=args.slo_sample_interval_s,
+                       slo_windows=args.slo_windows)
     if args.replica_mode == "subprocess":
         for _, source in specs + lm_specs:
             base, _variant = parse_variant(source)
@@ -352,14 +408,22 @@ def _main_fleet(args, specs, lm_specs, buckets, decode_cfg) -> int:
         per_replica_inflight=args.per_replica_inflight,
         hedge=not args.no_hedge, timeout_s=args.deadline_s,
         slo_p99_ms=args.slo_p99_ms)
+    from deeplearning4j_tpu.monitor import slo as slo_mod
+    slo_engine = _slo_setup(args, slo_mod.router_objectives(
+        slo_p99_ms=args.slo_p99_ms,
+        availability_target=args.slo_availability))
     server = RouterServer(router, supervisor=supervisor,
-                          host=args.host, port=args.port)
+                          host=args.host, port=args.port,
+                          slo_engine=slo_engine)
+    endpoints = ["/v1/models", "/v1/fleet", "/healthz", "/readyz",
+                 "/metrics"]
+    if slo_engine is not None:
+        endpoints += ["/v1/slo", "/v1/timeseries"]
     print(json.dumps({"serving": server.url, "role": "router",
                       "replicas": [r.describe() for r in
                                    supervisor.replicas],
                       "priority_classes": list(classes),
-                      "endpoints": ["/v1/models", "/v1/fleet", "/healthz",
-                                    "/readyz", "/metrics"]}))
+                      "endpoints": endpoints}))
     sys.stdout.flush()
 
     stop = threading.Event()

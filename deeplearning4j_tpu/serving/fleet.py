@@ -79,7 +79,11 @@ class ReplicaSpec:
                  trace_out: Optional[str] = None,
                  postmortem_dir: Optional[str] = None,
                  flight: bool = True,
-                 flight_records: int = 512):
+                 flight_records: int = 512,
+                 slo_availability: Optional[float] = None,
+                 slo_p99_ms: Optional[float] = None,
+                 slo_sample_interval_s: float = 5.0,
+                 slo_windows: Optional[str] = None):
         self.models = list(models)              # [(name, source), ...]
         self.buckets = tuple(int(b) for b in buckets)
         self.max_delay_ms = float(max_delay_ms)
@@ -102,6 +106,14 @@ class ReplicaSpec:
         #: recorder, not just the router's)
         self.flight = bool(flight)
         self.flight_records = int(flight_records)
+        #: replica-side SLO engine knobs (monitor/slo.py), threaded as
+        #: --slo-* flags so each subprocess replica runs its own
+        #: objectives and the router's /v1/slo fan-out aggregates them
+        self.slo_availability = (None if slo_availability is None
+                                 else float(slo_availability))
+        self.slo_p99_ms = None if slo_p99_ms is None else float(slo_p99_ms)
+        self.slo_sample_interval_s = float(slo_sample_interval_s)
+        self.slo_windows = slo_windows
 
 
 class Replica:
@@ -266,6 +278,16 @@ class SubprocessReplica(Replica):
             argv.append("--no-flight")
         elif self.spec.flight_records != 512:
             argv += ["--flight-records", str(self.spec.flight_records)]
+        if self.spec.slo_availability is not None:
+            argv += ["--slo-availability", str(self.spec.slo_availability)]
+        if self.spec.slo_p99_ms is not None:
+            argv += ["--slo-p99-ms", str(self.spec.slo_p99_ms)]
+        if (self.spec.slo_availability is not None
+                or self.spec.slo_p99_ms is not None):
+            argv += ["--slo-sample-interval-s",
+                     str(self.spec.slo_sample_interval_s)]
+            if self.spec.slo_windows:
+                argv += ["--slo-windows", self.spec.slo_windows]
         return argv
 
     def launch(self):

@@ -52,8 +52,12 @@ from urllib.parse import urlparse
 
 from deeplearning4j_tpu import monitor
 from deeplearning4j_tpu.monitor import flight
+from deeplearning4j_tpu.monitor import slo as slo_mod
+from deeplearning4j_tpu.monitor import timeseries as timeseries_mod
 from deeplearning4j_tpu.serving.fleet import Replica
-from deeplearning4j_tpu.serving.server import retry_after_seconds
+from deeplearning4j_tpu.serving.server import (
+    metrics_payload, retry_after_seconds, timeseries_doc,
+)
 from deeplearning4j_tpu.util.locks import DiagnosedLock
 
 log = logging.getLogger("deeplearning4j_tpu")
@@ -292,9 +296,11 @@ class ResilientRouter:
             = {}
         #: model -> deque of recent successful latencies (hedge p99 input)
         self._latencies: Dict[str, deque] = {}
-        #: p99 SLO (ms): tracked p99 beyond it trips a flight postmortem
+        #: p99 SLO (ms), kept as declared configuration: the breach
+        #: itself is watched by monitor/slo.py's latency burn-rate
+        #: alert over serving_router_request_seconds (the CLI wires
+        #: --slo-p99-ms into an Objective with reason="p99_breach")
         self.slo_p99_ms = None if slo_p99_ms is None else float(slo_p99_ms)
-        self._slo_notes = 0
 
     # ------------------------------------------------------------- breakers
     def breaker(self, replica: Replica, model: str) -> CircuitBreaker:
@@ -368,24 +374,14 @@ class ResilientRouter:
 
     # -------------------------------------------------------------- hedging
     def _note_latency(self, model: str, seconds: float):
-        check = None
+        # feeds hedge_delay's tracked p99 only — SLO breach detection
+        # moved to monitor/slo.py's windowed burn-rate alert, which
+        # replaced the old every-16th-sample check here
         with self._lock:
             dq = self._latencies.get(model)
             if dq is None:
                 dq = self._latencies[model] = deque(maxlen=512)
             dq.append(seconds)
-            if self.slo_p99_ms is not None:
-                self._slo_notes += 1
-                # check every 16th sample (p99 over <16 samples is
-                # noise, and sorting 512 floats per request is waste)
-                if self._slo_notes % 16 == 0 and len(dq) >= 32:
-                    check = list(dq)
-        if check is not None:
-            p99_ms = _percentile(check, 99) * 1e3
-            if p99_ms > self.slo_p99_ms:
-                flight.trip("p99_breach", model=model,
-                            p99_ms=round(p99_ms, 3),
-                            slo_ms=self.slo_p99_ms)
 
     def hedge_delay(self, model: str) -> Optional[float]:
         """Fire a hedge after the tracked p99 (never sooner than
@@ -943,9 +939,16 @@ class _RouterHandler(BaseHTTPRequestHandler):
                                rng=self._rs.router._rng))),))
             return
         if url.path == "/metrics":
-            self._reply(200, [("Content-Type",
-                               "text/plain; version=0.0.4; charset=utf-8")],
-                        monitor.prometheus_text().encode())
+            body, ctype = metrics_payload(url.query)
+            self._reply(200, [("Content-Type", ctype)], body)
+            return
+        if url.path == "/v1/timeseries":
+            ring = (self._rs.timeseries_ring
+                    or timeseries_mod.default_ring())
+            self._json(timeseries_doc(ring, url.query))
+            return
+        if url.path == "/v1/slo":
+            self._slo()
             return
         if url.path == "/v1/fleet":
             sup = self._rs.supervisor
@@ -997,6 +1000,57 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 self._json({"error": str(e)}, code=503)
             return
         self._json({"error": "not found"}, code=404)
+
+    def _slo(self):
+        """GET /v1/slo — the fleet SLO verdict: the router's own
+        engine's verdict plus every healthy replica's /v1/slo, fetched
+        in PARALLEL (one 5 s budget total, same pattern as
+        /v1/debug/flight), folded into one worst-state-wins summary."""
+        engine = self._rs.slo_engine or slo_mod.default_engine()
+        doc = {"router": (engine.verdict() if engine is not None
+                          else {"enabled": False}),
+               "replicas": {}}
+        lock = threading.Lock()
+
+        def _one(r: Replica):
+            try:
+                code, _, payload = self._rs.router._transport(
+                    r, "/v1/slo", None, {}, 5.0)
+                out = json.loads(payload) if code == 200 \
+                    else {"error": f"http_{code}"}
+            except (ReplicaTransportError, ValueError) as e:
+                out = {"error": str(e)}
+            with lock:
+                doc["replicas"][r.name] = out
+
+        threads = [threading.Thread(target=_one, args=(r,), daemon=True,
+                                    name=f"slo-{r.name}")
+                   for r in self._rs.router._replicas_fn()]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        order = {"ok": 0, "pending": 1, "firing": 2}
+        worst, firing, unreachable, reporting = "ok", [], [], 0
+        verdicts = [("router", doc["router"])] \
+            + sorted(doc["replicas"].items())
+        for name, v in verdicts:
+            if not v.get("enabled"):
+                if "error" in v:
+                    unreachable.append(name)
+                continue
+            reporting += 1
+            state = v.get("state", "ok")
+            if order.get(state, 0) > order[worst]:
+                worst = state
+            for obj in v.get("objectives", []):
+                for alert in obj.get("alerts", []):
+                    if alert.get("state") == "firing":
+                        firing.append(
+                            f"{name}:{obj['name']}:{alert['severity']}")
+        doc["fleet"] = {"state": worst, "reporting": reporting,
+                        "unreachable": unreachable, "firing": firing}
+        self._json(doc)
 
     def do_POST(self):
         url = urlparse(self.path)
@@ -1102,9 +1156,14 @@ class RouterServer:
     supervisor whose fleet it routes)."""
 
     def __init__(self, router: ResilientRouter, supervisor=None,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 slo_engine=None, timeseries_ring=None):
         self.router = router
         self.supervisor = supervisor
+        # GET /v1/slo and /v1/timeseries sources; None falls back to
+        # the process defaults the CLI's --slo-* flags install
+        self.slo_engine = slo_engine
+        self.timeseries_ring = timeseries_ring
         #: flipped before teardown: /readyz -> 503 so the balancer
         #: drains us while in-flight work finishes (see cli._main_fleet)
         self.draining = False

@@ -13,10 +13,17 @@ ThreadingHTTPServer pattern as ui/server.py) in front of a ModelRegistry:
     POST /v1/models/{name}/rollback
     GET  /healthz                     process liveness (always 200)
     GET  /readyz                      200 only when warmed and not draining
-    GET  /metrics                     Prometheus exposition (monitor/)
+    GET  /metrics                     Prometheus exposition (monitor/);
+                                      ``?format=openmetrics`` adds
+                                      trace exemplars + ``# EOF``
     GET  /v1/debug/flight             flight-recorder snapshot (monitor/
                                       flight.py): recent request
                                       timelines, postmortems, exemplars
+    GET  /v1/slo                      SLO verdict (monitor/slo.py):
+                                      burn rates + alert states, or
+                                      {"enabled": false} when off
+    GET  /v1/timeseries               windowed series views (monitor/
+                                      timeseries.py): ?series=&window=
 
 Every request adopts the caller's ``traceparent`` header (or mints a
 fresh trace context at ingress), binds it to the handling thread so the
@@ -55,7 +62,7 @@ from urllib.parse import parse_qs, urlparse
 import numpy as np
 
 from deeplearning4j_tpu import monitor
-from deeplearning4j_tpu.monitor import flight
+from deeplearning4j_tpu.monitor import flight, slo, timeseries
 from deeplearning4j_tpu.serving.batcher import (
     DeadlineExceededError, ServerDrainingError, ServerOverloadedError,
 )
@@ -89,6 +96,41 @@ def retry_after_seconds(queue_depth: int, queue_limit: int,
         fullness = min(1.0, queue_depth / max(1, queue_limit))
         ceiling = 1 + int(round(4 * fullness))
     return rng.randint(1, max(1, ceiling))
+
+
+def metrics_payload(query: str):
+    """``GET /metrics`` body + content type, shared with RouterServer.
+    ``?format=openmetrics`` opts into the exemplar-carrying OpenMetrics
+    exposition; the default stays the byte-identical v0.0.4 text."""
+    fmt = parse_qs(query or "").get("format", [""])[0]
+    if fmt == "openmetrics":
+        return (monitor.openmetrics_text().encode(),
+                "application/openmetrics-text; version=1.0.0; "
+                "charset=utf-8")
+    return (monitor.prometheus_text().encode(),
+            "text/plain; version=0.0.4; charset=utf-8")
+
+
+def timeseries_doc(ring, query: str) -> dict:
+    """The ``GET /v1/timeseries`` document, shared with RouterServer.
+    No ``series`` param lists the ring (names + coverage);
+    ``series=<family>&window=<seconds>`` answers the typed windowed
+    view, and every other query param pins a label value
+    (e.g. ``&model=m``)."""
+    if ring is None:
+        return {"enabled": False}
+    q = {k: v[0] for k, v in parse_qs(query or "").items()}
+    series = q.pop("series", None)
+    try:
+        window = float(q.pop("window", 60.0))
+    except (TypeError, ValueError):
+        return {"enabled": True, "error": "window must be a number"}
+    if series is None:
+        doc = ring.describe()
+    else:
+        doc = ring.query(series, window, **q)
+    doc["enabled"] = True
+    return doc
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -190,8 +232,17 @@ class _Handler(BaseHTTPRequestHandler):
                 self._json(self._srv.faults.describe())
             return
         if url.path == "/metrics":
-            self._reply(200, monitor.prometheus_text().encode(),
-                        "text/plain; version=0.0.4; charset=utf-8")
+            body, ctype = metrics_payload(url.query)
+            self._reply(200, body, ctype)
+            return
+        if url.path == "/v1/slo":
+            engine = self._srv.slo_engine or slo.default_engine()
+            self._json(engine.verdict() if engine is not None
+                       else {"enabled": False})
+            return
+        if url.path == "/v1/timeseries":
+            ring = self._srv.timeseries_ring or timeseries.default_ring()
+            self._json(timeseries_doc(ring, url.query))
             return
         if parts[:2] == ["v1", "models"]:
             if len(parts) == 2:
@@ -579,10 +630,16 @@ class ModelServer:
                  default_deadline_s: float = 30.0,
                  enable_faults: bool = False,
                  retry_jitter: Optional[random.Random] = None,
-                 faults: Optional[fault_util.ServingFaults] = None):
+                 faults: Optional[fault_util.ServingFaults] = None,
+                 slo_engine=None, timeseries_ring=None):
         self.registry = registry if registry is not None else ModelRegistry()
         self.default_deadline = float(default_deadline_s)
         self.enable_faults = bool(enable_faults)
+        # GET /v1/slo and /v1/timeseries sources; None falls back to the
+        # process defaults (slo.default_engine() / timeseries.
+        # default_ring()) so the CLI's enable_* calls just work
+        self.slo_engine = slo_engine
+        self.timeseries_ring = timeseries_ring
         # fault toggles are per-server injectable so in-process fleets
         # can wedge ONE replica; the default stays the process singleton
         # (env-armed subprocess children, existing tests)

@@ -1,0 +1,524 @@
+"""SLO engine tests — monitor/timeseries.py + monitor/slo.py and their
+serving endpoints.
+
+The windowed math (rates, percentiles, counter resets) is validated
+against a numpy oracle on a fake clock; the alert state machine
+(pending -> firing -> resolved, flap suppression, multi-window
+AND-gating) is driven entirely by injected time — no sleeps, no
+sampler threads. Endpoint tests cover /v1/slo (including the router's
+fleet aggregation) and the opt-in OpenMetrics exposition.
+"""
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import monitor
+from deeplearning4j_tpu.monitor import slo as slo_mod
+from deeplearning4j_tpu.monitor import timeseries as ts_mod
+from deeplearning4j_tpu.monitor.metrics import MetricsRegistry
+from deeplearning4j_tpu.monitor.slo import (
+    DEFAULT_RULES, BurnRule, Objective, SLOEngine, _Alert,
+)
+from deeplearning4j_tpu.monitor.timeseries import TimeSeriesRing
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    """Fresh global registry and no default ring/engine around every
+    test (the engine exports slo_* gauges into the global registry)."""
+    slo_mod.disable_slo()
+    ts_mod.disable_timeseries()
+    monitor.REGISTRY.reset()
+    yield
+    slo_mod.disable_slo()
+    ts_mod.disable_timeseries()
+    monitor.REGISTRY.reset()
+
+
+def _ring(reg=None, clock=None, **kw):
+    clock = clock or FakeClock()
+    reg = reg or MetricsRegistry()
+    return reg, clock, TimeSeriesRing(registry=reg, time_fn=clock,
+                                      wall_fn=clock, **kw)
+
+
+# --------------------------------------------------- windowed counter math
+def test_counter_rate_matches_numpy_oracle_on_fake_clock():
+    reg, clock, ring = _ring()
+    c = reg.counter("reqs_total", "r", labels=("code",))
+    rs = np.random.RandomState(0)
+    increments = rs.poisson(5, size=60).astype(float)
+    for inc in increments:
+        c.inc(inc, code="200")
+        clock.advance(1.0)
+        ring.sample()
+    # samples at t = 1001..1060; a 30 s window spans [1030, 1060] — the
+    # t=1030 sample is the baseline, so the oracle is increments[30:]
+    oracle = increments[30:].sum()
+    assert ring.increase("reqs_total", 30.0) == pytest.approx(oracle)
+    assert ring.rate("reqs_total", 30.0) == pytest.approx(oracle / 30.0)
+    # full-history window: the first sample is the baseline
+    assert ring.increase("reqs_total", 1e9) == pytest.approx(
+        increments[1:].sum())
+
+
+def test_counter_reset_across_restart_counts_post_reset_value():
+    reg, clock, ring = _ring()
+    reg.counter("reqs_total", "r").inc(100.0)
+    clock.advance(1.0)
+    ring.sample()
+    reg.counter("reqs_total", "r").inc(50.0)     # 150 cumulative
+    clock.advance(1.0)
+    ring.sample()
+    # replica restart: the counter starts over at 0 and climbs to 7
+    reg.reset()
+    reg.counter("reqs_total", "r").inc(7.0)
+    clock.advance(1.0)
+    ring.sample()
+    # prometheus increase() semantics: 50 before the reset, then the
+    # post-reset value in full — never a negative delta
+    assert ring.increase("reqs_total", 60.0) == pytest.approx(57.0)
+
+
+def test_increase_by_groups_one_label():
+    reg, clock, ring = _ring()
+    c = reg.counter("reqs_total", "r", labels=("code", "model"))
+    for code in ("200", "500", "429"):
+        c.inc(0, code=code, model="m")
+    ring.sample()
+    for code, n in (("200", 30), ("500", 7), ("429", 3)):
+        c.inc(n, code=code, model="m")
+    c.inc(9, code="503", model="m")   # series born after the baseline
+    clock.advance(5.0)
+    ring.sample()
+    by = ring.increase_by("reqs_total", 60.0, "code")
+    # a series first seen mid-window is its own baseline: its initial
+    # value is not an increase (prometheus-style birth semantics)
+    assert by == {"200": 30.0, "500": 7.0, "429": 3.0, "503": 0.0}
+    # label pinning filters children
+    assert ring.increase_by("reqs_total", 60.0, "code", model="other") == {}
+
+
+def test_unknown_series_and_thin_windows_return_none():
+    reg, clock, ring = _ring()
+    reg.counter("reqs_total", "r").inc()
+    ring.sample()
+    assert ring.increase("nope_total", 60.0) is None
+    assert ring.rate("reqs_total", 60.0) is None        # one sample only
+    clock.advance(100.0)
+    ring.sample()
+    assert ring.increase("reqs_total", 10.0) is None    # window too short
+
+
+# ------------------------------------------------- windowed histogram math
+def test_hist_window_deltas_match_numpy_histogram():
+    bounds = (0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0)
+    reg, clock, ring = _ring()
+    h = reg.histogram("lat_seconds", "l", buckets=bounds)
+    rs = np.random.RandomState(1)
+    per_second = []
+    for _ in range(40):
+        obs = rs.gamma(2.0, 0.03, size=8)
+        for v in obs:
+            h.observe(float(v))
+        per_second.append(obs)
+        clock.advance(1.0)
+        ring.sample()
+    win = ring.hist_window("lat_seconds", 20.0)
+    # window [t_end-20, t_end]: baseline sample is t-20, so the
+    # windowed observations are the last 20 seconds' worth
+    windowed = np.concatenate(per_second[-20:])
+    edges = [0.0] + list(bounds) + [np.inf]
+    oracle_counts, _ = np.histogram(windowed, bins=edges)
+    assert win["count"] == pytest.approx(len(windowed))
+    assert win["sum"] == pytest.approx(windowed.sum(), rel=1e-6)
+    assert np.allclose(win["counts"], oracle_counts)
+    # interpolated percentile lands within the oracle quantile's bucket
+    for q in (50, 95, 99):
+        est = ring.percentile("lat_seconds", 20.0, q)
+        oracle = float(np.percentile(windowed, q))
+        edge_idx = int(np.searchsorted(bounds, oracle))
+        lo = 0.0 if edge_idx == 0 else bounds[edge_idx - 1]
+        hi = bounds[edge_idx] if edge_idx < len(bounds) else float("inf")
+        assert lo <= est <= hi, (q, est, oracle)
+    # fraction_le against the oracle share, within one bucket's mass
+    thr = 0.1
+    est = ring.fraction_le("lat_seconds", 20.0, thr)
+    oracle_frac = float((windowed <= thr).mean())
+    bucket_mass = oracle_counts[list(bounds).index(thr) + 1] / len(windowed)
+    assert abs(est - oracle_frac) <= bucket_mass + 1e-9
+
+
+def test_hist_reset_uses_post_reset_counts():
+    bounds = (0.1, 1.0)
+    reg, clock, ring = _ring()
+    h = reg.histogram("lat_seconds", "l", buckets=bounds)
+    for _ in range(10):
+        h.observe(0.05)
+    clock.advance(1.0)
+    ring.sample()
+    reg.reset()                                  # replica restart
+    h = reg.histogram("lat_seconds", "l", buckets=bounds)
+    for _ in range(4):
+        h.observe(0.5)
+    clock.advance(1.0)
+    ring.sample()
+    win = ring.hist_window("lat_seconds", 60.0)
+    assert win["count"] == pytest.approx(4)      # post-reset only
+    assert ring.percentile("lat_seconds", 60.0, 50) == pytest.approx(
+        0.1 + 0.9 / 2)
+
+
+def test_gauge_stats_over_window():
+    reg, clock, ring = _ring()
+    g = reg.gauge("depth", "d")
+    for v in (1.0, 5.0, 3.0):
+        g.set(v)
+        clock.advance(1.0)
+        ring.sample()
+    stats = ring.gauge_stats("depth", 60.0)
+    assert stats == {"last": 3.0, "min": 1.0, "max": 5.0,
+                     "avg": 3.0, "samples": 3}
+
+
+# ------------------------------------------------------ alert state machine
+def _alert(for_s=0.0, keep_firing_s=60.0, burn_threshold=2.0):
+    obj = Objective("o", "availability", "reqs_total", 0.9)
+    rule = BurnRule("page", 3600.0, 300.0, burn_threshold, for_s=for_s,
+                    keep_firing_s=keep_firing_s)
+    return _Alert(obj, rule)
+
+
+def test_alert_fires_immediately_without_for_hold():
+    a = _alert(for_s=0.0)
+    assert a.update(0.0, 5.0, 5.0) == "fired"
+    assert a.describe()["state"] == "firing"
+
+
+def test_alert_pending_waits_out_for_s_then_fires():
+    a = _alert(for_s=30.0)
+    assert a.update(0.0, 5.0, 5.0) is None
+    assert a.describe()["state"] == "pending"
+    assert a.update(10.0, 5.0, 5.0) is None
+    # a dip back under threshold cancels the pending alert entirely
+    assert a.update(20.0, 1.0, 1.0) is None
+    assert a.describe()["state"] == "inactive"
+    # the hold restarts from scratch
+    assert a.update(30.0, 5.0, 5.0) is None
+    assert a.update(59.0, 5.0, 5.0) is None
+    assert a.update(60.0, 5.0, 5.0) == "fired"
+
+
+def test_alert_multi_window_and_gating():
+    a = _alert()
+    # long window burning but the short window already clean: the
+    # incident is OVER — must not fire (and vice versa)
+    assert a.update(0.0, 5.0, 1.0) is None
+    assert a.update(1.0, 1.0, 5.0) is None
+    assert a.describe()["state"] == "inactive"
+    # a window with no evidence (None) can never satisfy the gate
+    assert a.update(2.0, None, 5.0) is None
+    assert a.update(3.0, 5.0, None) is None
+    assert a.describe()["state"] == "inactive"
+
+
+def test_alert_flap_suppression_and_resolution():
+    a = _alert(keep_firing_s=30.0)
+    assert a.update(0.0, 5.0, 5.0) == "fired"
+    # brief dips must not resolve: clear for 10 s, burn again, clear...
+    assert a.update(10.0, 1.0, 1.0) is None
+    assert a.update(20.0, 5.0, 5.0) is None      # clear timer reset
+    assert a.update(30.0, 1.0, 1.0) is None
+    assert a.update(59.0, 1.0, 1.0) is None      # 29 s clear: still held
+    assert a.describe()["state"] == "firing"
+    assert a.update(60.0, 1.0, 1.0) == "resolved"
+    assert a.describe()["state"] == "inactive"
+    # machine is reusable after resolution
+    assert a.update(70.0, 5.0, 5.0) == "fired"
+
+
+# ------------------------------------------------------------------ engine
+def _engine(objectives, rules, clock, ring, trips):
+    return SLOEngine(ring, objectives, rules=rules, time_fn=clock,
+                     wall_fn=clock,
+                     trip_fn=lambda reason, **meta: trips.append(
+                         (reason, meta)))
+
+
+def test_engine_availability_fire_and_resolve_lifecycle():
+    reg, clock, ring = _ring()
+    c = reg.counter("reqs_total", "r", labels=("code",))
+    rules = (BurnRule("page", 20.0, 5.0, 2.0, keep_firing_s=4.0),)
+    trips = []
+    eng = _engine([Objective("avail", "availability", "reqs_total", 0.9)],
+                  rules, clock, ring, trips)
+    c.inc(0, code="500")              # pre-seed so errors count in full
+
+    def tick(ok, bad):
+        c.inc(ok, code="200")
+        if bad:
+            c.inc(bad, code="500")
+        clock.advance(1.0)
+        ring.sample()
+        eng.evaluate()
+
+    for _ in range(10):
+        tick(10, 0)                       # clean traffic: no alert
+    assert eng.alert_state("avail", "page") == "inactive" and not trips
+    for _ in range(10):
+        tick(5, 5)                        # 50% errors -> burn 5x short
+    assert eng.alert_state("avail", "page") == "firing"
+    assert trips and trips[0][0] == "slo_availability_burn"
+    assert trips[0][1]["severity"] == "page"
+    assert trips[0][1]["burn_long"] >= 2.0
+    # recovery: clean traffic ages the errors out of both windows, then
+    # keep_firing_s holds the alert a little longer before resolving
+    for _ in range(40):
+        tick(10, 0)
+    assert eng.alert_state("avail", "page") == "inactive"
+    events = [h["event"] for h in eng.history()]
+    assert events == ["fired", "resolved"]
+    assert len(trips) == 1                # resolution never re-trips
+    # verdict reflects the quiet state and carries the history
+    v = eng.verdict()
+    assert v["state"] == "ok" and v["enabled"]
+    assert v["objectives"][0]["ratio"] == pytest.approx(1.0)
+
+
+def test_engine_no_traffic_means_no_verdict_and_no_alert():
+    reg, clock, ring = _ring()
+    reg.counter("reqs_total", "r", labels=("code",))
+    trips = []
+    eng = _engine([Objective("avail", "availability", "reqs_total", 0.9)],
+                  (BurnRule("page", 20.0, 5.0, 1.0),), clock, ring, trips)
+    for _ in range(10):                   # samples, but zero increments
+        clock.advance(1.0)
+        ring.sample()
+        eng.evaluate()
+    assert eng.alert_state("avail", "page") == "inactive" and not trips
+    assert eng.verdict()["objectives"][0]["ratio"] is None
+
+
+def test_engine_latency_objective_preserves_p99_breach_reason():
+    reg, clock, ring = _ring()
+    h = reg.histogram("serving_router_request_seconds", "lat",
+                      buckets=(0.05, 0.1, 0.5, 1.0))
+    trips = []
+    objectives = slo_mod.router_objectives(slo_p99_ms=100.0)
+    assert [o.name for o in objectives] == ["router_latency_p99"]
+    eng = _engine(objectives, (BurnRule("page", 20.0, 5.0, 2.0),),
+                  clock, ring, trips)
+    for _ in range(10):
+        # 90% fast, 10% slow: 10x the 1% budget on both windows
+        for _ in range(9):
+            h.observe(0.01)
+        h.observe(0.4)
+        clock.advance(1.0)
+        ring.sample()
+        eng.evaluate()
+    assert eng.alert_state("router_latency_p99", "page") == "firing"
+    assert trips[0][0] == "p99_breach"
+
+
+def test_engine_exports_slo_metric_families():
+    reg, clock, ring = _ring(reg=monitor.REGISTRY)
+    c = monitor.counter("reqs_total", "r", labels=("code",))
+    eng = _engine([Objective("avail", "availability", "reqs_total", 0.9)],
+                  (BurnRule("page", 20.0, 5.0, 2.0),), clock, ring, [])
+    c.inc(1, code="200")
+    c.inc(1, code="500")
+    ring.sample()
+    c.inc(5, code="200")
+    c.inc(5, code="500")
+    clock.advance(1.0)
+    ring.sample()
+    eng.evaluate()
+    text = monitor.prometheus_text()
+    for family in ("timeseries_samples_total", "timeseries_series",
+                   "timeseries_sample_seconds", "slo_burn_rate",
+                   "slo_alert_state", "slo_objective_ratio",
+                   "slo_alerts_total"):
+        assert family in text, family
+    assert monitor.gauge("slo_alert_state",
+                         labels=("objective", "severity")).value(
+        objective="avail", severity="page") == 2.0
+
+
+def test_default_rules_are_the_sre_workbook_pair():
+    (fast, slow) = DEFAULT_RULES
+    assert (fast.long_window_s, fast.short_window_s,
+            fast.burn_threshold) == (3600.0, 300.0, 14.4)
+    assert (slow.long_window_s, slow.short_window_s,
+            slow.burn_threshold) == (21600.0, 1800.0, 6.0)
+
+
+# --------------------------------------------------------- zero-cost seam
+def test_timeseries_disabled_by_default_and_lifecycle():
+    assert not ts_mod.timeseries_enabled()
+    assert ts_mod.default_ring() is None
+    assert not any(t.name == "timeseries-sampler"
+                   for t in threading.enumerate())
+    ring = ts_mod.enable_timeseries(interval_s=60.0)
+    assert ts_mod.timeseries_enabled()
+    assert ts_mod.enable_timeseries() is ring          # idempotent
+    assert any(t.name == "timeseries-sampler"
+               for t in threading.enumerate())
+    ts_mod.disable_timeseries()
+    assert ts_mod.default_ring() is None
+    assert not any(t.name == "timeseries-sampler"
+                   for t in threading.enumerate())
+
+
+def test_enable_slo_requires_a_ring():
+    with pytest.raises(RuntimeError):
+        slo_mod.enable_slo([Objective("a", "availability", "x_total", 0.9)])
+
+
+# ---------------------------------------------------- OpenMetrics satellite
+def test_openmetrics_exemplars_and_eof_default_stays_v004():
+    reg = MetricsRegistry()
+    reg.counter("hits_total", "hits", labels=("code",)).inc(3, code="200")
+    h = reg.histogram("lat_seconds", "lat", buckets=(0.1, 1.0))
+    h.observe(0.05, exemplar="00aa11bb")
+    h.observe(0.5)
+    before = reg.prometheus_text()
+    om = reg.openmetrics_text()
+    # the default exposition is untouched by OpenMetrics rendering
+    assert reg.prometheus_text() == before
+    assert "#" not in before.replace("# HELP", "").replace("# TYPE", "")
+    # counter family name drops _total on HELP/TYPE, samples keep it
+    assert "# TYPE hits counter" in om
+    assert 'hits_total{code="200"} 3' in om
+    # exemplar on the landing bucket, OpenMetrics syntax
+    assert 'lat_seconds_bucket{le="0.1"} 1 # {trace_id="00aa11bb"} 0.05' \
+        in om
+    assert 'lat_seconds_bucket{le="1"} 2\n' in om      # no exemplar here
+    assert om.endswith("# EOF\n")
+
+
+# ---------------------------------------------------------- HTTP endpoints
+def _get_json(url):
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+def test_model_server_slo_and_timeseries_endpoints():
+    from deeplearning4j_tpu.serving.registry import ModelRegistry
+    from deeplearning4j_tpu.serving.server import ModelServer
+
+    clock = FakeClock()
+    ring = TimeSeriesRing(registry=monitor.REGISTRY, time_fn=clock,
+                          wall_fn=clock)
+    c = monitor.counter("reqs_total", "r", labels=("code",))
+    c.inc(0, code="200")
+    ring.sample()
+    c.inc(8, code="200")
+    clock.advance(5.0)
+    ring.sample()
+    eng = SLOEngine(ring, [Objective("avail", "availability",
+                                     "reqs_total", 0.9)],
+                    rules=(BurnRule("page", 60.0, 10.0, 2.0),),
+                    time_fn=clock, wall_fn=clock,
+                    trip_fn=lambda *a, **k: None)
+    server = ModelServer(ModelRegistry(), port=0, slo_engine=eng,
+                         timeseries_ring=ring)
+    try:
+        doc = _get_json(server.url + "/v1/slo")
+        assert doc["enabled"] and doc["state"] == "ok"
+        assert doc["objectives"][0]["name"] == "avail"
+        listing = _get_json(server.url + "/v1/timeseries")
+        assert listing["enabled"] and "reqs_total" in listing["series"]
+        q = _get_json(server.url
+                      + "/v1/timeseries?series=reqs_total&window=60")
+        assert q["kind"] == "counter" and q["increase"] == 8.0
+        q2 = _get_json(server.url + "/v1/timeseries?series=nope&window=60")
+        assert q2.get("error") == "unknown series"
+    finally:
+        server.drain(timeout=5.0)
+
+
+def test_model_server_slo_disabled_answers_enabled_false():
+    from deeplearning4j_tpu.serving.registry import ModelRegistry
+    from deeplearning4j_tpu.serving.server import ModelServer
+
+    server = ModelServer(ModelRegistry(), port=0)
+    try:
+        assert _get_json(server.url + "/v1/slo") == {"enabled": False}
+        assert _get_json(server.url + "/v1/timeseries") == \
+            {"enabled": False}
+    finally:
+        server.drain(timeout=5.0)
+
+
+def test_router_slo_fleet_aggregation_worst_state_wins():
+    from deeplearning4j_tpu.serving.fleet import Replica
+    from deeplearning4j_tpu.serving.router import (
+        ResilientRouter, RouterServer,
+    )
+
+    verdicts = {
+        "r0": {"enabled": True, "state": "firing", "objectives": [
+            {"name": "avail", "alerts": [
+                {"severity": "page", "state": "firing"}]}]},
+        "r1": {"enabled": True, "state": "ok", "objectives": []},
+    }
+
+    def transport(replica, path, body, headers, timeout):
+        assert path == "/v1/slo"
+        return 200, {}, json.dumps(verdicts[replica.name]).encode()
+
+    reps = []
+    for i in range(2):
+        r = Replica(f"r{i}")
+        r.state = "ready"
+        r.url = f"http://fake-{i}"
+        reps.append(r)
+    router = ResilientRouter(lambda: reps, transport=transport,
+                             hedge=False)
+    server = RouterServer(router, port=0)
+    try:
+        doc = _get_json(server.url + "/v1/slo")
+        assert doc["router"] == {"enabled": False}     # no router engine
+        assert doc["fleet"]["state"] == "firing"
+        assert doc["fleet"]["reporting"] == 2
+        assert doc["fleet"]["firing"] == ["r0:avail:page"]
+        assert doc["fleet"]["unreachable"] == []
+    finally:
+        server.stop()
+
+
+def test_router_slo_marks_unreachable_replicas():
+    from deeplearning4j_tpu.serving.fleet import Replica
+    from deeplearning4j_tpu.serving.router import (
+        ReplicaTransportError, ResilientRouter, RouterServer,
+    )
+
+    def transport(replica, path, body, headers, timeout):
+        raise ReplicaTransportError("connection refused")
+
+    r = Replica("r0")
+    r.state = "ready"
+    r.url = "http://fake-0"
+    router = ResilientRouter(lambda: [r], transport=transport, hedge=False)
+    server = RouterServer(router, port=0)
+    try:
+        doc = _get_json(server.url + "/v1/slo")
+        assert doc["fleet"]["state"] == "ok"
+        assert doc["fleet"]["reporting"] == 0
+        assert doc["fleet"]["unreachable"] == ["r0"]
+    finally:
+        server.stop()

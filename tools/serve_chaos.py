@@ -23,7 +23,14 @@ The SLO asserted from the traffic log and the router's /metrics:
 - the breaker state gauge and per-class shed counters are exposed, and
   shedding hit the LOW class (`serving_router_shed_total{cls="batch"}`);
 - **post-fault p99 recovers** to within a CI-noise multiple of the
-  pre-fault baseline.
+  pre-fault baseline;
+- the **SLO engine pages on the wedge**: a fast-burn availability
+  alert (monitor/slo.py over the in-process time-series ring, windows
+  scaled to drill seconds) fires while the fleet is degraded — tripping
+  a ``slo_availability_burn`` flight postmortem with request evidence —
+  and resolves once traffic is clean again; the alert timeline is
+  banked in the report and the router's /v1/slo fleet verdict returns
+  to ``ok``.
 
 Prints a JSON report (with a bench-style "sweep" row carrying
 ``chaos_p99_under_fault_ms`` / ``chaos_goodput_under_fault_rps`` /
@@ -108,7 +115,10 @@ def main(argv=None) -> int:
     spec = ReplicaSpec([("m", model_zip)], buckets=(1, 8),
                        max_delay_ms=2.0, queue_limit=64,
                        default_deadline_s=30.0, enable_faults=True,
-                       postmortem_dir=pm_dir)
+                       postmortem_dir=pm_dir,
+                       # replica-side SLO engines too, so the router's
+                       # /v1/slo fleet verdict aggregates 4 reporters
+                       slo_availability=0.995, slo_sample_interval_s=0.5)
     supervisor = ReplicaSupervisor(
         lambda i: SubprocessReplica(f"replica-{i}", spec, env=env),
         n_replicas=3, probe_interval_s=0.5, probe_timeout_s=2.0,
@@ -123,6 +133,26 @@ def main(argv=None) -> int:
         per_replica_inflight=4, hedge=True, hedge_min_s=0.2,
         timeout_s=30.0, breaker_open_for_s=3.0)
     server = RouterServer(router, supervisor=supervisor, port=0)
+
+    # the SLO engine over the in-process time-series ring: availability
+    # burn-rate alerting with windows scaled down to drill timescales
+    # (seconds, not the SRE-workbook hours) so the wedge fires a
+    # fast-burn page while the drill runs and resolves once the fleet
+    # is clean again. "bad" = any non-2xx: the fleet contract above
+    # means faults surface as 429/503 backpressure, never 5xx, and the
+    # availability objective treats that backpressure as burned budget.
+    from deeplearning4j_tpu.monitor import slo as slo_mod
+    from deeplearning4j_tpu.monitor import timeseries
+    ring = timeseries.enable_timeseries(interval_s=0.25, capacity=4096)
+    slo_engine = slo_mod.enable_slo(
+        [slo_mod.Objective(
+            "router_availability", "availability",
+            "serving_router_requests_total", target=0.98,
+            bad_code=lambda code: not code.startswith("2"),
+            reason="slo_availability_burn")],
+        rules=(slo_mod.BurnRule("page", 10.0, 2.5, 2.0,
+                                keep_firing_s=2.0),),
+        ring=ring)
 
     class Args:                      # LoadGen's knob surface, programmatic
         url = server.url
@@ -243,6 +273,71 @@ def main(argv=None) -> int:
                 f"post-fault p99 {rec_p99:.1f}ms did not recover "
                 f"(baseline {base_p99:.1f}ms, budget {p99_budget:.1f}ms)")
 
+        # ---------------- SLO burn-rate alert timeline -------------------
+        # the wedge must have fired the fast-burn availability page while
+        # the fleet was degraded, and with traffic now stopped the burn
+        # evidence ages out of both windows, so the alert must resolve
+        # (held keep_firing_s first — flap suppression)
+        resolve_deadline = time.monotonic() + 30.0
+        while slo_engine.alert_state("router_availability", "page") \
+                != "inactive" and time.monotonic() < resolve_deadline:
+            time.sleep(0.25)
+        alerts = slo_engine.history()
+        summary["slo_alerts"] = alerts
+        slo_fired = [h for h in alerts if h["event"] == "fired"]
+        slo_resolved = [h for h in alerts if h["event"] == "resolved"]
+        if not slo_fired:
+            failures.append(
+                "the wedge drill never fired the fast-burn availability "
+                f"alert (history: {alerts})")
+        if not slo_resolved:
+            failures.append(
+                "the availability alert did not resolve after recovery "
+                "(state "
+                f"{slo_engine.alert_state('router_availability', 'page')})")
+        if slo_fired and slo_resolved \
+                and slo_resolved[-1]["unix"] < slo_fired[0]["unix"]:
+            failures.append("alert resolution precedes the first fire")
+
+        # the firing alert must have tripped a flight postmortem that
+        # carries actual request timelines as evidence
+        slo_pm = None
+        for fn in sorted(os.listdir(pm_dir)) if os.path.isdir(pm_dir) \
+                else []:
+            if fn.startswith("postmortem-") and fn.endswith(".json"):
+                with open(os.path.join(pm_dir, fn)) as f:
+                    doc = json.load(f)
+                if doc["reason"] == "slo_availability_burn":
+                    slo_pm = (fn, doc)
+        if slo_pm is None:
+            failures.append(
+                "the firing availability alert did not dump a "
+                "slo_availability_burn flight postmortem")
+        else:
+            fn, doc = slo_pm
+            summary["slo_postmortem"] = {"file": fn, "meta": doc["meta"],
+                                         "n_records": doc["n_records"]}
+            if doc["n_records"] <= 0:
+                failures.append("slo_availability_burn postmortem "
+                                "carries no flight records")
+
+        # fleet verdict after recovery: the router engine plus all three
+        # replica engines (spec slo_availability) report, and nothing
+        # is firing any more
+        fleet_slo = json.loads(urllib.request.urlopen(
+            server.url + "/v1/slo", timeout=10).read())
+        summary["fleet_slo"] = fleet_slo["fleet"]
+        if not fleet_slo["router"].get("enabled"):
+            failures.append("/v1/slo: router engine not enabled")
+        if fleet_slo["fleet"]["state"] != "ok":
+            failures.append(
+                f"fleet SLO state after recovery: {fleet_slo['fleet']}")
+        if fleet_slo["fleet"]["reporting"] < 4:
+            failures.append(
+                "expected router + 3 replica SLO engines reporting, got "
+                f"{fleet_slo['fleet']['reporting']} "
+                f"(unreachable: {fleet_slo['fleet']['unreachable']})")
+
         # ---------------- metrics assertions ----------------------------
         metrics = urllib.request.urlopen(server.url + "/metrics",
                                          timeout=10).read().decode()
@@ -266,7 +361,9 @@ def main(argv=None) -> int:
             if fam not in metrics:
                 failures.append(f"/metrics missing {fam}")
         for fam in ("serving_flight_records_total",
-                    "serving_flight_postmortems_total"):
+                    "serving_flight_postmortems_total",
+                    "timeseries_samples_total", "slo_burn_rate",
+                    "slo_alert_state", "slo_alerts_total"):
             if fam not in metrics:
                 failures.append(f"/metrics missing {fam}")
 
@@ -342,6 +439,8 @@ def main(argv=None) -> int:
                     json.dump(doc, f, indent=1)
                 summary["postmortem"]["banked_as"] = cli.bank_postmortem
     finally:
+        slo_mod.disable_slo()        # engine first: it listens on the ring
+        timeseries.disable_timeseries()
         supervisor.stop()
         server.stop()
 
@@ -362,6 +461,17 @@ def main(argv=None) -> int:
         # number (server-side histogram exemplars carry the same ids)
         "slow_trace_ids": summary.get("under_fault", {}).get("slowest"),
         "postmortem": summary.get("postmortem", {}).get("file"),
+        # burn-rate alert timeline: when the wedge paged, how hot the
+        # burn was, and when the alert resolved after recovery
+        "chaos_slo_fired_unix": next(
+            (h["unix"] for h in summary.get("slo_alerts", [])
+             if h["event"] == "fired"), None),
+        "chaos_slo_resolved_unix": next(
+            (h["unix"] for h in reversed(summary.get("slo_alerts", []))
+             if h["event"] == "resolved"), None),
+        "chaos_slo_burn_long_at_fire": next(
+            (h["burn_long"] for h in summary.get("slo_alerts", [])
+             if h["event"] == "fired"), None),
     }]
     print(json.dumps(summary, indent=1))
     return 0 if not failures else 1

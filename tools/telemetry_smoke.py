@@ -32,6 +32,13 @@ then asserts:
   adopted; the flight recorder exposes the request on
   GET /v1/debug/flight (router-aggregated) and the
   serving_flight_* / trace_* metric families are live,
+- the SLO engine answers live over the same fleet: GET /v1/slo reports
+  the router's engine enabled with a fleet verdict, /v1/timeseries
+  serves windowed counter increases from real traffic, the
+  ``?format=openmetrics`` exposition renders histogram trace exemplars
+  and terminates with ``# EOF`` while the default v0.0.4 exposition
+  stays exemplar-free, and the ``timeseries_*`` / ``slo_*`` metric
+  families are live,
 - tools/trace_report.py merges per-process segments into one valid
   Perfetto document with distinct process tracks (pid collisions
   remapped).
@@ -80,6 +87,13 @@ XLA_REQUIRED = ("xla_compile_seconds", "xla_program_flops",
 #: "Tracing a single request")
 TRACE_REQUIRED = ("trace_contexts_minted_total",
                   "serving_flight_records_total")
+
+#: time-series ring + SLO engine families (docs/OBSERVABILITY.md "SLOs
+#: and burn-rate alerting"); slo_alerts_total is deliberately absent —
+#: a clean smoke run never transitions an alert
+SLO_REQUIRED = ("timeseries_samples_total", "timeseries_sample_seconds",
+                "timeseries_series", "slo_objective_ratio",
+                "slo_burn_rate", "slo_alert_state")
 
 #: top-level + per-program keys of the persisted perf-ledger schema
 LEDGER_KEYS = ("version", "created_unix", "device_kind", "backend",
@@ -225,6 +239,16 @@ def main(argv=None) -> int:
     )
     flight.enable_flight(capacity=64, dump_dir=os.path.join(
         os.path.dirname(trace_path), "postmortems"))
+    # SLO engine over the in-process time-series ring, watching the
+    # router's own metric families (short windows: the smoke only needs
+    # the machinery live, not SRE-workbook timescales)
+    from deeplearning4j_tpu.monitor import slo as slo_mod
+    from deeplearning4j_tpu.monitor import timeseries
+    ring = timeseries.enable_timeseries(interval_s=0.2, capacity=512)
+    slo_mod.enable_slo(
+        slo_mod.router_objectives(slo_p99_ms=5000.0,
+                                  availability_target=0.99),
+        rules=(slo_mod.BurnRule("page", 5.0, 1.0, 14.4),), ring=ring)
     serve_net = _net(seed=7)
     spec = ReplicaSpec([("m", serve_net)], buckets=(1, 8),
                        max_delay_ms=1.0)
@@ -293,7 +317,55 @@ def main(argv=None) -> int:
             failures.append("no replica flight record carries the "
                             "minted trace_id")
         summary["flight_router_records"] = len(router_recs)
+        # 5) SLO + time-series endpoints answer live over real traffic.
+        # Bracket a known burst of predicts with explicit samples so the
+        # windowed increase is deterministic (the background sampler
+        # also runs; extra samples are harmless).
+        ring.sample()
+        for _ in range(3):
+            urllib.request.urlopen(urllib.request.Request(
+                rserver.url + "/v1/models/m/predict", data=body,
+                headers={"Content-Type": "application/json"}),
+                timeout=30).read()
+        ring.sample()
+        slo_doc = json.loads(urllib.request.urlopen(
+            rserver.url + "/v1/slo", timeout=10).read())
+        summary["fleet_slo"] = slo_doc.get("fleet")
+        if not slo_doc.get("router", {}).get("enabled"):
+            failures.append("/v1/slo: router SLO engine not enabled")
+        if len(slo_doc.get("replicas", {})) != 2:
+            failures.append("/v1/slo did not poll both replicas")
+        if slo_doc.get("fleet", {}).get("state") != "ok":
+            failures.append("clean smoke traffic should leave the fleet "
+                            f"SLO ok, got {slo_doc.get('fleet')}")
+        ts_doc = json.loads(urllib.request.urlopen(
+            rserver.url + "/v1/timeseries?series="
+            "serving_router_requests_total&window=60", timeout=10).read())
+        summary["timeseries_query"] = ts_doc
+        if ts_doc.get("kind") != "counter" \
+                or (ts_doc.get("increase") or 0) < 3:
+            failures.append(
+                "windowed /v1/timeseries increase did not cover the "
+                f"predict burst: {ts_doc}")
+        # 6) OpenMetrics opt-in renders exemplars + # EOF; the default
+        # v0.0.4 exposition stays byte-compatible (no exemplars, no EOF)
+        om = urllib.request.urlopen(
+            rserver.url + "/metrics?format=openmetrics",
+            timeout=10).read().decode()
+        v004 = urllib.request.urlopen(
+            rserver.url + "/metrics", timeout=10).read().decode()
+        if not om.endswith("# EOF\n"):
+            failures.append("openmetrics exposition missing # EOF "
+                            "terminator")
+        if ' # {trace_id="' not in om:
+            failures.append("openmetrics exposition carries no histogram "
+                            "trace exemplars")
+        if "# EOF" in v004 or ' # {' in v004:
+            failures.append("default /metrics exposition leaked "
+                            "OpenMetrics syntax (v0.0.4 compat broke)")
     finally:
+        slo_mod.disable_slo()       # engine first: it listens on the ring
+        timeseries.disable_timeseries()
         supervisor.stop()
         rserver.stop()
 
@@ -317,6 +389,9 @@ def main(argv=None) -> int:
         if fam not in families:
             failures.append(f"{fam} missing from /metrics exposition")
     for fam in TRACE_REQUIRED:
+        if fam not in families:
+            failures.append(f"{fam} missing from /metrics exposition")
+    for fam in SLO_REQUIRED:
         if fam not in families:
             failures.append(f"{fam} missing from /metrics exposition")
 

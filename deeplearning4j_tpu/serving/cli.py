@@ -57,8 +57,10 @@ def build_parser() -> argparse.ArgumentParser:
                           "generation, POST .../generate). Same SOURCE "
                           "forms as --model; an @int8 / @bf16 suffix "
                           "serves a post-training-quantized variant "
-                          "(e.g. zoo:TransformerLM?n_layers=2@int8). "
-                          "Repeatable.")
+                          "(e.g. zoo:TransformerLM?n_layers=2@int8) and "
+                          "@spec[:draft=...,k=...] serves with "
+                          "speculative decoding (draft-verify; greedy "
+                          "output is unchanged). Repeatable.")
     dec.add_argument("--decode-slots", type=int, default=4,
                      help="fixed in-flight decode batch positions")
     dec.add_argument("--decode-page-size", type=int, default=16,
@@ -83,6 +85,24 @@ def build_parser() -> argparse.ArgumentParser:
                           "one long prompt cannot stall every stream's "
                           "inter-token latency (default: 4 pages; 0 "
                           "disables chunking)")
+    dec.add_argument("--spec-draft", default=None, metavar="SRC",
+                     help="turn on speculative decoding for every --lm "
+                          "servable: 'int8'/'bf16' self-draft the target "
+                          "through a quantized variant of its own "
+                          "params; any other value loads a servable "
+                          "source with the SAME vocab (mismatch is a "
+                          "deploy-time error). Per-servable override: "
+                          "the @spec source suffix")
+    dec.add_argument("--spec-k", type=int, default=4,
+                     help="draft tokens proposed per verify round")
+    dec.add_argument("--spec-accept-floor", type=float, default=0.4,
+                     help="rolling acceptance-rate floor below which a "
+                          "stream stops speculating (plain decode)")
+    dec.add_argument("--spec-window", type=int, default=8,
+                     help="rounds in the per-stream acceptance window")
+    dec.add_argument("--spec-draft-pool-pages", type=int, default=None,
+                     help="KV pages in the draft engine's own pool "
+                          "(default: sized like the target's)")
     dec.add_argument("--no-prefix-cache", action="store_true",
                      help="disable copy-on-write KV prefix sharing "
                           "(radix-indexed page reuse across requests "
@@ -344,7 +364,12 @@ def _decode_config(args):
                         prefill_buckets=prefill,
                         queue_limit=args.decode_queue_limit,
                         prefix_cache=not args.no_prefix_cache,
-                        prefill_chunk_tokens=args.prefill_chunk_tokens)
+                        prefill_chunk_tokens=args.prefill_chunk_tokens,
+                        spec_draft=args.spec_draft,
+                        spec_k=args.spec_k,
+                        spec_accept_floor=args.spec_accept_floor,
+                        spec_window=args.spec_window,
+                        spec_draft_pool_pages=args.spec_draft_pool_pages)
 
 
 def _main_fleet(args, specs, lm_specs, buckets, decode_cfg) -> int:

@@ -43,6 +43,7 @@ quantized servables (`quantize.py`) falls out of plain model naming.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import logging
 import queue
 import threading
@@ -68,7 +69,8 @@ from deeplearning4j_tpu.serving.batcher import (
     DeadlineExceededError, ServerDrainingError, ServerOverloadedError,
 )
 from deeplearning4j_tpu.serving.quantize import (
-    parse_variant, qdot, qtake, quantize_params,
+    QUANT_MODES, is_spec_variant, parse_variant, qdot, qtake,
+    quantize_params,
 )
 from deeplearning4j_tpu.util.params import own_tree
 from deeplearning4j_tpu.util.locks import DiagnosedLock
@@ -106,6 +108,59 @@ class DecodeConfig:
     #: stream's inter-token latency. None = auto (4 pages); 0 = off
     #: (whole suffix in one program call, the pre-chunking behavior)
     prefill_chunk_tokens: Optional[int] = None
+    #: speculative decoding (draft-verify): None = off. "int8"/"bf16"
+    #: self-draft the target through a quantized variant of its own
+    #: params; any other string is loaded as a servable source (it must
+    #: serve the SAME vocab — mismatch is a loud ModelLoadError). The
+    #: ``@spec[:draft=...,k=...]`` source suffix sets these per servable.
+    spec_draft: Optional[str] = None
+    spec_k: int = 4                      # draft tokens per verify round
+    #: rolling acceptance-rate floor: over the last `spec_window` rounds
+    #: of a stream, accepted/proposed below this turns speculation OFF
+    #: for that stream (it plain-decodes to completion)
+    spec_accept_floor: float = 0.4
+    spec_window: int = 8                 # rounds in the acceptance window
+    #: draft engine's page pool (its own second pool); None = derived
+    #: like the target's (no oversubscription)
+    spec_draft_pool_pages: Optional[int] = None
+
+
+def apply_variant(cfg: DecodeConfig, variant: Optional[str]) -> DecodeConfig:
+    """Apply a parsed ``@<variant>`` source suffix to a DecodeConfig:
+    ``int8``/``bf16`` select quantized weights, ``spec[:k=...,draft=...,
+    floor=...,window=...,pool_pages=...]`` turns on speculative decoding
+    (unset options keep the config's defaults)."""
+    if variant is None:
+        return cfg
+    if variant in QUANT_MODES:
+        return dataclasses.replace(cfg, quantize=variant)
+    if is_spec_variant(variant):
+        updates = {"spec_draft": cfg.spec_draft or "int8"}
+        if variant.startswith("spec:"):
+            for item in variant[len("spec:"):].split(","):
+                if not item:
+                    continue
+                key, sep, val = item.partition("=")
+                if not sep:
+                    raise ValueError(
+                        f"@spec option {item!r} is not key=value")
+                if key == "draft":
+                    updates["spec_draft"] = val
+                elif key == "k":
+                    updates["spec_k"] = int(val)
+                elif key == "floor":
+                    updates["spec_accept_floor"] = float(val)
+                elif key == "window":
+                    updates["spec_window"] = int(val)
+                elif key == "pool_pages":
+                    updates["spec_draft_pool_pages"] = int(val)
+                else:
+                    raise ValueError(
+                        f"unknown @spec option {key!r}; known: draft, k, "
+                        "floor, window, pool_pages")
+        return dataclasses.replace(cfg, **updates)
+    raise ValueError(f"unknown servable variant {variant!r}; known: "
+                     f"{QUANT_MODES} or spec[:...]")
 
 
 class GenerateRequest:
@@ -135,6 +190,13 @@ class GenerateRequest:
         #: the uncached suffix (set when prefill completes)
         self.cached_tokens = 0
         self.prefill_chunks = 0
+        #: speculative-decoding accounting: draft tokens proposed to /
+        #: accepted by the verifier, and verify rounds run, for THIS
+        #: stream (0/0/0 on plain decode) — ride the done event so one
+        #: loadgen compares speculative and plain runs
+        self.spec_proposed = 0
+        self.spec_accepted = 0
+        self.spec_rounds = 0
         self.cancelled = threading.Event()
         self.done = threading.Event()
         # the submitting thread's trace context (the HTTP handler binds
@@ -165,6 +227,9 @@ class GenerateRequest:
             "version": self.version,
             "cached_tokens": self.cached_tokens,
             "prefill_chunks": self.prefill_chunks,
+            "spec_proposed": self.spec_proposed,
+            "spec_accepted": self.spec_accepted,
+            "spec_rounds": self.spec_rounds,
         }))
 
     def fail(self, exc: Exception):
@@ -314,6 +379,66 @@ class DecodeEngine:
         self._chunk_jit = jax.jit(self._chunk_fn, donate_argnums=(1, 2))
         self._copy_jit = jax.jit(kvcache.copy_page, donate_argnums=(0, 1))
         self._logits_jit = jax.jit(self._logits_fn)
+        # ---------------------------------------- speculative decoding
+        # the draft is a full second engine (own params, own smaller
+        # page pool, own compiled programs under "<name>.draft"); the
+        # target keeps per-slot speculation state and the slot mapping
+        self.draft: Optional["DecodeEngine"] = None
+        self._verify_jit = None
+        self._draft_slots: Dict[int, Optional[int]] = {}
+        self._draft_origin: Dict[int, int] = {}
+        self._spec_on = np.ones((cfg.slots,), bool)
+        self._spec_hist = [deque(maxlen=max(1, int(cfg.spec_window)))
+                           for _ in range(cfg.slots)]
+        # host-side rejection/residual sampling stream (the draft's
+        # in-graph Gumbel stream provides q; acceptance runs on the host)
+        self._spec_rng = np.random.RandomState((cfg.seed ^ 0x5EC5) &
+                                               0x7FFFFFFF)
+        if cfg.spec_draft is not None:
+            self._build_draft(model)
+
+    def _build_draft(self, model):
+        """Construct the speculative draft engine. ``spec_draft`` is a
+        quantize mode (self-draft: the target's own params, int8/bf16) or
+        any servable source with the SAME vocabulary — a mismatched draft
+        would run every acceptance test over a different symbol set, so
+        it is rejected loudly here, at deploy/swap time (the PR-11 vocab
+        swap-rejection policy)."""
+        from deeplearning4j_tpu.serving.registry import ModelLoadError
+        cfg = self.cfg
+        k = int(cfg.spec_k)
+        if k < 1:
+            raise ModelLoadError(
+                f"decode[{self.name}]: spec_k must be >= 1 (got {k})")
+        src = str(cfg.spec_draft)
+        if src in QUANT_MODES:
+            draft_model, dquant, dsrc = model, src, f"self@{src}"
+        else:
+            from deeplearning4j_tpu.serving.registry import load_servable
+            base, dquant = parse_variant(src)
+            draft_model, dsrc = load_servable(base), src
+        dcfg = dataclasses.replace(
+            cfg, quantize=dquant, max_context=self.max_context,
+            pool_pages=cfg.spec_draft_pool_pages, spec_draft=None,
+            seed=cfg.seed + 1)
+        draft = DecodeEngine(draft_model, dcfg, name=f"{self.name}.draft")
+        if draft.vocab != self.vocab:
+            dvocab = draft.vocab
+            draft.close()
+            raise ModelLoadError(
+                f"decode[{self.name}]: speculative draft {dsrc!r} has "
+                f"vocab {dvocab}, target serves {self.vocab} — rejection "
+                "sampling needs one symbol set (deploy a matching-vocab "
+                "draft, or fix the tokenizer mismatch upstream)")
+        self.draft = draft
+        self._verify_jit = jax.jit(self._verify_fn, donate_argnums=(1, 2))
+        draft._propose_jit = jax.jit(
+            functools.partial(draft._spec_propose_fn, k),
+            donate_argnums=(1, 2))
+
+    @property
+    def spec_enabled(self) -> bool:
+        return self.draft is not None
 
     # --------------------------------------------------------- the forward
     def _forward_tokens(self, params, tokens, mask):
@@ -514,10 +639,13 @@ class DecodeEngine:
         tok = self._sample(last[None], temp[None], topk[None], counter)[0]
         return kpool, vpool, tok, last
 
-    def _decode_fn(self, params, kpool, vpool, page_table, seq_lens,
-                   tokens, active, temps, topks, counter):
-        """One token for every slot (inactive slots compute masked
-        garbage into the dump page). Returns (kpool, vpool, sampled (S,),
+    def _step_body(self, params, kpool, vpool, page_table, seq_lens,
+                   tokens, active):
+        """The one-token decode forward shared — primitive call for
+        primitive call — by the decode step AND each unrolled position of
+        the speculative verify/propose programs: identical subgraphs are
+        what makes verify logits bitwise-equal to sequential decode steps
+        (the oracle greedy spec-parity rests on). Returns (kpool, vpool,
         logits (S, V))."""
         pos = seq_lens[:, None]
         x = None
@@ -540,9 +668,65 @@ class DecodeEngine:
                 if "b" in p:
                     z = z + p["b"]
                 x = z
-        logits = x[:, 0, :]
+        return kpool, vpool, x[:, 0, :]
+
+    def _decode_fn(self, params, kpool, vpool, page_table, seq_lens,
+                   tokens, active, temps, topks, counter):
+        """One token for every slot (inactive slots compute masked
+        garbage into the dump page). Returns (kpool, vpool, sampled (S,),
+        logits (S, V))."""
+        kpool, vpool, logits = self._step_body(
+            params, kpool, vpool, page_table, seq_lens, tokens, active)
         toks = self._sample(logits, temps, topks, counter)
         return kpool, vpool, toks, logits
+
+    def _verify_fn(self, params, kpool, vpool, page_table, seq_lens,
+                   tokens, drafted, active):
+        """The speculative verify: score k+1 positions per slot in ONE
+        fixed-shape program — position 0 consumes the stream's last
+        sampled token, positions 1..k consume the draft's proposals —
+        writing each position's KV as it goes (rejected-tail rows land
+        past the post-acceptance seq_len; the validity mask hides them
+        until the next round overwrites). k+1 unrolled `_step_body`
+        calls, NOT a chunked-attention reformulation: per-position logits
+        must be bitwise those of k+1 sequential decode steps. Returns
+        (kpool, vpool, logits (S, k+1, V))."""
+        k = drafted.shape[1]
+        outs = []
+        tok = tokens
+        for i in range(k + 1):
+            kpool, vpool, logits = self._step_body(
+                params, kpool, vpool, page_table, seq_lens + i, tok,
+                active)
+            outs.append(logits)
+            if i < k:
+                tok = drafted[:, i]
+        return kpool, vpool, jnp.stack(outs, axis=1)
+
+    def _spec_propose_fn(self, k, params, kpool, vpool, page_table,
+                         seq_lens, tokens, active, temps, topks, counter):
+        """The draft's fused propose program: k autoregressive tokens per
+        slot in ONE dispatch (sampled in-graph, each fed to the next
+        position), plus one extra body that consumes the k-th sample so
+        the draft cache covers every token the target may accept — the
+        next round then always resumes from exactly one new token
+        regardless of where acceptance stopped. Returns (kpool, vpool,
+        drafted (S, k), draft logits (S, k, V)); the logits give the
+        host-side rejection sampler its q distribution."""
+        drafted = []
+        qlogits = []
+        tok = tokens
+        for i in range(k):
+            kpool, vpool, logits = self._step_body(
+                params, kpool, vpool, page_table, seq_lens + i, tok,
+                active)
+            tok = self._sample(logits, temps, topks, counter + i)
+            drafted.append(tok)
+            qlogits.append(logits)
+        kpool, vpool, _ = self._step_body(
+            params, kpool, vpool, page_table, seq_lens + k, tok, active)
+        return (kpool, vpool, jnp.stack(drafted, axis=1),
+                jnp.stack(qlogits, axis=1))
 
     def _logits_fn(self, params, tokens):
         """(B, T) -> (B, T, V) full-sequence pre-softmax logits (parity /
@@ -619,6 +803,35 @@ class DecodeEngine:
                 np.zeros((s,), bool), np.zeros((s,), np.float32),
                 np.zeros((s,), np.int32), np.uint32(0))
         warmups.inc(model=self.name)
+        if self.draft is not None:
+            # the draft engine warms its own ledger (programs metered
+            # under "<name>.draft"), then the two speculative programs:
+            # the fused k-token propose (draft's) and the k+1-position
+            # verify (target's) — zero request-path compiles with
+            # speculation live is part of the compiles==warmups contract
+            d = self.draft
+            d.warm()
+            k = int(self.cfg.spec_k)
+            ds = d.cfg.slots
+            d._meter_program(f"draft_{k}", warmup=True)
+            with monitor.span("serving/spec_draft", model=self.name,
+                              warmup=1):
+                d._kpool, d._vpool, _, _ = d._propose_jit(
+                    d._params, d._kpool, d._vpool,
+                    np.asarray(d.cache.page_table),
+                    np.zeros((ds,), np.int32), np.zeros((ds,), np.int32),
+                    np.zeros((ds,), bool), np.zeros((ds,), np.float32),
+                    np.zeros((ds,), np.int32), np.uint32(0))
+            warmups.inc(model=d.name)
+            self._meter_program(f"verify_{k + 1}", warmup=True)
+            with monitor.span("serving/spec_verify", model=self.name,
+                              warmup=1):
+                self._kpool, self._vpool, _ = self._verify_jit(
+                    self._params, self._kpool, self._vpool,
+                    np.asarray(self.cache.page_table),
+                    np.zeros((s,), np.int32), np.zeros((s,), np.int32),
+                    np.zeros((s, k), np.int32), np.zeros((s,), bool))
+            warmups.inc(model=self.name)
         monitor.histogram(
             "serving_decode_warmup_seconds",
             "Full decode-runtime warmup duration (buckets + step)",
@@ -657,7 +870,86 @@ class DecodeEngine:
                 self.cache.unref_page(info.cow_src)
                 raise
             self.cache.unref_page(info.cow_src)
+        if self.draft is not None:
+            self._admit_draft(info.slot, prompt)
         return info
+
+    def _admit_draft(self, slot: int, prompt: np.ndarray):
+        """Mirror a successful target admission into the draft's (own,
+        typically smaller) pool. A dry draft pool never blocks the
+        stream — it just decodes plain (speculation off, metered as a
+        fallback)."""
+        self._spec_on[slot] = True
+        self._spec_hist[slot].clear()
+        dinfo = None
+        try:
+            dinfo = self.draft.admit_prompt(
+                np.asarray(prompt, np.int32))
+        except Exception:   # noqa: BLE001 — draft trouble must never
+            # take down an admission the target already accepted
+            log.exception("decode[%s]: draft admission failed; stream "
+                          "decodes plain", self.name)
+        if dinfo is None:
+            self.spec_disable(slot, "draft_admit")
+        else:
+            self._draft_slots[slot] = int(dinfo.slot)
+            self._draft_origin[slot] = int(dinfo.cached_len)
+
+    def spec_disable(self, slot: int, reason: str):
+        """Turn speculation off for ONE stream (it plain-decodes to
+        completion) and free its draft pages for the streams still
+        speculating. Metered per reason: draft_admit / draft_prefill /
+        draft_pages / acceptance_floor."""
+        self._spec_on[slot] = False
+        ds = self._draft_slots.get(slot)
+        self._draft_slots[slot] = None
+        if ds is not None and self.draft is not None:
+            self.draft.cache.release(ds)
+        monitor.counter(
+            "serving_decode_spec_fallbacks_total",
+            "Streams whose speculation turned off (draft pool dry, "
+            "draft prefill failure, or rolling acceptance under the "
+            "floor)", labels=("model", "reason")).inc(
+            model=self.name, reason=reason)
+
+    def release_slot(self, slot: int):
+        """Release a finished stream's target slot AND its draft mirror
+        (scheduler call sites use this, never cache.release directly)."""
+        self.cache.release(slot)
+        if self.draft is not None:
+            ds = self._draft_slots.pop(slot, None)
+            self._draft_origin.pop(slot, None)
+            if ds is not None:
+                self.draft.cache.release(ds)
+            self._spec_on[slot] = True
+            self._spec_hist[slot].clear()
+
+    def draft_prefill_origin(self, slot: int) -> Optional[int]:
+        """Where the draft's prefill starts for this stream (its own
+        cached-prefix length), or None when the stream speculates not."""
+        if self.draft is None or self._draft_slots.get(slot) is None:
+            return None
+        return self._draft_origin.get(slot, 0)
+
+    def draft_prefill(self, slot: int, prompt: np.ndarray, start: int,
+                      n: int, temperature: float, top_k: int):
+        """Advance the draft's prefill for `slot` by prompt positions
+        [start, start+n) — same dense-vs-chunk split as the target's
+        path; the sampled token is discarded (the stream's first token
+        comes from the TARGET's prefill)."""
+        ds = self._draft_slots[slot]
+        if start == 0 and n == len(prompt):
+            self.draft.prefill(ds, prompt, temperature, top_k)
+        else:
+            self.draft.prefill_chunk(ds, prompt, start, n, temperature,
+                                     top_k)
+
+    def draft_prefill_done(self, slot: int, prompt: np.ndarray):
+        """Draft prefill complete: index the draft's prompt pages so the
+        NEXT admission of this prefix is a draft-side cache hit too."""
+        ds = self._draft_slots.get(slot)
+        if ds is not None:
+            self.draft.cache.register_prefix(ds, prompt)
 
     def prefill_chunk(self, slot: int, prompt: np.ndarray, start: int,
                       n: int, temperature: float, top_k: int) -> int:
@@ -750,6 +1042,177 @@ class DecodeEngine:
                         labels=("model",)).inc(model=self.name)
         return toks_np, act, np.asarray(logits, np.float32)
 
+    # ------------------------------------------------- speculative decoding
+    def _spec_dist(self, logits, temp: float, topk: int) -> np.ndarray:
+        """The sampling distribution `_sample` draws from, recomputed on
+        the host (float64): top-k filtering with the SAME clip against
+        TOP_K_MAX, then temperature softmax. Rejection sampling is only
+        exact when this q/p matches the in-graph Gumbel-max sampler's
+        distribution term for term."""
+        lg = np.asarray(logits, np.float64)
+        v = lg.shape[-1]
+        if topk > 0:
+            kk = min(max(int(topk), 1), min(TOP_K_MAX, v))
+            kth = np.sort(lg)[-kk]
+            lg = np.where(lg >= kth, lg, -np.inf)
+        z = lg / max(float(temp), 1e-30)
+        z = z - z.max()
+        p = np.exp(z)
+        return p / p.sum()
+
+    def _spec_accept(self, drafted, vlog, qlog, temp: float, topk: int
+                     ) -> Tuple[int, int]:
+        """Accept/reject one stream's k draft proposals against the
+        target's k+1 verify logits. Returns (accepted count a, the one
+        extra token): greedy is exact prefix-match on argmax with the
+        target's own argmax at the first mismatch (bitwise the
+        non-speculative stream); temperature is true rejection sampling
+        — accept d_i with prob min(1, p(d_i)/q(d_i)), resample the first
+        rejection from the residual max(p - q, 0), and on full
+        acceptance sample the bonus token from the target's (k+1)-th
+        distribution."""
+        k = len(drafted)
+        if temp <= 0:
+            a = 0
+            for i in range(k):
+                if int(np.argmax(vlog[i])) == int(drafted[i]):
+                    a += 1
+                else:
+                    break
+            return a, int(np.argmax(vlog[a]))
+        for i in range(k):
+            d = int(drafted[i])
+            p = self._spec_dist(vlog[i], temp, topk)
+            q = self._spec_dist(qlog[i], temp, topk)
+            if q[d] > 0.0 and self._spec_rng.random_sample() \
+                    < min(1.0, float(p[d]) / float(q[d])):
+                continue
+            res = np.maximum(p - q, 0.0)
+            tot = float(res.sum())
+            if tot <= 0.0:
+                res, tot = p, float(p.sum())    # p == q: any sample of
+                # p is already correctly distributed
+            return i, int(self._spec_rng.choice(len(res), p=res / tot))
+        p = self._spec_dist(vlog[k], temp, topk)
+        return k, int(self._spec_rng.choice(len(p), p=p / p.sum()))
+
+    def spec_step(self, exclude=()) -> Dict[int, dict]:
+        """One speculative round over every eligible stream: the draft
+        proposes k tokens for all of them in ONE dispatch, the target
+        scores all k+1 positions in ONE dispatch, and the host accepts
+        per slot. Both caches advance by accepted+1 (the draft's propose
+        program already consumed its own k-th sample, so whatever prefix
+        survives, the next round resumes from exactly one new token).
+
+        Returns {slot: {"tokens": [...], "proposed": k, "accepted": a}}
+        for every slot handled this round — the scheduler emits those
+        bursts and excludes the slots from the plain step. Slots under
+        page/context pressure are simply left for the plain path this
+        round; a dry DRAFT pool or a collapsed acceptance window turns
+        speculation off for that stream (`spec_disable`)."""
+        if self.draft is None:
+            return {}
+        k = int(self.cfg.spec_k)
+        excl = frozenset(int(s) for s in exclude)
+        pairs = []
+        for s in self.cache.active_slots():
+            if s in excl or not self._spec_on[s]:
+                continue
+            ds = self._draft_slots.get(s)
+            if ds is None:
+                continue
+            if not self.cache.ensure_capacity(s, k + 1):
+                # target page stall or context cap: the plain step's
+                # per-token path copes (and finishes length_cap streams)
+                continue
+            if not self.draft.cache.ensure_capacity(ds, k + 1):
+                self.spec_disable(s, "draft_pages")
+                continue
+            pairs.append((s, ds))
+        if not pairs:
+            return {}
+        d = self.draft
+        dact = np.zeros((d.cfg.slots,), bool)
+        dtok = d._last_tokens.copy()
+        for s, ds in pairs:
+            dact[ds] = True
+            # the draft extends the TARGET's stream: it consumes the
+            # target's last sampled token, not its own prefill sample
+            dtok[ds] = self._last_tokens[s]
+        d._counter += k
+        d._meter_program(f"draft_{k}", warmup=False)
+        with monitor.span("serving/spec_draft", model=self.name,
+                          active=len(pairs)):
+            d._kpool, d._vpool, drafted, qlog = d._propose_jit(
+                d._params, d._kpool, d._vpool,
+                np.asarray(d.cache.page_table),
+                np.asarray(d.cache.seq_lens), dtok, dact,
+                d._temps.copy(), d._topks.copy(),
+                np.uint32((d._counter - k + 1) & 0xFFFFFFFF))
+        drafted = np.asarray(drafted)
+        qlog = np.asarray(qlog, np.float32)
+        tact = np.zeros((self.cfg.slots,), bool)
+        vdraft = np.zeros((self.cfg.slots, k), np.int32)
+        for s, ds in pairs:
+            tact[s] = True
+            vdraft[s] = drafted[ds]
+        self._meter_program(f"verify_{k + 1}", warmup=False)
+        with monitor.span("serving/spec_verify", model=self.name,
+                          active=len(pairs)):
+            self._kpool, self._vpool, vlog = self._verify_jit(
+                self._params, self._kpool, self._vpool,
+                np.asarray(self.cache.page_table),
+                np.asarray(self.cache.seq_lens),
+                self._last_tokens.copy(), vdraft, tact)
+        vlog = np.asarray(vlog, np.float32)
+        out: Dict[int, dict] = {}
+        n_prop = n_acc = 0
+        ratio = monitor.histogram(
+            "serving_decode_spec_acceptance_ratio",
+            "Per-stream-per-round fraction of draft proposals the "
+            "verifier accepted (accepted / k)", labels=("model",),
+            buckets=(0.0, 0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875,
+                     1.0))
+        floor = float(self.cfg.spec_accept_floor)
+        for s, ds in pairs:
+            a, extra = self._spec_accept(
+                vdraft[s], vlog[s], qlog[ds], float(self._temps[s]),
+                int(self._topks[s]))
+            for _ in range(a + 1):
+                self.cache.advance(s)
+                d.cache.advance(ds)
+            self._last_tokens[s] = extra
+            d._last_tokens[ds] = extra
+            n_prop += k
+            n_acc += a
+            out[s] = {"tokens": [int(t) for t in vdraft[s][:a]]
+                      + [int(extra)],
+                      "proposed": k, "accepted": a}
+            ratio.observe(a / k, model=self.name)
+            hist = self._spec_hist[s]
+            hist.append((k, a))
+            if len(hist) == hist.maxlen:
+                pw = sum(p for p, _ in hist)
+                aw = sum(acc for _, acc in hist)
+                if pw > 0 and aw / pw < floor:
+                    self.spec_disable(s, "acceptance_floor")
+                    out[s]["fallback"] = "acceptance_floor"
+        monitor.counter(
+            "serving_decode_spec_proposed_total",
+            "Draft tokens proposed to the verifier",
+            labels=("model",)).inc(n_prop, model=self.name)
+        monitor.counter(
+            "serving_decode_spec_accepted_total",
+            "Draft tokens the verifier accepted (the speedup is "
+            "accepted + rounds extra tokens for 2 dispatches per round)",
+            labels=("model",)).inc(n_acc, model=self.name)
+        monitor.counter(
+            "serving_decode_spec_rounds_total",
+            "Speculative draft+verify rounds executed (2 dispatches "
+            "each, emitting accepted+1 tokens per handled stream)",
+            labels=("model",)).inc(model=self.name)
+        return out
+
     def logits_full(self, tokens) -> np.ndarray:
         """(B, T) -> (B, T, V) float32 logits by full-sequence recompute
         (the parity oracle and the quantization-quality probe)."""
@@ -763,6 +1226,8 @@ class DecodeEngine:
         self._closed = True
         self._kpool = self._vpool = None
         self._params = None
+        if self.draft is not None:
+            self.draft.close()
 
     def describe(self) -> dict:
         d = self.cache.describe()
@@ -771,6 +1236,12 @@ class DecodeEngine:
                   "vocab_size": self.vocab,
                   "n_layers": self.n_layers,
                   "prefill_chunk_tokens": self.prefill_chunk_tokens})
+        if self.draft is not None:
+            d["spec"] = {"draft": self.cfg.spec_draft,
+                         "k": int(self.cfg.spec_k),
+                         "accept_floor": float(self.cfg.spec_accept_floor),
+                         "window": int(self.cfg.spec_window),
+                         "draft_pool": self.draft.cache.describe()}
         return d
 
 
@@ -782,12 +1253,21 @@ class _PrefillJob:
     [pos, len(prompt)) still to prefill, executed in budgeted chunks
     between decode steps (head-of-line-free prefill)."""
 
-    __slots__ = ("req", "pos", "chunks")
+    __slots__ = ("req", "pos", "chunks", "dpos", "tok")
 
-    def __init__(self, req: GenerateRequest, pos: int):
+    def __init__(self, req: GenerateRequest, pos: int,
+                 dpos: Optional[int] = None):
         self.req = req
         self.pos = pos
         self.chunks = 0
+        #: the speculative draft mirror's prefill cursor (None: stream
+        #: has no draft slot); the job completes only when BOTH caches
+        #: cover the prompt
+        self.dpos = dpos
+        #: the target's sampled first token, held until the draft mirror
+        #: catches up (speculation needs both KV states at the prompt
+        #: boundary before the stream's first round)
+        self.tok: Optional[int] = None
 
 
 class _EngineRun:
@@ -907,10 +1387,10 @@ class DecodeScheduler:
             self._runs.clear()
         for run in runs:
             for slot, job in run.prefill.items():
-                run.engine.cache.release(slot)
+                run.engine.release_slot(slot)
                 job.req.fail(exc)
             for slot, req in run.slot_req.items():
-                run.engine.cache.release(slot)
+                run.engine.release_slot(slot)
                 req.fail(exc)
             run.engine.close()
         self._fail_pending(crash if crash is not None
@@ -1003,7 +1483,9 @@ class DecodeScheduler:
                             cow=info.cow_src is not None,
                             model=self.name)
             req.version = run.version
-            run.prefill[slot] = _PrefillJob(req, int(info.cached_len))
+            run.prefill[slot] = _PrefillJob(
+                req, int(info.cached_len),
+                run.engine.draft_prefill_origin(slot))
             if joined_running:
                 monitor.counter(
                     "serving_decode_preempted_joins_total",
@@ -1053,7 +1535,6 @@ class DecodeScheduler:
                     worked = True
                     continue
                 total = len(req.prompt)
-                tok = None
                 try:
                     # bind the stream's context so prefill spans (and any
                     # first-compile ledger capture inside) carry its
@@ -1079,18 +1560,44 @@ class DecodeScheduler:
                             job.chunks += 1
                             spent += n
                             worked = True
+                            if job.pos >= total:
+                                job.tok = tok
                 except Exception as e:  # noqa: BLE001 — surfaced to req
                     run.prefill.pop(slot, None)
-                    run.engine.cache.release(slot)
+                    run.engine.release_slot(slot)
                     log.exception("decode[%s]: prefill failed", self.name)
                     req.fail(e)
                     continue
-                if job.pos >= total:
+                # the speculative draft mirror prefills under the same
+                # per-tick budget; its failure never fails the stream —
+                # speculation just turns off and the stream decodes plain
+                try:
+                    with monitor.bind_context(req.ctx):
+                        while job.dpos is not None and job.dpos < total:
+                            if budget > 0 and spent >= budget:
+                                break
+                            n = total - job.dpos if budget <= 0 \
+                                else min(total - job.dpos,
+                                         budget - spent)
+                            run.engine.draft_prefill(
+                                slot, req.prompt, job.dpos, n,
+                                req.temperature, req.top_k)
+                            job.dpos += n
+                            spent += n
+                            worked = True
+                except Exception:  # noqa: BLE001 — draft is optional
+                    log.exception("decode[%s]: draft prefill failed; "
+                                  "stream decodes plain", self.name)
+                    run.engine.spec_disable(slot, "draft_prefill")
+                    job.dpos = None
+                if job.pos >= total and (job.dpos is None
+                                         or job.dpos >= total):
                     run.prefill.pop(slot, None)
                     req.prefill_chunks = job.chunks
                     # prefill complete: every mapped prompt page holds
                     # final K/V — only now may the prefix index share it
                     run.engine.cache.register_prefix(slot, req.prompt)
+                    run.engine.draft_prefill_done(slot, req.prompt)
                     run.slot_req[slot] = req
                     monitor.histogram(
                         "serving_decode_prefill_chunks",
@@ -1104,7 +1611,7 @@ class DecodeScheduler:
                                 chunks=job.chunks,
                                 cached_tokens=req.cached_tokens,
                                 model=self.name)
-                    self._emit(run, slot, req, tok)
+                    self._emit(run, slot, req, job.tok)
         return worked
 
     def _emit(self, run: _EngineRun, slot: int, req: GenerateRequest,
@@ -1154,7 +1661,7 @@ class DecodeScheduler:
 
     def _finish(self, run: _EngineRun, slot: int, req: GenerateRequest,
                 reason: str):
-        run.engine.cache.release(slot)
+        run.engine.release_slot(slot)
         run.slot_req.pop(slot, None)
         req.finish(reason)
         if monitor.tracing_enabled():
@@ -1166,7 +1673,9 @@ class DecodeScheduler:
                              tokens=req.n_emitted,
                              engine_version=run.version)
         flight.note(req.ctx, "finish", reason=reason,
-                    tokens=req.n_emitted, model=self.name)
+                    tokens=req.n_emitted,
+                    spec_proposed=req.spec_proposed,
+                    spec_accepted=req.spec_accepted, model=self.name)
         monitor.counter("serving_decode_finished_total",
                         "Finished generations by reason",
                         labels=("model", "reason")).inc(
@@ -1177,8 +1686,39 @@ class DecodeScheduler:
             runs = [r for r in self._runs if r.slot_req]
         worked = False
         for run in runs:
-            toks, act, _ = run.engine.step(exclude=run.prefill.keys())
+            # speculation first: eligible streams get an accepted burst
+            # (draft propose + target verify, two dispatches for up to
+            # k+1 tokens each); everything speculation did not handle
+            # falls through to the plain one-token step below
+            spec = run.engine.spec_step(exclude=run.prefill.keys()) \
+                if run.engine.spec_enabled else {}
+            for slot, res in spec.items():
+                req = run.slot_req.get(slot)
+                if req is None:
+                    continue
+                req.spec_rounds += 1
+                req.spec_proposed += res["proposed"]
+                req.spec_accepted += res["accepted"]
+                if res.get("fallback") and flight.enabled():
+                    flight.note(req.ctx, "spec_fallback",
+                                reason=res["fallback"], slot=slot,
+                                proposed=req.spec_proposed,
+                                accepted=req.spec_accepted,
+                                model=self.name)
+                for tok in res["tokens"]:
+                    self._emit(run, slot, req, tok)
+                    if req.done.is_set():
+                        break
+            if spec:
+                worked = True
+            handled = set(spec)
+            if not any(s not in handled for s in run.slot_req):
+                continue
+            toks, act, _ = run.engine.step(
+                exclude=set(run.prefill.keys()) | handled)
             for slot, req in list(run.slot_req.items()):
+                if slot in handled:
+                    continue
                 if act[slot]:
                     self._emit(run, slot, req, int(toks[slot]))
                 elif int(run.engine.cache.seq_lens[slot]) \
@@ -1311,12 +1851,15 @@ class ServedLM:
         return req
 
     # ------------------------------------------------------------ lifecycle
-    def _activate(self, sv, quantize: Optional[str]):
+    def _activate(self, sv, variant: Optional[str]):
         """Warm a full replacement engine off-path, then roll admissions
         onto it; in-flight sequences finish on their own engine (KV pages
-        are only meaningful under the params that wrote them)."""
+        are only meaningful under the params that wrote them). `variant`
+        is the source's parsed ``@`` suffix (quantize mode or ``spec``
+        options); None keeps the servable's config as deployed."""
         from deeplearning4j_tpu.serving.registry import ModelLoadError
-        cfg = dataclasses.replace(self.cfg, quantize=quantize)
+        cfg = apply_variant(self.cfg, variant) \
+            if variant is not None else self.cfg
         t0 = time.perf_counter()
         engine = DecodeEngine(sv.model, cfg, name=self.name)
         if engine.vocab != self.vocab:
@@ -1358,8 +1901,7 @@ class ServedLM:
             with self._state_lock:
                 next_version = self.versions[-1].version + 1
             sv = ServableVersion(next_version, str(source), model)
-            self._activate(sv, variant if variant is not None
-                           else self.cfg.quantize)
+            self._activate(sv, variant)
             with self._state_lock:
                 self.versions.append(sv)
                 self.active = len(self.versions) - 1
@@ -1392,8 +1934,7 @@ class ServedLM:
             # the rolled-back-to version gets a FRESH warmed engine (its
             # old one may already be retired); the same rolling handoff
             base, variant = parse_variant(str(sv.source))
-            self._activate(sv, variant if variant is not None
-                           else self.cfg.quantize)
+            self._activate(sv, variant)
             with self._state_lock:
                 self.active -= 1
                 self.active_info = sv.describe()

@@ -267,6 +267,14 @@ class SubprocessReplica(Replica):
                          str(d.prefill_chunk_tokens)]
             if not d.prefix_cache:
                 argv.append("--no-prefix-cache")
+            if d.spec_draft is not None:
+                argv += ["--spec-draft", str(d.spec_draft),
+                         "--spec-k", str(d.spec_k),
+                         "--spec-accept-floor", str(d.spec_accept_floor),
+                         "--spec-window", str(d.spec_window)]
+                if d.spec_draft_pool_pages is not None:
+                    argv += ["--spec-draft-pool-pages",
+                             str(d.spec_draft_pool_pages)]
         if self.spec.enable_faults:
             argv.append("--enable-fault-injection")
         if self.spec.trace_out:

@@ -457,6 +457,40 @@ class KVCacheState:
             self._gauges()
             return True
 
+    def ensure_capacity(self, slot: int, n: int) -> bool:
+        """Guarantee physical pages for this slot's next ``n`` positions
+        (``seq_lens[slot] .. seq_lens[slot]+n-1``) — the write span of a
+        speculative draft/verify burst. Returns False when the context
+        cap or a dry pool blocks any of them; pages allocated before the
+        pool ran dry stay mapped (slot-owned, reused on retry/release)."""
+        with self._lock:
+            pos = int(self.seq_lens[slot])
+            if pos + n > self.max_context:
+                return False            # burst would overrun the context
+            if n < 1:
+                return True
+            need_idx = (pos + n - 1) // self.page_size
+            allocated = False
+            while self._pages_per_slot_live[slot] <= need_idx:
+                page = self._take_page_locked()
+                if page is None:
+                    monitor.counter(
+                        "serving_decode_page_stalls_total",
+                        "Decode steps a slot sat out waiting for a free "
+                        "KV page (pool oversubscribed)",
+                        labels=("model",)).inc(model=self.name)
+                    if allocated:
+                        self._gauges()
+                    return False
+                idx = self._pages_per_slot_live[slot]
+                self._ref[page] = 1
+                self.page_table[slot, idx] = page
+                self._pages_per_slot_live[slot] = idx + 1
+                allocated = True
+            if allocated:
+                self._gauges()
+            return True
+
     def advance(self, slot: int):
         """One token appended at ``seq_lens[slot]`` by the decode step."""
         self.seq_lens[slot] += 1

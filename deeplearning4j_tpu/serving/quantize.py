@@ -119,12 +119,28 @@ def cast_tree_bf16(params):
     return jax.tree_util.tree_map(cast, params)
 
 
+def is_spec_variant(variant) -> bool:
+    """True for the speculative-decoding variant suffix: bare ``spec``
+    or ``spec:opt=val,...`` (options parsed by decode.apply_variant)."""
+    return isinstance(variant, str) and (
+        variant == "spec" or variant.startswith("spec:"))
+
+
 def parse_variant(source: str):
-    """Split a servable source's ``@int8`` / ``@bf16`` variant suffix.
+    """Split a servable source's ``@<variant>`` suffix: ``@int8`` /
+    ``@bf16`` (quantized weights) or ``@spec[:...]`` (speculative
+    decoding, serving/decode.py).
 
     ``zoo:TransformerLM?n_layers=2@int8`` -> (``zoo:...?n_layers=2``,
-    ``"int8"``); plain sources come back with variant None."""
+    ``"int8"``); ``ckpt@spec:draft=int8,k=4`` -> (``ckpt``,
+    ``"spec:draft=int8,k=4"``); plain sources come back with variant
+    None."""
     if isinstance(source, str) and "@" in source:
+        # @spec splits at its FIRST occurrence: the options may name a
+        # draft source carrying its own @int8/@bf16 suffix
+        i = source.find("@spec")
+        if i > 0 and is_spec_variant(source[i + 1:]):
+            return source[:i], source[i + 1:]
         base, _, suffix = source.rpartition("@")
         if suffix in QUANT_MODES:
             return base, suffix

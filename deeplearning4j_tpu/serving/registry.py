@@ -363,10 +363,13 @@ class ModelRegistry:
         continuous-batching generation over a paged KV cache) under
         `name`. `decode` is a DecodeConfig; a ``@int8`` / ``@bf16``
         suffix on a string source selects a post-training-quantized
-        variant (serving/quantize.py). Redeploying an existing name is a
-        rolling swap — new streams admit on the new engine while
-        in-flight streams finish on the old one."""
-        from deeplearning4j_tpu.serving.decode import DecodeConfig, ServedLM
+        variant and ``@spec[:draft=...,k=...]`` turns on speculative
+        decoding (serving/quantize.py, serving/decode.py). Redeploying
+        an existing name is a rolling swap — new streams admit on the
+        new engine while in-flight streams finish on the old one."""
+        from deeplearning4j_tpu.serving.decode import (
+            DecodeConfig, ServedLM, apply_variant,
+        )
         from deeplearning4j_tpu.serving.quantize import parse_variant
         with self._deploy_lock:
             with self._lock:
@@ -387,9 +390,9 @@ class ModelRegistry:
                 return existing
             base, variant = parse_variant(str(source))
             if variant is not None:
-                decode = dataclasses.replace(
+                decode = apply_variant(
                     decode if decode is not None else DecodeConfig(),
-                    quantize=variant)
+                    variant)
             model = load_servable(base)
             served = ServedLM(name, model, str(source), decode=decode)
             with self._lock:

@@ -521,6 +521,12 @@ class _Handler(BaseHTTPRequestHandler):
                 # SSE path carries the same fields on its done event)
                 "cached_tokens": info.get("cached_tokens"),
                 "prefill_chunks": info.get("prefill_chunks"),
+                # speculative-decoding telemetry: draft tokens proposed /
+                # accepted and verify rounds for this stream (0 on plain
+                # decode; the SSE done event carries the same fields)
+                "spec_proposed": info.get("spec_proposed"),
+                "spec_accepted": info.get("spec_accepted"),
+                "spec_rounds": info.get("spec_rounds"),
                 "ttft_ms": round((req.first_token_at - req.enqueued) * 1e3,
                                  3) if req.first_token_at else None,
                 "latency_ms": round((time.perf_counter() - t0) * 1e3, 3),

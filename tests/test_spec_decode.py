@@ -1,0 +1,316 @@
+"""Speculative decoding: draft-verify generation with provably
+unchanged outputs.
+
+The contract under test, layer by layer:
+
+- the ``@spec[:draft=...,k=...]`` source-suffix grammar parses and maps
+  onto DecodeConfig (`parse_variant` / `apply_variant`);
+- the fused verify program is BITWISE the sequential decode program: one
+  k-drafted `_verify_fn` call produces, position for position, the exact
+  logits of k+1 single `step()` calls on an identical engine — across a
+  KV page boundary (the property the greedy-parity guarantee rests on);
+- greedy speculative streams are token-for-token equal to their
+  non-speculative twin over 24+ steps, with the prefix cache on AND off;
+- the temperature path is true rejection sampling: p==q always accepts,
+  a zero-probability proposal deterministically rejects and resamples
+  from the residual max(p-q, 0);
+- a draft that disagrees with the target trips the rolling
+  acceptance-rate floor (per-stream fallback counter, stream still
+  completes, output still exact);
+- a vocab-mismatched draft is rejected loudly at build time;
+- speculative traffic never compiles on the request path: compiles ==
+  warmups for the target AND its ``<name>.draft`` ledger labels.
+"""
+
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import monitor
+from deeplearning4j_tpu.serving.decode import (
+    DecodeConfig, DecodeEngine, ServedLM, apply_variant,
+)
+from deeplearning4j_tpu.serving.quantize import (
+    is_spec_variant, parse_variant,
+)
+from deeplearning4j_tpu.serving.registry import (
+    ModelLoadError, load_servable,
+)
+
+ZOO_SRC = ("zoo:TransformerLM?vocab_size=48&n_layers=1&n_embd=32"
+           "&n_heads=4&seq_length=32")
+#: same arch, different init: a draft that legitimately serves the same
+#: vocab but almost never matches the target's argmax
+DRAFT_SRC = ZOO_SRC + "&seed=99"
+
+
+def _tokens(req, timeout=60.0):
+    """Drain one library GenerateRequest; returns (tokens, done info)."""
+    toks = []
+    deadline = time.monotonic() + timeout
+    while True:
+        ev = req.events.get(timeout=max(0.1, deadline - time.monotonic()))
+        if ev[0] == "token":
+            toks.append(int(ev[1]))
+        elif ev[0] == "done":
+            return toks, ev[1]
+        else:
+            raise ev[1]
+
+
+# ------------------------------------------------------- variant grammar
+def test_spec_variant_grammar_splits_at_first_spec():
+    assert parse_variant("zoo:X?a=1@spec") == ("zoo:X?a=1", "spec")
+    # the draft value may carry its own @int8 — the split is at the
+    # FIRST @spec occurrence, not the last @
+    src, variant = parse_variant("zoo:X@spec:draft=zoo:Y@int8,k=4")
+    assert src == "zoo:X"
+    assert variant == "spec:draft=zoo:Y@int8,k=4"
+    assert is_spec_variant(variant)
+    # plain quant splits stay at the last @
+    assert parse_variant("zoo:X@int8") == ("zoo:X", "int8")
+    assert not is_spec_variant("int8")
+    assert parse_variant("zoo:X") == ("zoo:X", None)
+
+
+def test_apply_variant_spec_options():
+    cfg = DecodeConfig(slots=2, page_size=8)
+    on = apply_variant(cfg, "spec")
+    assert on.spec_draft == "int8"          # self-draft default
+    assert on.spec_k == cfg.spec_k
+    full = apply_variant(
+        cfg, "spec:draft=bf16,k=2,floor=0.6,window=3,pool_pages=9")
+    assert full.spec_draft == "bf16"
+    assert full.spec_k == 2
+    assert full.spec_accept_floor == 0.6
+    assert full.spec_window == 3
+    assert full.spec_draft_pool_pages == 9
+    # the draft value keeps its own query string / quant suffix intact
+    nested = apply_variant(cfg, f"spec:draft={DRAFT_SRC}")
+    assert nested.spec_draft == DRAFT_SRC
+    assert apply_variant(cfg, "int8").quantize == "int8"
+    assert apply_variant(cfg, None) is cfg
+    with pytest.raises(ValueError, match="key=value"):
+        apply_variant(cfg, "spec:k4")
+    with pytest.raises(ValueError, match="unknown @spec option"):
+        apply_variant(cfg, "spec:bogus=1")
+    with pytest.raises(ValueError, match="unknown servable variant"):
+        apply_variant(cfg, "fp4")
+
+
+# ------------------------------------------- verify-program bitwise oracle
+def test_verify_program_bitwise_equals_sequential_steps():
+    """One k-drafted verify call == k+1 sequential decode steps, logits
+    compared bitwise per position, with the burst crossing a KV page
+    boundary (prompt 6 + 4 rows over page_size 8)."""
+    cfg = DecodeConfig(slots=2, page_size=8)
+    a = DecodeEngine(load_servable(ZOO_SRC), cfg, name="vo-seq")
+    b = DecodeEngine(load_servable(ZOO_SRC), cfg, name="vo-fused")
+    try:
+        prompt = np.array([1, 2, 3, 4, 5, 6], np.int32)
+        k = 3
+        sa = a.cache.admit(len(prompt))
+        sb = b.cache.admit(len(prompt))
+        t0a, _ = a.prefill(sa, prompt, 0.0, 0)
+        t0b, _ = b.prefill(sb, prompt, 0.0, 0)
+        assert t0a == t0b
+        seq_logits, toks = [], [int(t0a)]
+        for _ in range(k + 1):
+            tk, act, lg = a.step()
+            assert act[sa]
+            seq_logits.append(lg[sa].copy())
+            toks.append(int(tk[sa]))
+        assert b.cache.ensure_capacity(sb, k + 1)     # 6 -> 10 rows: the
+        assert (b.cache.page_table[sb, :2] > 0).all()  # burst spans 2 pages
+        drafted = np.zeros((cfg.slots, k), np.int32)
+        drafted[sb] = toks[1:k + 1]
+        act = np.zeros((cfg.slots,), bool)
+        act[sb] = True
+        _, _, vlog = jax.jit(b._verify_fn)(
+            b._params, b._kpool, b._vpool,
+            np.asarray(b.cache.page_table),
+            np.asarray(b.cache.seq_lens),
+            b._last_tokens.copy(), drafted, act)
+        vlog = np.asarray(vlog, np.float32)
+        for i in range(k + 1):
+            assert np.array_equal(vlog[sb, i], seq_logits[i]), \
+                f"verify position {i} is not bitwise the {i + 1}-th step"
+    finally:
+        a.close()
+        b.close()
+
+
+# --------------------------------------------------- greedy spec parity
+@pytest.fixture(scope="module")
+def spec_pair():
+    cfg = DecodeConfig(slots=2, page_size=8)
+    plain = ServedLM("spec-plain", load_servable(ZOO_SRC), ZOO_SRC,
+                     decode=cfg)
+    spec = ServedLM("spec-on", load_servable(ZOO_SRC), ZOO_SRC,
+                    decode=apply_variant(cfg, "spec:draft=int8,k=4"))
+    yield plain, spec
+    plain.shutdown(drain=False, timeout=5)
+    spec.shutdown(drain=False, timeout=5)
+
+
+def test_greedy_spec_parity_cache_on(spec_pair):
+    """Greedy speculative == greedy plain, token for token, 24+ steps,
+    prefix cache live (the second pass of each prompt admits hot)."""
+    plain, spec = spec_pair
+    eng = spec.scheduler.admitting_engine()
+    assert eng.spec_enabled and eng.describe()["spec"]["k"] == 4
+    prompts = [[1, 2, 3], [7, 8, 9, 10], [5] * 6, [1, 2, 3]]
+    for prompt in prompts:
+        pt, _ = _tokens(plain.generate(prompt, max_new_tokens=26))
+        st, info = _tokens(spec.generate(prompt, max_new_tokens=26))
+        assert len(st) >= 24
+        assert pt == st, "speculation changed a greedy stream"
+        assert info["spec_rounds"] > 0 and info["spec_proposed"] > 0
+        assert 0 <= info["spec_accepted"] <= info["spec_proposed"]
+
+
+def test_greedy_spec_parity_cache_off():
+    cfg = DecodeConfig(slots=2, page_size=8, prefix_cache=False)
+    plain = ServedLM("spec-plain-nc", load_servable(ZOO_SRC), ZOO_SRC,
+                     decode=cfg)
+    spec = ServedLM("spec-on-nc", load_servable(ZOO_SRC), ZOO_SRC,
+                    decode=apply_variant(cfg, "spec:draft=int8,k=4"))
+    try:
+        for prompt in ([4, 5, 6], [11] * 5):
+            pt, _ = _tokens(plain.generate(prompt, max_new_tokens=26))
+            st, info = _tokens(spec.generate(prompt, max_new_tokens=26))
+            assert len(st) >= 24 and pt == st
+            assert info["spec_proposed"] > 0
+    finally:
+        plain.shutdown(drain=False, timeout=5)
+        spec.shutdown(drain=False, timeout=5)
+
+
+def test_temperature_spec_stream_completes(spec_pair):
+    """The sampled path runs end to end through rejection sampling and
+    still reports the speculative counters."""
+    _, spec = spec_pair
+    toks, info = _tokens(spec.generate([2, 4, 6], max_new_tokens=20,
+                                       temperature=0.9, top_k=8))
+    assert len(toks) == 20
+    assert all(0 <= t < 48 for t in toks)
+    assert info["spec_rounds"] > 0
+    assert 0 <= info["spec_accepted"] <= info["spec_proposed"]
+
+
+# ------------------------------------------------- rejection sampler math
+@pytest.fixture(scope="module")
+def bare_engine():
+    eng = DecodeEngine(load_servable(ZOO_SRC),
+                       DecodeConfig(slots=1, page_size=8), name="rj")
+    yield eng
+    eng.close()
+
+
+def test_greedy_accept_is_argmax_prefix_match(bare_engine):
+    v = 8
+    vlog = np.full((4, v), -5.0, np.float32)
+    vlog[0, 4] = 5.0
+    vlog[1, 7] = 5.0
+    vlog[2, 2] = 5.0          # target argmax 2 disagrees with draft's 1
+    vlog[3, 6] = 5.0
+    a, extra = bare_engine._spec_accept(
+        np.array([4, 7, 1]), vlog, vlog[:3], 0.0, 0)
+    assert (a, extra) == (2, 2)   # prefix accepted, target's own argmax
+    a, extra = bare_engine._spec_accept(
+        np.array([4, 7, 2]), vlog, vlog[:3], 0.0, 0)
+    assert (a, extra) == (3, 6)   # full acceptance + bonus token
+
+
+def test_rejection_sampling_p_equals_q_always_accepts(bare_engine):
+    rs = np.random.RandomState(5)
+    lg = rs.randn(4, 16).astype(np.float32)
+    for _ in range(8):            # accept prob is exactly 1, any rng draw
+        a, extra = bare_engine._spec_accept(
+            np.array([3, 9, 14]), lg, lg[:3], 0.7, 0)
+        assert a == 3 and 0 <= extra < 16
+
+
+def test_rejection_resamples_residual_deterministically(bare_engine):
+    """q one-hot at 1, p one-hot at 2: p(d)=0 forces rejection at i=0
+    (accept prob 0 beats any rng draw) and the residual max(p-q, 0) is
+    one-hot at the target's token."""
+    v = 8
+    qlog = np.full((1, v), -1e9, np.float32)
+    qlog[0, 1] = 0.0
+    vlog = np.full((2, v), -1e9, np.float32)
+    vlog[0, 2] = 0.0
+    a, extra = bare_engine._spec_accept(
+        np.array([1]), vlog, qlog, 1.0, 0)
+    assert (a, extra) == (0, 2)
+
+
+def test_spec_dist_matches_sampler_topk_clip(bare_engine):
+    """The host-side q/p recomputation applies the SAME top-k clip as
+    the in-graph sampler: mass lands only on the k highest logits."""
+    lg = np.arange(16, dtype=np.float32)
+    p = bare_engine._spec_dist(lg, 1.0, 4)
+    assert np.all(p[:-4] == 0.0) and abs(p.sum() - 1.0) < 1e-12
+    assert np.argmax(p) == 15
+
+
+# -------------------------------------------- acceptance-floor fallback
+def test_low_acceptance_trips_floor_and_output_is_still_exact(spec_pair):
+    """A same-vocab but differently-initialized draft almost never
+    matches the target's argmax: the rolling window trips the floor,
+    the stream falls back to plain decode, and the greedy output is
+    STILL token-for-token the non-speculative stream."""
+    plain, _ = spec_pair
+    cfg = DecodeConfig(slots=2, page_size=8)
+    bad = ServedLM(
+        "spec-fb", load_servable(ZOO_SRC), ZOO_SRC,
+        decode=apply_variant(
+            cfg, f"spec:draft={DRAFT_SRC},k=4,floor=0.9,window=2"))
+    try:
+        prompt = [3, 1, 4, 1, 5]
+        pt, _ = _tokens(plain.generate(prompt, max_new_tokens=26))
+        st, info = _tokens(bad.generate(prompt, max_new_tokens=26))
+        assert pt == st, "fallback path changed the stream"
+        assert len(st) >= 24
+        fb = monitor.counter(
+            "serving_decode_spec_fallbacks_total", "x",
+            labels=("model", "reason")).value(
+                model="spec-fb", reason="acceptance_floor")
+        assert fb >= 1
+    finally:
+        bad.shutdown(drain=False, timeout=5)
+
+
+# --------------------------------------------------- loud build failures
+def test_vocab_mismatched_draft_rejected_at_build():
+    mismatched = ZOO_SRC.replace("vocab_size=48", "vocab_size=32")
+    cfg = apply_variant(DecodeConfig(slots=1, page_size=8),
+                        f"spec:draft={mismatched}")
+    with pytest.raises(ModelLoadError, match="vocab"):
+        DecodeEngine(load_servable(ZOO_SRC), cfg, name="vmm")
+
+
+def test_spec_k_must_be_positive():
+    cfg = apply_variant(DecodeConfig(slots=1, page_size=8), "spec:k=0")
+    with pytest.raises(ModelLoadError, match="spec_k"):
+        DecodeEngine(load_servable(ZOO_SRC), cfg, name="k0")
+
+
+# ----------------------------------------------------- compile ledger
+def test_spec_traffic_never_compiles_on_request_path(spec_pair):
+    """After real speculative traffic (the parity/temperature tests
+    above), compiles == warmups for the target AND its draft ledger
+    labels: the draft_{k} and verify_{k+1} programs were AOT-warmed."""
+    def fam_sum(family, model):
+        total = 0.0
+        for line in monitor.prometheus_text().splitlines():
+            if line.startswith(family + "{") and f'model="{model}"' in line:
+                total += float(line.rsplit(" ", 1)[1])
+        return total
+
+    for model in ("spec-on", "spec-on.draft"):
+        csum = fam_sum("serving_decode_compiles_total", model)
+        wsum = fam_sum("serving_decode_warmup_runs_total", model)
+        assert csum == wsum and csum > 0, (model, csum, wsum)

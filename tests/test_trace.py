@@ -300,10 +300,20 @@ class _FakeCache:
 class _FakeEngine:
     max_context = 128
     prefill_chunk_tokens = 0          # chunking off: one-shot prefill
+    spec_enabled = False              # no speculative draft engine
 
     def __init__(self, slots=2):
         self.cache = _FakeCache(slots)
         self.closed = False
+
+    def draft_prefill_origin(self, slot):
+        return None
+
+    def draft_prefill_done(self, slot, prompt):
+        pass
+
+    def release_slot(self, slot):
+        self.cache.release(slot)
 
     def admit_prompt(self, prompt):
         from deeplearning4j_tpu.serving.kvcache import AdmitInfo

@@ -316,6 +316,73 @@ def test_perf_report_roofline_from_ledger(tmp_path):
     assert by_fp["bb"]["mfu_ceiling_pct"] == pytest.approx(0.8, abs=0.05)
 
 
+def _decode_round(tokens_sec, itl_ms, calib_ms=None):
+    doc = {"sweep": [{"batch": 4, "mode": "decode", "on_tpu": False,
+                      "decode_tokens_sec": tokens_sec,
+                      "decode_itl_p99_ms": itl_ms}],
+           "tpu_unavailable": True}
+    if calib_ms is not None:
+        doc["calib_cpu_ms"] = calib_ms
+    return doc
+
+
+def test_perf_report_calibration_normalizes_host_drift(tmp_path):
+    """A 2x slower host halves throughput and doubles latency; with both
+    rounds calibrated the gate compares in host-normalized space and
+    stays clean — a genuine regression on top of the drift still trips."""
+    (tmp_path / "DECODE_r01.json").write_text(
+        json.dumps(_decode_round(2000.0, 5.0, calib_ms=20.0)))
+    (tmp_path / "DECODE_r02.json").write_text(
+        json.dumps(_decode_round(1000.0, 10.0, calib_ms=40.0)))
+    r = _run_perf_report(tmp_path)
+    assert r.returncode == 0, r.stdout + r.stderr
+    report = json.loads(r.stdout)
+    assert report["ok"] and report["series_compared"] == 2
+    for rec in report["comparisons"]:
+        assert rec["calibration"]["host_speed_ratio"] == 2.0
+        assert rec["delta_pct"] == 0.0
+    # same drift + a real 30% code regression: the gate still fires
+    (tmp_path / "DECODE_r02.json").write_text(
+        json.dumps(_decode_round(700.0, 10.0, calib_ms=40.0)))
+    r = _run_perf_report(tmp_path)
+    assert r.returncode == 2, r.stdout + r.stderr
+    reg = json.loads(r.stdout)["regressions"]
+    assert [x["series"]["metric"] for x in reg] == ["decode_tokens_sec"]
+    assert reg[0]["delta_pct"] == -30.0
+
+
+def test_perf_report_skips_uncalibrated_baselines(tmp_path):
+    """A calibrated latest cannot be fairly judged by pre-calibration
+    rounds: those are excluded and the series reports as skipped rather
+    than gating on raw wall-clock."""
+    (tmp_path / "DECODE_r01.json").write_text(
+        json.dumps(_decode_round(2000.0, 5.0)))             # legacy round
+    (tmp_path / "DECODE_r02.json").write_text(
+        json.dumps(_decode_round(1000.0, 10.0, calib_ms=40.0)))
+    r = _run_perf_report(tmp_path)
+    assert r.returncode == 0, r.stdout + r.stderr
+    report = json.loads(r.stdout)
+    assert report["ok"] and report["series_compared"] == 0
+    assert {s["series"]["metric"] for s in report["series_skipped"]} \
+        == {"decode_tokens_sec", "decode_itl_p99_ms"}
+    assert all(s["reason"] == "no calibrated baseline round"
+               for s in report["series_skipped"])
+
+
+def test_perf_report_uncalibrated_latest_keeps_raw_comparison(tmp_path):
+    """Legacy behavior is untouched when the LATEST round lacks a
+    calibration reference — even if an earlier round has one."""
+    (tmp_path / "DECODE_r01.json").write_text(
+        json.dumps(_decode_round(2000.0, 5.0, calib_ms=20.0)))
+    (tmp_path / "DECODE_r02.json").write_text(
+        json.dumps(_decode_round(1000.0, 5.0)))             # raw -50%
+    r = _run_perf_report(tmp_path)
+    assert r.returncode == 2, r.stdout + r.stderr
+    reg = json.loads(r.stdout)["regressions"]
+    assert [x["series"]["metric"] for x in reg] == ["decode_tokens_sec"]
+    assert "calibration" not in reg[0]
+
+
 def test_perf_report_banked_repo_trajectory_is_clean():
     """The acceptance gate: the repo's own banked BENCH history exits 0."""
     r = _run_perf_report(_REPO)

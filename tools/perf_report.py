@@ -8,7 +8,12 @@ Merges two artifact streams:
   more than one round — per-mode/batch ResNet imgs/sec, char-LSTM
   chars/sec, Word2Vec pairs/sec, LeNet imgs/sec, h2d MB/s, and the
   headline — is compared LATEST vs. BEST-EARLIER within its own device
-  class (CPU rows never gate TPU rows and vice versa);
+  class (CPU rows never gate TPU rows and vice versa). Artifacts that
+  bank a ``calib_cpu_ms`` machine-speed reference (decode smokes, r17+)
+  are compared in HOST-NORMALIZED space: baselines are rescaled by the
+  calibration ratio so a slower/faster container does not masquerade as
+  a code regression/improvement, and uncalibrated earlier rounds are
+  excluded (reported as skipped when no calibrated baseline exists);
 - the compiled-program ledger (``monitor.xla.save_ledger()`` JSON,
   ``--ledger``): each program's arithmetic intensity is placed on the
   device roofline (ridge = peak_flops / hbm_bandwidth) to report whether
@@ -51,7 +56,8 @@ THROUGHPUT_KEYS = ("imgs_sec", "lenet_imgs_sec", "chars_sec", "pairs_sec",
                    "fit_e2e_imgs_sec",
                    "fit_e2e_chars_sec", "fit_e2e_pairs_sec",
                    "chaos_goodput_under_fault_rps", "mesh_imgs_sec",
-                   "decode_tokens_sec", "decode_cache_hit_rate")
+                   "decode_tokens_sec", "decode_cache_hit_rate",
+                   "decode_spec_acceptance_rate")
 
 #: lower-is-better series (latencies). Banked by tools/serve_chaos.py
 #: (CHAOS_r*.json): p99 while a replica is killed + another wedged, and
@@ -69,6 +75,11 @@ THROUGHPUT_KEYS = ("imgs_sec", "lenet_imgs_sec", "chars_sec", "pairs_sec",
 LATENCY_KEYS = ("chaos_p99_under_fault_ms", "chaos_recovered_p99_ms",
                 "decode_ttft_p99_ms", "decode_itl_p99_ms",
                 "decode_ttft_hot_p99_ms", "decode_itl_interferer_p99_ms")
+
+#: dimensionless series (fractions of work, not work per second): host
+#: speed cannot move them, so calibration normalization never applies —
+#: they always compare raw, against every earlier round.
+RATIO_KEYS = ("decode_cache_hit_rate", "decode_spec_acceptance_rate")
 
 
 def _round_of(name: str) -> int:
@@ -107,27 +118,35 @@ def load_rounds(directory: str):
             continue
         # absent flag = the early TPU rounds (r01/r02) that predate it
         on_tpu = not payload.get("tpu_unavailable", False)
+        # machine-speed reference (decode_smoke r17+): wall-ms for a
+        # fixed numpy workload on the banking host; None on older rounds
+        calib = payload.get("calib_cpu_ms")
+        if not isinstance(calib, (int, float)) or calib <= 0:
+            calib = None
         entries.append({"artifact": os.path.basename(path),
                         "round": _round_of(os.path.basename(path)),
-                        "on_tpu": on_tpu, "payload": payload})
+                        "on_tpu": on_tpu, "calib": calib,
+                        "payload": payload})
     entries.sort(key=lambda e: (e["round"], e["artifact"]))
     return entries
 
 
 def extract_series(entries):
-    """{series_id: [(round, artifact, value), ...]} — series_id keys are
-    (on_tpu, mode, batch, metric); the headline rides as
-    (on_tpu, "__headline__", None, "value")."""
+    """{series_id: [(round, artifact, value, calib), ...]} — series_id
+    keys are (on_tpu, mode, batch, metric); the headline rides as
+    (on_tpu, "__headline__", None, "value"). ``calib`` is the artifact's
+    machine-speed reference (None when the round predates it)."""
     series = {}
 
-    def add(sid, rnd, artifact, value):
-        series.setdefault(sid, []).append((rnd, artifact, float(value)))
+    def add(sid, rnd, artifact, value, calib):
+        series.setdefault(sid, []).append(
+            (rnd, artifact, float(value), calib))
 
     for e in entries:
         p = e["payload"]
         if isinstance(p.get("value"), (int, float)):
             add((e["on_tpu"], "__headline__", None, "value"),
-                e["round"], e["artifact"], p["value"])
+                e["round"], e["artifact"], p["value"], e["calib"])
         for row in p.get("sweep", []) or []:
             if not isinstance(row, dict) or "error" in row \
                     or "skipped" in row:
@@ -136,7 +155,7 @@ def extract_series(entries):
             for key in THROUGHPUT_KEYS + LATENCY_KEYS:
                 if isinstance(row.get(key), (int, float)):
                     add((on_tpu, row.get("mode"), row.get("batch"), key),
-                        e["round"], e["artifact"], row[key])
+                        e["round"], e["artifact"], row[key], e["calib"])
     return series
 
 
@@ -145,32 +164,70 @@ def check_regressions(series, threshold: float):
     "Best" is direction-aware: highest for throughput series, lowest for
     LATENCY_KEYS series, and a regression is a move AWAY from best beyond
     the threshold in either regime. Single-round series (e.g. a config
-    measured only once) cannot gate."""
-    checked, regressions = [], []
+    measured only once) cannot gate.
+
+    Machine-speed normalization: when the LATEST artifact banked a
+    ``calib_cpu_ms`` reference, every baseline candidate that also has
+    one is mapped to the latest host's speed before the comparison
+    (throughput scales with 1/calib, latency with calib) — the gate then
+    measures the CODE, not which container the round happened to run in.
+    Earlier rounds WITHOUT a reference cannot give a fair verdict against
+    a calibrated latest, so they are excluded from baseline selection; if
+    none remain the series is reported as skipped, not gated. A latest
+    without a reference keeps the legacy raw comparison."""
+    checked, regressions, skipped = [], [], []
     for sid, points in sorted(series.items(), key=lambda kv: str(kv[0])):
         lower_better = sid[3] in LATENCY_KEYS
         better = (lambda a, b: a < b) if lower_better \
             else (lambda a, b: a > b)
         rounds = {}
-        for rnd, artifact, value in points:
+        for rnd, artifact, value, calib in points:
             cur = rounds.get(rnd)
             if cur is None or better(value, cur[1]):  # same-round: best
-                rounds[rnd] = (artifact, value)
+                rounds[rnd] = (artifact, value, calib)
         if len(rounds) < 2:
             continue
         latest_round = max(rounds)
-        latest_art, latest = rounds[latest_round]
-        base_round, (base_art, baseline) = \
+        latest_art, latest, latest_calib = rounds[latest_round]
+        earlier = {r: v for r, v in rounds.items() if r != latest_round}
+        on_tpu, mode, batch, key = sid
+        sdesc = {"on_tpu": on_tpu, "mode": mode, "batch": batch,
+                 "metric": key}
+        calibrated = latest_calib is not None and key not in RATIO_KEYS
+        if calibrated:
+            earlier = {r: v for r, v in earlier.items()
+                       if v[2] is not None}
+            if not earlier:
+                skipped.append({
+                    "series": sdesc,
+                    "latest": {"round": latest_round,
+                               "artifact": latest_art, "value": latest},
+                    "reason": "no calibrated baseline round",
+                })
+                continue
+
+            def adjust(value, calib):
+                # map a baseline taken at `calib` to the latest host
+                ratio = latest_calib / calib
+                return value * (ratio if lower_better else 1.0 / ratio)
+        else:
+            def adjust(value, calib):
+                return value
+        base_round, (base_art, base_raw, base_calib) = \
             (min if lower_better else max)(
-                ((r, v) for r, v in rounds.items() if r != latest_round),
-                key=lambda rv: rv[1][1])
+                earlier.items(), key=lambda rv: adjust(rv[1][1], rv[1][2]))
+        baseline = adjust(base_raw, base_calib)
         delta = (latest - baseline) / baseline if baseline > 0 else 0.0
         if lower_better:
             delta = -delta      # normalized: negative delta == worse
-        on_tpu, mode, batch, key = sid
+        calibration = {
+            "latest_calib_ms": latest_calib,
+            "baseline_calib_ms": base_calib,
+            "host_speed_ratio": round(latest_calib / base_calib, 4),
+            "baseline_raw": base_raw,
+        } if calibrated else None
         rec = {
-            "series": {"on_tpu": on_tpu, "mode": mode, "batch": batch,
-                       "metric": key},
+            "series": sdesc,
             "baseline": {"round": base_round, "artifact": base_art,
                          "value": baseline},
             "latest": {"round": latest_round, "artifact": latest_art,
@@ -178,10 +235,12 @@ def check_regressions(series, threshold: float):
             "delta_pct": round(delta * 100, 2),
             "regressed": delta < -threshold,
         }
+        if calibration:
+            rec["calibration"] = calibration
         checked.append(rec)
         if rec["regressed"]:
             regressions.append(rec)
-    return checked, regressions
+    return checked, regressions, skipped
 
 
 def roofline(ledger: dict):
@@ -245,7 +304,8 @@ def main(argv=None) -> int:
               file=sys.stderr)
         return 1
     series = extract_series(entries)
-    checked, regressions = check_regressions(series, args.threshold)
+    checked, regressions, skipped = check_regressions(series,
+                                                      args.threshold)
 
     ledger_doc, roof = None, []
     if args.ledger:
@@ -263,6 +323,7 @@ def main(argv=None) -> int:
         "threshold": args.threshold,
         "series_tracked": len(series),
         "series_compared": len(checked),
+        "series_skipped": skipped,
         "comparisons": checked,
         "regressions": regressions,
         "roofline": roof,
@@ -276,10 +337,17 @@ def main(argv=None) -> int:
               f"(threshold {args.threshold:.0%})")
         for rec in checked:
             mark = "REGRESSED" if rec["regressed"] else "ok"
+            cal = rec.get("calibration")
+            note = (f"  [host x{cal['host_speed_ratio']:.2f}, baseline "
+                    f"{cal['baseline_raw']:.2f} raw]" if cal else "")
             print(f"  {mark:>9}  {_fmt_series(rec):<42} "
                   f"{rec['baseline']['value']:>12.2f} (r{rec['baseline']['round']})"
                   f" -> {rec['latest']['value']:>12.2f} "
-                  f"(r{rec['latest']['round']})  {rec['delta_pct']:+.1f}%")
+                  f"(r{rec['latest']['round']})  {rec['delta_pct']:+.1f}%"
+                  f"{note}")
+        for rec in skipped:
+            print(f"    skipped  {_fmt_series(rec):<42} "
+                  f"{rec['reason']} (latest r{rec['latest']['round']})")
         for row in roof:
             pos = (f"{row['bound']}-bound, MFU ceiling "
                    f"{row['mfu_ceiling_pct']}%"

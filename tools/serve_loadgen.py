@@ -92,6 +92,20 @@ def _latency_stats(lat_s):
     }
 
 
+def _spec_cols(st):
+    """Speculative-decoding columns from summed done-event counters:
+    acceptance rate (accepted / proposed draft tokens) and tokens per
+    verify round (each round emits accepted + 1)."""
+    return {
+        "spec_streams": st["streams"],
+        "spec_acceptance_rate": round(st["accepted"] / st["proposed"], 4)
+        if st["proposed"] else None,
+        "spec_accepted_per_step": round(
+            (st["accepted"] + st["rounds"]) / st["rounds"], 4)
+        if st["rounds"] else None,
+    }
+
+
 class LoadGen:
     def __init__(self, args, input_shape):
         self.args = args
@@ -165,6 +179,9 @@ class LoadGen:
             self.itls = {}              # class -> [seconds] between tokens
             self.tokens = 0
             self.prefix_stats = {}      # prefix class -> counters/ttfts
+            # speculative-decoding counters per class, read off the done
+            # event (0/0/0 streams on a plain servable stay comparable)
+            self.spec_stats = {}        # class -> proposed/accepted/rounds
         else:
             self.bodies = [
                 json.dumps({"inputs": self.rs.rand(
@@ -222,7 +239,7 @@ class LoadGen:
         t0 = time.perf_counter()
         retry_after = None
         ttft, itls, ntok, last, done = None, [], 0, None, False
-        cached = None
+        cached = spec = None
         try:
             r = urllib.request.urlopen(urllib.request.Request(
                 self.url, data=body, headers=headers),
@@ -242,6 +259,10 @@ class LoadGen:
                 elif ev.get("done"):
                     done = True
                     cached = ev.get("cached_tokens")
+                    if ev.get("spec_rounds") is not None:
+                        spec = (int(ev.get("spec_proposed") or 0),
+                                int(ev.get("spec_accepted") or 0),
+                                int(ev.get("spec_rounds") or 0))
                 elif "error" in ev:
                     break
             code = r.status if done else 0
@@ -252,10 +273,10 @@ class LoadGen:
         except Exception:               # connection refused/reset, timeout
             code = 0
         return (code, time.perf_counter() - t0, retry_after, ttft, itls,
-                ntok, cached)
+                ntok, cached, spec)
 
     def _record(self, i: int, code, dt: float, ttft=None, itls=(),
-                ntok: int = 0, trace_id=None, cached=None):
+                ntok: int = 0, trace_id=None, cached=None, spec=None):
         cls = self._class_of(i) or "default"
         kind = classify(code if code != 0 else "transport")
         with self.lock:
@@ -274,6 +295,14 @@ class LoadGen:
                         self.ttfts.setdefault(cls, []).append(ttft)
                     if itls:
                         self.itls.setdefault(cls, []).extend(itls)
+                    if spec is not None:
+                        st = self.spec_stats.setdefault(
+                            cls, {"streams": 0, "proposed": 0,
+                                  "accepted": 0, "rounds": 0})
+                        st["streams"] += 1
+                        st["proposed"] += spec[0]
+                        st["accepted"] += spec[1]
+                        st["rounds"] += spec[2]
                     if self.prefix_mix and cached is not None:
                         # hot = the server's prefix cache served >= one
                         # full page of this prompt's KV; split TTFT by
@@ -294,10 +323,10 @@ class LoadGen:
         """One wire attempt in the configured workload; returns
         (code, retry_after)."""
         if self.mode == "decode":
-            (code, dt, retry_after, ttft, itls, ntok,
-             cached) = self._send_decode(i, traceparent)
+            (code, dt, retry_after, ttft, itls, ntok, cached,
+             spec) = self._send_decode(i, traceparent)
             self._record(i, code, dt, ttft=ttft, itls=itls, ntok=ntok,
-                         trace_id=trace_id, cached=cached)
+                         trace_id=trace_id, cached=cached, spec=spec)
         else:
             code, dt, retry_after = self._send(i, traceparent)
             self._record(i, code, dt, trace_id=trace_id)
@@ -444,6 +473,13 @@ class LoadGen:
                 "ttft_ms": _latency_stats(all_ttft),
                 "inter_token_ms": _latency_stats(all_itl),
             }
+            if self.spec_stats:
+                tot = {"streams": 0, "proposed": 0, "accepted": 0,
+                       "rounds": 0}
+                for st in self.spec_stats.values():
+                    for key in tot:
+                        tot[key] += st[key]
+                rep["decode"].update(_spec_cols(tot))
             if self.prefix_mix:
                 total = sum(s["requests"]
                             for s in self.prefix_stats.values())
@@ -481,6 +517,8 @@ class LoadGen:
                         self.ttfts.get(cls, []))
                     sub["inter_token_ms"] = _latency_stats(
                         self.itls.get(cls, []))
+                    if cls in self.spec_stats:
+                        sub.update(_spec_cols(self.spec_stats[cls]))
         return rep
 
 

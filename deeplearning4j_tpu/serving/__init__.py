@@ -49,8 +49,11 @@ from deeplearning4j_tpu.serving.quantize import (
     QTensor, quality_delta, quantize_params,
 )
 from deeplearning4j_tpu.serving.fleet import (
-    InProcessReplica, Replica, ReplicaSpec, ReplicaSupervisor,
-    SubprocessReplica,
+    AutoscaleConfig, InProcessReplica, Replica, ReplicaSpec,
+    ReplicaSupervisor, SubprocessReplica,
+)
+from deeplearning4j_tpu.serving.rollout import (
+    RolloutController, read_blessed,
 )
 from deeplearning4j_tpu.serving.registry import (
     ModelLoadError, ModelRegistry, ServedModel, ServableVersion,
@@ -64,13 +67,15 @@ from deeplearning4j_tpu.serving.server import (
 )
 
 __all__ = [
-    "CircuitBreaker", "DEFAULT_BUCKETS", "DeadlineExceededError",
+    "AutoscaleConfig", "CircuitBreaker", "DEFAULT_BUCKETS",
+    "DeadlineExceededError",
     "DecodeConfig", "DecodeEngine", "DecodeScheduler", "GenerateRequest",
     "InProcessReplica", "KVCacheState", "ModelLoadError", "ModelRegistry",
     "ModelServer", "QTensor", "Replica", "ReplicaSpec",
-    "ReplicaSupervisor", "ResilientRouter", "RouterServer",
+    "ReplicaSupervisor", "ResilientRouter", "RolloutController",
+    "RouterServer",
     "ServableVersion", "ServedLM", "ServedModel", "ServerDrainingError",
     "ServerOverloadedError", "ServingError", "ShapeBucketedBatcher",
     "SubprocessReplica", "load_servable", "quality_delta",
-    "quantize_params", "retry_after_seconds",
+    "quantize_params", "read_blessed", "retry_after_seconds",
 ]

@@ -195,6 +195,51 @@ def build_parser() -> argparse.ArgumentParser:
     fleet.add_argument("--no-hedge", action="store_true",
                        help="disable hedged retries for straggler "
                             "predicts")
+    # ----------------------------------------------- continuous rollout
+    ro = p.add_argument_group(
+        "continuous rollout (docs/SERVING.md 'Continuous rollout')")
+    ro.add_argument("--rollout-watch", default=None, metavar="DIR",
+                    help="checkpoint directory to tail for new versions; "
+                         "enables the RolloutController (fleet mode only)")
+    ro.add_argument("--rollout-model", default=None,
+                    help="served model name the rollout swaps (default: "
+                         "the first --model/--lm name)")
+    ro.add_argument("--rollout-mode", choices=("blessed", "latest"),
+                    default="blessed",
+                    help="tail the eval-gated blessed.json manifest "
+                         "(default) or the raw newest manifest entry")
+    ro.add_argument("--rollout-observe-s", type=float, default=30.0,
+                    help="canary observation window before the verdict")
+    ro.add_argument("--rollout-poll-s", type=float, default=5.0,
+                    help="how often the watcher re-reads the manifest")
+    ro.add_argument("--rollout-canary-fraction", type=float, default=0.1,
+                    help="bounded share of live traffic routed to the "
+                         "canary replica (0 < f <= 0.5)")
+    ro.add_argument("--rollout-min-requests", type=int, default=20,
+                    help="minimum canary requests before a promote "
+                         "verdict (insufficient traffic rejects)")
+    ro.add_argument("--rollout-p99-floor-ms", type=float, default=10.0,
+                    help="p99 regressions below this floor are noise, "
+                         "not a verdict; raise it where the canary's "
+                         "first requests pay a compile (cold swap)")
+    # ------------------------------------------------------- autoscaling
+    asc = p.add_argument_group(
+        "load-signal autoscaling (docs/SERVING.md 'Autoscaling')")
+    asc.add_argument("--autoscale-max", type=int, default=None,
+                     metavar="N",
+                     help="enable autoscaling up to N replicas "
+                          "(--replicas is the floor); scale signal is "
+                          "router in-flight vs healthy capacity "
+                          "(--per-replica-inflight)")
+    asc.add_argument("--autoscale-high", type=float, default=0.8,
+                     help="utilization above this for consecutive ticks "
+                          "scales up")
+    asc.add_argument("--autoscale-low", type=float, default=0.25,
+                     help="utilization below this for consecutive ticks "
+                          "drains one replica (readyz-confirmed drain, "
+                          "never a kill)")
+    asc.add_argument("--autoscale-cooldown-s", type=float, default=10.0,
+                     help="minimum seconds between scaling decisions")
     return p
 
 
@@ -377,7 +422,8 @@ def _main_fleet(args, specs, lm_specs, buckets, decode_cfg) -> int:
     import os
 
     from deeplearning4j_tpu.serving.fleet import (
-        InProcessReplica, ReplicaSpec, ReplicaSupervisor, SubprocessReplica,
+        AutoscaleConfig, InProcessReplica, ReplicaSpec, ReplicaSupervisor,
+        SubprocessReplica,
     )
     from deeplearning4j_tpu.serving.quantize import parse_variant
     from deeplearning4j_tpu.serving.router import (
@@ -417,12 +463,26 @@ def _main_fleet(args, specs, lm_specs, buckets, decode_cfg) -> int:
         def factory(i):
             return InProcessReplica(f"replica-{i}", spec)
 
+    autoscale = None
+    if args.autoscale_max is not None:
+        try:
+            autoscale = AutoscaleConfig(
+                min_replicas=args.replicas,
+                max_replicas=args.autoscale_max,
+                capacity_per_replica=args.per_replica_inflight,
+                high_watermark=args.autoscale_high,
+                low_watermark=args.autoscale_low,
+                cooldown_s=args.autoscale_cooldown_s,
+                drain_timeout_s=args.drain_timeout_s)
+        except ValueError as e:
+            raise SystemExit(f"--autoscale-*: {e}")
     supervisor = ReplicaSupervisor(
         factory, args.replicas,
         probe_interval_s=args.probe_interval_s,
         probe_timeout_s=args.probe_timeout_s,
         unhealthy_after=args.unhealthy_after,
-        restart_budget=args.restart_budget)
+        restart_budget=args.restart_budget,
+        autoscale=autoscale)
     try:
         supervisor.start()
     except Exception as e:                    # noqa: BLE001
@@ -432,7 +492,8 @@ def _main_fleet(args, specs, lm_specs, buckets, decode_cfg) -> int:
         shed_floor=args.shed_floor,
         per_replica_inflight=args.per_replica_inflight,
         hedge=not args.no_hedge, timeout_s=args.deadline_s,
-        slo_p99_ms=args.slo_p99_ms)
+        slo_p99_ms=args.slo_p99_ms,
+        canary_fraction=args.rollout_canary_fraction)
     from deeplearning4j_tpu.monitor import slo as slo_mod
     slo_engine = _slo_setup(args, slo_mod.router_objectives(
         slo_p99_ms=args.slo_p99_ms,
@@ -440,6 +501,24 @@ def _main_fleet(args, specs, lm_specs, buckets, decode_cfg) -> int:
     server = RouterServer(router, supervisor=supervisor,
                           host=args.host, port=args.port,
                           slo_engine=slo_engine)
+    rollout = None
+    if args.rollout_watch is not None:
+        from deeplearning4j_tpu.serving.rollout import RolloutController
+        model_names = [n for n, _ in specs + lm_specs]
+        rollout_model = args.rollout_model or (
+            model_names[0] if model_names else None)
+        if rollout_model is None:
+            raise SystemExit("--rollout-watch needs a model "
+                             "(--rollout-model or at least one --model)")
+        rollout = RolloutController(
+            supervisor, router, args.rollout_watch, rollout_model,
+            watch=args.rollout_mode,
+            poll_interval_s=args.rollout_poll_s,
+            observe_s=args.rollout_observe_s,
+            min_canary_requests=args.rollout_min_requests,
+            p99_floor_ms=args.rollout_p99_floor_ms)
+        server.rollout = rollout
+        rollout.start()
     endpoints = ["/v1/models", "/v1/fleet", "/healthz", "/readyz",
                  "/metrics"]
     if slo_engine is not None:
@@ -448,7 +527,12 @@ def _main_fleet(args, specs, lm_specs, buckets, decode_cfg) -> int:
                       "replicas": [r.describe() for r in
                                    supervisor.replicas],
                       "priority_classes": list(classes),
-                      "endpoints": endpoints}))
+                      "endpoints": endpoints,
+                      "rollout": (rollout.describe()
+                                  if rollout is not None else None),
+                      "autoscale": (None if autoscale is None else
+                                    {"min": autoscale.min_replicas,
+                                     "max": autoscale.max_replicas})}))
     sys.stdout.flush()
 
     stop = threading.Event()
@@ -467,6 +551,10 @@ def _main_fleet(args, specs, lm_specs, buckets, decode_cfg) -> int:
     # only then tear the replicas down (their own SIGTERM drain flushes
     # whatever is still inside them)
     server.draining = True
+    if rollout is not None:
+        # settle the control loop first: a rollout mid-promotion must
+        # not race the teardown's replica stops
+        rollout.stop()
     grace = min(2.0, args.drain_timeout_s)
     time.sleep(grace)
     deadline = time.monotonic() + max(0.0, args.drain_timeout_s - grace)

@@ -50,6 +50,7 @@ import sys
 import threading
 import time
 import random as _random
+import urllib.error
 import urllib.request
 from typing import Callable, List, Optional, Sequence, Tuple
 
@@ -59,9 +60,15 @@ from deeplearning4j_tpu.util.locks import DiagnosedLock
 
 log = logging.getLogger("deeplearning4j_tpu")
 
-#: replica lifecycle states (the serving_fleet_replicas{state} gauge keys)
+#: replica lifecycle states (the serving_fleet_replicas{state} gauge keys).
+#: "draining" is the autoscaler's scale-down limbo: out of the routing
+#: set, finishing in-flight work, /readyz already answering not-ready.
 REPLICA_STATES = ("starting", "ready", "unhealthy", "backoff", "dead",
-                  "stopped")
+                  "stopped", "draining")
+
+#: rollout roles a replica can hold (serving/rollout.py sets these;
+#: the router's canary split and /v1/fleet read them)
+REPLICA_ROLES = ("stable", "canary")
 
 
 class ReplicaSpec:
@@ -128,6 +135,15 @@ class Replica:
         self.state = "starting"
         self.generation = 0                  # bumps on every relaunch
         self.consecutive_probe_failures = 0
+        # rollout state (serving/rollout.py): "canary" while this replica
+        # serves a version under evaluation; rollout_generation bumps on
+        # every rollout that touches the replica so operators can line up
+        # /v1/fleet with the controller's decisions
+        self.role = "stable"
+        self.rollout_generation = 0
+        # scale-down bookkeeping (autoscaler): None until this replica is
+        # chosen as a drain victim, then a dict tracking the drain steps
+        self.scaledown: Optional[dict] = None
         # router-maintained queue-depth signal (power-of-two-choices input)
         self._inflight = 0
         self._inflight_lock = DiagnosedLock(
@@ -162,11 +178,30 @@ class Replica:
         """Graceful stop (drain in-flight work)."""
         self.kill()
 
+    def begin_drain(self):
+        """Start a graceful drain WITHOUT waiting for exit (the
+        autoscaler's scale-down path): the replica should flip its own
+        /readyz to not-ready and finish in-flight work; a later stop()
+        reaps it. Default: nothing to signal — stop() does the drain."""
+
+    def set_role(self, role: str, rollout_generation: int):
+        """Mark this replica canary/stable (RolloutController). Subclasses
+        propagate into the serving process so its own /readyz agrees with
+        the fleet view."""
+        self.role = role
+        self.rollout_generation = int(rollout_generation)
+
     def describe(self) -> dict:
-        return {"name": self.name, "url": self.url, "state": self.state,
-                "generation": self.generation,
-                "inflight": self.inflight(),
-                "probe_failures": self.consecutive_probe_failures}
+        doc = {"name": self.name, "url": self.url, "state": self.state,
+               "generation": self.generation,
+               "role": self.role,
+               "rollout_generation": self.rollout_generation,
+               "inflight": self.inflight(),
+               "probe_failures": self.consecutive_probe_failures}
+        scaledown = getattr(self, "scaledown", None)
+        if scaledown is not None:
+            doc["scaledown"] = dict(scaledown)
+        return doc
 
 
 class InProcessReplica(Replica):
@@ -214,6 +249,16 @@ class InProcessReplica(Replica):
         if self._server is not None:
             self._server.drain(timeout=10.0)
         self._server = self._registry = None
+
+    def begin_drain(self):
+        if self._server is not None:
+            self._server.draining = True     # /readyz -> 503 immediately
+
+    def set_role(self, role: str, rollout_generation: int):
+        super().set_role(role, rollout_generation)
+        if self._server is not None:
+            self._server.role = role
+            self._server.rollout_generation = int(rollout_generation)
 
 
 class SubprocessReplica(Replica):
@@ -360,6 +405,76 @@ class SubprocessReplica(Replica):
             except subprocess.TimeoutExpired:
                 self.kill()
 
+    def begin_drain(self):
+        if self.proc is not None and self.proc.poll() is None:
+            self.proc.terminate()            # SIGTERM: CLI flips /readyz
+            # 503 and drains in-flight work; the child exits on its own
+
+    def set_role(self, role: str, rollout_generation: int):
+        super().set_role(role, rollout_generation)
+        if self.url is None:
+            return
+        # best-effort push into the child so ITS /readyz agrees with the
+        # fleet view; the supervisor-side fields above stay authoritative
+        # for routing even if the child is briefly unreachable
+        body = json.dumps({"role": role,
+                           "rollout_generation": int(rollout_generation)})
+        req = urllib.request.Request(
+            f"{self.url}/v1/rollout/role", data=body.encode("utf-8"),
+            headers={"Content-Type": "application/json"}, method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=5.0):
+                pass
+        except (urllib.error.URLError, OSError) as e:
+            log.warning("fleet: %s role push failed: %s", self.name, e)
+
+
+class AutoscaleConfig:
+    """Load-signal autoscaling policy: track traffic, not a --replicas
+    flag. The signal is the router-maintained in-flight count (the same
+    queue-depth input power-of-two-choices balances on) against healthy
+    capacity: ``utilization = sum(inflight) / (healthy * capacity)``.
+
+    - utilization >= ``high_watermark`` for ``up_after_ticks`` consecutive
+      supervision ticks -> add one replica (launched through the same
+      spawn/generation/restart-budget machinery as a relaunch);
+    - utilization <= ``low_watermark`` for ``down_after_ticks`` ticks ->
+      retire one replica by DRAINING it: out of the routing set first,
+      its own /readyz confirmed not-ready, in-flight work finished, then
+      a graceful stop — never a kill (a forced kill after
+      ``drain_timeout_s`` is counted loudly on /metrics);
+    - one scaling action per ``cooldown_s``, canaries are never victims,
+      and the count stays inside [min_replicas, max_replicas].
+    """
+
+    def __init__(self, min_replicas: int, max_replicas: int,
+                 capacity_per_replica: int,
+                 high_watermark: float = 0.8,
+                 low_watermark: float = 0.25,
+                 up_after_ticks: int = 2,
+                 down_after_ticks: int = 5,
+                 cooldown_s: float = 10.0,
+                 drain_timeout_s: float = 30.0):
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = int(max_replicas)
+        self.capacity_per_replica = int(capacity_per_replica)
+        self.high_watermark = float(high_watermark)
+        self.low_watermark = float(low_watermark)
+        self.up_after_ticks = int(up_after_ticks)
+        self.down_after_ticks = int(down_after_ticks)
+        self.cooldown_s = float(cooldown_s)
+        self.drain_timeout_s = float(drain_timeout_s)
+        if not 1 <= self.min_replicas <= self.max_replicas:
+            raise ValueError(
+                "autoscale needs 1 <= min_replicas <= max_replicas, got "
+                f"[{self.min_replicas}, {self.max_replicas}]")
+        if self.capacity_per_replica < 1:
+            raise ValueError("autoscale capacity_per_replica must be >= 1")
+        if not 0.0 < self.low_watermark < self.high_watermark <= 1.0:
+            raise ValueError(
+                "autoscale needs 0 < low_watermark < high_watermark <= 1, "
+                f"got ({self.low_watermark}, {self.high_watermark})")
+
 
 def _threaded_spawn(fn: Callable[[], None], name: str):
     """Default relaunch spawner: a daemon thread, returned for joining.
@@ -412,9 +527,16 @@ class ReplicaSupervisor:
                  sleep_fn: Callable[[float], None] = time.sleep,
                  rng: Optional[_random.Random] = None,
                  probe_fn: Callable[[Replica, float], bool] = http_probe,
-                 spawn_fn: Callable = _threaded_spawn):
+                 spawn_fn: Callable = _threaded_spawn,
+                 autoscale: Optional[AutoscaleConfig] = None):
         if n_replicas < 1:
             raise ValueError("n_replicas must be >= 1")
+        if autoscale is not None and not (
+                autoscale.min_replicas <= n_replicas
+                <= autoscale.max_replicas):
+            raise ValueError(
+                f"n_replicas={n_replicas} outside the autoscale range "
+                f"[{autoscale.min_replicas}, {autoscale.max_replicas}]")
         self.replicas = [factory(i) for i in range(int(n_replicas))]
         names = [r.name for r in self.replicas]
         if len(set(names)) != len(names):
@@ -432,6 +554,12 @@ class ReplicaSupervisor:
         self._rng = rng if rng is not None else _random.Random()
         self._probe = probe_fn
         self._spawn = spawn_fn
+        self.autoscale = autoscale
+        self._factory = factory
+        self._next_index = int(n_replicas)   # names for scaled-up replicas
+        self._ticks_above = 0                # consecutive high-utilization
+        self._ticks_below = 0                # consecutive low-utilization
+        self._scale_ok_at = 0.0              # cooldown gate
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._lock = DiagnosedLock(
@@ -518,7 +646,17 @@ class ReplicaSupervisor:
         return [r for r in self.replicas if r.state == "ready"]
 
     def describe(self) -> dict:
-        return {"replicas": [r.describe() for r in self.replicas]}
+        doc = {"replicas": [r.describe() for r in self.replicas]}
+        if self.autoscale is not None:
+            cfg = self.autoscale
+            doc["autoscale"] = {
+                "min_replicas": cfg.min_replicas,
+                "max_replicas": cfg.max_replicas,
+                "capacity_per_replica": cfg.capacity_per_replica,
+                "high_watermark": cfg.high_watermark,
+                "low_watermark": cfg.low_watermark,
+            }
+        return doc
 
     def stop_replicas(self):
         for r in self.replicas:
@@ -646,6 +784,7 @@ class ReplicaSupervisor:
                         log.exception("fleet: killing wedged %s failed",
                                       r.name)
                     self._schedule_restart(r, now)
+            grow, shrink = self._autoscale_tick(now)
             self._export_states()
         for name, gen, probe_failures in wedged:
             # OUTSIDE the tick lock (postmortems write a file): a wedge
@@ -656,6 +795,129 @@ class ReplicaSupervisor:
         for r in due:
             r._launch_thread = self._spawn(
                 lambda r=r: self._relaunch(r), f"relaunch-{r.name}")
+        for r in grow:
+            r._launch_thread = self._spawn(
+                lambda r=r: self._relaunch(r), f"scale-up-{r.name}")
+        for r in shrink:
+            r._drain_thread = self._spawn(
+                lambda r=r: self._drain_retired(r), f"drain-{r.name}")
+
+    # ---------------------------------------------------------- autoscaling
+    def _autoscale_tick(self, now: float):
+        """One autoscale evaluation (called under the tick lock). Returns
+        (replicas to launch, replicas to drain) for the caller to spawn
+        OUTSIDE the lock — same discipline as relaunches."""
+        cfg = self.autoscale
+        if cfg is None:
+            return [], []
+        # retired replicas whose drain finished leave the roster entirely
+        # (a scaled-down replica is gone, not a gap to alert on)
+        self.replicas = [
+            r for r in self.replicas
+            if not (r.state == "stopped"
+                    and getattr(r, "scaledown", None) is not None)]
+        ready = [r for r in self.replicas if r.state == "ready"]
+        # anything not permanently gone still counts against max_replicas:
+        # a starting or backoff replica is capacity in flight
+        active = [r for r in self.replicas
+                  if r.state not in ("dead", "stopped", "draining")]
+        capacity = len(ready) * cfg.capacity_per_replica
+        demand = sum(r.inflight() for r in ready)
+        # no ready capacity but demand pressure cannot be measured — treat
+        # as saturated only if there's nothing coming up already
+        util = (demand / capacity) if capacity else (
+            1.0 if not active else 0.0)
+        monitor.gauge("serving_autoscale_utilization",
+                      "Router-tracked in-flight demand over healthy "
+                      "capacity (the autoscaler's input signal)"
+                      ).set(round(util, 4))
+        self._ticks_above = self._ticks_above + 1 \
+            if util >= cfg.high_watermark else 0
+        self._ticks_below = self._ticks_below + 1 \
+            if util <= cfg.low_watermark else 0
+        if now < self._scale_ok_at:
+            return [], []
+        events = monitor.counter(
+            "serving_autoscale_events_total",
+            "Autoscaler scaling actions (direction: up = replica added, "
+            "down = replica drained out)", labels=("direction",))
+        if self._ticks_above >= cfg.up_after_ticks \
+                and len(active) < cfg.max_replicas:
+            name_index = self._next_index
+            self._next_index += 1
+            replica = self._factory(name_index)
+            replica.state = "starting"
+            self.replicas.append(replica)
+            self._ticks_above = 0
+            self._scale_ok_at = now + cfg.cooldown_s
+            events.inc(direction="up")
+            log.info("fleet: autoscale up -> launching %s "
+                     "(utilization %.2f over %d ready)", replica.name,
+                     util, len(ready))
+            return [replica], []
+        if self._ticks_below >= cfg.down_after_ticks \
+                and len(active) > cfg.min_replicas:
+            # victim: the youngest READY stable replica — canaries are
+            # under rollout evaluation and must never be drained away
+            victims = [r for r in ready if r.role != "canary"]
+            if not victims:
+                return [], []
+            victim = victims[-1]
+            victim.state = "draining"
+            victim.scaledown = {"readyz_confirmed": False,
+                                "forced_kill": False}
+            self._ticks_below = 0
+            self._scale_ok_at = now + cfg.cooldown_s
+            events.inc(direction="down")
+            log.info("fleet: autoscale down -> draining %s "
+                     "(utilization %.2f over %d ready)", victim.name,
+                     util, len(ready))
+            return [], [victim]
+        return [], []
+
+    def _drain_retired(self, replica: Replica):
+        """Scale-down teardown, OFF the tick lock: the replica already
+        left the routing set (state 'draining'); signal the drain, wait
+        for its own /readyz to confirm not-ready, wait out in-flight
+        work, then stop gracefully. Killing is the loud last resort after
+        drain_timeout_s, never the plan."""
+        cfg = self.autoscale
+        try:
+            replica.begin_drain()
+        except Exception:                     # noqa: BLE001
+            log.exception("fleet: begin_drain on %s failed", replica.name)
+        deadline = self._time() + cfg.drain_timeout_s
+        # the replica itself must acknowledge the drain: its probe
+        # (healthz+readyz) failing is the /readyz-flipped-503 signal
+        while self._time() < deadline and not self._stop.is_set():
+            if not self._probe(replica, self.probe_timeout):
+                replica.scaledown["readyz_confirmed"] = True
+                break
+            self._sleep(min(0.2, self.probe_interval))
+        while replica.inflight() > 0 and self._time() < deadline \
+                and not self._stop.is_set():
+            self._sleep(min(0.2, self.probe_interval))
+        try:
+            replica.stop()                   # graceful reap
+        except Exception:                     # noqa: BLE001
+            log.exception("fleet: draining stop of %s failed", replica.name)
+        if replica.alive():
+            replica.scaledown["forced_kill"] = True
+            monitor.counter(
+                "serving_autoscale_forced_kills_total",
+                "Scale-down drains that exhausted drain_timeout_s and "
+                "fell back to a kill (should be zero)",
+                labels=("replica",)).inc(replica=replica.name)
+            log.warning("fleet: %s did not drain within %.0fs — killing",
+                        replica.name, cfg.drain_timeout_s)
+            try:
+                replica.kill()
+            except Exception:                 # noqa: BLE001
+                log.exception("fleet: kill of undrained %s failed",
+                              replica.name)
+        with self._lock:
+            replica.state = "stopped"
+            self._export_states()
 
     def _schedule_restart(self, replica: Replica, now: float):
         replica.restart_times = [t for t in replica.restart_times
@@ -721,6 +983,7 @@ class ReplicaSupervisor:
 
     def _join_relaunches(self, timeout: float = 30.0):
         for r in self.replicas:
-            t = getattr(r, "_launch_thread", None)
-            if t is not None:
-                t.join(timeout)
+            for attr in ("_launch_thread", "_drain_thread"):
+                t = getattr(r, attr, None)
+                if t is not None:
+                    t.join(timeout)

@@ -254,7 +254,8 @@ class ResilientRouter:
                  time_fn: Callable[[], float] = time.monotonic,
                  rng: Optional[_random.Random] = None,
                  transport: Callable = http_transport,
-                 slo_p99_ms: Optional[float] = None):
+                 slo_p99_ms: Optional[float] = None,
+                 canary_fraction: float = 0.1):
         self._replicas_fn = replicas_fn
         # normalized to lowercase: _classify lowercases the header value,
         # so a class configured as "Interactive" must still match
@@ -301,6 +302,13 @@ class ResilientRouter:
         #: alert over serving_router_request_seconds (the CLI wires
         #: --slo-p99-ms into an Objective with reason="p99_breach")
         self.slo_p99_ms = None if slo_p99_ms is None else float(slo_p99_ms)
+        #: bounded share of live traffic a canary replica receives while
+        #: a rollout evaluates it (serving/rollout.py flips replica.role);
+        #: the rest of the traffic routes around the canary entirely
+        if not 0.0 < float(canary_fraction) <= 0.5:
+            raise ValueError("canary_fraction must be in (0, 0.5], got "
+                             f"{canary_fraction}")
+        self.canary_fraction = float(canary_fraction)
 
     # ------------------------------------------------------------- breakers
     def breaker(self, replica: Replica, model: str) -> CircuitBreaker:
@@ -402,6 +410,29 @@ class ResilientRouter:
         a, b = self._rng.sample(candidates, 2)
         return a if a.inflight() <= b.inflight() else b
 
+    def _canary_split(self, healthy: List[Replica], model: str
+                      ) -> Tuple[List[Replica], Optional[Replica]]:
+        """Weighted canary routing: while a rollout has a replica marked
+        ``role == "canary"``, ~canary_fraction of requests are ASSIGNED
+        to it (preferred primary, stable failover) and the rest route on
+        stable replicas only — the canary's share of traffic is bounded
+        above by the fraction, never inflated by power-of-two luck.
+        Returns (candidate pool, preferred canary or None)."""
+        canaries = [r for r in healthy if r.role == "canary"]
+        if not canaries or len(canaries) == len(healthy):
+            return healthy, None
+        stable = [r for r in healthy if r.role != "canary"]
+        if self._rng.random() >= self.canary_fraction:
+            return stable, None
+        preferred = canaries[0] if len(canaries) == 1 \
+            else self._pick(canaries)
+        monitor.counter("serving_router_canary_requests_total",
+                        "Requests assigned to a canary replica by the "
+                        "weighted rollout split",
+                        labels=("model", "replica")).inc(
+            model=model, replica=preferred.name)
+        return [preferred] + stable, preferred
+
     def _json_response(self, code: int, payload: dict, retry_after=None
                        ) -> Tuple[int, List[Tuple[str, str]], bytes]:
         headers = [("Content-Type", "application/json")]
@@ -477,7 +508,8 @@ class ResilientRouter:
                 429, {"error": f"fleet saturated; class {cls!r} is being "
                                "shed", "class": cls},
                 retry_after=retry_after_seconds(used, cap, rng=self._rng))
-        candidates = [r for r in healthy
+        pool, preferred = self._canary_split(healthy, model)
+        candidates = [r for r in pool
                       if self.breaker(r, model).would_allow()]
         if not candidates:
             monitor.counter("serving_router_no_backend_total",
@@ -492,7 +524,8 @@ class ResilientRouter:
         if headers.get("__query__"):
             path += "?" + headers.pop("__query__")
         return self._attempt_with_hedge(model, cls, candidates, path,
-                                        body, headers, timeout)
+                                        body, headers, timeout,
+                                        preferred=preferred)
 
     def _fire(self, replica: Replica, model: str, path: str, body, headers,
               timeout: float, resq: "queue.Queue"):
@@ -571,14 +604,18 @@ class ResilientRouter:
 
     def _attempt_with_hedge(self, model: str, cls: str,
                             candidates: List[Replica], path: str,
-                            body, headers, timeout: float):
+                            body, headers, timeout: float,
+                            preferred: Optional[Replica] = None):
         """The send engine: primary attempt, one optional hedge when the
         primary outlives the tracked p99, then bounded failover to the
-        remaining candidates. First acceptable outcome wins."""
+        remaining candidates. First acceptable outcome wins. `preferred`
+        (the canary split's assignment) pins the primary pick; failover
+        and hedging still spread over the rest of the pool."""
         deadline = time.monotonic() + timeout
         remaining = list(candidates)
         resq: "queue.Queue" = queue.Queue()
-        primary = self._pick(remaining)
+        primary = preferred if preferred in remaining \
+            else self._pick(remaining)
         remaining.remove(primary)
         # allow() consumes a half-open probe slot; every candidate —
         # including a replacement after the first pick was denied — must
@@ -766,11 +803,15 @@ class ResilientRouter:
             path = f"/v1/models/{model}/generate"
             if headers.get("__query__"):
                 path += "?" + headers.pop("__query__")
-            remaining = [r for r in healthy
+            pool, preferred = self._canary_split(healthy, model)
+            remaining = [r for r in pool
                          if self.breaker(r, model).would_allow()]
             backpressure = None
             while remaining:
-                replica = self._pick(remaining)
+                if preferred is not None and preferred in remaining:
+                    replica, preferred = preferred, None
+                else:
+                    replica = self._pick(remaining)
                 remaining.remove(replica)
                 breaker = self.breaker(replica, model)
                 if not breaker.allow():
@@ -952,8 +993,11 @@ class _RouterHandler(BaseHTTPRequestHandler):
             return
         if url.path == "/v1/fleet":
             sup = self._rs.supervisor
-            self._json(sup.describe() if sup is not None
-                       else {"replicas": []})
+            doc = sup.describe() if sup is not None else {"replicas": []}
+            rollout = self._rs.rollout
+            if rollout is not None:
+                doc["rollout"] = rollout.describe()
+            self._json(doc)
             return
         if url.path == "/v1/debug/flight":
             # fleet-wide view: the router's own ring plus every healthy
@@ -1120,6 +1164,22 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 done(ok)
             return
         if verb in ("swap", "rollback"):
+            rollout = self._rs.rollout
+            if rollout is not None and rollout.holds_admin():
+                # a manual admin call racing an in-flight canary must
+                # lose LOUDLY: interleaving a fan-out swap with the
+                # controller's canary/promote sequence would fork the
+                # fleet's version history mid-evaluation
+                monitor.counter(
+                    "serving_rollout_admin_conflicts_total",
+                    "Manual swap/rollback calls refused (409) because a "
+                    "rollout held the admin surface",
+                    labels=("verb",)).inc(verb=verb)
+                self._json({"error": f"{verb} rejected: a rollout is in "
+                                     "progress and holds the fleet admin "
+                                     "surface; retry after it settles",
+                            "rollout": rollout.describe()}, code=409)
+                return
             results = self._rs.router.fan_out(
                 f"/v1/models/{name}/{verb}", body,
                 {"Content-Type": "application/json"})
@@ -1128,21 +1188,37 @@ class _RouterHandler(BaseHTTPRequestHandler):
             sup = self._rs.supervisor
             skipped = [r.name for r in (sup.replicas if sup else [])
                        if r.name not in results]
-            if ok and verb == "swap" and sup is not None:
+            if ok and sup is not None:
                 # the fan-out reaches only currently-healthy replicas; a
                 # replica restarted later relaunches from its ReplicaSpec
                 # — update the spec so fresh incarnations load the
-                # swapped source, not the boot-time one
-                try:
-                    src = json.loads(body or b"{}").get("source")
-                except ValueError:
-                    src = None
+                # post-admin source, not the boot-time one. For swap the
+                # source came in the request body; for rollback it is
+                # whatever version the replicas re-activated (their
+                # responses name it) — without this rewrite a restarted
+                # replica would silently rejoin on the ROLLED-BACK-FROM
+                # version (the PR-8 caveat, now closed).
+                src = None
+                if verb == "swap":
+                    try:
+                        src = json.loads(body or b"{}").get("source")
+                    except ValueError:
+                        src = None
+                else:
+                    for out in results.values():
+                        active = out.get("body", {}).get("active") or {}
+                        if active.get("source"):
+                            src = active["source"]
+                            break
                 if src:
                     for r in sup.replicas:
                         if r.spec is not None:
                             r.spec.models = [
                                 (n, src if n == name else s)
                                 for n, s in r.spec.models]
+                            r.spec.lms = [
+                                (n, src if n == name else s)
+                                for n, s in r.spec.lms]
             self._json({"model": name, "verb": verb, "ok": ok,
                         "replicas": results,
                         "skipped_unhealthy": skipped},
@@ -1157,9 +1233,13 @@ class RouterServer:
 
     def __init__(self, router: ResilientRouter, supervisor=None,
                  host: str = "127.0.0.1", port: int = 0,
-                 slo_engine=None, timeseries_ring=None):
+                 slo_engine=None, timeseries_ring=None, rollout=None):
         self.router = router
         self.supervisor = supervisor
+        #: attached RolloutController (set late via ``rs.rollout = rc`` is
+        #: fine) — while it holds the admin surface, manual swap/rollback
+        #: fan-outs are refused with 409 instead of interleaving
+        self.rollout = rollout
         # GET /v1/slo and /v1/timeseries sources; None falls back to
         # the process defaults the CLI's --slo-* flags install
         self.slo_engine = slo_engine

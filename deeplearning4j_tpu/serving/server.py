@@ -218,7 +218,10 @@ class _Handler(BaseHTTPRequestHandler):
         if url.path == "/readyz":
             if self._srv.ready():
                 self._json({"status": "ready",
-                            "models": self._srv.registry.names()})
+                            "models": self._srv.registry.names(),
+                            "role": self._srv.role,
+                            "rollout_generation":
+                                self._srv.rollout_generation})
             else:
                 self._json({"status": "draining"
                             if self._srv.draining else "loading"}, code=503,
@@ -274,6 +277,25 @@ class _Handler(BaseHTTPRequestHandler):
             if verb in ("swap", "rollback"):
                 self._admin(name, verb)
                 return
+        if url.path == "/v1/rollout/role":
+            # rollout control surface: the fleet's RolloutController (or
+            # SubprocessReplica.set_role relaying for it) marks this
+            # replica canary/stable so the replica's OWN /readyz agrees
+            # with the fleet view operators see on /v1/fleet
+            try:
+                payload = json.loads(self._body() or b"{}")
+                role = payload.get("role")
+                if role not in ("stable", "canary"):
+                    raise ValueError('role must be "stable" or "canary"')
+                self._srv.role = role
+                self._srv.rollout_generation = int(
+                    payload.get("rollout_generation", 0))
+            except (ValueError, TypeError) as e:
+                self._json({"error": str(e)}, code=400)
+                return
+            self._json({"role": self._srv.role,
+                        "rollout_generation": self._srv.rollout_generation})
+            return
         if url.path == "/v1/faults" and self._srv.enable_faults:
             # chaos-tool surface: wedge/unwedge THIS replica mid-traffic.
             # Only exists when fault injection was requested at startup.
@@ -655,6 +677,11 @@ class ModelServer:
         if self.enable_faults:
             self.faults.apply_env()
         self.draining = False
+        # rollout state mirrored from the fleet (POST /v1/rollout/role):
+        # surfaced on /readyz so operators and the drill can see which
+        # replica is under canary evaluation
+        self.role = "stable"
+        self.rollout_generation = 0
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.model_server = self          # type: ignore[attr-defined]
         self.host = host

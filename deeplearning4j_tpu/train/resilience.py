@@ -115,6 +115,7 @@ class FitReport:
     skipped_steps: int = 0
     retries: int = 0
     checkpoints_written: int = 0
+    checkpoints_blessed: int = 0
     resumed_from: Optional[str] = None
     preempted: bool = False
     diverged: bool = False
@@ -153,6 +154,7 @@ class CheckpointManager:
     """
 
     MANIFEST = "manifest.json"
+    BLESSED = "blessed.json"
 
     def __init__(self, directory: str, keep_last: int = 3,
                  prefix: str = "ckpt"):
@@ -224,15 +226,66 @@ class CheckpointManager:
             "time": time.time(),
         })
         manifest["next_ordinal"] = ordinal + 1
-        # keep_last pruning: drop only files THIS manifest tracks
+        # keep_last pruning: drop only files THIS manifest tracks — and
+        # never the blessed (serving-eligible) one: the rollout watcher
+        # may not have deployed it yet, and pruning it would leave
+        # blessed.json pointing at nothing
+        blessed = self._blessed_file()
         while len(manifest["checkpoints"]) > self.keep_last:
-            old = manifest["checkpoints"].pop(0)
+            prunable = [e for e in manifest["checkpoints"][:-self.keep_last]
+                        if e["file"] != blessed]
+            if not prunable:
+                break
+            old = prunable[0]
+            manifest["checkpoints"].remove(old)
             try:
                 os.remove(os.path.join(self.dir, old["file"]))
             except OSError:
                 pass
         self._write_manifest(manifest)
         return path
+
+    # ---------------------------------------------------------------- bless
+    def _blessed_path(self) -> str:
+        return os.path.join(self.dir, self.BLESSED)
+
+    def _blessed_file(self) -> Optional[str]:
+        try:
+            with open(self._blessed_path()) as f:
+                return json.load(f).get("file")
+        except (OSError, ValueError):
+            return None
+
+    def bless(self, path: str, metrics: Optional[dict] = None) -> str:
+        """Mark a checkpoint serving-eligible: atomically (re)write
+        <dir>/blessed.json naming the file, its SHA-256, and the eval
+        metrics that justified the blessing. serving/rollout.py tails
+        this manifest — blessing is the eval gate between "the trainer
+        wrote a checkpoint" and "the fleet may canary it"."""
+        fname = os.path.basename(path)
+        doc = {
+            "version": 1,
+            "file": fname,
+            "path": os.path.abspath(path),
+            "sha256": self._sha256(path),
+            "blessed_at": time.time(),
+            "metrics": dict(metrics or {}),
+        }
+        for entry in self._read_manifest().get("checkpoints", []):
+            if entry["file"] == fname:
+                doc["iteration"] = entry["iteration"]
+                doc["epoch"] = entry["epoch"]
+                break
+        tmp = self._blessed_path() + f".tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1)
+        os.replace(tmp, self._blessed_path())
+        monitor.counter("resilience_checkpoints_blessed_total",
+                        "Checkpoints marked serving-eligible "
+                        "(blessed.json writes)").inc()
+        log.info("checkpoint blessed for serving: %s (metrics %s)",
+                 fname, doc["metrics"])
+        return self._blessed_path()
 
     # --------------------------------------------------------------- resume
     def latest_valid(self) -> Optional[dict]:
@@ -614,7 +667,8 @@ class ResilientTrainer:
                  injector: Optional[FaultInjector] = None,
                  normalizer=None,
                  resume: bool = True,
-                 write_checkpoints: Optional[bool] = None):
+                 write_checkpoints: Optional[bool] = None,
+                 eval_gate=None):
         from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
         if isinstance(model, ParallelWrapper):
             self._driver = _WrapperDriver(model)
@@ -632,6 +686,11 @@ class ResilientTrainer:
         self.normalizer = normalizer
         self.resume = resume
         self.write_checkpoints = write_checkpoints
+        # eval gate for continuous rollout: called after every checkpoint
+        # save with the live network; return a metrics dict to bless the
+        # checkpoint (CheckpointManager.bless -> blessed.json, which
+        # serving/rollout.py tails) or None to withhold it from serving
+        self.eval_gate = eval_gate
         self._jitter = random.Random(self.policy.seed)
         self._rng = None
         self._dispatch_idx = 0          # batches consumed, fit-global
@@ -737,6 +796,20 @@ class ResilientTrainer:
         log.info("checkpoint written: %s (iteration %d, epoch %d, step %d)",
                  path, self.net.iteration_count, self.net.epoch_count,
                  step_in_epoch)
+        if self.eval_gate is not None:
+            try:
+                metrics = self.eval_gate(self.net)
+            except Exception:           # noqa: BLE001 — a broken eval gate
+                # must not kill training; it only withholds the blessing,
+                # and loudly: an unblessed stream starves the rollout
+                log.warning("eval gate raised; checkpoint NOT blessed",
+                            exc_info=True)
+                metrics = None
+            if metrics is not None:
+                if not isinstance(metrics, dict):
+                    metrics = {"score": float(metrics)}
+                self.ckpt.bless(path, metrics)
+                report.checkpoints_blessed += 1
         return path
 
     # ------------------------------------------------------------ stepping

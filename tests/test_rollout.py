@@ -1,0 +1,665 @@
+"""Continuous-rollout tests: RolloutController state machine, blessing
+contract, load-signal autoscaling, canary-aware routing surfaces, and the
+admin-race guard (serving/rollout.py + fleet.py + router.py).
+
+Same determinism contract as test_fleet.py: fake clocks drive the
+controller's poll/observe windows and the autoscaler's tick counters,
+a fake wire pins every verdict input (per-replica /v1/slo, /v1/timeseries,
+probe predicts), and every decision path is asserted without wall-clock
+waits. The end-to-end drill (real train -> bless -> canary -> promote
+under live traffic, plus a poisoned-checkpoint auto-rollback) lives in
+tools/rollout_drill.py and rides as a slow-marked test here.
+"""
+import hashlib
+import json
+import os
+import random
+import subprocess
+import sys
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import monitor
+from deeplearning4j_tpu.monitor import flight
+from deeplearning4j_tpu.serving.fleet import (
+    AutoscaleConfig, Replica, ReplicaSpec, ReplicaSupervisor,
+)
+from deeplearning4j_tpu.serving.rollout import (
+    RolloutController, read_blessed,
+)
+from deeplearning4j_tpu.serving.router import ResilientRouter, RouterServer
+from deeplearning4j_tpu.train.resilience import CheckpointManager
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class FakeReplica(Replica):
+    def __init__(self, name, spec=None):
+        super().__init__(name, spec)
+        self.probe_ok = True
+        self.alive_flag = False
+        self.launches = 0
+        self.kills = 0
+        self.stops = 0
+        self.draining = False
+
+    def launch(self):
+        self.launches += 1
+        self.alive_flag = True
+        self.url = f"http://fake/{self.name}/{self.launches}"
+
+    def alive(self):
+        return self.alive_flag
+
+    def kill(self):
+        self.kills += 1
+        self.alive_flag = False
+
+    def stop(self):
+        self.stops += 1
+        self.alive_flag = False
+
+    def begin_drain(self):
+        self.draining = True
+        self.probe_ok = False          # its own /readyz flips to 503
+
+
+class FakeWire:
+    """Transport fake: records swaps/rollbacks per replica and serves
+    canned /v1/slo + /v1/timeseries verdict inputs."""
+
+    def __init__(self):
+        self.swaps = []                # (replica, source)
+        self.rollbacks = []
+        self.fail_swap_on = set()
+        self.fail_rollback_on = set()
+        self.slo = {}                  # replica -> doc
+        self.ts = {}                   # replica -> doc
+        self.predict_fn = None         # (replica, body) -> outputs row
+
+    def __call__(self, replica, path, body, headers, timeout):
+        def _json(doc, code=200):
+            return code, {"Content-Type": "application/json"}, \
+                json.dumps(doc).encode()
+        if path.endswith("/swap"):
+            src = json.loads(body)["source"]
+            self.swaps.append((replica.name, src))
+            if replica.name in self.fail_swap_on:
+                return _json({"error": "load failed"}, code=500)
+            return _json({"model": "m",
+                          "active": {"version": 2, "source": src}})
+        if path.endswith("/rollback"):
+            self.rollbacks.append(replica.name)
+            if replica.name in self.fail_rollback_on:
+                return _json({"error": "no previous version"}, code=409)
+            return _json({"model": "m",
+                          "active": {"version": 1, "source": "/old/src"}})
+        if path.endswith("/predict"):
+            row = self.predict_fn(replica, json.loads(body))
+            return _json({"model": "m", "version": 2, "outputs": [row]})
+        if path == "/v1/slo":
+            return _json(self.slo.get(replica.name, {"enabled": False}))
+        if path.startswith("/v1/timeseries"):
+            return _json(self.ts.get(replica.name, {"enabled": False}))
+        if path == "/v1/debug/flight":
+            return _json({"records": [
+                {"trace_id": "t-slow", "duration_ms": 512.0},
+                {"trace_id": "t-fast", "duration_ms": 4.0}]})
+        return _json({"error": "not found"}, code=404)
+
+
+def _healthy_stats(wire, names, p99=0.01, ratio=1.0, requests=200):
+    # ratio is the /v1/slo availability objective's measured GOOD
+    # fraction (1.0 = no errors), matching monitor/slo.py verdict()
+    for n in names:
+        wire.slo[n] = {"enabled": True, "state": "ok", "objectives": [
+            {"name": "availability", "kind": "availability",
+             "ratio": ratio}]}
+        wire.ts[n] = {"enabled": True, "kind": "histogram",
+                      "count": requests, "p99": p99}
+
+
+def _fleet(n=3):
+    spec = ReplicaSpec([("m", "/old/src")], lms=[("other-lm", "/lm/src")])
+    reps = []
+    for i in range(n):
+        r = FakeReplica(f"r{i}", spec)
+        r.launch()
+        r.state = "ready"
+        reps.append(r)
+
+    class Sup:
+        replicas = reps
+
+        def healthy(self):
+            return [r for r in self.replicas if r.state == "ready"]
+
+    return Sup(), reps, spec
+
+
+def _bless_dir(tmp_path, content=b"weights-v2", name="ckpt_000002.zip"):
+    path = tmp_path / name
+    path.write_bytes(content)
+    doc = {"version": 1, "file": name, "path": str(path),
+           "sha256": hashlib.sha256(content).hexdigest(),
+           "blessed_at": 1.0, "metrics": {"accuracy": 0.97},
+           "iteration": 42}
+    (tmp_path / "blessed.json").write_text(json.dumps(doc))
+    return str(path)
+
+
+def _controller(tmp_path, sup, wire, clock, **kw):
+    kw.setdefault("poll_interval_s", 1.0)
+    kw.setdefault("observe_s", 10.0)
+    kw.setdefault("min_canary_requests", 0)
+    kw.setdefault("promote_stagger_s", 0.0)
+    return RolloutController(
+        sup, None, str(tmp_path), "m", transport=wire,
+        time_fn=clock, wall_fn=clock, sleep_fn=lambda s: None, **kw)
+
+
+# ------------------------------------------------------ blessing contract
+def test_read_blessed_resolves_and_rejects_missing_file(tmp_path):
+    assert read_blessed(str(tmp_path)) is None
+    path = _bless_dir(tmp_path)
+    doc = read_blessed(str(tmp_path))
+    assert doc["path"] == path and doc["metrics"]["accuracy"] == 0.97
+    os.remove(path)                      # blessed file vanished
+    assert read_blessed(str(tmp_path)) is None
+
+
+def test_checkpoint_manager_bless_writes_manifest(tmp_path):
+    mgr = CheckpointManager(str(tmp_path))
+    ckpt = tmp_path / "ckpt_000000.zip"
+    ckpt.write_bytes(b"fake-zip")
+    out = mgr.bless(str(ckpt), {"accuracy": 0.91})
+    assert os.path.basename(out) == "blessed.json"
+    doc = read_blessed(str(tmp_path))
+    assert doc["file"] == "ckpt_000000.zip"
+    assert doc["sha256"] == hashlib.sha256(b"fake-zip").hexdigest()
+    assert doc["metrics"] == {"accuracy": 0.91}
+    # re-blessing another checkpoint replaces the manifest atomically
+    ckpt2 = tmp_path / "ckpt_000001.zip"
+    ckpt2.write_bytes(b"fake-zip-2")
+    mgr.bless(str(ckpt2))
+    assert read_blessed(str(tmp_path))["file"] == "ckpt_000001.zip"
+
+
+# -------------------------------------------------- canary -> promote
+def test_canary_on_one_replica_then_fleet_promote(tmp_path):
+    sup, reps, spec = _fleet(3)
+    wire = FakeWire()
+    clock = FakeClock()
+    rc = _controller(tmp_path, sup, wire, clock)
+    assert rc.current_source == "/old/src"
+    src = _bless_dir(tmp_path)
+    rc.tick()
+    # exactly ONE replica swapped, marked canary, admin surface held
+    assert len(wire.swaps) == 1
+    canary_name = wire.swaps[0][0]
+    canary = next(r for r in reps if r.name == canary_name)
+    assert canary.role == "canary" and canary.rollout_generation == 1
+    assert rc.state == "canary" and rc.holds_admin()
+    # healthy evidence on every replica -> promote at window end
+    _healthy_stats(wire, [r.name for r in reps])
+    clock.advance(10.1)
+    rc.tick()
+    assert rc.state == "idle" and not rc.holds_admin()
+    assert rc.last_verdict["decision"] == "promoted"
+    # the two incumbents were swapped too (staggered fan-out)
+    assert sorted(n for n, _ in wire.swaps) == ["r0", "r1", "r2"]
+    assert all(s == src for _, s in wire.swaps)
+    # restart durability: the shared spec now names the promoted source
+    assert spec.models == [("m", src)]
+    assert spec.lms == [("other-lm", "/lm/src")]    # other models untouched
+    assert all(r.role == "stable" for r in reps)
+    assert rc.current_source == src
+    # the decided identity is not re-canaried on the next poll
+    clock.advance(2.0)
+    rc.tick()
+    assert len(wire.swaps) == 3 and rc.state == "idle"
+
+
+def test_canary_needs_two_ready_replicas(tmp_path):
+    sup, reps, _ = _fleet(1)
+    wire = FakeWire()
+    clock = FakeClock()
+    rc = _controller(tmp_path, sup, wire, clock)
+    _bless_dir(tmp_path)
+    rc.tick()
+    # never canary the only serving replica
+    assert wire.swaps == [] and rc.state == "idle"
+
+
+# ------------------------------------------------ rejection -> rollback
+def test_error_ratio_regression_rolls_back_with_postmortem(tmp_path):
+    pm_dir = tmp_path / "pm"
+    flight.enable_flight(capacity=64, dump_dir=str(pm_dir))
+    try:
+        sup, reps, spec = _fleet(3)
+        wire = FakeWire()
+        clock = FakeClock()
+        rc = _controller(tmp_path, sup, wire, clock)
+        src = _bless_dir(tmp_path)
+        rc.tick()
+        canary_name = wire.swaps[0][0]
+        _healthy_stats(wire, [r.name for r in reps])
+        # the canary burns error budget the incumbents don't
+        wire.slo[canary_name]["objectives"][0]["ratio"] = 0.25
+        clock.advance(10.1)
+        rc.tick()
+        assert rc.state == "idle"
+        assert rc.last_verdict["decision"] == "rejected"
+        assert rc.last_verdict["metric"] == "error_ratio"
+        assert wire.rollbacks == [canary_name]
+        assert spec.models == [("m", "/old/src")]   # spec never touched
+        canary = next(r for r in reps if r.name == canary_name)
+        assert canary.role == "stable" and canary.kills == 0
+        # the postmortem names the regressing metric and slow traces
+        pms = [p for p in flight.postmortems()
+               if p["reason"] == "rollout_rejected"]
+        assert pms, "rollout_rejected postmortem missing"
+        meta = pms[-1]["meta"]
+        assert meta["metric"] == "error_ratio"
+        assert meta["source"] == src
+        assert "t-slow" in meta["slow_traces"]
+        # rejected identity is remembered: no re-canary next poll
+        clock.advance(2.0)
+        rc.tick()
+        assert len(wire.swaps) == 1
+    finally:
+        flight.disable_flight()
+
+
+def test_latency_regression_is_named(tmp_path):
+    sup, reps, _ = _fleet(3)
+    wire = FakeWire()
+    clock = FakeClock()
+    rc = _controller(tmp_path, sup, wire, clock, max_p99_ratio=1.5,
+                     p99_floor_ms=10.0)
+    _bless_dir(tmp_path)
+    rc.tick()
+    canary_name = wire.swaps[0][0]
+    _healthy_stats(wire, [r.name for r in reps], p99=0.020)
+    wire.ts[canary_name]["p99"] = 0.200      # 10x the incumbents
+    clock.advance(10.1)
+    rc.tick()
+    assert rc.last_verdict["metric"] == "latency_p99"
+    assert rc.last_verdict["details"]["canary_p99_ms"] == 200.0
+
+
+def test_probe_set_rejects_scrambled_model_immediately(tmp_path):
+    sup, reps, _ = _fleet(3)
+    wire = FakeWire()
+    # a scrambled model answers the wrong class for every probe
+    wire.predict_fn = lambda replica, body: [0.9, 0.1]
+    clock = FakeClock()
+    probes = [(np.zeros((2,), "float32"), 1)] * 4
+    rc = _controller(tmp_path, sup, wire, clock, probe_set=probes,
+                     probe_min_accuracy=0.75)
+    _bless_dir(tmp_path)
+    rc.tick()
+    # rejected inside the SAME tick — no observation window burned
+    assert rc.state == "idle"
+    assert rc.last_verdict["decision"] == "rejected"
+    assert rc.last_verdict["metric"] == "probe_accuracy"
+    assert rc.last_verdict["details"]["probe_accuracy"] == 0.0
+    assert wire.rollbacks == [wire.swaps[0][0]]
+
+
+def test_canary_crash_mid_observation_aborts_without_rollback(tmp_path):
+    sup, reps, _ = _fleet(3)
+    wire = FakeWire()
+    clock = FakeClock()
+    rc = _controller(tmp_path, sup, wire, clock)
+    _bless_dir(tmp_path)
+    rc.tick()
+    canary = next(r for r in reps if r.name == wire.swaps[0][0])
+    # supervisor relaunched it (generation bump): the fresh incarnation
+    # loaded the INCUMBENT spec, so there is nothing to roll back
+    canary.generation += 1
+    clock.advance(1.0)
+    rc.tick()
+    assert rc.last_verdict["metric"] == "canary_crashed"
+    assert wire.rollbacks == []
+    assert rc.state == "idle"
+
+
+def test_promote_swap_failure_reverts_already_swapped(tmp_path):
+    sup, reps, spec = _fleet(3)
+    wire = FakeWire()
+    clock = FakeClock()
+    rc = _controller(tmp_path, sup, wire, clock)
+    _bless_dir(tmp_path)
+    rc.tick()
+    canary_name = wire.swaps[0][0]
+    _healthy_stats(wire, [r.name for r in reps])
+    remaining = [r.name for r in reps if r.name != canary_name]
+    wire.fail_swap_on = {remaining[-1]}      # second fan-out target fails
+    clock.advance(10.1)
+    rc.tick()
+    assert rc.last_verdict["decision"] == "rejected"
+    assert rc.last_verdict["metric"] == "promote_swap_failed"
+    # the fleet reverted: the successfully-swapped target AND the canary
+    assert set(wire.rollbacks) == {remaining[0], canary_name}
+    assert spec.models == [("m", "/old/src")]
+    assert rc.current_source == "/old/src"
+
+
+def test_failed_rollback_kills_canary_so_supervisor_relaunches(tmp_path):
+    sup, reps, _ = _fleet(3)
+    wire = FakeWire()
+    clock = FakeClock()
+    rc = _controller(tmp_path, sup, wire, clock)
+    _bless_dir(tmp_path)
+    rc.tick()
+    canary = next(r for r in reps if r.name == wire.swaps[0][0])
+    wire.fail_rollback_on = {canary.name}
+    _healthy_stats(wire, [r.name for r in reps])
+    wire.slo[canary.name]["objectives"][0]["ratio"] = 0.5
+    clock.advance(10.1)
+    rc.tick()
+    # rollback refused -> the known-bad canary must not stay serving
+    assert canary.kills == 1
+    assert rc.last_verdict["rolled_back"] is False
+
+
+# ------------------------------------------------------ admin-race guard
+def test_manual_swap_racing_rollout_loses_loudly(tmp_path):
+    """Satellite: an admin swap racing an in-flight canary must get a
+    409 naming the rollout — never interleave with the fan-out."""
+    sup, reps, spec = _fleet(3)
+    wire = FakeWire()
+    clock = FakeClock()
+    rc = _controller(tmp_path, sup, wire, clock)
+    _bless_dir(tmp_path)
+    rc.tick()
+    assert rc.holds_admin()
+    router = ResilientRouter(sup.healthy, transport=wire, hedge=False,
+                             rng=random.Random(0))
+    server = RouterServer(router, supervisor=sup, rollout=rc)
+    try:
+        swaps_before = len(wire.swaps)
+        req = urllib.request.Request(
+            f"{server.url}/v1/models/m/swap",
+            data=json.dumps({"source": "/manual/src"}).encode(),
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(req, timeout=10)
+        assert exc.value.code == 409
+        doc = json.loads(exc.value.read())
+        assert doc["rollout"]["state"] == "canary"
+        assert "rollout" in doc["error"]
+        # the losing call did NOT reach any replica
+        assert len(wire.swaps) == swaps_before
+        assert spec.models == [("m", "/old/src")]
+        # once the rollout settles, manual admin works again
+        _healthy_stats(wire, [r.name for r in reps])
+        clock.advance(10.1)
+        rc.tick()
+        assert not rc.holds_admin()
+        r = urllib.request.urlopen(req, timeout=10)
+        assert r.status == 200 and json.loads(r.read())["ok"]
+    finally:
+        server.stop()
+
+
+def test_fleet_rollback_rewrites_spec_like_swap(tmp_path):
+    """Satellite: the PR-8 caveat is closed — a fleet-level rollback
+    rewrites ReplicaSpec.models/lms to the version the replicas actually
+    re-activated, so a restarted replica rejoins on the rolled-back
+    version instead of the rejected one."""
+    spec = ReplicaSpec([("m", "/rejected/src")], lms=[("m", "/rejected/src")])
+    sup, reps, _ = _fleet(2)
+    for r in reps:
+        r.spec = spec
+
+    def transport(replica, path, body, headers, timeout):
+        return 200, {"Content-Type": "application/json"}, json.dumps(
+            {"model": "m",
+             "active": {"version": 1, "source": "/prev/good"}}).encode()
+
+    router = ResilientRouter(sup.healthy, transport=transport, hedge=False,
+                             rng=random.Random(0))
+    server = RouterServer(router, supervisor=sup)
+    try:
+        req = urllib.request.Request(
+            f"{server.url}/v1/models/m/rollback", data=b"{}",
+            headers={"Content-Type": "application/json"})
+        r = urllib.request.urlopen(req, timeout=10)
+        assert r.status == 200 and json.loads(r.read())["ok"]
+        assert spec.models == [("m", "/prev/good")]
+        assert spec.lms == [("m", "/prev/good")]
+    finally:
+        server.stop()
+
+
+# ------------------------------------------------- canary-aware routing
+def test_router_bounds_canary_traffic_share():
+    from tests.test_fleet import _ready_replicas, _ok_transport
+    reps = _ready_replicas(3)
+    reps[0].role = "canary"
+    router = ResilientRouter(lambda: reps, transport=_ok_transport,
+                             hedge=False, rng=random.Random(0),
+                             canary_fraction=0.2)
+    served = {r.name: 0 for r in reps}
+    for _ in range(500):
+        code, headers, _ = router.route_predict("m", b"{}", {})
+        assert code == 200
+        served[dict(headers)["X-Served-By"]] += 1
+    share = served["r0"] / 500
+    # ~20% target with p2c noise bounds; crucially NOT 1/3 (uniform)
+    assert 0.10 < share < 0.30, served
+    with pytest.raises(ValueError, match="canary_fraction"):
+        ResilientRouter(lambda: reps, canary_fraction=0.8)
+
+
+def test_readyz_surfaces_canary_state():
+    from deeplearning4j_tpu.nn.conf.base import InputType
+    from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.updaters import Adam
+    from deeplearning4j_tpu.serving.registry import ModelRegistry
+    from deeplearning4j_tpu.serving.server import ModelServer
+    conf = (NeuralNetConfiguration.Builder().seed(0).updater(Adam(1e-2))
+            .list()
+            .layer(DenseLayer(n_out=8, activation="relu"))
+            .layer(OutputLayer(n_out=3, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.feed_forward(6)).build())
+    registry = ModelRegistry()
+    registry.deploy("m", MultiLayerNetwork(conf).init(), buckets=(1, 8))
+    server = ModelServer(registry, port=0)
+    try:
+        doc = json.loads(urllib.request.urlopen(
+            f"{server.url}/readyz", timeout=10).read())
+        assert doc["role"] == "stable" and doc["rollout_generation"] == 0
+        req = urllib.request.Request(
+            f"{server.url}/v1/rollout/role",
+            data=json.dumps({"role": "canary",
+                             "rollout_generation": 7}).encode(),
+            headers={"Content-Type": "application/json"})
+        assert urllib.request.urlopen(req, timeout=10).status == 200
+        doc = json.loads(urllib.request.urlopen(
+            f"{server.url}/readyz", timeout=10).read())
+        assert doc["role"] == "canary" and doc["rollout_generation"] == 7
+        bad = urllib.request.Request(
+            f"{server.url}/v1/rollout/role",
+            data=json.dumps({"role": "purple"}).encode(),
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(bad, timeout=10)
+        assert exc.value.code == 400
+    finally:
+        server.stop()
+
+
+# ----------------------------------------------------------- autoscaling
+def _auto_supervisor(n=2, maximum=4, clock=None, **cfg_kw):
+    clock = clock or FakeClock()
+    reps = []
+
+    def factory(i):
+        r = FakeReplica(f"a{i}")
+        reps.append(r)
+        return r
+
+    cfg_kw.setdefault("capacity_per_replica", 4)
+    cfg_kw.setdefault("up_after_ticks", 2)
+    cfg_kw.setdefault("down_after_ticks", 3)
+    cfg_kw.setdefault("cooldown_s", 5.0)
+    cfg = AutoscaleConfig(min_replicas=n, max_replicas=maximum, **cfg_kw)
+    sup = ReplicaSupervisor(
+        factory, n, time_fn=clock, sleep_fn=lambda s: None,
+        rng=random.Random(0), probe_interval_s=1.0,
+        spawn_fn=lambda fn, name: (fn(), None)[1],
+        probe_fn=lambda r, timeout: r.probe_ok and r.alive(),
+        autoscale=cfg)
+    for r in sup.replicas:
+        r.launch()
+    return sup, reps, clock
+
+
+def test_autoscale_scales_up_on_sustained_high_utilization():
+    sup, reps, clock = _auto_supervisor(n=2, maximum=4)
+    sup.tick()
+    assert len(sup.replicas) == 2
+    for r in reps:
+        r.inflight_add(4)                  # 8/8 = 1.0 utilization
+    clock.advance(1.0)
+    sup.tick()                             # 1 tick above: not yet
+    assert len(sup.replicas) == 2
+    clock.advance(1.0)
+    sup.tick()                             # 2nd consecutive tick: scale up
+    assert len(sup.replicas) == 3
+    new = sup.replicas[-1]
+    assert new.name == "a2" and new.launches == 1
+    clock.advance(1.0)
+    sup.tick()
+    assert new.state == "ready"
+    assert monitor.REGISTRY.collect(
+        "serving_autoscale_events_total").value(direction="up") >= 1
+    # cooldown: still saturated but no second action inside cooldown_s
+    clock.advance(1.0)
+    sup.tick()
+    clock.advance(1.0)
+    sup.tick()
+    assert len(sup.replicas) == 3
+    # past cooldown it may grow again, but never beyond max_replicas
+    for _ in range(10):
+        clock.advance(2.0)
+        sup.tick()
+    assert len(sup.replicas) <= 4
+
+
+def test_autoscale_scale_down_drains_never_kills():
+    sup, reps, clock = _auto_supervisor(n=2, maximum=4)
+    sup.tick()
+    for r in reps:
+        r.inflight_add(4)
+    for _ in range(2):
+        clock.advance(1.0)
+        sup.tick()                         # scale up to 3
+    assert len(sup.replicas) == 3
+    victim = sup.replicas[-1]
+    clock.advance(1.0)
+    sup.tick()
+    assert victim.state == "ready"
+    for r in reps:
+        r.inflight_add(-r.inflight())      # traffic stops: util 0
+    clock.advance(6.0)                     # past cooldown
+    for _ in range(3):                     # down_after_ticks
+        clock.advance(1.0)
+        sup.tick()
+    # the victim DRAINED: begin_drain -> readyz confirmed -> graceful
+    # stop; no kill, roster pruned back to the floor
+    assert victim.draining is True
+    assert victim.stops == 1 and victim.kills == 0
+    assert victim.scaledown["readyz_confirmed"] is True
+    assert victim.scaledown["forced_kill"] is False
+    clock.advance(1.0)
+    sup.tick()                             # prune the stopped victim
+    assert len(sup.replicas) == 2
+    assert victim not in sup.replicas
+    # never below the floor, no matter how idle
+    for _ in range(10):
+        clock.advance(2.0)
+        sup.tick()
+    assert len(sup.replicas) == 2
+
+
+def test_autoscale_never_drains_a_canary():
+    sup, reps, clock = _auto_supervisor(n=2, maximum=4,
+                                        down_after_ticks=1)
+    sup.tick()
+    # idle fleet, but the youngest ready replica is a canary under
+    # rollout evaluation — it must never be the scale-down victim
+    reps[-1].role = "canary"
+    clock.advance(6.0)
+    for _ in range(3):
+        clock.advance(1.0)
+        sup.tick()
+    assert reps[-1].state == "ready"       # canary untouched
+    # min_replicas=2 with one canary: the other replica is also safe
+    assert all(r.state == "ready" for r in reps)
+
+
+def test_autoscale_config_validation():
+    with pytest.raises(ValueError, match="min_replicas"):
+        AutoscaleConfig(min_replicas=3, max_replicas=2,
+                        capacity_per_replica=4)
+    with pytest.raises(ValueError, match="watermark"):
+        AutoscaleConfig(min_replicas=1, max_replicas=2,
+                        capacity_per_replica=4,
+                        low_watermark=0.9, high_watermark=0.8)
+    with pytest.raises(ValueError, match="capacity"):
+        AutoscaleConfig(min_replicas=1, max_replicas=2,
+                        capacity_per_replica=0)
+    # supervisor floor must sit inside the autoscale band
+    with pytest.raises(ValueError, match="autoscale"):
+        ReplicaSupervisor(
+            lambda i: FakeReplica(f"v{i}"), 5,
+            autoscale=AutoscaleConfig(min_replicas=1, max_replicas=3,
+                                      capacity_per_replica=4))
+
+
+# ------------------------------------------------- rollout drill (slow)
+@pytest.mark.slow
+def test_rollout_drill_end_to_end(tmp_path):
+    """The acceptance run: train -> blessed checkpoint -> canary ->
+    promote under live load with zero 5xx, then a poisoned checkpoint
+    whose canary auto-rolls back with a postmortem naming the regressing
+    metric, then an autoscaling ramp that scales up and drains down —
+    all asserted by tools/rollout_drill.py itself (exit 0 == green)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    out = tmp_path / "ROLLOUT.json"
+    proc = subprocess.run(
+        [sys.executable, os.path.join("tools", "rollout_drill.py"),
+         "--out", str(out)],
+        capture_output=True, text=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        env=env, timeout=580)
+    assert proc.returncode == 0, \
+        f"rollout drill failed:\n{proc.stdout[-4000:]}\n" \
+        f"{proc.stderr[-2000:]}"
+    report = json.loads(out.read_text())
+    assert report["ok"] and not report["failures"]
+    assert report["promote"]["server_5xx"] == 0
+    assert report["rollback"]["postmortem_metric"] == "probe_accuracy"
+    assert report["autoscale"]["peak_replicas"] > \
+        report["autoscale"]["initial_replicas"]
+    assert report["autoscale"]["forced_kills"] == 0

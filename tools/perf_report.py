@@ -72,9 +72,15 @@ THROUGHPUT_KEYS = ("imgs_sec", "lenet_imgs_sec", "chars_sec", "pairs_sec",
 #: (chunked prefill keeps it bounded). Both r14+. The cold-TTFT and
 #: chunking-off interferer numbers are banked for the ratio but NOT
 #: gated (they measure the path the cache/chunking replaced).
+#: rollout_* are the continuous-rollout control-loop latencies from
+#: tools/rollout_drill.py (ROLLOUT_r*.json, r18+): fleet-wide staggered
+#: promote fan-out seconds, and wall seconds from a poisoned blessing
+#: landing on disk to the auto-rollback decision. Host-calibrated like
+#: the decode series (both scale with model-load / probe round-trips).
 LATENCY_KEYS = ("chaos_p99_under_fault_ms", "chaos_recovered_p99_ms",
                 "decode_ttft_p99_ms", "decode_itl_p99_ms",
-                "decode_ttft_hot_p99_ms", "decode_itl_interferer_p99_ms")
+                "decode_ttft_hot_p99_ms", "decode_itl_interferer_p99_ms",
+                "rollout_promote_s", "rollout_rollback_detect_s")
 
 #: dimensionless series (fractions of work, not work per second): host
 #: speed cannot move them, so calibration normalization never applies —
@@ -104,7 +110,11 @@ def load_rounds(directory: str):
                                              "MULTICHIP_r*.json")))
              # continuous-batching decode smokes (tokens/sec, TTFT, ITL)
              + sorted(glob.glob(os.path.join(directory,
-                                             "DECODE_r*.json"))))
+                                             "DECODE_r*.json")))
+             # continuous-rollout drills (promote fan-out / rollback
+             # detection latency from tools/rollout_drill.py)
+             + sorted(glob.glob(os.path.join(directory,
+                                             "ROLLOUT_r*.json"))))
     for path in names:
         try:
             with open(path) as f:

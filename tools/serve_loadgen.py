@@ -399,6 +399,81 @@ class LoadGen:
             t.join()
         return time.perf_counter() - t0, ok[0]
 
+    def run_ramp(self, steps, fleet_url=None, sample_interval_s=1.0):
+        """Stepped open-loop schedule: ``steps`` is [(rate_rps, secs),
+        ...]; each step fires at its own fixed arrival rate. When
+        ``fleet_url`` is given, a sampler thread polls ``/v1/fleet``
+        alongside the schedule and records ready-replica count over
+        time — the autoscaling drill's evidence that the fleet tracked
+        the offered load."""
+        threads = []
+        ok = [0]
+        samples = []
+        current_rate = [0.0]
+        stop = threading.Event()
+
+        def fire(i):
+            try:
+                if self.one_open(i):
+                    with self.lock:
+                        ok[0] += 1
+            except Exception as e:          # noqa: BLE001 — fail loud
+                print(f"serve_loadgen: ramp request {i} crashed: {e!r}",
+                      file=sys.stderr)
+                raise
+
+        t0 = time.perf_counter()
+
+        def sample_fleet():
+            while not stop.wait(sample_interval_s):
+                doc = {}
+                try:
+                    doc = json.loads(urllib.request.urlopen(
+                        f"{fleet_url}/v1/fleet", timeout=5).read())
+                except Exception as e:      # noqa: BLE001 — a missed
+                    # sample is a gap in the chart, not a run failure
+                    print(f"serve_loadgen: fleet sample failed: {e!r}",
+                          file=sys.stderr)
+                reps = doc.get("replicas", [])
+                samples.append({
+                    "t_s": round(time.perf_counter() - t0, 1),
+                    "offered_rps": current_rate[0],
+                    "ready": sum(1 for r in reps
+                                 if r.get("state") == "ready"),
+                    "draining": sum(1 for r in reps
+                                    if r.get("state") == "draining"),
+                    "replicas": len(reps)})
+
+        sampler = None
+        if fleet_url:
+            sampler = threading.Thread(target=sample_fleet, daemon=True,
+                                       name="loadgen-fleet-sampler")
+            sampler.start()
+        i = 0
+        for rate, dur in steps:
+            current_rate[0] = rate
+            period = 1.0 / rate
+            step_start = time.perf_counter()
+            for k in range(max(1, int(rate * dur))):
+                target = step_start + k * period
+                delay = target - time.perf_counter()
+                if delay > 0:
+                    time.sleep(delay)
+                t = threading.Thread(target=fire, args=(i,), daemon=True,
+                                     name=f"loadgen-ramp-{i}")
+                t.start()
+                threads.append(t)
+                i += 1
+        for t in threads:
+            t.join(timeout=self.args.timeout_s + 5)
+        stop.set()
+        if sampler is not None:
+            sampler.join(timeout=sample_interval_s + 5)
+        self.replica_samples = samples
+        self.ramp_steps = [{"rate_rps": r, "seconds": d}
+                           for r, d in steps]
+        return time.perf_counter() - t0, ok[0]
+
     def run_open(self):
         period = 1.0 / self.args.rate
         threads = []
@@ -434,8 +509,10 @@ class LoadGen:
         for cls_counts in self.class_codes.values():
             for kind, cnt in cls_counts.items():
                 taxonomy[kind] = taxonomy.get(kind, 0) + cnt
+        ramp = getattr(self, "ramp_steps", None)
         rep = {
-            "mode": "open" if self.args.rate else "closed",
+            "mode": "ramp" if ramp
+            else ("open" if self.args.rate else "closed"),
             "workload": self.mode,
             # issued, not args.requests: callers (serve_chaos) accumulate
             # several run_closed() passes into one LoadGen/report
@@ -451,6 +528,12 @@ class LoadGen:
             "goodput_rps": round(ok / wall, 2) if wall > 0 else None,
             "latency_ms": _latency_stats(all_lat),
         }
+        if ramp:
+            # replica count over time rides next to goodput: the chart
+            # that shows the autoscaler tracking the offered-rate steps
+            rep["ramp"] = ramp
+            rep["replicas_over_time"] = getattr(self, "replica_samples",
+                                                [])
         if self.slow_k > 0:
             # the K slowest successful requests per class, by trace_id:
             # a banked percentile now points at reproducible traces
@@ -522,6 +605,31 @@ class LoadGen:
         return rep
 
 
+def parse_ramp(spec):
+    """``5:10,20:15,5:10`` -> [(5.0, 10.0), (20.0, 15.0), (5.0, 10.0)]
+    (offered rate req/s : step duration seconds)."""
+    steps = []
+    for part in str(spec).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        rate, sep, dur = part.partition(":")
+        try:
+            if not sep:
+                raise ValueError("missing ':'")
+            step = (float(rate), float(dur))
+        except ValueError:
+            raise SystemExit(
+                f"--ramp expects RATE:SECONDS steps, got {part!r}")
+        if step[0] <= 0 or step[1] <= 0:
+            raise SystemExit(f"--ramp rates and durations must be > 0: "
+                             f"{part!r}")
+        steps.append(step)
+    if not steps:
+        raise SystemExit("--ramp needs at least one RATE:SECONDS step")
+    return steps
+
+
 def parse_priority_mix(spec):
     """``interactive=3,batch=1`` -> {"interactive": 3, "batch": 1}."""
     if not spec:
@@ -565,6 +673,14 @@ def main(argv=None) -> int:
                    help="closed-loop worker threads")
     p.add_argument("--rate", type=float, default=None,
                    help="open-loop offered rate (req/s); omit = closed loop")
+    p.add_argument("--ramp", default=None, metavar="R:S,R:S,...",
+                   help="stepped open-loop schedule (RATE:SECONDS steps, "
+                        "e.g. 5:10,20:15,5:10) — overrides --rate/"
+                        "--requests; the report banks replica-count-"
+                        "over-time sampled from /v1/fleet next to "
+                        "goodput (the autoscaling-drill view)")
+    p.add_argument("--fleet-sample-s", type=float, default=1.0,
+                   help="--ramp: /v1/fleet sampling interval")
     p.add_argument("--input-shape", default=None,
                    help="comma ints; default: ask GET /v1/models/{name}")
     p.add_argument("--batch-sizes", default="1,2,4",
@@ -615,9 +731,16 @@ def main(argv=None) -> int:
         shape = tuple(meta["input_shape"])
 
     gen = LoadGen(args, shape)
-    wall, ok = gen.run_open() if args.rate else gen.run_closed()
+    if args.ramp:
+        steps = parse_ramp(args.ramp)
+        wall, ok = gen.run_ramp(steps, fleet_url=args.url,
+                                sample_interval_s=args.fleet_sample_s)
+    elif args.rate:
+        wall, ok = gen.run_open()
+    else:
+        wall, ok = gen.run_closed()
     print(json.dumps(gen.report(wall, ok), indent=1))
-    return 0 if ok == args.requests else 1
+    return 0 if ok == gen.issued else 1
 
 
 if __name__ == "__main__":

@@ -587,6 +587,19 @@ def _etl_wait_delta(before):
             "etl_fetch_wait_mean_s": round(tot / cnt, 6) if cnt else 0.0}
 
 
+def _goodput_stats():
+    """The just-ended fit's goodput-ledger summary, shaped for a bench
+    row: goodput% + the non-trivial category seconds. Empty while the
+    ledger is off (so rows stay stable for older rounds)."""
+    from deeplearning4j_tpu.monitor import goodput
+    s = goodput.last_session()
+    if s is None:
+        return {}
+    cats = {k: v for k, v in s["categories"].items() if v >= 1e-4}
+    return {"train_goodput_pct": s["goodput_pct"],
+            "goodput_categories_s": cats}
+
+
 def _fit_e2e_lenet(on_tpu, best_of, tmp):
     import dataclasses
 
@@ -679,7 +692,7 @@ def _fit_e2e_lenet(on_tpu, best_of, tmp):
             net2.fit(pipe, epochs=1)
             float(net2.score())
             dt = time.perf_counter() - t0
-            return dt, _etl_wait_delta(wait0)
+            return dt, {**_etl_wait_delta(wait0), **_goodput_stats()}
 
         dt, waits = _timed_best_stats(run_pipe, best_of)
         out.update(waits)
@@ -760,7 +773,7 @@ def _fit_e2e_char_lstm(on_tpu, best_of, tmp):
         net.fit(it, epochs=1)
         float(net.score())
         dt = time.perf_counter() - t0
-        return dt, _etl_wait_delta(wait0)
+        return dt, {**_etl_wait_delta(wait0), **_goodput_stats()}
 
     dt, waits = _timed_best_stats(run, best_of)
     out.update(waits)
@@ -833,6 +846,11 @@ def _run_fit_e2e(cfg):
     on_tpu, best_of = _bench_env()
     runner = {"lenet": _fit_e2e_lenet, "char-lstm": _fit_e2e_char_lstm,
               "word2vec": _fit_e2e_word2vec}[cfg["model"]]
+    # goodput attribution rides along on the fit() rows (lenet /
+    # char-lstm; word2vec drives the raw step, no fit session) so
+    # BENCH_r* trajectories explain their own throughput deltas
+    from deeplearning4j_tpu.monitor import goodput
+    goodput.enable_goodput()
     # the temp dataset (order-100MB of synthetic JPEGs for lenet) is
     # removed even when the run raises; a config-timeout SIGKILL still
     # leaks it, which is why it lives under the OS tempdir
@@ -840,6 +858,7 @@ def _run_fit_e2e(cfg):
     try:
         return runner(on_tpu, best_of, tmp)
     finally:
+        goodput.disable_goodput()
         shutil.rmtree(tmp, ignore_errors=True)
 
 

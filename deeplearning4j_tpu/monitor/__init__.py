@@ -65,6 +65,10 @@ from deeplearning4j_tpu.monitor import timeseries  # noqa: E402,F401
 # the SLO engine (objectives, multi-window burn-rate alerts, fleet
 # verdicts on GET /v1/slo) — namespaced as monitor.slo
 from deeplearning4j_tpu.monitor import slo  # noqa: E402,F401
+# the goodput ledger (wall-clock attribution per fit, train_goodput_pct,
+# step-time anomaly trips) — namespaced as monitor.goodput;
+# docs/OBSERVABILITY.md "Goodput accounting"
+from deeplearning4j_tpu.monitor import goodput  # noqa: E402,F401
 
 __all__ = [
     "DEFAULT_BUCKETS", "REGISTRY", "Counter", "Gauge", "Histogram",
@@ -74,5 +78,5 @@ __all__ = [
     "clear_trace", "current_context", "disable_tracing", "enable_tracing",
     "instant", "mint_context", "parse_traceparent", "save_trace", "span",
     "trace_events", "tracing_enabled",
-    "xla", "flight", "timeseries", "slo",
+    "xla", "flight", "timeseries", "slo", "goodput",
 ]

@@ -36,8 +36,10 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import threading
 import time
+import traceback
 from collections import deque
 from typing import Dict, List, Optional
 
@@ -53,6 +55,8 @@ _last_trip: Dict[str, float] = {}          # reason -> monotonic stamp
 _postmortems: deque = deque(maxlen=8)      # recent postmortem docs
 _MAX_EVENTS_PER_RECORD = 128               # one stuck stream can't flood
 _TRIP_COOLDOWN_S = 10.0
+_SNAPSHOT_MAX_THREADS = 32                 # stack-snapshot bounds: a
+_SNAPSHOT_MAX_FRAMES = 20                  # postmortem stays a few KB
 
 #: latency families whose trace_id exemplars ride along in snapshot()
 EXEMPLAR_FAMILIES = ("serving_request_seconds",
@@ -239,9 +243,48 @@ def postmortems() -> List[dict]:
         return list(_postmortems)
 
 
+def _thread_snapshot() -> List[dict]:
+    """Bounded where-was-every-thread capture: up to
+    `_SNAPSHOT_MAX_THREADS` threads, innermost `_SNAPSHOT_MAX_FRAMES`
+    frames each — the postmortem shows where every thread sat, not just
+    the metric that tripped. Best-effort: a failure here must never take
+    the trip path down."""
+    try:
+        frames = sys._current_frames()
+        by_ident = {t.ident: t for t in threading.enumerate()}
+        threads = []
+        for ident, frame in list(frames.items())[:_SNAPSHOT_MAX_THREADS]:
+            t = by_ident.get(ident)
+            stack = traceback.format_stack(frame)[-_SNAPSHOT_MAX_FRAMES:]
+            threads.append({
+                "name": t.name if t is not None else f"ident-{ident}",
+                "ident": ident,
+                "daemon": bool(t.daemon) if t is not None else None,
+                "stack": [ln.rstrip() for ln in stack]})
+        return threads
+    except Exception:
+        # diagnostics capture inside the postmortem path: any failure
+        # degrades to an empty snapshot rather than masking the trip
+        return []
+
+
+def _lock_holder_snapshot() -> dict:
+    """The util/locks.py DiagnosedLock holder table as plain JSON: which
+    named lock is held, by which thread, for how long."""
+    try:
+        from deeplearning4j_tpu.util import locks
+        return {name: {"thread": thread, "held_for_s": round(held, 3)}
+                for name, (thread, held) in locks.holder_table().items()}
+    except Exception:
+        # same contract as _thread_snapshot: degrade to empty, never
+        # mask the original trip reason
+        return {}
+
+
 def trip(reason: str, **meta) -> Optional[str]:
-    """SLO breach: snapshot the ring into a postmortem document, keep
-    it in memory, and (when a dump_dir is configured) write it to
+    """SLO breach: snapshot the ring into a postmortem document — plus a
+    bounded all-thread stack snapshot and the DiagnosedLock holder table
+    — keep it in memory, and (when a dump_dir is configured) write it to
     ``postmortem-<unix_ms>-<reason>.json`` atomically. Rate-limited to
     one dump per reason per cooldown so a flapping breaker cannot
     dump-storm the disk. Returns the written path (or None)."""
@@ -253,6 +296,11 @@ def trip(reason: str, **meta) -> Optional[str]:
         if last is not None and now - last < _TRIP_COOLDOWN_S:
             return None
         _last_trip[reason] = now
+    # capture the stacks OUTSIDE the ring lock: formatting 32 threads is
+    # milliseconds, and nothing here touches flight state
+    threads = _thread_snapshot()
+    locks_held = _lock_holder_snapshot()
+    with _lock:
         doc = {"reason": reason,
                "dumped_unix": round(time.time(), 6),
                "pid": os.getpid(),
@@ -260,7 +308,9 @@ def trip(reason: str, **meta) -> Optional[str]:
                "n_records": len(_ring),
                "records": list(_ring),
                "live": [_strip_open(r) for rs in _live.values()
-                        for r in rs]}
+                        for r in rs],
+               "threads": threads,
+               "locks": locks_held}
         _postmortems.append(doc)
         dump_dir = _dump_dir
     metrics.counter("serving_flight_postmortems_total",

@@ -48,6 +48,12 @@ _enabled = False
 _jax_annotations = False
 _MAX_EVENTS = 1_000_000          # runaway-loop backstop (~hundreds of MB)
 
+#: optional live consumer of the span stream: fn(name, t0_s, t1_s, attrs).
+#: The goodput ledger installs itself here so wall-clock attribution works
+#: with tracing off — while BOTH are disabled span()/add_span() stay on the
+#: original zero-cost path (one extra None check).
+_span_sink = None
+
 #: the header every serving hop forwards (W3C trace-context shape)
 TRACEPARENT_HEADER = "traceparent"
 
@@ -187,6 +193,31 @@ class _Span:
             except Exception:
                 pass
         _record(self.name, self.t0, t1, self.args, ctx=self._ctx)
+        sink = _span_sink
+        if sink is not None:
+            sink(self.name, self.t0 / 1e6, t1 / 1e6, self.args)
+        return False
+
+
+class _SinkSpan:
+    """What span() hands out while tracing is off but a span sink (the
+    goodput ledger) is installed: times the extent with the same clock as
+    `_Span` and feeds only the sink — no event buffer, no lock."""
+
+    __slots__ = ("name", "args", "t0")
+
+    def __init__(self, name: str, args: dict):
+        self.name = name
+        self.args = args
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        sink = _span_sink
+        if sink is not None:
+            sink(self.name, self.t0, time.perf_counter(), self.args)
         return False
 
 
@@ -230,6 +261,8 @@ def span(name: str, ctx: Optional[TraceContext] = None, **attrs):
     trace context (for recording on behalf of another thread's
     request); by default the bound context, if any, is attached."""
     if not _enabled:
+        if _span_sink is not None:
+            return _SinkSpan(name, attrs)
         return _NULL
     return _Span(name, attrs, ctx)
 
@@ -239,6 +272,9 @@ def add_span(name: str, start_s: float, end_s: float,
     """Record a complete event from `time.perf_counter()` stamps already
     taken — for loops that measure a phase anyway (ETL timers in the fit
     loops) and shouldn't pay a second pair of clock reads."""
+    sink = _span_sink
+    if sink is not None:
+        sink(name, start_s, end_s, attrs)
     if not _enabled:
         return
     _record(name, start_s * 1e6, end_s * 1e6, attrs, ctx=ctx)
@@ -281,6 +317,15 @@ def disable_tracing():
 
 def tracing_enabled() -> bool:
     return _enabled
+
+
+def set_span_sink(sink) -> None:
+    """Install (or, with None, remove) the live span consumer — called
+    through `goodput.enable_goodput()` / `disable_goodput()`, not
+    directly. At most one sink exists; it must be cheap and exception-free
+    (it runs inline on every span boundary)."""
+    global _span_sink
+    _span_sink = sink
 
 
 def clear_trace():

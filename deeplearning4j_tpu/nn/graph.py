@@ -459,6 +459,8 @@ class ComputationGraph:
                 from deeplearning4j_tpu.data.pipeline import (
                     mark_copy_for_stacking)
                 copy_marked = mark_copy_for_stacking(data)
+            from deeplearning4j_tpu.monitor import goodput
+            gp_session = goodput.fit_begin("graph/fit")
             try:
                 from deeplearning4j_tpu import monitor
                 for _ in range(epochs):
@@ -479,6 +481,7 @@ class ComputationGraph:
                     if hasattr(data, "reset"):
                         data.reset()
             finally:
+                goodput.fit_end(gp_session)
                 self._input_affine = None
                 for it_ in copy_marked:
                     it_._copy = False
@@ -532,6 +535,7 @@ class ComputationGraph:
 
     def _fit_epoch_per_call(self, data, rng, tbptt):
         from deeplearning4j_tpu import monitor
+        from deeplearning4j_tpu.monitor import goodput
         from deeplearning4j_tpu.monitor import xla as xla_ledger
         etl_start = time.perf_counter()
         for mds in self._mds_stream(data):
@@ -560,10 +564,18 @@ class ComputationGraph:
                     self.params, self.opt_state, self.state, inputs,
                     labels, fmasks, lmasks, sub, None)
                 sync_start = time.perf_counter()
+                # block for device completion FIRST (goodput:
+                # step_compute; banks per-shard barrier wait under a
+                # plan), so the host_sync span below covers only the
+                # narrow D2H fetch
+                goodput.device_wait(loss)
+                fetch_start = time.perf_counter()
+                monitor.add_span("train/device_wait", sync_start,
+                                 fetch_start)
                 # graftlint: disable=host-sync-in-hot-path -- the step's ONE budgeted loss fetch (the deliberate per-iteration sync; PERF.md) — bracketed by the train/host_sync span
                 self._score = float(loss)
                 step_end = time.perf_counter()
-                monitor.add_span("train/host_sync", sync_start, step_end)
+                monitor.add_span("train/host_sync", fetch_start, step_end)
                 monitor.add_span("train/step", step_start, step_end,
                                  iteration=self.iteration_count,
                                  score=self._score, batch_size=bs)
@@ -584,7 +596,7 @@ class ComputationGraph:
                                                 step_end - step_start)
                 _record_iteration(self._score, bs,
                                   step_seconds=step_end - step_start,
-                                  sync_seconds=step_end - sync_start)
+                                  sync_seconds=step_end - fetch_start)
                 for lst in self.listeners:
                     lst.iteration_done(self, self.iteration_count,
                                        self.epoch_count, self._score,

@@ -745,6 +745,8 @@ class MultiLayerNetwork:
                     if np.dtype(self._compute_dtype).itemsize == 2
                     else None,
                     cast_features=self._input_affine is None)
+            from deeplearning4j_tpu.monitor import goodput
+            gp_session = goodput.fit_begin("mln/fit")
             try:
                 from deeplearning4j_tpu import monitor
                 for _ in range(epochs):
@@ -765,6 +767,7 @@ class MultiLayerNetwork:
                     self.epoch_count += 1
                     iterator.reset()
             finally:
+                goodput.fit_end(gp_session)
                 self._input_affine = None
                 for it_ in copy_marked:
                     it_._copy = False
@@ -834,6 +837,7 @@ class MultiLayerNetwork:
 
     def _fit_epoch(self, iterator):
         from deeplearning4j_tpu import monitor
+        from deeplearning4j_tpu.monitor import goodput
         from deeplearning4j_tpu.monitor import xla as xla_ledger
         etl_start = time.perf_counter()
         rng = jax.random.PRNGKey(self.conf.seed + 7919 * (self.epoch_count + 1))
@@ -863,11 +867,17 @@ class MultiLayerNetwork:
             else:
                 self.params, self.opt_state, self.state, loss, _ = out
             sync_start = time.perf_counter()
+            # block for device completion FIRST (goodput: step_compute;
+            # banks per-shard barrier wait under a plan), so the
+            # host_sync span below covers only the narrow D2H fetch
+            goodput.device_wait(loss)
+            fetch_start = time.perf_counter()
+            monitor.add_span("train/device_wait", sync_start, fetch_start)
             # graftlint: disable=host-sync-in-hot-path -- the step's ONE budgeted loss fetch (the deliberate per-iteration sync; PERF.md) — bracketed by the train/host_sync span
             self._score = float(loss)     # the step's one blocking fetch
             step_end = time.perf_counter()
             bs = int(np.shape(ds.features)[0])
-            monitor.add_span("train/host_sync", sync_start, step_end)
+            monitor.add_span("train/host_sync", fetch_start, step_end)
             monitor.add_span("train/step", step_start, step_end,
                              iteration=self.iteration_count,
                              score=self._score, batch_size=bs)
@@ -884,7 +894,7 @@ class MultiLayerNetwork:
                     xla_ledger.observe_step(rec, step_end - step_start)
             _record_iteration(self._score, bs,
                               step_seconds=step_end - step_start,
-                              sync_seconds=step_end - sync_start)
+                              sync_seconds=step_end - fetch_start)
             for lst in capture:
                 lst.on_gradients(self, self.iteration_count, self.epoch_count,
                                  grads, updates)

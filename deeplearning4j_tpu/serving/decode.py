@@ -1303,6 +1303,10 @@ class DecodeScheduler:
         self._wake = threading.Event()
         self._stop = threading.Event()
         self._draining = False
+        # goodput accounting: page-stall slot-seconds apportioned out of
+        # the step window by _step_all (stalled/considered share of each
+        # step's wall) — read by _loop, only meaningful under the ledger
+        self._stall_s = 0.0
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name=f"DecodeScheduler-{name}")
         self._started = False
@@ -1359,10 +1363,19 @@ class DecodeScheduler:
 
     # --------------------------------------------------------------- loop
     def _loop(self):
+        from deeplearning4j_tpu.monitor import goodput
         crash: Optional[Exception] = None
         while not self._stop.is_set():
+            # goodput split of the scheduler pass: admission vs the
+            # compute window (prefill + step + retire) with the step's
+            # page-stall share apportioned out, vs idle wait below.
+            # Zero-cost while the ledger is off: one flag check per pass
+            gp = goodput.goodput_enabled()
+            t_pass = time.perf_counter() if gp else 0.0
             try:
                 worked = self._admit()
+                t_admitted = time.perf_counter() if gp else 0.0
+                stall0 = self._stall_s
                 worked = self._prefill_tick() or worked
                 worked = self._step_all() or worked
                 self._retire()
@@ -1376,9 +1389,22 @@ class DecodeScheduler:
                               "all streams", self.name)
                 self._stop.set()
                 break
+            if gp:
+                t_end = time.perf_counter()
+                stall = max(self._stall_s - stall0, 0.0)
+                goodput.decode_note(self.name, "admission",
+                                    t_admitted - t_pass)
+                goodput.decode_note(self.name, "page_stall", stall)
+                goodput.decode_note(
+                    self.name, "step_compute",
+                    max(t_end - t_admitted - stall, 0.0))
             if not worked:
+                idle0 = time.perf_counter() if gp else 0.0
                 self._wake.wait(0.005)
                 self._wake.clear()
+                if gp:
+                    goodput.decode_note(self.name, "idle",
+                                        time.perf_counter() - idle0)
         # teardown: everything still live gets a terminal error
         exc = crash if crash is not None else ServerDrainingError(
             f"decode[{self.name}] shut down mid-stream")
@@ -1682,6 +1708,8 @@ class DecodeScheduler:
             model=self.name, reason=reason)
 
     def _step_all(self) -> bool:
+        from deeplearning4j_tpu.monitor import goodput
+        gp = goodput.goodput_enabled()
         with self._rlock:
             runs = [r for r in self._runs if r.slot_req]
         worked = False
@@ -1714,11 +1742,14 @@ class DecodeScheduler:
             handled = set(spec)
             if not any(s not in handled for s in run.slot_req):
                 continue
+            step_t0 = time.perf_counter() if gp else 0.0
             toks, act, _ = run.engine.step(
                 exclude=set(run.prefill.keys()) | handled)
+            considered = stalled = 0
             for slot, req in list(run.slot_req.items()):
                 if slot in handled:
                     continue
+                considered += 1
                 if act[slot]:
                     self._emit(run, slot, req, int(toks[slot]))
                 elif int(run.engine.cache.seq_lens[slot]) \
@@ -1733,14 +1764,21 @@ class DecodeScheduler:
                 elif req.deadline is not None \
                         and time.monotonic() > req.deadline:
                     self._finish(run, slot, req, "deadline")
-                elif flight.enabled():
+                else:
                     # page-stalled this step (metered by the cache); the
                     # per-stream timeline needs the stall itself — it is
                     # THE explanation for an ITL-gap span in the trace
-                    flight.note(req.ctx, "page_stall", slot=slot,
-                                seq_len=int(
-                                    run.engine.cache.seq_lens[slot]),
-                                model=self.name)
+                    stalled += 1
+                    if flight.enabled():
+                        flight.note(req.ctx, "page_stall", slot=slot,
+                                    seq_len=int(
+                                        run.engine.cache.seq_lens[slot]),
+                                    model=self.name)
+            if gp and considered:
+                # the stalled slots' share of this step's wall is page-
+                # stall time, not compute — _loop bills it separately
+                self._stall_s += (time.perf_counter() - step_t0) \
+                    * (stalled / considered)
             worked = True
         return worked
 

@@ -126,9 +126,22 @@ class PerformanceListener(TrainingListener):
             monitor.histogram("train_etl_seconds",
                               "Host ETL time per reported iteration "
                               "(PerformanceListener)").observe(etl_ms / 1e3)
+            # goodput beside throughput, sourced from the ledger's live
+            # session (the same accumulators /metrics scrapes, so the
+            # log line and the gauge cannot disagree); absent while the
+            # ledger is off
+            from deeplearning4j_tpu.monitor import goodput
+            gp = goodput.live_stats()
+            if gp is not None:
+                rec["goodput_pct"] = gp["goodput_pct"]
+                rec["dominant_stall"] = gp["dominant_stall"]
             if self.report:
+                suffix = ""
+                if gp is not None:
+                    suffix = (f"; goodput: {gp['goodput_pct']:.1f}%% "
+                              f"(top stall: {gp['dominant_stall']})")
                 log.info("ETL: %.0f ms; iteration %d; iteration time: %.1f ms; "
-                         "examples/sec: %.1f; batches/sec: %.2f",
+                         "examples/sec: %.1f; batches/sec: %.2f" + suffix,
                          etl_ms, iteration, rec["iteration_ms"],
                          rec["examples_per_sec"], rec["batches_per_sec"])
         self._last_time = now

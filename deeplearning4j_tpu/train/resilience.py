@@ -63,6 +63,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu import monitor
+from deeplearning4j_tpu.monitor import goodput
 from deeplearning4j_tpu.train.listeners import (
     DivergenceListener, TrainingDivergedError,
 )
@@ -121,6 +122,11 @@ class FitReport:
     diverged: bool = False
     restored_checkpoint: Optional[str] = None
     final_score: Optional[float] = None
+    #: goodput-ledger session summary, when `monitor.goodput` is enabled:
+    #: the fit's wall-clock split over the closed category set (so a
+    #: preempt->resume run accounts its replay as overhead, not compute)
+    goodput_pct: Optional[float] = None
+    time_by_category: Optional[dict] = None
 
 
 class _Unrecoverable(Exception):
@@ -798,7 +804,9 @@ class ResilientTrainer:
                  step_in_epoch)
         if self.eval_gate is not None:
             try:
-                metrics = self.eval_gate(self.net)
+                with monitor.span("resilience/eval_gate",
+                                  iteration=self.net.iteration_count):
+                    metrics = self.eval_gate(self.net)
             except Exception:           # noqa: BLE001 — a broken eval gate
                 # must not kill training; it only withholds the blessing,
                 # and loudly: an unblessed stream starves the rollout
@@ -830,10 +838,20 @@ class ResilientTrainer:
                 if self.injector is not None:
                     self.injector.before_step(step_idx)
                 loss, bs = self._driver.step(batch, sub)
+                wait_start = time.perf_counter()
+                # block for device completion FIRST (goodput:
+                # step_compute; banks per-shard barrier wait under a
+                # plan), so host_sync covers only the narrow D2H fetch
+                goodput.device_wait(loss)
+                fetch_start = time.perf_counter()
+                monitor.add_span("train/device_wait", wait_start,
+                                 fetch_start)
                 loss_f = float(loss)
-                step_secs = time.perf_counter() - attempt_start
+                step_end = time.perf_counter()
+                step_secs = step_end - attempt_start
+                monitor.add_span("train/host_sync", fetch_start, step_end)
                 monitor.add_span("train/step", attempt_start,
-                                 attempt_start + step_secs, step=step_idx)
+                                 step_end, step=step_idx)
                 # capture AFTER the attempt clock stops: the first sight
                 # of a program pays an AOT lower+compile that must not
                 # read as compute time
@@ -895,9 +913,24 @@ class ResilientTrainer:
 
     # ------------------------------------------------------------------ fit
     def fit(self, data, epochs: int = 1, batch_size: int = 32) -> FitReport:
+        report = FitReport()
+        # the goodput session owns the WHOLE resilient fit wall-clock —
+        # prepare, restore, replay, every epoch, the final save — so the
+        # report's categories sum to what an outside stopwatch measures
+        # (the exclusivity contract telemetry_smoke enforces)
+        gp_session = goodput.fit_begin("resilient/fit")
+        try:
+            return self._fit_guarded(data, epochs, batch_size, report)
+        finally:
+            gp = goodput.fit_end(gp_session)
+            if gp is not None:
+                report.goodput_pct = gp["goodput_pct"]
+                report.time_by_category = gp["categories"]
+
+    def _fit_guarded(self, data, epochs: int, batch_size: int,
+                     report: FitReport) -> FitReport:
         net = self.net
         policy = self.policy
-        report = FitReport()
         self._driver.prepare()
 
         # -------- auto-resume from the newest valid checkpoint
@@ -1007,8 +1040,12 @@ class ResilientTrainer:
                         # the exact next shard offset instead of replaying
                         # — decoding the whole stream prefix just to throw
                         # it away is the resume tax this skips
+                        seek_start = time.perf_counter()
                         source.seek(step_in_epoch)
                         consumed = step_in_epoch
+                        monitor.add_span("train/resume_replay", seek_start,
+                                         time.perf_counter(),
+                                         seeked=step_in_epoch)
                         if hasattr(source, "stream_state"):
                             log.info("resume: seeked stream to %s",
                                      source.stream_state())
@@ -1031,6 +1068,10 @@ class ResilientTrainer:
                                         net.iteration_count)
                             return report
                         etl_start = time.perf_counter()
+                        if self.injector is not None:
+                            # inside the ETL window: an injected stall
+                            # must read as data_wait, like a real one
+                            self.injector.before_fetch(self._dispatch_idx)
                         try:
                             batch = next(it)
                         except StopIteration:
@@ -1038,6 +1079,12 @@ class ResilientTrainer:
                         etl_end = time.perf_counter()
                         if consumed < step_in_epoch:    # resume fast-forward
                             consumed += 1
+                            # replayed batches are resume overhead, not
+                            # data_wait: the goodput ledger bills them to
+                            # resume_replay
+                            monitor.add_span("train/resume_replay",
+                                             etl_start, etl_end,
+                                             step=consumed)
                             continue
                         consumed += 1
                         etl_ms = (etl_end - etl_start) * 1e3

@@ -70,6 +70,11 @@ class FaultInjector:
                       analog delivered through the trainer's flag).
     drop_send_at:     0-based outbound message ordinals a wrapped
                       SocketTransport silently drops.
+    etl_stall_at:     steps whose batch fetch is delayed by
+                      ``etl_stall_s`` (a throttled input pipeline — the
+                      goodput ledger must bill it to data_wait and the
+                      step-time anomaly detector must trip on it).
+    etl_stall_s:      the injected fetch delay in seconds.
     """
 
     def __init__(self, nan_at: Iterable[int] = (),
@@ -77,17 +82,22 @@ class FaultInjector:
                  transient_every: Optional[int] = None,
                  crash_at: Optional[int] = None,
                  preempt_at: Optional[int] = None,
-                 drop_send_at: Iterable[int] = ()):
+                 drop_send_at: Iterable[int] = (),
+                 etl_stall_at: Iterable[int] = (),
+                 etl_stall_s: float = 0.0):
         self.nan_at = set(nan_at)
         self.transient_at = set(transient_at)
         self.transient_every = transient_every
         self.crash_at = crash_at
         self.preempt_at = preempt_at
         self.drop_send_at = set(drop_send_at)
+        self.etl_stall_at = set(etl_stall_at)
+        self.etl_stall_s = float(etl_stall_s)
         self._fired: Set[Tuple[str, int]] = set()
         self.nans_injected = 0
         self.transients_injected = 0
         self.sends_dropped = 0
+        self.stalls_injected = 0
 
     # ------------------------------------------------------------- parsing
     @classmethod
@@ -104,10 +114,13 @@ class FaultInjector:
                 continue
             key, _, val = part.partition("=")
             key = key.strip()
-            if key in ("nan_at", "transient_at", "drop_send_at"):
+            if key in ("nan_at", "transient_at", "drop_send_at",
+                       "etl_stall_at"):
                 kw[key] = _parse_steps(val)
             elif key in ("transient_every", "crash_at", "preempt_at"):
                 kw[key] = int(val)
+            elif key == "etl_stall_s":
+                kw[key] = float(val)
             else:
                 raise ValueError(f"{var}: unknown fault key {key!r}")
         log.warning("fault injection ACTIVE from $%s: %s", var, spec)
@@ -133,6 +146,18 @@ class FaultInjector:
             self.transients_injected += 1
             log.warning("injecting transient fault at step %d", step)
             raise TransientFaultError(f"injected transient fault at step {step}")
+
+    def before_fetch(self, step: int):
+        """Called inside the trainer's ETL window (before pulling step
+        `step`'s batch): sleeps ``etl_stall_s`` on scheduled steps, once
+        each — a deterministic throttled-input-pipeline analog."""
+        if step in self.etl_stall_at and self.etl_stall_s > 0 \
+                and self._once("etl_stall", step):
+            self.stalls_injected += 1
+            log.warning("injecting %.3fs ETL stall at step %d",
+                        self.etl_stall_s, step)
+            import time
+            time.sleep(self.etl_stall_s)
 
     def corrupt_loss(self, step: int, loss: float) -> float:
         """Replace the loss with NaN on scheduled steps (the observable

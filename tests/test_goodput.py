@@ -1,0 +1,384 @@
+"""Goodput ledger (monitor/goodput.py): fake-clock attribution goldens,
+the exclusivity contract (categories sum to session wall exactly), the
+step-time anomaly detector + cooldown, resume-replay accounting through
+ResilientTrainer, stack-snapshot postmortems, and the zero-cost span
+fast path."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import monitor
+from deeplearning4j_tpu.data.iterator import ArrayDataSetIterator
+from deeplearning4j_tpu.monitor import flight, goodput, metrics, trace
+from deeplearning4j_tpu.monitor.goodput import CATEGORIES, GoodputLedger
+from deeplearning4j_tpu.nn.conf.base import InputType
+from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updaters import Adam
+from deeplearning4j_tpu.train import FaultPolicy, ResilientTrainer
+from deeplearning4j_tpu.util.faults import FaultInjector, SimulatedCrash
+
+FAST = FaultPolicy(backoff_base=0.001, backoff_max=0.004)
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    monitor.REGISTRY.reset()
+    monitor.disable_tracing()
+    monitor.clear_trace()
+    goodput.disable_goodput()
+    flight.disable_flight()
+    flight.clear()
+    yield
+    monitor.REGISTRY.reset()
+    monitor.disable_tracing()
+    monitor.clear_trace()
+    goodput.disable_goodput()
+    flight.disable_flight()
+    flight.clear()
+
+
+class _FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def _span(led, name, t0, t1, **attrs):
+    led.on_span(name, t0, t1, attrs)
+
+
+# --------------------------------------------------------------- goldens
+def test_attribution_golden_fake_clock():
+    """Every span family lands in its category and `other` is exactly the
+    unattributed remainder — the deterministic waterfall."""
+    clk = _FakeClock()
+    led = GoodputLedger(clock=clk)
+    s = led.fit_begin("golden/fit")
+    _span(led, "train/etl", 0.0, 1.0)
+    _span(led, "train/device_wait", 1.0, 3.0)
+    _span(led, "train/host_sync", 3.0, 3.5)
+    _span(led, "train/step", 1.0, 3.5, iteration=0)  # residual 0
+    _span(led, "xla/compile", 3.5, 4.0)
+    _span(led, "resilience/checkpoint_save", 4.0, 5.0)
+    _span(led, "resilience/eval_gate", 5.0, 5.5)
+    _span(led, "train/resume_replay", 5.5, 6.0)
+    clk.t = 8.0
+    out = led.fit_end(s)
+    assert out["kind"] == "golden/fit"
+    assert out["wall_s"] == 8.0
+    assert out["categories"] == {
+        "step_compute": 2.0, "data_wait": 1.0, "host_sync": 0.5,
+        "compile": 0.5, "checkpoint": 1.0, "eval_gate": 0.5,
+        "resume_replay": 0.5, "other": 2.0}
+    assert out["goodput_pct"] == 25.0
+    assert out["steps"] == 1
+    # the live families saw the same numbers
+    fam = metrics.REGISTRY.collect("train_time_seconds_total")
+    assert fam.value(category="data_wait") == 1.0
+    assert fam.value(category="other") == 2.0
+    assert metrics.REGISTRY.collect("train_goodput_pct").value() == 25.0
+    assert led.last_session() == out
+
+
+def test_step_residual_counts_as_step_compute():
+    """A train/step extent minus its contained child spans is device
+    execution the loop didn't bracket -> step_compute; spans outside the
+    step window don't subtract."""
+    clk = _FakeClock()
+    led = GoodputLedger(clock=clk)
+    s = led.fit_begin()
+    _span(led, "train/etl", 0.0, 1.0)          # before the step window
+    _span(led, "train/device_wait", 1.0, 2.5)  # contained
+    _span(led, "train/step", 1.0, 3.0)         # residual 0.5
+    clk.t = 3.0
+    out = led.fit_end(s)
+    assert out["categories"]["data_wait"] == 1.0
+    assert out["categories"]["step_compute"] == pytest.approx(2.0)
+    assert out["categories"]["other"] == pytest.approx(0.0)
+
+
+def test_exclusivity_categories_sum_to_wall():
+    clk = _FakeClock()
+    led = GoodputLedger(clock=clk)
+    s = led.fit_begin()
+    _span(led, "train/etl", 0.0, 0.3)
+    _span(led, "train/step", 0.3, 1.1)
+    _span(led, "resilience/checkpoint_save", 1.1, 1.4)
+    clk.t = 2.75
+    out = led.fit_end(s)
+    assert set(out["categories"]) == set(CATEGORIES)
+    assert sum(out["categories"].values()) == pytest.approx(
+        out["wall_s"], abs=1e-9)
+    assert all(v >= 0.0 for v in out["categories"].values())
+
+
+def test_sink_ignores_other_threads_and_nested_sessions():
+    clk = _FakeClock()
+    led = GoodputLedger(clock=clk)
+    s = led.fit_begin()
+    assert led.fit_begin("nested") is None      # outer session owns wall
+    done = threading.Event()
+
+    def _other():
+        _span(led, "train/etl", 0.0, 5.0)       # wrong thread: dropped
+        done.set()
+
+    threading.Thread(target=_other).start()
+    assert done.wait(5.0)
+    clk.t = 1.0
+    out = led.fit_end(s)
+    assert out["categories"]["data_wait"] == 0.0
+    assert out["categories"]["other"] == pytest.approx(1.0)
+    assert led.fit_end(None) is None
+
+
+def test_barrier_wait_banks_outside_the_partition():
+    clk = _FakeClock()
+    led = GoodputLedger(clock=clk)
+    s = led.fit_begin()
+    _span(led, "train/barrier_wait", 0.2, 0.5, shards=4)
+    clk.t = 1.0
+    out = led.fit_end(s)
+    assert out["barrier_wait_s"] == pytest.approx(0.3)
+    assert sum(out["categories"].values()) == pytest.approx(1.0)
+    fam = metrics.REGISTRY.collect("train_barrier_wait_seconds_total")
+    assert fam.value() == pytest.approx(0.3)
+
+
+# --------------------------------------------------------------- anomaly
+def _steady_steps(led, n, start=0.0, spacing=1.0, dur=0.1):
+    """n train/step spans whose ENDS are `spacing` apart."""
+    t_end = start
+    for i in range(n):
+        t_end += spacing
+        _span(led, "train/step", t_end - dur, t_end, iteration=i)
+    return t_end
+
+
+def test_anomaly_trip_names_dominant_category_and_cools_down(tmp_path):
+    flight.enable_flight(dump_dir=str(tmp_path))
+    clk = _FakeClock()
+    led = GoodputLedger(clock=clk, warmup_steps=4,
+                        anomaly_cooldown_steps=32)
+    s = led.fit_begin()
+    t = _steady_steps(led, 8)                   # baseline: 1.0s spacing
+    # spike: 5.0s iteration wall, 4.8s of it an ETL stall
+    _span(led, "train/etl", t, t + 4.8)
+    _span(led, "train/step", t + 4.8, t + 5.0, iteration=8)
+    assert s.anomalies == 1
+    assert metrics.REGISTRY.collect(
+        "train_step_anomalies_total").value() == 1.0
+    doc = flight.postmortems()[-1]
+    assert doc["reason"] == "step_time_anomaly"
+    assert doc["meta"]["dominant_category"] == "data_wait"
+    assert doc["meta"]["step"] == 8
+    assert doc["meta"]["iteration_wall_s"] == pytest.approx(5.0)
+    assert doc["meta"]["dominant_seconds"] == pytest.approx(4.8)
+    # a second spike inside the 32-step cooldown must NOT re-fire
+    _span(led, "train/step", t + 11.8, t + 12.0, iteration=9)
+    assert s.anomalies == 1
+    clk.t = t + 12.0
+    led.fit_end(s)
+
+
+def test_anomaly_detector_stays_quiet_during_warmup():
+    flight.enable_flight()
+    clk = _FakeClock()
+    led = GoodputLedger(clock=clk, warmup_steps=16)
+    s = led.fit_begin()
+    # a huge spike on step 3 — history too short, detector disarmed
+    _span(led, "train/step", 0.9, 1.0, iteration=0)
+    _span(led, "train/step", 1.9, 2.0, iteration=1)
+    _span(led, "train/step", 41.9, 42.0, iteration=2)
+    assert s.anomalies == 0
+    clk.t = 42.0
+    led.fit_end(s)
+
+
+def test_anomaly_dominant_falls_back_to_other():
+    """When the slow interval's time is unattributed (no span covered
+    it), the postmortem says `other` instead of guessing."""
+    flight.enable_flight()
+    clk = _FakeClock()
+    led = GoodputLedger(clock=clk, warmup_steps=4)
+    s = led.fit_begin()
+    t = _steady_steps(led, 8)
+    _span(led, "train/step", t + 5.8, t + 6.0, iteration=8)  # naked gap
+    assert s.anomalies == 1
+    doc = flight.postmortems()[-1]
+    assert doc["meta"]["dominant_category"] == "other"
+    clk.t = t + 6.0
+    led.fit_end(s)
+
+
+# ---------------------------------------------------------- live surface
+def test_live_stats_reports_pct_and_dominant_stall():
+    clk = _FakeClock()
+    led = GoodputLedger(clock=clk)
+    assert led.live_stats() is None             # no session
+    s = led.fit_begin()
+    _span(led, "train/step", 0.0, 6.0)
+    _span(led, "train/etl", 6.0, 9.0)
+    clk.t = 10.0
+    live = led.live_stats()
+    assert live["goodput_pct"] == pytest.approx(60.0)
+    assert live["dominant_stall"] == "data_wait"
+    assert live["stall_seconds"] == pytest.approx(3.0)
+    clk.t = 10.0
+    led.fit_end(s)
+
+
+def test_decode_note_aggregates_per_model_and_metric():
+    led = GoodputLedger()
+    led.decode_note("lm", "step_compute", 0.5)
+    led.decode_note("lm", "step_compute", 0.25)
+    led.decode_note("lm", "page_stall", 0.1)
+    led.decode_note("other-lm", "idle", 0.2)
+    led.decode_note("lm", "admission", 0.0)     # <=0 dropped
+    totals = led.decode_totals()
+    assert totals["lm"] == {"step_compute": 0.75, "page_stall": 0.1}
+    assert totals["other-lm"] == {"idle": 0.2}
+    fam = metrics.REGISTRY.collect("serving_decode_time_seconds_total")
+    assert fam.value(model="lm", category="step_compute") == 0.75
+
+
+# ------------------------------------------------------------- zero cost
+def test_zero_cost_span_paths():
+    """Disabled: span() hands back the shared null object. Goodput-only
+    (tracing off): a _SinkSpan that feeds the sink. Both off again after
+    disable_goodput()."""
+    assert trace.span("x") is trace._NULL
+    seen = []
+    trace.set_span_sink(lambda name, t0, t1, attrs: seen.append(name))
+    try:
+        sp = trace.span("y")
+        assert sp is not trace._NULL
+        with sp:
+            pass
+        assert seen == ["y"]
+        trace.add_span("z", 0.0, 1.0)
+        assert seen == ["y", "z"]
+    finally:
+        trace.set_span_sink(None)
+    assert trace.span("x2") is trace._NULL
+    assert not trace.trace_events()             # nothing recorded
+
+
+def test_device_wait_passthrough_and_block():
+    assert goodput.device_wait("not-an-array") == "not-an-array"
+
+    class _Arr:
+        def __init__(self):
+            self.blocked = 0
+
+        def block_until_ready(self):
+            self.blocked += 1
+
+    a = _Arr()
+    assert goodput.device_wait(a) is a          # disabled: bare block
+    assert a.blocked == 1
+    led = goodput.enable_goodput()
+    s = led.fit_begin()
+    goodput.device_wait(a)                      # active session, 0 shards
+    assert a.blocked == 2
+    led.fit_end(s)
+
+
+# ------------------------------------------------------------ end to end
+def _net(seed=7):
+    conf = (NeuralNetConfiguration.Builder().seed(seed).updater(Adam(1e-2))
+            .list()
+            .layer(DenseLayer(n_out=8, activation="relu"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(6)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _data():
+    rs = np.random.RandomState(0)
+    X = rs.randn(120, 6).astype("float32")
+    Y = np.eye(3, dtype="float32")[rs.randint(0, 3, 120)]
+    return ArrayDataSetIterator(X, Y, batch_size=30)
+
+
+def test_fit_report_carries_goodput_and_resume_replay(tmp_path):
+    """A preempt->resume pair: both reports carry the goodput summary,
+    the resumed run attributes its iterator fast-forward to
+    resume_replay, and categories sum to wall within tolerance."""
+    goodput.enable_goodput()
+    # crash at 6 with saves every 2: the resume lands mid-epoch
+    # (step_in_epoch 2 of 4), forcing the iterator fast-forward
+    with pytest.raises(SimulatedCrash):
+        ResilientTrainer(_net(), str(tmp_path), save_every_n_iterations=2,
+                         policy=FAST, injector=FaultInjector(crash_at=6)
+                         ).fit(_data(), epochs=3)
+    rep = ResilientTrainer(_net(), str(tmp_path), save_every_n_iterations=2,
+                           policy=FAST).fit(_data(), epochs=3)
+    assert rep.resumed_from is not None
+    assert rep.goodput_pct is not None and rep.goodput_pct > 0.0
+    assert set(rep.time_by_category) == set(CATEGORIES)
+    assert rep.time_by_category["resume_replay"] > 0.0
+    wall = sum(rep.time_by_category.values())
+    s = goodput.last_session()
+    assert s["wall_s"] == pytest.approx(wall, abs=1e-3)
+    assert s["steps"] > 0
+
+
+def test_performance_listener_logs_goodput(caplog):
+    from deeplearning4j_tpu.train.listeners import PerformanceListener
+    goodput.enable_goodput()
+    lis = PerformanceListener(frequency=1)
+    net = _net()
+    net.set_listeners(lis)
+    import logging
+    with caplog.at_level(logging.INFO, logger="deeplearning4j_tpu"):
+        net.fit(_data(), epochs=2)
+    recs = lis.history
+    assert recs and all("goodput_pct" in r for r in recs)
+    assert all(r["dominant_stall"] in CATEGORIES for r in recs)
+    assert any("goodput:" in m for m in caplog.messages)
+
+
+def test_etl_stall_attributes_to_data_wait(tmp_path):
+    """The acceptance shape: a FaultInjector-throttled ETL fit shows the
+    stall in data_wait and trips an anomaly postmortem naming it, with
+    thread stacks attached."""
+    goodput.enable_goodput(warmup_steps=8, anomaly_min_s=0.05)
+    flight.enable_flight(dump_dir=str(tmp_path / "pm"))
+    rep = ResilientTrainer(
+        _net(), str(tmp_path / "ck"), save_every_n_iterations=10_000,
+        policy=FAST,
+        injector=FaultInjector(etl_stall_at=[10], etl_stall_s=0.4)
+    ).fit(_data(), epochs=4)
+    assert rep.time_by_category["data_wait"] >= 0.4
+    docs = [d for d in flight.postmortems()
+            if d["reason"] == "step_time_anomaly"]
+    assert docs, "the injected stall must trip the detector"
+    doc = docs[-1]
+    assert doc["meta"]["dominant_category"] == "data_wait"
+    assert doc["threads"], "postmortem carries thread stacks"
+    th = doc["threads"][0]
+    assert set(th) == {"name", "ident", "daemon", "stack"}
+    assert 0 < len(th["stack"]) <= 20
+    assert len(doc["threads"]) <= 32
+    assert isinstance(doc["locks"], dict)
+    dumps = list((tmp_path / "pm").glob("postmortem-*step_time_anomaly*"))
+    assert dumps, "postmortem JSON auto-dumped to disk"
+
+
+def test_goodput_session_survives_fit_exception():
+    """fit() failing mid-flight still closes the session (finally path):
+    a later fit can open a fresh one."""
+    led = goodput.enable_goodput()
+    net = _net()
+    with pytest.raises(Exception):
+        net.fit(object())                       # not an iterator
+    assert led._session is None
+    net.fit(_data(), epochs=1)
+    assert goodput.last_session()["steps"] == 4

@@ -51,13 +51,18 @@ import sys
 #: decode_cache_hit_rate is the shared-prefix workload's KV prefix-cache
 #: hit fraction (DECODE_r*.json, r14+): higher = more prefill compute
 #: skipped, gated like a throughput so a cache regression trips CI.
+#: train_goodput_pct is the clean-fit step-compute share of wall-clock
+#: from the goodput ledger (`tools/goodput_report.py`, banked as
+#: GOODPUT_r*.json, r19+): an attribution regression (more time leaking
+#: into data_wait/host_sync/other) trips CI even when raw imgs/sec
+#: noise hides it.
 THROUGHPUT_KEYS = ("imgs_sec", "lenet_imgs_sec", "chars_sec", "pairs_sec",
                    "h2d_f32_mbytes_sec", "h2d_u8_mbytes_sec",
                    "fit_e2e_imgs_sec",
                    "fit_e2e_chars_sec", "fit_e2e_pairs_sec",
                    "chaos_goodput_under_fault_rps", "mesh_imgs_sec",
                    "decode_tokens_sec", "decode_cache_hit_rate",
-                   "decode_spec_acceptance_rate")
+                   "decode_spec_acceptance_rate", "train_goodput_pct")
 
 #: lower-is-better series (latencies). Banked by tools/serve_chaos.py
 #: (CHAOS_r*.json): p99 while a replica is killed + another wedged, and
@@ -85,7 +90,8 @@ LATENCY_KEYS = ("chaos_p99_under_fault_ms", "chaos_recovered_p99_ms",
 #: dimensionless series (fractions of work, not work per second): host
 #: speed cannot move them, so calibration normalization never applies —
 #: they always compare raw, against every earlier round.
-RATIO_KEYS = ("decode_cache_hit_rate", "decode_spec_acceptance_rate")
+RATIO_KEYS = ("decode_cache_hit_rate", "decode_spec_acceptance_rate",
+              "train_goodput_pct")
 
 
 def _round_of(name: str) -> int:
@@ -114,7 +120,11 @@ def load_rounds(directory: str):
              # continuous-rollout drills (promote fan-out / rollback
              # detection latency from tools/rollout_drill.py)
              + sorted(glob.glob(os.path.join(directory,
-                                             "ROLLOUT_r*.json"))))
+                                             "ROLLOUT_r*.json")))
+             # goodput-ledger acceptance runs (clean-fit goodput% from
+             # tools/goodput_report.py)
+             + sorted(glob.glob(os.path.join(directory,
+                                             "GOODPUT_r*.json"))))
     for path in names:
         try:
             with open(path) as f:

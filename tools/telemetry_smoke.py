@@ -9,7 +9,11 @@ Exercises every instrumented subsystem on CPU in one process:
 - ResilientTrainer fit over an AsyncDataSetIterator (train + ETL +
   resilience series; one injected NaN step ticks
   resilience_steps_skipped_total) with the compiled-program ledger
-  enabled (xla_* series + a live train_mfu_pct),
+  enabled (xla_* series + a live train_mfu_pct) AND the goodput ledger
+  enabled — the fit's attributed category seconds must sum to its
+  externally measured wall-clock within tolerance (the exclusivity
+  contract) and the train_goodput_pct / train_time_seconds_total
+  families must be live,
 - ParallelInference BATCHED serving (inference + serving-side ledger),
 - a two-rank SocketTransport exchange (transport series),
 
@@ -50,6 +54,7 @@ import json
 import os
 import sys
 import tempfile
+import time
 import urllib.request
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -94,6 +99,17 @@ TRACE_REQUIRED = ("trace_contexts_minted_total",
 SLO_REQUIRED = ("timeseries_samples_total", "timeseries_sample_seconds",
                 "timeseries_series", "slo_objective_ratio",
                 "slo_burn_rate", "slo_alert_state")
+
+#: goodput-ledger families (docs/OBSERVABILITY.md "Goodput accounting");
+#: train_step_anomalies_total is deliberately absent — a clean smoke
+#: fit never trips the anomaly detector
+GOODPUT_REQUIRED = ("train_goodput_pct", "train_time_seconds_total")
+
+#: exclusivity tolerance: attributed category seconds vs the externally
+#: measured fit wall-clock (acceptance: within 5%, plus a small absolute
+#: slack for the clock reads outside the session)
+GOODPUT_SUM_TOL_FRAC = 0.05
+GOODPUT_SUM_TOL_ABS_S = 0.25
 
 #: top-level + per-program keys of the persisted perf-ledger schema
 LEDGER_KEYS = ("version", "created_unix", "device_kind", "backend",
@@ -176,6 +192,7 @@ def main(argv=None) -> int:
 
     monitor.enable_tracing()
     monitor.xla.enable_ledger(ledger_path)
+    monitor.goodput.enable_goodput()
     failures = []
     summary = {"trace_out": trace_path, "perf_ledger": ledger_path}
 
@@ -188,15 +205,39 @@ def main(argv=None) -> int:
     ckdir = tempfile.mkdtemp(prefix="telemetry_ck_")
     source = AsyncDataSetIterator(
         ArrayDataSetIterator(X, Y, batch_size=args.batch_size))
-    report = ResilientTrainer(
+    trainer = ResilientTrainer(
         net, ckdir, save_every_n_iterations=4,
-        injector=FaultInjector(nan_at=[3]),
-    ).fit(source, epochs=args.epochs, batch_size=args.batch_size)
+        injector=FaultInjector(nan_at=[3]))
+    fit_t0 = time.perf_counter()
+    report = trainer.fit(source, epochs=args.epochs,
+                         batch_size=args.batch_size)
+    fit_wall = time.perf_counter() - fit_t0
     summary["fit"] = {"applied": report.applied_steps,
                       "skipped": report.skipped_steps,
                       "checkpoints": report.checkpoints_written}
     if report.skipped_steps < 1:
         failures.append("injected NaN step was not skipped")
+
+    # ---- goodput exclusivity: attributed seconds == measured wall ------
+    if report.goodput_pct is None or not report.time_by_category:
+        failures.append("FitReport carries no goodput session summary")
+    else:
+        attributed = sum(report.time_by_category.values())
+        tol = max(GOODPUT_SUM_TOL_FRAC * fit_wall, GOODPUT_SUM_TOL_ABS_S)
+        summary["goodput"] = {
+            "goodput_pct": report.goodput_pct,
+            "categories_s": {k: round(v, 4)
+                             for k, v in report.time_by_category.items()},
+            "attributed_s": round(attributed, 4),
+            "measured_wall_s": round(fit_wall, 4)}
+        if abs(attributed - fit_wall) > tol:
+            failures.append(
+                f"goodput exclusivity broke: categories sum to "
+                f"{attributed:.3f}s but the fit measured {fit_wall:.3f}s "
+                f"(tolerance {tol:.3f}s)")
+        if any(v < 0 for v in report.time_by_category.values()):
+            failures.append("goodput category went negative: "
+                            f"{report.time_by_category}")
 
     # ---- GSPMD plan-sharded fit: arg_shardings lands in the ledger -----
     import jax
@@ -392,6 +433,9 @@ def main(argv=None) -> int:
         if fam not in families:
             failures.append(f"{fam} missing from /metrics exposition")
     for fam in SLO_REQUIRED:
+        if fam not in families:
+            failures.append(f"{fam} missing from /metrics exposition")
+    for fam in GOODPUT_REQUIRED:
         if fam not in families:
             failures.append(f"{fam} missing from /metrics exposition")
 

@@ -108,6 +108,20 @@ def build_parser() -> argparse.ArgumentParser:
                           "(radix-indexed page reuse across requests "
                           "with a common prompt prefix; on by default — "
                           "greedy outputs are identical either way)")
+    dec.add_argument("--kv-spill-pages", type=int, default=0,
+                     help="host-RAM KV spill-tier capacity in pages (0 "
+                          "disables): zero-ref retained prefix pages "
+                          "demote into pinned host memory instead of "
+                          "being dropped, and promote back into HBM on a "
+                          "prefix hit (docs/SERVING.md 'Tiered KV "
+                          "fabric')")
+    dec.add_argument("--kv-role", choices=("prefill", "decode", "mixed"),
+                     default="mixed",
+                     help="disaggregation role this server advertises on "
+                          "/readyz: 'prefill' computes KV and ships "
+                          "pages, 'decode' streams tokens, 'mixed' does "
+                          "both (single-replica mode; fleet mode assigns "
+                          "roles with --kv-roles)")
     p.add_argument("--host", default="127.0.0.1",
                    help="bind address (0.0.0.0 behind a load balancer)")
     p.add_argument("--port", type=int, default=8500)
@@ -195,6 +209,27 @@ def build_parser() -> argparse.ArgumentParser:
     fleet.add_argument("--no-hedge", action="store_true",
                        help="disable hedged retries for straggler "
                             "predicts")
+    fleet.add_argument("--kv-roles", default=None, metavar="R0,R1,...",
+                       help="per-replica disaggregation roles (comma "
+                            "list of prefill|decode|mixed, indexed by "
+                            "replica); replicas beyond the list — "
+                            "autoscaled ones included — serve 'mixed'. "
+                            "At least one replica must be able to decode")
+    fleet.add_argument("--no-affinity", action="store_true",
+                       help="disable prefix-affinity routing (steering "
+                            "same-prefix streams to the replica whose "
+                            "heartbeat advertises ownership of the "
+                            "prompt's leading KV block)")
+    fleet.add_argument("--disagg-min-tokens", type=int, default=None,
+                       help="prompts at least this many tokens long are "
+                            "prefilled on a prefill-role replica and "
+                            "their KV pages shipped to the decode "
+                            "replica before the stream is routed "
+                            "(default: disabled)")
+    fleet.add_argument("--disagg-timeout-s", type=float, default=30.0,
+                       help="per-leg timeout for the kv export/import "
+                            "transfer; a missed deadline fails over to "
+                            "local prefill on the decode replica")
     # ----------------------------------------------- continuous rollout
     ro = p.add_argument_group(
         "continuous rollout (docs/SERVING.md 'Continuous rollout')")
@@ -335,7 +370,7 @@ def main(argv=None) -> int:
     server = ModelServer(registry, host=args.host, port=args.port,
                          default_deadline_s=args.deadline_s,
                          enable_faults=args.enable_fault_injection,
-                         slo_engine=slo_engine)
+                         slo_engine=slo_engine, kv_role=args.kv_role)
     endpoints = ["/v1/models", "/healthz", "/readyz", "/metrics"]
     if slo_engine is not None:
         endpoints += ["/v1/slo", "/v1/timeseries"]
@@ -414,7 +449,8 @@ def _decode_config(args):
                         spec_k=args.spec_k,
                         spec_accept_floor=args.spec_accept_floor,
                         spec_window=args.spec_window,
-                        spec_draft_pool_pages=args.spec_draft_pool_pages)
+                        spec_draft_pool_pages=args.spec_draft_pool_pages,
+                        spill_pages=args.kv_spill_pages)
 
 
 def _main_fleet(args, specs, lm_specs, buckets, decode_cfg) -> int:
@@ -434,20 +470,42 @@ def _main_fleet(args, specs, lm_specs, buckets, decode_cfg) -> int:
                     if c.strip())
     if not classes:
         raise SystemExit("--priority-classes must name at least one class")
-    spec = ReplicaSpec(specs, buckets=buckets,
-                       max_delay_ms=args.max_delay_ms,
-                       queue_limit=args.queue_limit,
-                       default_deadline_s=args.deadline_s,
-                       enable_faults=args.enable_fault_injection,
-                       lms=lm_specs, decode=decode_cfg,
-                       trace_out=args.trace_out,
-                       postmortem_dir=args.postmortem_dir,
-                       flight=not args.no_flight,
-                       flight_records=args.flight_records,
-                       slo_availability=args.slo_availability,
-                       slo_p99_ms=args.slo_p99_ms,
-                       slo_sample_interval_s=args.slo_sample_interval_s,
-                       slo_windows=args.slo_windows)
+    roles: tuple = ()
+    if args.kv_roles:
+        roles = tuple(r.strip() for r in args.kv_roles.split(",")
+                      if r.strip())
+        bad = sorted({r for r in roles
+                      if r not in ("prefill", "decode", "mixed")})
+        if bad:
+            raise SystemExit(f"--kv-roles: unknown role(s) {bad} "
+                             "(expected prefill|decode|mixed)")
+        if (len(roles) >= args.replicas
+                and all(r == "prefill" for r in roles[:args.replicas])):
+            raise SystemExit("--kv-roles: every replica is 'prefill' — "
+                             "at least one must be able to decode")
+        if roles and not lm_specs:
+            raise SystemExit("--kv-roles only applies to --lm servables")
+
+    def _role(i: int) -> str:
+        # replicas past the list (autoscaled growth included) serve mixed
+        return roles[i] if i < len(roles) else "mixed"
+
+    def _spec(i: int) -> ReplicaSpec:
+        return ReplicaSpec(specs, buckets=buckets,
+                           max_delay_ms=args.max_delay_ms,
+                           queue_limit=args.queue_limit,
+                           default_deadline_s=args.deadline_s,
+                           enable_faults=args.enable_fault_injection,
+                           lms=lm_specs, decode=decode_cfg,
+                           trace_out=args.trace_out,
+                           postmortem_dir=args.postmortem_dir,
+                           flight=not args.no_flight,
+                           flight_records=args.flight_records,
+                           slo_availability=args.slo_availability,
+                           slo_p99_ms=args.slo_p99_ms,
+                           slo_sample_interval_s=args.slo_sample_interval_s,
+                           slo_windows=args.slo_windows,
+                           kv_role=_role(i))
     if args.replica_mode == "subprocess":
         for _, source in specs + lm_specs:
             base, _variant = parse_variant(source)
@@ -457,11 +515,11 @@ def _main_fleet(args, specs, lm_specs, buckets, decode_cfg) -> int:
                              "(need a path or zoo: name)")
 
         def factory(i):
-            return SubprocessReplica(f"replica-{i}", spec,
+            return SubprocessReplica(f"replica-{i}", _spec(i),
                                      env=dict(os.environ))
     else:
         def factory(i):
-            return InProcessReplica(f"replica-{i}", spec)
+            return InProcessReplica(f"replica-{i}", _spec(i))
 
     autoscale = None
     if args.autoscale_max is not None:
@@ -493,7 +551,10 @@ def _main_fleet(args, specs, lm_specs, buckets, decode_cfg) -> int:
         per_replica_inflight=args.per_replica_inflight,
         hedge=not args.no_hedge, timeout_s=args.deadline_s,
         slo_p99_ms=args.slo_p99_ms,
-        canary_fraction=args.rollout_canary_fraction)
+        canary_fraction=args.rollout_canary_fraction,
+        affinity=not args.no_affinity,
+        disagg_min_tokens=args.disagg_min_tokens,
+        disagg_timeout_s=args.disagg_timeout_s)
     from deeplearning4j_tpu.monitor import slo as slo_mod
     slo_engine = _slo_setup(args, slo_mod.router_objectives(
         slo_p99_ms=args.slo_p99_ms,

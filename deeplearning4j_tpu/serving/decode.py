@@ -64,7 +64,7 @@ from deeplearning4j_tpu.nn.layers.attention import (
     _merge_heads, _split_heads, dot_product_attention, rope,
 )
 from deeplearning4j_tpu.nn.layers.recurrent import RnnOutputLayer
-from deeplearning4j_tpu.serving import kvcache
+from deeplearning4j_tpu.serving import kvcache, kvfabric
 from deeplearning4j_tpu.serving.batcher import (
     DeadlineExceededError, ServerDrainingError, ServerOverloadedError,
 )
@@ -123,6 +123,11 @@ class DecodeConfig:
     #: draft engine's page pool (its own second pool); None = derived
     #: like the target's (no oversubscription)
     spec_draft_pool_pages: Optional[int] = None
+    #: host-RAM spill tier size in pages: zero-ref retained prefix pages
+    #: demote here under HBM pool pressure and promote back on a hit, so
+    #: the effective prefix cache is host-RAM sized. None/0 = off. Only
+    #: the TARGET engine spills (the draft's cache is derivative)
+    spill_pages: Optional[int] = None
 
 
 def apply_variant(cfg: DecodeConfig, variant: Optional[str]) -> DecodeConfig:
@@ -379,6 +384,27 @@ class DecodeEngine:
         self._chunk_jit = jax.jit(self._chunk_fn, donate_argnums=(1, 2))
         self._copy_jit = jax.jit(kvcache.copy_page, donate_argnums=(0, 1))
         self._logits_jit = jax.jit(self._logits_fn)
+        # ---------------------------------------------- tiered KV fabric
+        # page extract/land programs: extract reads one physical page
+        # WITHOUT donating (the pools stay live), land scatters one page
+        # back donating as every other pool writer does. Both take the
+        # page id as a traced operand — ONE compile each serves every
+        # page. They back the host-RAM spill tier and the disaggregated
+        # prefill transfer path, so they exist (and warm) regardless of
+        # whether spill is configured.
+        self._extract_jit = jax.jit(self._extract_fn)
+        self._land_jit = jax.jit(self._land_fn, donate_argnums=(0, 1))
+        self.spill: Optional[kvfabric.HostPageStore] = None
+        if cfg.spill_pages and int(cfg.spill_pages) > 0 \
+                and cfg.prefix_cache:
+            self.spill = kvfabric.HostPageStore(
+                int(cfg.spill_pages),
+                kvfabric.frame_capacity(self.n_layers, cfg.page_size,
+                                        self.n_heads, self.head_dim,
+                                        np.dtype(self._dtype)),
+                name=name)
+            self.cache.attach_spill(self.spill, self._demote_page,
+                                    self._land_frame)
         # ---------------------------------------- speculative decoding
         # the draft is a full second engine (own params, own smaller
         # page pool, own compiled programs under "<name>.draft"); the
@@ -420,7 +446,7 @@ class DecodeEngine:
         dcfg = dataclasses.replace(
             cfg, quantize=dquant, max_context=self.max_context,
             pool_pages=cfg.spec_draft_pool_pages, spec_draft=None,
-            seed=cfg.seed + 1)
+            spill_pages=None, seed=cfg.seed + 1)
         draft = DecodeEngine(draft_model, dcfg, name=f"{self.name}.draft")
         if draft.vocab != self.vocab:
             dvocab = draft.vocab
@@ -733,6 +759,92 @@ class DecodeEngine:
         quality scoring; never on the request path)."""
         return self._forward_tokens(params, tokens, None)[0]
 
+    # ------------------------------------------------- tiered KV fabric
+    def _extract_fn(self, kpool, vpool, page):
+        """Read one physical page across every layer -> (K, V) each
+        shaped (L, page_size, H, D). `page` is a traced scalar; the
+        pools are NOT donated (the page must survive its own export)."""
+        return (jax.lax.dynamic_index_in_dim(kpool, page, axis=1,
+                                             keepdims=False),
+                jax.lax.dynamic_index_in_dim(vpool, page, axis=1,
+                                             keepdims=False))
+
+    def _land_fn(self, kpool, vpool, page, kpage, vpage):
+        """Write one (L, page_size, H, D) K/V pair into physical page
+        `page` (traced scalar), donating the pools like every writer."""
+        kpool = kpool.at[:, page].set(kpage)
+        vpool = vpool.at[:, page].set(vpage)
+        return kpool, vpool
+
+    def _demote_page(self, page: int, digest: bytes) -> bytes:
+        """Spill-extract callback: one HBM page -> a packed, sealed
+        frame. SCHEDULER THREAD ONLY (the pools are donated buffers)."""
+        self._meter_program("kv_extract", warmup=False)
+        with monitor.span("serving/kv_extract", model=self.name):
+            k, v = self._extract_jit(self._kpool, self._vpool,
+                                     np.int32(page))
+        return kvfabric.pack_page(np.asarray(k), np.asarray(v), digest)
+
+    def _land_frame(self, page: int, payload: bytes, digest: bytes):
+        """Spill-land callback: verify + write one frame into physical
+        page `page`. Raises kvfabric.FrameError on corruption or a
+        geometry that does not fit this pool — a clean rejection the
+        caller degrades from. SCHEDULER THREAD ONLY."""
+        k, v, _ = kvfabric.unpack_page(payload, expect_digest=digest)
+        shape = (self.n_layers, self.cfg.page_size, self.n_heads,
+                 self.head_dim)
+        want = np.dtype(self._dtype)
+        if k.shape != shape or k.dtype != want or v.dtype != want:
+            raise kvfabric.FrameError(
+                f"frame geometry {k.shape}/{k.dtype} does not fit pool "
+                f"{shape}/{want} (mismatched model or quantize mode)")
+        self._meter_program("kv_land", warmup=False)
+        with monitor.span("serving/kv_land", model=self.name):
+            self._kpool, self._vpool = self._land_jit(
+                self._kpool, self._vpool, np.int32(page),
+                jnp.asarray(k), jnp.asarray(v))
+
+    def export_pages(self, tokens) -> List[bytes]:
+        """Serialize the cached pages covering `tokens`' full blocks
+        (which must all be radix-indexed — the caller prefills first)
+        into sealed frames for a disaggregated transfer. SCHEDULER
+        THREAD ONLY (runs as a fabric job)."""
+        _, keys = self.cache._blocks(tokens)
+        with self.cache._lock:
+            node, pages = self.cache._walk_locked(keys)
+            if len(pages) < len(keys):
+                raise RuntimeError(
+                    f"decode[{self.name}]: prefix fell out of the cache "
+                    f"mid-export ({len(pages)}/{len(keys)} blocks "
+                    "indexed); retry after re-prefilling")
+            digests = kvfabric.chain_digests(keys)
+        frames = []
+        for page, dig in zip(pages, digests):
+            self._meter_program("kv_extract", warmup=False)
+            with monitor.span("serving/kv_extract", model=self.name):
+                k, v = self._extract_jit(self._kpool, self._vpool,
+                                         np.int32(page))
+            frames.append(kvfabric.pack_page(np.asarray(k),
+                                             np.asarray(v), dig))
+        return frames
+
+    def import_pages(self, tokens, frames: List[bytes]) -> int:
+        """Adopt a shipment of sealed frames as this cache's retained
+        prefix pages (the disaggregated-prefill landing). Frame i lands
+        for block i via the verified land program; corruption raises
+        kvfabric.FrameError cleanly. SCHEDULER THREAD ONLY."""
+        _, keys = self.cache._blocks(tokens)
+        if len(frames) != len(keys):
+            raise kvfabric.FrameError(
+                f"shipment has {len(frames)} frames for {len(keys)} "
+                "full token blocks")
+        digests = kvfabric.chain_digests(keys)
+
+        def land(i: int, page: int):
+            self._land_frame(page, frames[i], digests[i])
+
+        return self.cache.adopt_pages(tokens, land)
+
     # ----------------------------------------------------- compile ledger
     def _meter_program(self, program: str, warmup: bool):
         if program in self._compiled:
@@ -792,6 +904,20 @@ class DecodeEngine:
             self._kpool, self._vpool = self._copy_jit(
                 self._kpool, self._vpool, np.int32(kvcache.DUMP_PAGE),
                 np.int32(kvcache.DUMP_PAGE))
+        warmups.inc(model=self.name)
+        # the KV-fabric page programs (spill demote/promote + the
+        # disaggregated transfer path): extract reads the dump page,
+        # land writes the extracted garbage straight back to it
+        self._meter_program("kv_extract", warmup=True)
+        with monitor.span("serving/kv_extract", model=self.name, warmup=1):
+            kx, vx = self._extract_jit(self._kpool, self._vpool,
+                                       np.int32(kvcache.DUMP_PAGE))
+        warmups.inc(model=self.name)
+        self._meter_program("kv_land", warmup=True)
+        with monitor.span("serving/kv_land", model=self.name, warmup=1):
+            self._kpool, self._vpool = self._land_jit(
+                self._kpool, self._vpool, np.int32(kvcache.DUMP_PAGE),
+                kx, vx)
         warmups.inc(model=self.name)
         self._meter_program("decode", warmup=True)
         with monitor.span("serving/decode_step", model=self.name, warmup=1):
@@ -1226,6 +1352,8 @@ class DecodeEngine:
         self._closed = True
         self._kpool = self._vpool = None
         self._params = None
+        if self.spill is not None:
+            self.spill.close()
         if self.draft is not None:
             self.draft.close()
 
@@ -1303,6 +1431,10 @@ class DecodeScheduler:
         self._wake = threading.Event()
         self._stop = threading.Event()
         self._draining = False
+        #: KV-fabric jobs (page export/import) marshalled onto the
+        #: scheduler thread — the ONLY thread allowed to touch the
+        #: donated device pools. Guarded by _plock; (fn, done, box)
+        self._fabric: deque = deque()
         # goodput accounting: page-stall slot-seconds apportioned out of
         # the step window by _step_all (stalled/considered share of each
         # step's wall) — read by _loop, only meaningful under the ledger
@@ -1346,6 +1478,64 @@ class DecodeScheduler:
         flight.note(req.ctx, "queued", depth=depth, model=self.name)
         self._wake.set()
 
+    def run_fabric(self, fn, timeout: float = 30.0):
+        """Run ``fn(engine)`` on the scheduler thread against the
+        admitting engine and return its result. The device pools are
+        donated by every compiled step, so any HTTP-thread work that
+        reads or writes them (page export for a disaggregated transfer,
+        shipment import) MUST marshal through here — the job executes
+        between ticks, never concurrently with a step. Raises the job's
+        own exception, or DeadlineExceededError if the loop never got
+        to it within `timeout`."""
+        if self._stop.is_set() or self._draining:
+            raise ServerDrainingError(
+                f"decode[{self.name}] is shutting down")
+        box: dict = {}
+        done = threading.Event()
+        with self._plock:
+            self._fabric.append((fn, done, box))
+        self._wake.set()
+        if not done.wait(timeout):
+            raise DeadlineExceededError(
+                f"decode[{self.name}]: fabric job did not run within "
+                f"{timeout}s (scheduler saturated or stopped)")
+        if "exc" in box:
+            raise box["exc"]
+        return box.get("res")
+
+    def _fabric_tick(self) -> bool:
+        """Drain queued fabric jobs on the scheduler thread. A job's
+        failure belongs to its submitting thread (delivered through the
+        box), never to the loop."""
+        if not self._fabric:
+            # unlocked empty-check on the common per-pass path: deque
+            # reads are atomic under the GIL, and a submit racing this
+            # pass sets _wake — the NEXT pass drains it. Skipping the
+            # lock keeps the fabric free for the two hot schedulers of
+            # an interference pair (no extra GIL handoff per pass)
+            return False
+        worked = False
+        while True:
+            with self._plock:
+                if not self._fabric:
+                    return worked
+                fn, done, box = self._fabric.popleft()
+            with self._rlock:
+                engine = self._runs[-1].engine \
+                    if self._runs and self._runs[-1].admitting else None
+            try:
+                if engine is None:
+                    raise ServerDrainingError(
+                        f"decode[{self.name}]: no admitting engine for "
+                        "fabric job")
+                box["res"] = fn(engine)
+            except Exception as e:  # noqa: BLE001 — surfaced to the
+                # submitting thread via the box; the scheduler loop
+                # must outlive any single job's corrupt shipment
+                box["exc"] = e
+            done.set()
+            worked = True
+
     def queue_state(self) -> Tuple[int, int]:
         with self._plock:
             return len(self._pending), self.queue_limit
@@ -1374,6 +1564,7 @@ class DecodeScheduler:
             t_pass = time.perf_counter() if gp else 0.0
             try:
                 worked = self._admit()
+                worked = self._fabric_tick() or worked
                 t_admitted = time.perf_counter() if gp else 0.0
                 stall0 = self._stall_s
                 worked = self._prefill_tick() or worked
@@ -1422,6 +1613,16 @@ class DecodeScheduler:
         self._fail_pending(crash if crash is not None
                            else ServerDrainingError(
                                f"decode[{self.name}] shut down"))
+        self._fail_fabric(exc)
+
+    def _fail_fabric(self, exc: Exception):
+        while True:
+            with self._plock:
+                if not self._fabric:
+                    return
+                _fn, done, box = self._fabric.popleft()
+            box["exc"] = exc
+            done.set()
 
     def _fail_pending(self, exc: Exception):
         while True:
@@ -1887,6 +2088,70 @@ class ServedLM:
             else time.monotonic() + float(deadline))
         self.scheduler.submit(req)
         return req
+
+    # ------------------------------------------------------------ kv fabric
+    def export_prefix(self, prompt, timeout: float = 30.0) -> bytes:
+        """Serialize the KV pages covering `prompt`'s full blocks into a
+        framed transfer blob (the prefill half of disaggregation). If the
+        prefix isn't cached yet, a one-token greedy generation prefills
+        and retains it first; the page reads are marshalled onto the
+        scheduler thread via run_fabric."""
+        if self.status == "stopping":
+            raise ServerDrainingError(f"decode[{self.name}] is draining")
+        prompt = np.asarray(prompt, np.int64).reshape(-1)
+        engine = self.scheduler.admitting_engine()
+        if engine is None:
+            raise ServerDrainingError(
+                f"decode[{self.name}]: no admitting engine")
+        if not engine.cfg.prefix_cache:
+            raise ValueError(
+                f"decode[{self.name}]: prefix cache disabled; nothing "
+                "to export")
+        ps = engine.cfg.page_size
+        full = (int(prompt.size) // ps) * ps
+        if full < ps:
+            raise ValueError(
+                f"prompt too short to export: {prompt.size} tokens "
+                f"< one {ps}-token page")
+        head = prompt[:full]
+        if engine.cache.cached_prefix_len(head) < full:
+            req = self.generate(head, max_new_tokens=1, temperature=0.0,
+                                deadline=timeout)
+            while True:
+                kind, payload = req.events.get(timeout=timeout)
+                if kind == "done":
+                    break
+                if kind == "error":
+                    raise payload
+        frames = self.scheduler.run_fabric(
+            lambda eng: eng.export_pages(head), timeout=timeout)
+        return kvfabric.pack_transfer(np.asarray(head, np.int32), frames,
+                                      ps)
+
+    def import_prefix(self, payload: bytes, timeout: float = 30.0) -> dict:
+        """Land a framed page transfer (produced by a prefill replica's
+        export_prefix) into this servable's prefix cache. Frame integrity
+        and geometry are verified before any pool write; a bad shipment
+        raises kvfabric.FrameError and leaves the cache untouched."""
+        if self.status == "stopping":
+            raise ServerDrainingError(f"decode[{self.name}] is draining")
+        tokens, frames, hdr = kvfabric.unpack_transfer(payload)
+        engine = self.scheduler.admitting_engine()
+        if engine is None:
+            raise ServerDrainingError(
+                f"decode[{self.name}]: no admitting engine")
+        if int(hdr["page_size"]) != int(engine.cfg.page_size):
+            raise kvfabric.FrameError(
+                f"transfer page_size {hdr['page_size']} != "
+                f"{engine.cfg.page_size} on decode[{self.name}]")
+        if not engine.cfg.prefix_cache:
+            raise ValueError(
+                f"decode[{self.name}]: prefix cache disabled; cannot "
+                "adopt pages")
+        adopted = self.scheduler.run_fabric(
+            lambda eng: eng.import_pages(tokens, frames), timeout=timeout)
+        return {"adopted": int(adopted), "pages": len(frames),
+                "tokens": int(np.asarray(tokens).size)}
 
     # ------------------------------------------------------------ lifecycle
     def _activate(self, sv, variant: Optional[str]):

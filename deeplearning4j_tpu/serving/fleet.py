@@ -90,7 +90,8 @@ class ReplicaSpec:
                  slo_availability: Optional[float] = None,
                  slo_p99_ms: Optional[float] = None,
                  slo_sample_interval_s: float = 5.0,
-                 slo_windows: Optional[str] = None):
+                 slo_windows: Optional[str] = None,
+                 kv_role: str = "mixed"):
         self.models = list(models)              # [(name, source), ...]
         self.buckets = tuple(int(b) for b in buckets)
         self.max_delay_ms = float(max_delay_ms)
@@ -121,6 +122,14 @@ class ReplicaSpec:
         self.slo_p99_ms = None if slo_p99_ms is None else float(slo_p99_ms)
         self.slo_sample_interval_s = float(slo_sample_interval_s)
         self.slo_windows = slo_windows
+        #: default KV-fabric disaggregation role for replicas built from
+        #: this spec; a factory may override per replica (replica.kv_role)
+        #: for mixed prefill/decode fleets
+        if kv_role not in ("prefill", "decode", "mixed"):
+            raise ValueError(
+                f'kv_role must be "prefill", "decode" or "mixed", '
+                f"got {kv_role!r}")
+        self.kv_role = kv_role
 
 
 class Replica:
@@ -141,6 +150,13 @@ class Replica:
         # /v1/fleet with the controller's decisions
         self.role = "stable"
         self.rollout_generation = 0
+        # KV-fabric state: the disaggregation role this replica serves
+        # under (spec default; factories override for split fleets) and
+        # the prefix-ownership advertisement its /readyz heartbeat last
+        # published ({model: {"block": N, "digests": [hex16...]}}) — the
+        # router's affinity pick reads both
+        self.kv_role = spec.kv_role if spec is not None else "mixed"
+        self.kv_ownership: dict = {}
         # scale-down bookkeeping (autoscaler): None until this replica is
         # chosen as a drain victim, then a dict tracking the drain steps
         self.scaledown: Optional[dict] = None
@@ -197,7 +213,10 @@ class Replica:
                "role": self.role,
                "rollout_generation": self.rollout_generation,
                "inflight": self.inflight(),
+               "kv_role": self.kv_role,
                "probe_failures": self.consecutive_probe_failures}
+        if self.kv_ownership:
+            doc["kv_ownership"] = self.kv_ownership
         scaledown = getattr(self, "scaledown", None)
         if scaledown is not None:
             doc["scaledown"] = dict(scaledown)
@@ -232,7 +251,8 @@ class InProcessReplica(Replica):
             enable_faults=self.spec.enable_faults,
             # own instance: wedging THIS replica must not wedge every
             # in-process sibling through the module singleton
-            faults=ServingFaults())
+            faults=ServingFaults(),
+            kv_role=self.kv_role)
         self.url = self._server.url
 
     def alive(self) -> bool:
@@ -312,6 +332,8 @@ class SubprocessReplica(Replica):
                          str(d.prefill_chunk_tokens)]
             if not d.prefix_cache:
                 argv.append("--no-prefix-cache")
+            if d.spill_pages:
+                argv += ["--kv-spill-pages", str(d.spill_pages)]
             if d.spec_draft is not None:
                 argv += ["--spec-draft", str(d.spec_draft),
                          "--spec-k", str(d.spec_k),
@@ -320,6 +342,8 @@ class SubprocessReplica(Replica):
                 if d.spec_draft_pool_pages is not None:
                     argv += ["--spec-draft-pool-pages",
                              str(d.spec_draft_pool_pages)]
+        if self.spec.lms and self.kv_role != "mixed":
+            argv += ["--kv-role", self.kv_role]
         if self.spec.enable_faults:
             argv.append("--enable-fault-injection")
         if self.spec.trace_out:
@@ -485,17 +509,31 @@ def _threaded_spawn(fn: Callable[[], None], name: str):
 
 
 def http_probe(replica: Replica, timeout: float) -> bool:
-    """Default probe: /healthz then /readyz, each 200 within `timeout`."""
+    """Default probe: /healthz then /readyz, each 200 within `timeout`.
+    The /readyz body doubles as the KV-fabric heartbeat: its kv_role and
+    kv_ownership fields are stashed on the replica handle so the router's
+    prefix-affinity pick always works from the latest advertisement."""
     if not replica.url:
         return False
+    body = b""
     for path in ("/healthz", "/readyz"):
         try:
             r = urllib.request.urlopen(replica.url + path, timeout=timeout)
             if r.status != 200:
                 return False
-            r.read()
+            body = r.read()
         except Exception:                     # noqa: BLE001 — any failure
             return False                      # (timeout, 5xx, conn refused)
+    try:
+        doc = json.loads(body)
+    except ValueError:
+        return True                           # pre-fabric replica: fine
+    if isinstance(doc, dict):
+        if doc.get("kv_role") in ("prefill", "decode", "mixed"):
+            replica.kv_role = doc["kv_role"]
+        own = doc.get("kv_ownership")
+        if isinstance(own, dict):
+            replica.kv_ownership = own
     return True
 
 

@@ -54,6 +54,7 @@ from deeplearning4j_tpu import monitor
 from deeplearning4j_tpu.monitor import flight
 from deeplearning4j_tpu.monitor import slo as slo_mod
 from deeplearning4j_tpu.monitor import timeseries as timeseries_mod
+from deeplearning4j_tpu.serving import kvfabric
 from deeplearning4j_tpu.serving.fleet import Replica
 from deeplearning4j_tpu.serving.server import (
     metrics_payload, retry_after_seconds, timeseries_doc,
@@ -255,7 +256,10 @@ class ResilientRouter:
                  rng: Optional[_random.Random] = None,
                  transport: Callable = http_transport,
                  slo_p99_ms: Optional[float] = None,
-                 canary_fraction: float = 0.1):
+                 canary_fraction: float = 0.1,
+                 affinity: bool = True,
+                 disagg_min_tokens: Optional[int] = None,
+                 disagg_timeout_s: float = 30.0):
         self._replicas_fn = replicas_fn
         # normalized to lowercase: _classify lowercases the header value,
         # so a class configured as "Interactive" must still match
@@ -309,6 +313,16 @@ class ResilientRouter:
             raise ValueError("canary_fraction must be in (0, 0.5], got "
                              f"{canary_fraction}")
         self.canary_fraction = float(canary_fraction)
+        #: prefix-affinity routing for generate: steer a stream toward
+        #: the replica advertising ownership of its leading token block
+        #: (p2c-guarded: a clearly less-loaded rival still wins)
+        self.affinity = bool(affinity)
+        #: prefill/decode disaggregation trigger: prompts of at least
+        #: this many tokens get their KV prefilled on a kv_role=prefill
+        #: replica and shipped to the decode replica; None disables
+        self.disagg_min_tokens = (None if disagg_min_tokens is None
+                                  else int(disagg_min_tokens))
+        self.disagg_timeout_s = float(disagg_timeout_s)
 
     # ------------------------------------------------------------- breakers
     def breaker(self, replica: Replica, model: str) -> CircuitBreaker:
@@ -730,6 +744,126 @@ class ResilientRouter:
                 retry_after=retry_after_seconds(1, 1, draining=True,
                                                 rng=self._rng))
 
+    # ------------------------------------------------------- kv fabric
+    @staticmethod
+    def _prompt_of(body: Optional[bytes]):
+        """Token ids of a generate body, or None when unparseable (the
+        fabric features degrade to plain routing, never reject)."""
+        try:
+            doc = json.loads(body or b"{}")
+        except (ValueError, TypeError):
+            return None
+        prompt = doc.get("prompt") if isinstance(doc, dict) else None
+        if isinstance(prompt, (list, tuple)) and prompt:
+            return list(prompt)
+        return None
+
+    def _affinity_pick(self, model: str, prompt,
+                       candidates: List[Replica]) -> Optional[Replica]:
+        """Prefix-affinity preference: the replica advertising ownership
+        of the prompt's leading page-aligned block (per its /readyz
+        heartbeat digest), guarded by power-of-two-choices — one random
+        rival with strictly lower in-flight still wins, so a hot prefix
+        cannot melt its owner. Ties break to the owner (the cache hit
+        is worth more than a one-request queue edge)."""
+        if not self.affinity or prompt is None or len(candidates) < 2:
+            return None
+        owners, dig_cache = [], {}
+        for r in candidates:
+            own = (getattr(r, "kv_ownership", None) or {}).get(model)
+            if not isinstance(own, dict):
+                continue
+            block = int(own.get("block") or 0)
+            if block < 1 or len(prompt) < block:
+                continue
+            if block not in dig_cache:
+                d = kvfabric.leading_digest(prompt, block)
+                dig_cache[block] = None if d is None else d.hex()[:16]
+            if dig_cache[block] is not None \
+                    and dig_cache[block] in (own.get("digests") or ()):
+                owners.append(r)
+        outcomes = monitor.counter(
+            "serving_router_affinity_requests_total",
+            "Generate routing decisions by the prefix-affinity pick "
+            "(owner = steered to the advertising replica, fallback = "
+            "p2c load guard overrode the owner, none = no replica "
+            "advertised the prefix)", labels=("model", "outcome"))
+        if not owners:
+            outcomes.inc(model=model, outcome="none")
+            return None
+        owner = owners[0] if len(owners) == 1 else self._pick(owners)
+        others = [r for r in candidates if r is not owner]
+        rival = self._rng.choice(others) if others else None
+        if rival is not None and rival.inflight() < owner.inflight():
+            outcomes.inc(model=model, outcome="fallback")
+            return rival
+        outcomes.inc(model=model, outcome="owner")
+        flight.note(monitor.current_context(), "affinity",
+                    replica=owner.name, model=model)
+        return owner
+
+    def _disagg_prefill(self, model: str, prompt,
+                        prefills: List[Replica],
+                        target: Replica) -> bool:
+        """Disaggregated prefill: export the prompt's KV pages from a
+        prefill replica, land them on `target` (the decode replica about
+        to take the stream). True on success; ANY failure — the prefill
+        replica dying mid-transfer included — is metered, postmortemed
+        with the dead peer's name, and answered False so the caller
+        falls back to local prefill. Never a 5xx of the router's
+        making."""
+        pre = prefills[0] if len(prefills) == 1 else self._pick(prefills)
+        t0 = time.perf_counter()
+        try:
+            with monitor.span("serving/disagg_transfer", model=model,
+                              prefill=pre.name, decode=target.name):
+                pre.inflight_add(1)
+                try:
+                    code, _, blob = self._transport(
+                        pre, f"/v1/models/{model}/kv/export",
+                        json.dumps({"prompt": prompt}).encode(),
+                        {"Content-Type": "application/json"},
+                        self.disagg_timeout_s)
+                finally:
+                    pre.inflight_add(-1)
+                if code != 200:
+                    raise ReplicaTransportError(
+                        f"{pre.name}: kv export answered {code}")
+                code, _, _out = self._transport(
+                    target, f"/v1/models/{model}/kv/import", blob,
+                    {"Content-Type": "application/octet-stream"},
+                    self.disagg_timeout_s)
+                if code != 200:
+                    raise ReplicaTransportError(
+                        f"{target.name}: kv import answered {code}")
+        except ReplicaTransportError as e:
+            monitor.counter(
+                "serving_transfer_failovers_total",
+                "Disaggregated prefills abandoned mid-transfer "
+                "(stream fell back to local prefill on the decode "
+                "replica)", labels=("model",)).inc(model=model)
+            flight.note(monitor.current_context(), "disagg_failover",
+                        model=model, peer=pre.name, error=str(e))
+            # the dead transfer peer is an SLO event: postmortem while
+            # the request evidence is still in the flight ring
+            flight.trip("transfer_peer_lost", model=model,
+                        peer=pre.name, decode=target.name,
+                        error=str(e))
+            log.warning("router: disaggregated prefill via %s failed "
+                        "(%s) — local prefill on %s", pre.name, e,
+                        target.name)
+            return False
+        monitor.counter(
+            "serving_transfer_orchestrations_total",
+            "Disaggregated prefill transfers completed by the router "
+            "(export from a prefill replica + import on the decode "
+            "replica)", labels=("model",)).inc(model=model)
+        flight.note(monitor.current_context(), "disagg_transfer",
+                    model=model, prefill=pre.name, decode=target.name,
+                    bytes=len(blob),
+                    ms=round((time.perf_counter() - t0) * 1e3, 2))
+        return True
+
     # ------------------------------------------------------------ streaming
     def route_generate(self, model: str, body: bytes,
                        headers: Dict[str, str],
@@ -806,6 +940,28 @@ class ResilientRouter:
             pool, preferred = self._canary_split(healthy, model)
             remaining = [r for r in pool
                          if self.breaker(r, model).would_allow()]
+            # ---- KV fabric: role split, prefix affinity, disaggregation
+            prefills = [r for r in healthy
+                        if getattr(r, "kv_role", "mixed") == "prefill"]
+            decode_pool = [r for r in remaining
+                           if getattr(r, "kv_role", "mixed") != "prefill"]
+            if decode_pool:
+                # prefill-only replicas take decode streams only when
+                # nothing else is routable: availability beats the split
+                remaining = decode_pool
+            prompt = self._prompt_of(body)
+            if preferred is None:
+                preferred = self._affinity_pick(model, prompt, remaining)
+            if (prefills and remaining and prompt is not None
+                    and self.disagg_min_tokens is not None
+                    and len(prompt) >= self.disagg_min_tokens):
+                target = preferred if preferred in remaining \
+                    else self._pick(remaining)
+                if self._disagg_prefill(model, prompt, prefills, target):
+                    # the shipped pages live on `target`: pin the stream
+                    # there (failover still covers a later death — the
+                    # fallback replica just prefills locally)
+                    preferred = target
             backpressure = None
             while remaining:
                 if preferred is not None and preferred in remaining:

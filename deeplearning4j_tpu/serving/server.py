@@ -66,6 +66,7 @@ from deeplearning4j_tpu.monitor import flight, slo, timeseries
 from deeplearning4j_tpu.serving.batcher import (
     DeadlineExceededError, ServerDrainingError, ServerOverloadedError,
 )
+from deeplearning4j_tpu.serving import kvfabric
 from deeplearning4j_tpu.serving.registry import ModelLoadError, ModelRegistry
 from deeplearning4j_tpu.util import faults as fault_util
 
@@ -221,7 +222,13 @@ class _Handler(BaseHTTPRequestHandler):
                             "models": self._srv.registry.names(),
                             "role": self._srv.role,
                             "rollout_generation":
-                                self._srv.rollout_generation})
+                                self._srv.rollout_generation,
+                            # KV-fabric publication: disaggregation role
+                            # + per-LM leading-block ownership digests,
+                            # consumed by the fleet probe for
+                            # prefix-affinity routing
+                            "kv_role": self._srv.kv_role,
+                            "kv_ownership": self._srv.kv_ownership()})
             else:
                 self._json({"status": "draining"
                             if self._srv.draining else "loading"}, code=503,
@@ -277,6 +284,10 @@ class _Handler(BaseHTTPRequestHandler):
             if verb in ("swap", "rollback"):
                 self._admin(name, verb)
                 return
+        if parts[:2] == ["v1", "models"] and len(parts) == 5 \
+                and parts[3] == "kv" and parts[4] in ("export", "import"):
+            self._kv(parts[2], parts[4])
+            return
         if url.path == "/v1/rollout/role":
             # rollout control surface: the fleet's RolloutController (or
             # SubprocessReplica.set_role relaying for it) marks this
@@ -641,6 +652,102 @@ class _Handler(BaseHTTPRequestHandler):
         finally:
             self._meter(name, code, t0)
 
+    # ----------------------------------------------------------- kv fabric
+    def _kv(self, name: str, verb: str):
+        """POST /v1/models/{name}/kv/export — JSON {"prompt": [ids...]}
+        answered with the framed page-transfer blob (octet-stream);
+        POST /v1/models/{name}/kv/import — a blob produced by export,
+        landed into this replica's prefix cache. The disaggregation wire:
+        a prefill replica answers export, the decode replica's import
+        adopts the pages, and the subsequent generate is a prefix-cache
+        hit. Corrupt/truncated frames map to a clean 400 — never a
+        scheduler-thread death (kvfabric verifies before any pool
+        write)."""
+        t0 = time.perf_counter()
+        ctx = self._ingress()
+        served = self._srv.registry.get(name)
+        if served is None:
+            if self._srv.draining:
+                self._meter(name, 503, t0)
+                self._json({"error": "server draining"}, code=503,
+                           extra=(("Retry-After", self._srv.retry_after()),))
+                return
+            self._meter(name, 404, t0)
+            self._json({"error": f"unknown model {name!r}"}, code=404)
+            return
+        code = 500
+        nbytes = 0
+        try:
+            if not hasattr(served, "export_prefix"):
+                raise ValueError(
+                    f"model {name!r} is a predict servable; the KV "
+                    "fabric needs an LM deployed via --lm / deploy_lm")
+            with monitor.bind_context(ctx), \
+                    monitor.span(f"serving/kv_{verb}", model=name):
+                if verb == "export":
+                    payload = json.loads(self._body() or b"{}")
+                    if not isinstance(payload, dict) \
+                            or "prompt" not in payload:
+                        raise ValueError(
+                            'JSON body must be {"prompt": [ids...]}')
+                    blob = served.export_prefix(payload["prompt"])
+                    nbytes = len(blob)
+                    code = 200
+                    self._reply(200, blob, "application/octet-stream")
+                else:
+                    body = self._body()
+                    nbytes = len(body)
+                    info = served.import_prefix(body)
+                    code = 200
+                    self._json(dict(info, model=name))
+        except ServerOverloadedError as e:
+            code = 429
+            self._json({"error": str(e)}, code=429,
+                       extra=(("Retry-After",
+                               self._srv.retry_after(served)),))
+        except DeadlineExceededError as e:
+            code = 504
+            self._json({"error": str(e)}, code=504)
+        except ServerDrainingError as e:
+            code = 503
+            self._json({"error": str(e)}, code=503,
+                       extra=(("Retry-After",
+                               self._srv.retry_after(served)),))
+        except (ValueError, TypeError) as e:
+            # kvfabric.FrameError subclasses ValueError: a corrupt or
+            # mismatched shipment is the sender's fault, not ours
+            code = 400
+            self._json({"error": f"{type(e).__name__}: {e}"}, code=400)
+        except Exception as e:          # noqa: BLE001 — never a traceback
+            code = 500
+            log.exception("serving[%s]: kv %s failed", name, verb)
+            self._json({"error": f"{type(e).__name__}: {e}"}, code=500)
+            flight.trip("http_5xx", model=name, verb=f"kv_{verb}",
+                        error=type(e).__name__,
+                        trace_id=None if ctx is None else ctx.trace_id)
+        finally:
+            outcome = "ok" if code == 200 else (
+                "rejected" if code == 400 else "error")
+            monitor.counter(
+                "serving_transfer_requests_total",
+                "KV page-transfer requests by direction and outcome",
+                labels=("model", "direction", "outcome")).inc(
+                model=name, direction=verb, outcome=outcome)
+            if nbytes:
+                monitor.counter(
+                    "serving_transfer_bytes_total",
+                    "Serialized KV page bytes moved over the fabric",
+                    labels=("model", "direction")).inc(
+                    nbytes, model=name, direction=verb)
+            monitor.histogram(
+                "serving_transfer_seconds",
+                "KV page transfer handling latency",
+                labels=("model", "direction"),
+                buckets=(0.005, 0.025, 0.1, 0.25, 1, 2.5, 10, 30)
+            ).observe(time.perf_counter() - t0, model=name,
+                      direction=verb)
+            self._meter(name, code, t0)
+
 
 class ModelServer:
     """HTTP front end over a ModelRegistry.
@@ -659,7 +766,8 @@ class ModelServer:
                  enable_faults: bool = False,
                  retry_jitter: Optional[random.Random] = None,
                  faults: Optional[fault_util.ServingFaults] = None,
-                 slo_engine=None, timeseries_ring=None):
+                 slo_engine=None, timeseries_ring=None,
+                 kv_role: str = "mixed"):
         self.registry = registry if registry is not None else ModelRegistry()
         self.default_deadline = float(default_deadline_s)
         self.enable_faults = bool(enable_faults)
@@ -682,6 +790,14 @@ class ModelServer:
         # replica is under canary evaluation
         self.role = "stable"
         self.rollout_generation = 0
+        # KV-fabric disaggregation role: "prefill" replicas compute KV
+        # for long prompts and ship pages, "decode" replicas only serve
+        # generation, "mixed" (default) does both — published on /readyz
+        if kv_role not in ("prefill", "decode", "mixed"):
+            raise ValueError(
+                f'kv_role must be "prefill", "decode" or "mixed", '
+                f"got {kv_role!r}")
+        self.kv_role = kv_role
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.model_server = self          # type: ignore[attr-defined]
         self.host = host
@@ -697,6 +813,24 @@ class ModelServer:
 
     def ready(self) -> bool:
         return not self.draining and self.registry.all_ready()
+
+    def kv_ownership(self) -> dict:
+        """Per-LM prefix-ownership advertisement for /readyz: the block
+        size plus the leading-block digests this replica can serve warm
+        (HBM-resident or spill-tier). The fleet probe stashes this on
+        the replica handle; the router's affinity pick consumes it."""
+        own = {}
+        for name in self.registry.names():
+            served = self.registry.get(name)
+            sched = getattr(served, "scheduler", None)
+            if sched is None:
+                continue
+            engine = sched.admitting_engine()
+            if engine is None or not engine.cfg.prefix_cache:
+                continue
+            own[name] = {"block": int(engine.cfg.page_size),
+                         "digests": engine.cache.ownership_digests()}
+        return own
 
     @staticmethod
     def _queue_state(served):

@@ -1,0 +1,530 @@
+"""Tiered KV fabric tests (serving/kvfabric.py + the spill/transfer
+surgery in kvcache.py, decode.py, server.py).
+
+The load-bearing ones are the greedy-parity trio (local prefill, spill
+promote-on-hit, and remote export->import must produce IDENTICAL
+tokens) and test_eviction_demotes_before_unindexing — the ordering
+contract that makes the host tier a cache and never a data-loss window.
+"""
+import hashlib
+import json
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import monitor
+from deeplearning4j_tpu.serving import kvfabric
+from deeplearning4j_tpu.serving.decode import (
+    DecodeConfig, ServedLM, ServerDrainingError,
+)
+from deeplearning4j_tpu.serving.kvcache import KVCacheState
+from deeplearning4j_tpu.serving.kvfabric import (
+    DIGEST_SEED, FrameError, HostPageStore, chain_digests, check_frame,
+    frame_capacity, leading_digest, pack_page, pack_transfer, unpack_page,
+    unpack_transfer,
+)
+from deeplearning4j_tpu.serving.registry import load_servable
+
+ZOO_SRC = ("zoo:TransformerLM?vocab_size=48&n_layers=1&n_embd=32"
+           "&n_heads=4&seq_length=32")
+
+
+def _counter(name, **labels):
+    return monitor.counter(name, "x",
+                           labels=tuple(labels)).value(**labels)
+
+
+# =========================================================== digests
+def test_chain_digests_identify_prefix_paths():
+    keys = [b"aaaa", b"bbbb", b"cccc"]
+    digs = chain_digests(keys)
+    assert len(digs) == 3 and len(set(digs)) == 3
+    # chained: block i's digest commits to every block before it
+    assert digs[0] == hashlib.sha256(DIGEST_SEED + b"aaaa").digest()
+    assert digs[1] == hashlib.sha256(digs[0] + b"bbbb").digest()
+    # the same block under a different predecessor is a DIFFERENT entry
+    assert chain_digests([b"xxxx", b"bbbb"])[1] != digs[1]
+
+
+def test_leading_digest_is_the_block_key_convention():
+    t = list(range(10))
+    d = leading_digest(t, 4)
+    assert d == chain_digests(
+        [np.asarray(t[:4], np.int32).tobytes()])[0]
+    # prompts shorter than one page own nothing
+    assert leading_digest([1, 2, 3], 4) is None
+    # the digest covers exactly the first page
+    assert leading_digest(t[:4] + [99, 98], 4) == d
+
+
+# ============================================== per-page frame serde
+def _rand(dtype, shape=(1, 4, 2, 3), seed=7):
+    rng = np.random.default_rng(seed)
+    if np.dtype(dtype) == np.int8:
+        return rng.integers(-128, 127, shape, dtype=np.int8)
+    return rng.standard_normal(shape, dtype=np.float32).astype(dtype)
+
+
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16", "int8"])
+def test_page_frame_roundtrip_bitwise(dtype):
+    if dtype == "bfloat16":
+        ml_dtypes = pytest.importorskip("ml_dtypes")
+        dtype = ml_dtypes.bfloat16
+    k, v = _rand(dtype, seed=1), _rand(dtype, seed=2)
+    digest = hashlib.sha256(b"page-0").digest()
+    frame = pack_page(k, v, digest)
+    k2, v2, hdr = unpack_page(frame, expect_digest=digest)
+    assert k2.dtype == k.dtype and k2.shape == k.shape
+    assert k2.tobytes() == k.tobytes()        # bitwise, not allclose
+    assert v2.tobytes() == v.tobytes()
+    assert hdr["v"] == kvfabric.VERSION
+    # prefix-path mismatch is a hard reject (wrong cache entry)
+    with pytest.raises(FrameError):
+        unpack_page(frame, expect_digest=hashlib.sha256(b"x").digest())
+
+
+def test_page_frame_rejects_every_corruption():
+    """Fuzz-ish sweep: EVERY single-byte flip and every truncation of a
+    frame must raise FrameError — never a crash, never silent garbage."""
+    k, v = _rand(np.float32, (1, 2, 2, 2)), _rand(np.float32, (1, 2, 2, 2))
+    frame = pack_page(k, v, hashlib.sha256(b"d").digest())
+    for i in range(len(frame)):
+        bad = bytearray(frame)
+        bad[i] ^= 0xFF
+        with pytest.raises(FrameError):
+            unpack_page(bytes(bad))
+        with pytest.raises(FrameError):
+            check_frame(bytes(bad))
+    for n in range(len(frame)):
+        with pytest.raises(FrameError):
+            unpack_page(frame[:n])
+    # version from the future: clean reject, not a parse attempt
+    fut = bytearray(frame)
+    fut[4] = 99
+    with pytest.raises(FrameError):
+        unpack_page(bytes(fut))
+
+
+def test_transfer_roundtrip_and_wire_rejections():
+    ps = 4
+    toks = np.arange(8, dtype=np.int32)
+    digs = chain_digests([toks[:4].tobytes(), toks[4:].tobytes()])
+    frames = [pack_page(_rand(np.float32, seed=i), _rand(np.float32,
+                                                         seed=i + 9), d)
+              for i, d in enumerate(digs)]
+    blob = pack_transfer(toks, frames, ps)
+    t2, f2, hdr = unpack_transfer(blob)
+    assert t2.tolist() == toks.tolist() and f2 == frames
+    assert hdr["page_size"] == ps and hdr["n_frames"] == 2
+    # geometry mismatch at pack time is a caller bug, not a FrameError
+    with pytest.raises(ValueError):
+        pack_transfer(toks[:7], frames, ps)
+    # every single-byte flip anywhere in the shipment is caught: the
+    # envelope head by its sha, every frame by its own trailer
+    for i in range(len(blob)):
+        bad = bytearray(blob)
+        bad[i] ^= 0xFF
+        with pytest.raises(FrameError):
+            unpack_transfer(bytes(bad))
+    # truncations (sampled: every boundary region matters, steps keep
+    # the sweep cheap) — includes mid-frame kill-the-sender cuts
+    for n in range(0, len(blob), 7):
+        with pytest.raises(FrameError):
+            unpack_transfer(blob[:n])
+
+
+def test_frame_capacity_bounds_real_frames():
+    shape = (2, 8, 4, 16)
+    cap = frame_capacity(*shape, np.float32)
+    k, v = _rand(np.float32, shape), _rand(np.float32, shape)
+    frame = pack_page(k, v, hashlib.sha256(b"cap").digest())
+    assert len(frame) <= cap
+
+
+# ======================================================== host store
+def test_host_store_lru_eviction_and_demotion_metering():
+    clock = [0.0]
+
+    def tick():
+        clock[0] += 1.0
+        return clock[0]
+
+    st = HostPageStore(2, 64, name="hs-lru", time_fn=tick)
+    try:
+        ka, kb, kc = (hashlib.sha256(x).digest() for x in
+                      (b"a", b"b", b"c"))
+        ev0 = _counter("serving_kv_spill_evictions_total", model="hs-lru")
+        assert st.put(ka, b"A" * 10) and st.put(kb, b"B" * 20)
+        assert len(st) == 2 and st.describe()["bytes_used"] == 30
+        # get() is an MRU touch: a now makes b the LRU victim
+        assert st.get(ka) == b"A" * 10
+        assert st.put(kc, b"C" * 5)
+        assert not st.contains(kb) and st.contains(ka)
+        assert _counter("serving_kv_spill_evictions_total",
+                        model="hs-lru") == ev0 + 1
+        assert st.keys() == [kc, ka]          # MRU first
+        # oversize frames are metered rejects, never exceptions
+        rj0 = _counter("serving_kv_spill_rejects_total", model="hs-lru")
+        assert not st.put(kb, b"X" * 65)
+        assert _counter("serving_kv_spill_rejects_total",
+                        model="hs-lru") == rj0 + 1
+        # the fake clock drove deterministic put stamps
+        assert st._last_put_at[kc] == 3.0
+        st.drop(kc)
+        assert not st.contains(kc) and len(st) == 1
+    finally:
+        st.close()
+    assert st.get(ka) is None                 # closed = empty
+
+
+def test_host_store_rewrite_same_key_reuses_slot():
+    st = HostPageStore(1, 32, name="hs-rw")
+    try:
+        k = hashlib.sha256(b"k").digest()
+        assert st.put(k, b"one") and st.put(k, b"two-longer")
+        assert st.get(k) == b"two-longer"
+        assert st.describe()["bytes_used"] == len(b"two-longer")
+        assert len(st) == 1
+    finally:
+        st.close()
+
+
+# ==================== eviction order: demote BEFORE unindex (the fix)
+class _OrderAssertingStore(HostPageStore):
+    """A spill store whose put() asserts the demotion-ordering contract
+    at the exact moment it runs: the HBM page being demoted must STILL
+    be indexed (in _by_page) and must NOT be on the free list — i.e.
+    the host copy becomes durable before the HBM copy is released."""
+
+    def __init__(self, cache, *a, **kw):
+        super().__init__(*a, **kw)
+        self.cache = cache
+        self.order_checks = 0
+
+    def put(self, key, payload):
+        c = self.cache
+        node = next((n for n in c._by_page.values()
+                     if n.digest == key), None)
+        assert node is not None, \
+            "demotion ran AFTER the page was unindexed"
+        assert node.page not in c._free_pages, \
+            "demotion ran AFTER the page was freed"
+        self.order_checks += 1
+        return super().put(key, payload)
+
+
+def test_eviction_demotes_before_unindexing():
+    """Deterministic (fake-clock, fake device) pin on the ordering fix:
+    pressure-evicting a retained prefix writes the durable host copy
+    FIRST, and only then unindexes + frees the HBM page. A promote-on-
+    hit admission then recovers the full prefix from the host tier."""
+    clock = [0.0]
+
+    def tick():
+        clock[0] += 1.0
+        return clock[0]
+
+    c = KVCacheState(slots=2, page_size=4, max_context=16, pool_pages=5,
+                     name="evt")                  # 4 usable + dump page
+    landed = []
+    store = _OrderAssertingStore(
+        c, 8, 64, name="evt", time_fn=tick)
+    try:
+        c.attach_spill(
+            store,
+            lambda page, digest: b"frame:%d:" % page + digest[:8],
+            lambda page, payload, digest: landed.append((page, payload)))
+        t = np.arange(8, dtype=np.int32)          # 2 full blocks
+        a = c.admit_prompt(t)
+        assert a is not None and a.cached_len == 0
+        c.register_prefix(a.slot, t)
+        c.release(a.slot)
+        assert c.retained_pages() == 2
+        # pool pressure: 4 pages wanted, 2 free -> evict both retained
+        # entries; every put() call re-asserted the ordering contract
+        b = c.admit(16)
+        assert b is not None
+        assert store.order_checks == 2 and len(store) == 2
+        assert store._last_put_at                 # fake clock stamped
+        c.release(b)
+        # promote-on-hit: the same prompt comes back; both blocks land
+        # from the host tier (no recompute), ref-pinned then mapped
+        pr0 = _counter("serving_kv_spill_promotions_total", model="evt")
+        h0 = _counter("serving_kv_spill_hits_total", model="evt")
+        a2 = c.admit_prompt(t)
+        assert a2 is not None
+        # fully-covered prompt: last token recomputes (COW), rest cached
+        assert a2.cached_len == 7 and a2.cow_src is not None
+        assert len(landed) == 2
+        assert _counter("serving_kv_spill_promotions_total",
+                        model="evt") == pr0 + 2
+        assert _counter("serving_kv_spill_hits_total",
+                        model="evt") == h0 + 1
+        c.release(a2.slot)
+    finally:
+        store.close()
+
+
+def test_promotion_failure_degrades_to_miss():
+    """A corrupt host frame (land_fn raises) must degrade to a cache
+    miss — dropped from the store, admission still succeeds."""
+    c = KVCacheState(slots=2, page_size=4, max_context=16, name="bad")
+    store = HostPageStore(4, 64, name="bad")
+
+    def bad_land(page, payload, digest):
+        raise FrameError("host frame rotted")
+
+    try:
+        c.attach_spill(store, lambda p, d: b"x", bad_land)
+        t = np.arange(4, dtype=np.int32)
+        a = c.admit_prompt(t)
+        c.register_prefix(a.slot, t)
+        # place the block's digest in the host tier by hand, then drop
+        # the HBM copy so the next admission must promote
+        node = next(iter(c._by_page.values()))
+        store.put(node.digest, b"frame")
+        c.release(a.slot)
+        c._drop_subtree_locked(node)              # evict (demote fails
+        #                                           too: extract is fake)
+        a2 = c.admit_prompt(t)                    # probes, land raises
+        assert a2 is not None and a2.cached_len == 0
+        assert not store.contains(node.digest)    # corrupt frame dropped
+        c.release(a2.slot)
+    finally:
+        store.close()
+
+
+# ================================== engine-level: the parity trio
+@pytest.fixture(scope="module")
+def spill_lm():
+    """Spill-enabled LM with a pool small enough that two long prompts
+    cannot both stay retained — the second evicts (demotes) the first."""
+    lm = ServedLM("spill-lm", load_servable(ZOO_SRC), ZOO_SRC,
+                  decode=DecodeConfig(slots=2, page_size=8, pool_pages=8,
+                                      spill_pages=8))
+    yield lm
+    lm.shutdown(drain=False, timeout=5)
+
+
+@pytest.fixture(scope="module")
+def importer_lm():
+    """Same weights, separate process-local replica: the decode side of
+    a disaggregated transfer."""
+    lm = ServedLM("importer-lm", load_servable(ZOO_SRC), ZOO_SRC,
+                  decode=DecodeConfig(slots=2, page_size=8,
+                                      spill_pages=4))
+    yield lm
+    lm.shutdown(drain=False, timeout=5)
+
+
+def _greedy(lm, prompt, n=6):
+    req = lm.generate(prompt, max_new_tokens=n, temperature=0.0)
+    toks, done = [], None
+    while done is None:
+        kind, payload = req.events.get(timeout=60)
+        if kind == "token":
+            toks.append(int(payload))
+        elif kind == "error":
+            raise payload
+        else:
+            done = payload
+    return toks, done
+
+
+def test_greedy_parity_local_spill_and_remote(spill_lm, importer_lm):
+    """THE fabric acceptance test: one prompt, three KV provenances —
+    local prefill, promote-on-hit from the host spill tier, and remote
+    pages shipped through export->import — EXACTLY the same greedy
+    tokens."""
+    prompt = list(range(1, 17))                   # 2 full pages of 8
+    other = list(range(30, 46))                   # distinct, same size
+
+    local, d0 = _greedy(spill_lm, prompt)
+    assert d0.get("cached_tokens", 0) == 0        # cold: local prefill
+
+    # pressure the pool until the first prompt's retained pages demote
+    # to the host tier (pool_pages=8 -> 7 usable; each stream peaks at
+    # 3 pages, so the three other-prompt passes evict prompt's pages)
+    dem0 = _counter("serving_kv_spill_demotions_total", model="spill-lm")
+    for fill in (other, [5, 6] + other[2:], [9, 8] + other[2:]):
+        _greedy(spill_lm, fill)
+    assert _counter("serving_kv_spill_demotions_total",
+                    model="spill-lm") > dem0
+
+    pr0 = _counter("serving_kv_spill_promotions_total", model="spill-lm")
+    hot, d1 = _greedy(spill_lm, prompt)
+    assert hot == local                           # parity: spill path
+    if _counter("serving_kv_spill_promotions_total",
+                model="spill-lm") > pr0:
+        # promote-on-hit engaged: the prefix came back from host RAM
+        assert d1.get("cached_tokens", 0) > 0
+
+    # remote: serialize the pages out of spill-lm, land them in the
+    # importer, and decode there — still the same tokens
+    blob = spill_lm.export_prefix(prompt)
+    assert unpack_transfer(blob)[2]["n_frames"] == 2
+    res = importer_lm.import_prefix(blob)
+    assert res["adopted"] == 2 and res["tokens"] == 16
+    remote, d2 = _greedy(importer_lm, prompt)
+    assert remote == local                        # parity: remote path
+    assert d2.get("cached_tokens", 0) >= 8        # adopted pages hit
+    # idempotent: re-importing the same shipment adopts nothing new
+    assert importer_lm.import_prefix(blob)["adopted"] == 0
+
+
+def test_export_prefix_validates_input(spill_lm):
+    with pytest.raises(ValueError):
+        spill_lm.export_prefix([1, 2, 3])         # < one full page
+
+
+def test_import_corrupt_payload_is_a_clean_400_class_error(importer_lm):
+    """A corrupt shipment raises FrameError in the CALLER — the
+    scheduler thread survives and keeps serving."""
+    blob = spill_lm_export = importer_lm.export_prefix(
+        list(range(1, 17)))
+    for cut in (blob[:25], b"junk" + blob[4:]):
+        with pytest.raises(FrameError):
+            importer_lm.import_prefix(cut)
+    bad = bytearray(spill_lm_export)
+    bad[-40] ^= 0xFF                              # inside the last frame
+    with pytest.raises(FrameError):
+        importer_lm.import_prefix(bytes(bad))
+    toks, _ = _greedy(importer_lm, [7, 7, 7])     # still alive
+    assert len(toks) == 6
+
+
+def test_fabric_jobs_propagate_errors_without_killing_scheduler(
+        spill_lm):
+    class Boom(RuntimeError):
+        pass
+
+    def job(engine):
+        raise Boom("fabric job failed")
+
+    with pytest.raises(Boom):
+        spill_lm.scheduler.run_fabric(job)
+    assert spill_lm.scheduler.run_fabric(
+        lambda eng: eng.cfg.page_size) == 8        # thread still turning
+
+
+def test_warm_ledger_covers_fabric_programs(spill_lm, importer_lm):
+    """AOT contract holds through spill + transfer traffic: every
+    compile (kv_extract/kv_land included) happened inside warmup."""
+    def fam_sum(family, model):
+        total = 0.0
+        for line in monitor.prometheus_text().splitlines():
+            if line.startswith(family + "{") \
+                    and f'model="{model}"' in line:
+                total += float(line.rsplit(" ", 1)[1])
+        return total
+
+    for name in ("spill-lm", "importer-lm"):
+        compiles = fam_sum("serving_decode_compiles_total", name)
+        warmups = fam_sum("serving_decode_warmup_runs_total", name)
+        assert compiles and compiles == warmups, \
+            f"{name}: {compiles} compiles vs {warmups} warmup runs"
+        # the fabric page programs are in the ledger by name
+        text = monitor.prometheus_text()
+        for prog in ("kv_extract", "kv_land"):
+            assert (f'serving_decode_compiles_total{{model="{name}",'
+                    f'program="{prog}"}}') in text, (name, prog)
+
+
+def test_engine_export_transfer_shape(spill_lm):
+    """export_prefix produces a version-tagged envelope whose header
+    round-trips through JSON (wire-debuggability contract)."""
+    blob = spill_lm.export_prefix(list(range(1, 17)))
+    tokens, frames, hdr = unpack_transfer(blob)
+    assert hdr["v"] == kvfabric.VERSION
+    assert json.loads(json.dumps(hdr)) == hdr
+    for fr, dig in zip(frames, chain_digests(
+            [np.asarray(tokens[:8], np.int32).tobytes(),
+             np.asarray(tokens[8:], np.int32).tobytes()])):
+        k, v, fh = unpack_page(fr, expect_digest=dig)
+        assert k.shape == v.shape and k.shape[1] == 8
+
+
+# ===================================== router: affinity + disagg unit
+def _fake_replicas(n):
+    from deeplearning4j_tpu.serving.fleet import Replica
+    reps = []
+    for i in range(n):
+        r = Replica(f"r{i}")
+        r.state = "ready"
+        r.url = f"http://fake-{i}"
+        reps.append(r)
+    return reps
+
+
+def _fake_router(reps, **kw):
+    import random
+
+    from deeplearning4j_tpu.serving.router import ResilientRouter
+    kw.setdefault("hedge", False)
+    kw.setdefault("rng", random.Random(0))
+    return ResilientRouter(lambda: [r for r in reps
+                                    if r.state == "ready"], **kw)
+
+
+def test_affinity_pick_owner_fallback_and_none():
+    reps = _fake_replicas(3)
+    prompt = list(range(8))
+    d16 = leading_digest(prompt, 4).hex()[:16]
+    reps[1].kv_ownership = {"m": {"block": 4, "digests": [d16]}}
+    router = _fake_router(reps)
+    # the advertising replica wins (ties break to the owner)
+    assert router._affinity_pick("m", prompt, reps) is reps[1]
+    # load guard: a strictly-less-loaded rival overrides the owner
+    reps[1].inflight_add(3)
+    got = router._affinity_pick("m", prompt, reps)
+    assert got is not None and got is not reps[1]
+    reps[1].inflight_add(-3)
+    # nobody advertises this prefix -> no preference (p2c decides)
+    assert router._affinity_pick("m", [99] * 8, reps) is None
+    # sub-block prompts own nothing
+    assert router._affinity_pick("m", [1, 2], reps) is None
+    assert _fake_router(reps, affinity=False)._affinity_pick(
+        "m", prompt, reps) is None
+
+
+def test_disagg_prefill_failover_meters_the_dead_peer():
+    from deeplearning4j_tpu.serving.router import ReplicaTransportError
+    reps = _fake_replicas(3)
+    pre, target = reps[0], reps[2]
+    calls = []
+
+    def dead_transport(replica, path, body, headers, timeout):
+        calls.append((replica.name, path))
+        raise ReplicaTransportError(f"{replica.name}: connection refused")
+
+    router = _fake_router(reps, transport=dead_transport)
+    f0 = _counter("serving_transfer_failovers_total", model="m")
+    assert router._disagg_prefill("m", list(range(8)), [pre],
+                                  target) is False
+    assert _counter("serving_transfer_failovers_total",
+                    model="m") == f0 + 1
+    assert calls == [("r0", "/v1/models/m/kv/export")]
+    assert pre.inflight() == 0                    # export leg unwound
+
+    def ok_transport(replica, path, body, headers, timeout):
+        if path.endswith("/kv/export"):
+            return 200, {}, b"BLOB"
+        assert body == b"BLOB"
+        return 200, {}, b"{}"
+
+    router2 = _fake_router(reps, transport=ok_transport)
+    o0 = _counter("serving_transfer_orchestrations_total", model="m")
+    assert router2._disagg_prefill("m", list(range(8)), [pre],
+                                   target) is True
+    assert _counter("serving_transfer_orchestrations_total",
+                    model="m") == o0 + 1
+
+
+def test_run_fabric_rejects_when_draining():
+    lm = ServedLM("drain-lm", load_servable(ZOO_SRC), ZOO_SRC,
+                  decode=DecodeConfig(slots=2, page_size=8))
+    lm.shutdown(drain=False, timeout=5)
+    with pytest.raises(ServerDrainingError):
+        lm.scheduler.run_fabric(lambda eng: None)
+    with pytest.raises(ServerDrainingError):
+        lm.export_prefix(list(range(8)))

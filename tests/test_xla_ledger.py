@@ -351,6 +351,35 @@ def test_perf_report_calibration_normalizes_host_drift(tmp_path):
     assert reg[0]["delta_pct"] == -30.0
 
 
+def test_perf_report_calibration_excuses_never_convicts(tmp_path):
+    """The matmul reference tracks compute speed, not dispatch overhead:
+    a faster-calib host must not manufacture a regression out of a
+    series whose RAW numbers held steady. Conviction requires the raw
+    delta to exceed the threshold too."""
+    (tmp_path / "DECODE_r01.json").write_text(
+        json.dumps(_decode_round(2000.0, 5.0, calib_ms=40.0)))
+    # host calib halved (2x faster matmul) but the code's raw numbers
+    # are unchanged — normalized this looks like -50% throughput / 2x
+    # latency, yet nothing actually regressed
+    (tmp_path / "DECODE_r02.json").write_text(
+        json.dumps(_decode_round(2000.0, 5.0, calib_ms=20.0)))
+    r = _run_perf_report(tmp_path)
+    assert r.returncode == 0, r.stdout + r.stderr
+    report = json.loads(r.stdout)
+    assert report["ok"]
+    for rec in report["comparisons"]:
+        assert rec["calibration"]["raw_delta_pct"] == 0.0
+        assert not rec["regressed"]
+    # a genuine raw regression on the same faster host still trips
+    (tmp_path / "DECODE_r02.json").write_text(
+        json.dumps(_decode_round(1200.0, 5.0, calib_ms=20.0)))
+    r = _run_perf_report(tmp_path)
+    assert r.returncode == 2, r.stdout + r.stderr
+    reg = json.loads(r.stdout)["regressions"]
+    assert [x["series"]["metric"] for x in reg] == ["decode_tokens_sec"]
+    assert reg[0]["calibration"]["raw_delta_pct"] == -40.0
+
+
 def test_perf_report_skips_uncalibrated_baselines(tmp_path):
     """A calibrated latest cannot be fairly judged by pre-calibration
     rounds: those are excluded and the series reports as skipped rather

@@ -36,7 +36,20 @@ In one process (CI-friendly, CPU, no network egress):
    token streams must be EXACTLY equal, the acceptance rate must clear
    0.5, per-stream mean ITL p99 must improve, and the compile ledger
    must still balance with the draft/verify programs live;
-8. banks a bench-style ``sweep`` with the decode throughput/latency row
+8. exercises the tiered KV fabric's host-RAM spill tier on a servable
+   with a deliberately tight HBM pool: distinct long prompts force
+   zero-ref retained prefixes to demote to the pinned host store, then
+   re-driving the first prompt must promote its pages back (spill hit)
+   and reproduce the EXACT greedy tokens of the cold pass — the banked
+   ``decode_spill_hit_rate`` is the admission hit fraction;
+9. stands up a real 2-replica in-process fleet and runs the
+   prefix-affinity A/B: two routers over the SAME fleet (affinity on vs
+   off), disjoint page-aligned shared-prefix sets per arm, ownership
+   refreshed via the /readyz heartbeat between the cold and measured
+   passes — affinity steering must beat random (p2c) routing on
+   repeat-prefix TTFT p99, and one serve_loadgen --prefix-mix pass
+   through the affinity router banks the per-replica cache-hit split;
+10. banks a bench-style ``sweep`` with the decode throughput/latency row
    (``decode_tokens_sec``, ``decode_ttft_p99_ms``, ``decode_itl_p99_ms``),
    the prefix-cache row (``decode_cache_hit_rate``,
    ``decode_ttft_hot_p99_ms``, ``decode_ttft_cold_p99_ms``), the
@@ -80,6 +93,46 @@ def _metric_sum(metrics_text: str, family: str) -> float:
 def _p99_ms(samples) -> float:
     from serve_loadgen import percentile
     return round((percentile(sorted(samples), 99) or 0.0) * 1e3, 3)
+
+
+def _metric_sum_where(metrics_text: str, family: str, needle: str) -> float:
+    """Like _metric_sum but only lines whose label set contains `needle`
+    (e.g. 'model="lm_spill"') — the fabric phases share one process-wide
+    registry with every other servable in this smoke."""
+    total = 0.0
+    for line in metrics_text.splitlines():
+        if line.startswith(family + "{") and needle in line:
+            try:
+                total += float(line.rsplit(" ", 1)[1])
+            except ValueError:
+                pass
+    return total
+
+
+def _sse_ttft(url: str, model: str, prompt, max_new_tokens: int = 2,
+              timeout: float = 120.0):
+    """One greedy generate through a router's SSE surface; returns
+    (ttft_s, tokens, X-Served-By header)."""
+    body = json.dumps({"prompt": list(prompt),
+                       "max_new_tokens": max_new_tokens,
+                       "temperature": 0.0}).encode()
+    req = urllib.request.Request(
+        f"{url}/v1/models/{model}/generate", data=body,
+        headers={"Content-Type": "application/json"})
+    t0 = time.perf_counter()
+    ttft, toks = None, []
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        served = r.headers.get("X-Served-By")
+        for raw in r:
+            line = raw.decode("utf-8", "replace").strip()
+            if not line.startswith("data: "):
+                continue
+            ev = json.loads(line[6:])
+            if "token" in ev:
+                if ttft is None:
+                    ttft = time.perf_counter() - t0
+                toks.append(ev["token"])
+    return ttft, toks, served
 
 
 def _calibrate(trials: int = 9) -> float:
@@ -464,6 +517,148 @@ def main(argv=None) -> int:
             f"speculation did not improve per-stream mean ITL p99 "
             f"({spec_p99}ms spec vs {spec_base_p99}ms plain)")
 
+    # --------------------- tiered KV fabric: host-RAM spill tier parity
+    # pool_pages barely over the floor (1 dump + one max-context
+    # sequence) so retained zero-ref prefixes MUST demote to the pinned
+    # host store when fresh admissions need pages; re-driving the first
+    # prompt promotes them back and must reproduce its exact cold tokens
+    from deeplearning4j_tpu import monitor as _monitor
+    pages_per_slot = args.seq_length // args.page_size
+    registry.deploy_lm(
+        "lm_spill", arch,
+        decode=DecodeConfig(slots=2, page_size=args.page_size,
+                            pool_pages=pages_per_slot + 4, spill_pages=64))
+    lm_spill = registry.get("lm_spill")
+    rs4 = np.random.RandomState(19)
+    spill_prompts = [rs4.randint(0, args.vocab, 80).tolist()
+                     for _ in range(3)]
+    cold_tokens, _, _ = _spec_stream(lm_spill, spill_prompts[0], n=8)
+    for pr in spill_prompts[1:]:            # force demotion of prompt 0
+        _spec_stream(lm_spill, pr, n=8)
+    hot_tokens, _, _ = _spec_stream(lm_spill, spill_prompts[0], n=8)
+    mtext = _monitor.prometheus_text()
+    where = 'model="lm_spill"'
+    spill = {k: _metric_sum_where(mtext, f"serving_kv_spill_{k}_total",
+                                  where)
+             for k in ("hits", "misses", "demotions", "promotions")}
+    probes = spill["hits"] + spill["misses"]
+    spill_hit_rate = round(spill["hits"] / probes, 4) if probes else 0.0
+    summary["spill"] = dict(spill, hit_rate=spill_hit_rate,
+                            parity=cold_tokens == hot_tokens)
+    if spill["demotions"] <= 0:
+        failures.append("spill tier never demoted a page — the tight "
+                        "pool did not overflow into host RAM")
+    if spill["hits"] <= 0 or spill["promotions"] <= 0:
+        failures.append(
+            f"re-driven prompt never hit the spill tier "
+            f"(hits={spill['hits']} promotions={spill['promotions']})")
+    if cold_tokens != hot_tokens:
+        failures.append(
+            f"greedy parity violated across the spill round-trip: "
+            f"cold {cold_tokens} vs promoted {hot_tokens}")
+
+    # -------------- prefix-affinity A/B: steering vs random over a fleet
+    # two routers over the SAME 2-replica in-process fleet; each arm
+    # drives its own disjoint page-aligned shared prefixes, so the only
+    # difference the measured pass sees is the routing policy: affinity
+    # steers repeat prefixes to the replica advertising ownership on its
+    # /readyz heartbeat, random (p2c) rediscovers the cache by luck
+    from deeplearning4j_tpu.serving.fleet import (
+        InProcessReplica, ReplicaSpec, ReplicaSupervisor, http_probe,
+    )
+    from deeplearning4j_tpu.serving.router import (
+        ResilientRouter, RouterServer,
+    )
+    fleet_cfg = DecodeConfig(slots=4, page_size=16, pool_pages=256,
+                             spill_pages=128)
+
+    def _replica_factory(i):
+        return InProcessReplica(
+            f"smoke-aff-{i}",
+            ReplicaSpec([], lms=[("aff", arch_long)], decode=fleet_cfg))
+
+    supervisor = ReplicaSupervisor(_replica_factory, 2,
+                                   probe_interval_s=0.3,
+                                   probe_timeout_s=10.0)
+    supervisor.start()
+    router_aff = ResilientRouter(supervisor.healthy, hedge=False,
+                                 affinity=True)
+    router_rand = ResilientRouter(supervisor.healthy, hedge=False,
+                                  affinity=False)
+    server_aff = RouterServer(router_aff, supervisor=supervisor)
+    server_rand = RouterServer(router_rand)
+    try:
+        rs5 = np.random.RandomState(23)
+        arm_p99 = {}
+        for arm, url in (("affinity", server_aff.url),
+                         ("random", server_rand.url)):
+            # 416 = 26 full 16-token blocks: page-aligned, so the
+            # leading-block digest chain is the ownership unit
+            prefixes = [rs5.randint(0, args.vocab, 416).tolist()
+                        for _ in range(4)]
+            for pref in prefixes:           # cold pass seeds an owner
+                _sse_ttft(url, "aff",
+                          pref + rs5.randint(0, args.vocab, 32).tolist())
+            # deterministic heartbeat: ownership advertisements land on
+            # the replica handles before the measured pass
+            for r in supervisor.replicas:
+                http_probe(r, 10.0)
+            samples = []
+            for pref in prefixes:
+                for _ in range(3):
+                    # let the router's in-flight count on the previous
+                    # stream decay: this pass measures steady-state
+                    # routing policy, not the p2c guard racing the
+                    # stream-teardown accounting
+                    time.sleep(0.05)
+                    ttft, _, _ = _sse_ttft(
+                        url, "aff",
+                        pref + rs5.randint(0, args.vocab, 32).tolist())
+                    samples.append(ttft)
+            arm_p99[arm] = _p99_ms(samples)
+        owner_hits = _metric_sum_where(
+            _monitor.prometheus_text(),
+            "serving_router_affinity_requests_total", 'outcome="owner"')
+        summary["affinity_ab"] = dict(arm_p99, owner_steered=owner_hits)
+        if owner_hits <= 0:
+            failures.append("affinity router never steered a request to "
+                            "an ownership-advertising replica")
+        if arm_p99["affinity"] >= arm_p99["random"]:
+            failures.append(
+                f"affinity routing did not beat random on repeat-prefix "
+                f"TTFT p99 ({arm_p99['affinity']}ms affinity vs "
+                f"{arm_p99['random']}ms random)")
+
+        # the fleet-mode loadgen split: --prefix-mix through the
+        # affinity router, per-replica cache-hit rates via X-Served-By
+        fleet_args = argparse.Namespace(
+            url=server_aff.url, model="aff", mode="decode",
+            prompt_len=192, max_new_tokens=4, temperature=0.0, top_k=0,
+            vocab=args.vocab, requests=16, concurrency=3, rate=None,
+            batch_sizes=[1], max_retries=4, retry_cap_s=2.0,
+            deadline_ms=None, timeout_s=120.0, seed=29,
+            priority_mix={}, prefix_mix={"shared": 3, "unique": 1},
+            shared_prefix_len=160)
+        fgen = LoadGen(fleet_args, ())
+        fwall, fok = fgen.run_closed()
+        freport = fgen.report(fwall, fok)
+        summary["fleet_prefix_loadgen"] = freport
+        per_replica = (freport.get("prefix") or {}).get("per_replica")
+        if freport["errors"]:
+            failures.append(f"{freport['errors']} fleet prefix streams "
+                            f"failed ({freport['error_classes']})")
+        if not per_replica:
+            failures.append("loadgen banked no per-replica cache-hit "
+                            "split (X-Served-By missing from router "
+                            "responses?)")
+        elif max(v["cache_hit_rate"] for v in per_replica.values()) <= 0:
+            failures.append(f"no replica saw a cache hit under the "
+                            f"prefix-mix fleet workload: {per_replica}")
+    finally:
+        server_aff.stop()
+        server_rand.stop()
+        supervisor.stop()
+
     # ----------------------------------------------- compile-ledger proof
     metrics = urllib.request.urlopen(server.url + "/metrics",
                                      timeout=10).read().decode()
@@ -535,6 +730,22 @@ def main(argv=None) -> int:
         "decode_itl_p99_ms": spec_p99,
         "decode_spec_itl_base_p99_ms": spec_base_p99,
         "streams": len(spec_prompts),
+    }, {
+        # tiered KV fabric: the host spill tier's admission hit
+        # fraction under pool pressure (ratio-gated); demotion/promotion
+        # counts ride along as ungated context
+        "mode": "decode_spill", "on_tpu": False, "batch": 1,
+        "decode_spill_hit_rate": spill_hit_rate,
+        "decode_spill_demotions": spill["demotions"],
+        "decode_spill_promotions": spill["promotions"],
+    }, {
+        # prefix-affinity A/B over the 2-replica fleet: the affinity
+        # arm's repeat-prefix TTFT p99 is latency-gated; the random arm
+        # is the ungated reference the improvement was asserted against
+        "mode": "decode_affinity", "on_tpu": False, "batch": 2,
+        "decode_affinity_ttft_hot_p99_ms": arm_p99["affinity"],
+        "decode_affinity_ttft_random_p99_ms": arm_p99["random"],
+        "streams": 24,
     }] + [{
         "mode": f"decode_quant_{variant}", "on_tpu": False, "batch": None,
         **quality[variant],

@@ -56,13 +56,18 @@ import sys
 #: GOODPUT_r*.json, r19+): an attribution regression (more time leaking
 #: into data_wait/host_sync/other) trips CI even when raw imgs/sec
 #: noise hides it.
+#: decode_spill_hit_rate is the tiered-KV-fabric host-RAM tier's
+#: admission hit fraction under pool pressure (DECODE_r*.json, r20+):
+#: spill-probing admissions whose HBM-missed blocks promoted back from
+#: host memory — a drop means evicted prefixes stopped coming back.
 THROUGHPUT_KEYS = ("imgs_sec", "lenet_imgs_sec", "chars_sec", "pairs_sec",
                    "h2d_f32_mbytes_sec", "h2d_u8_mbytes_sec",
                    "fit_e2e_imgs_sec",
                    "fit_e2e_chars_sec", "fit_e2e_pairs_sec",
                    "chaos_goodput_under_fault_rps", "mesh_imgs_sec",
                    "decode_tokens_sec", "decode_cache_hit_rate",
-                   "decode_spec_acceptance_rate", "train_goodput_pct")
+                   "decode_spec_acceptance_rate", "train_goodput_pct",
+                   "decode_spill_hit_rate")
 
 #: lower-is-better series (latencies). Banked by tools/serve_chaos.py
 #: (CHAOS_r*.json): p99 while a replica is killed + another wedged, and
@@ -82,16 +87,22 @@ THROUGHPUT_KEYS = ("imgs_sec", "lenet_imgs_sec", "chars_sec", "pairs_sec",
 #: promote fan-out seconds, and wall seconds from a poisoned blessing
 #: landing on disk to the auto-rollback decision. Host-calibrated like
 #: the decode series (both scale with model-load / probe round-trips).
+#: decode_affinity_ttft_hot_p99_ms is the repeat-prefix (would-be-hot)
+#: TTFT p99 through a 2-replica fleet router with prefix-affinity
+#: steering ON (DECODE_r*.json, r20+); the random-routing arm of the
+#: same A/B is banked as decode_affinity_ttft_random_p99_ms but NOT
+#: gated (it measures the policy affinity replaced).
 LATENCY_KEYS = ("chaos_p99_under_fault_ms", "chaos_recovered_p99_ms",
                 "decode_ttft_p99_ms", "decode_itl_p99_ms",
                 "decode_ttft_hot_p99_ms", "decode_itl_interferer_p99_ms",
-                "rollout_promote_s", "rollout_rollback_detect_s")
+                "rollout_promote_s", "rollout_rollback_detect_s",
+                "decode_affinity_ttft_hot_p99_ms")
 
 #: dimensionless series (fractions of work, not work per second): host
 #: speed cannot move them, so calibration normalization never applies —
 #: they always compare raw, against every earlier round.
 RATIO_KEYS = ("decode_cache_hit_rate", "decode_spec_acceptance_rate",
-              "train_goodput_pct")
+              "train_goodput_pct", "decode_spill_hit_rate")
 
 
 def _round_of(name: str) -> int:
@@ -194,7 +205,17 @@ def check_regressions(series, threshold: float):
     Earlier rounds WITHOUT a reference cannot give a fair verdict against
     a calibrated latest, so they are excluded from baseline selection; if
     none remain the series is reported as skipped, not gated. A latest
-    without a reference keeps the legacy raw comparison."""
+    without a reference keeps the legacy raw comparison.
+
+    Calibration may EXCUSE, never convict: the reference is one matmul
+    kernel, so the ratio tracks the host's compute speed but not its
+    Python/dispatch overhead — a faster-matmul host does not make every
+    latency proportionally cheaper. A slow host's raw regression is
+    forgiven when the normalized delta is clean (the original purpose),
+    but a conviction additionally requires the RAW delta against the
+    same baseline round to exceed the threshold; otherwise a fast-calib
+    round would manufacture regressions out of series whose raw numbers
+    held steady or improved."""
     checked, regressions, skipped = [], [], []
     for sid, points in sorted(series.items(), key=lambda kv: str(kv[0])):
         lower_better = sid[3] in LATENCY_KEYS
@@ -240,11 +261,15 @@ def check_regressions(series, threshold: float):
         delta = (latest - baseline) / baseline if baseline > 0 else 0.0
         if lower_better:
             delta = -delta      # normalized: negative delta == worse
+        raw_delta = (latest - base_raw) / base_raw if base_raw > 0 else 0.0
+        if lower_better:
+            raw_delta = -raw_delta
         calibration = {
             "latest_calib_ms": latest_calib,
             "baseline_calib_ms": base_calib,
             "host_speed_ratio": round(latest_calib / base_calib, 4),
             "baseline_raw": base_raw,
+            "raw_delta_pct": round(raw_delta * 100, 2),
         } if calibrated else None
         rec = {
             "series": sdesc,
@@ -253,7 +278,8 @@ def check_regressions(series, threshold: float):
             "latest": {"round": latest_round, "artifact": latest_art,
                        "value": latest},
             "delta_pct": round(delta * 100, 2),
-            "regressed": delta < -threshold,
+            "regressed": delta < -threshold
+            and (not calibrated or raw_delta < -threshold),
         }
         if calibration:
             rec["calibration"] = calibration
@@ -359,7 +385,8 @@ def main(argv=None) -> int:
             mark = "REGRESSED" if rec["regressed"] else "ok"
             cal = rec.get("calibration")
             note = (f"  [host x{cal['host_speed_ratio']:.2f}, baseline "
-                    f"{cal['baseline_raw']:.2f} raw]" if cal else "")
+                    f"{cal['baseline_raw']:.2f} raw, "
+                    f"{cal['raw_delta_pct']:+.1f}% raw]" if cal else "")
             print(f"  {mark:>9}  {_fmt_series(rec):<42} "
                   f"{rec['baseline']['value']:>12.2f} (r{rec['baseline']['round']})"
                   f" -> {rec['latest']['value']:>12.2f} "

@@ -32,10 +32,25 @@ The SLO asserted from the traffic log and the router's /metrics:
   banked in the report and the router's /v1/slo fleet verdict returns
   to ``ok``.
 
+After the predict fleet winds down, a second **disaggregation drill**
+stands up a prefill/decode split LM fleet (subprocess replicas with
+``kv_role`` prefill vs decode, router orchestrating KV-page transfers)
+and SIGKILLs the prefill replica while transfers are the serving path:
+
+- streams before the kill must ride completed transfers (the
+  ``serving_transfer_orchestrations_total`` proof) with greedy output
+  exactly equal across repeats;
+- the kill must trip the router's mid-transfer failover
+  (``serving_transfer_failovers_total``) — the stream falls back to
+  local prefill on the decode replica, the client sees 200s throughout
+  (**zero 5xx**), and a ``transfer_peer_lost`` flight postmortem names
+  the dead peer.
+
 Prints a JSON report (with a bench-style "sweep" row carrying
 ``chaos_p99_under_fault_ms`` / ``chaos_goodput_under_fault_rps`` /
-``chaos_recovered_p99_ms`` so the driver can bank it as CHAOS_r*.json for
-tools/perf_report.py's regression gate). Exit 0 iff every SLO held.
+``chaos_recovered_p99_ms`` plus the disaggregation-drill row, banked
+via --out as CHAOS_r*.json for tools/perf_report.py's regression
+gate). Exit 0 iff every SLO held.
 """
 import json
 import os
@@ -44,6 +59,7 @@ import tempfile
 import threading
 import time
 import urllib.request
+from urllib.error import HTTPError
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -52,6 +68,29 @@ os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
 
 N_IN, N_OUT = 6, 3
 RECOVERY_BUDGET_S = 150.0       # CPU CI: replica relaunch pays a jax import
+
+
+def _calibrate(trials: int = 9) -> float:
+    """Machine-speed reference: median wall-ms for a FIXED numpy f32
+    matmul workload, identical to tools/decode_smoke.py's. Banked as
+    ``calib_cpu_ms`` so perf_report compares chaos rounds taken on
+    differently-loaded hosts in normalized space — the fault-injection
+    tail percentiles are the most host-sensitive series this repo banks,
+    and nothing in the code paths can move this number, only the
+    machine."""
+    import numpy as np
+    a = np.random.RandomState(0).rand(384, 384).astype(np.float32)
+    b = np.random.RandomState(1).rand(384, 384).astype(np.float32)
+    samples = []
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        c = a
+        for _ in range(20):
+            c = c @ b
+        float(c[0, 0])              # force materialization
+        samples.append((time.perf_counter() - t0) * 1e3)
+    samples.sort()
+    return round(samples[len(samples) // 2], 3)
 
 
 def _metric_total(metrics: str, prefix: str, contains: str = "") -> float:
@@ -66,6 +105,153 @@ def _metric_total(metrics: str, prefix: str, contains: str = "") -> float:
     return total
 
 
+def _sse_gen(url: str, model: str, prompt, max_new_tokens: int = 4,
+             timeout: float = 60.0):
+    """One greedy generate through the router's SSE surface; returns
+    (status code | "transport", [tokens])."""
+    body = json.dumps({"prompt": list(prompt),
+                       "max_new_tokens": max_new_tokens,
+                       "temperature": 0.0}).encode()
+    req = urllib.request.Request(
+        f"{url}/v1/models/{model}/generate", data=body,
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            toks = []
+            for raw in r:
+                line = raw.decode("utf-8", "replace").strip()
+                if line.startswith("data: "):
+                    ev = json.loads(line[6:])
+                    if "token" in ev:
+                        toks.append(ev["token"])
+            return r.status, toks
+    except HTTPError as e:
+        e.read()
+        return e.code, []
+    except Exception:               # noqa: BLE001 — recorded, asserted on
+        return "transport", []
+
+
+def _disagg_drill(env, pm_dir):
+    """Prefill/decode disaggregation under machine loss: a split LM
+    fleet whose router ships KV pages from the prefill replica to the
+    decode replica, then the prefill replica is SIGKILLed while those
+    transfers are the serving path. Returns (summary, failures)."""
+    from deeplearning4j_tpu import monitor
+    from deeplearning4j_tpu.serving import (
+        ReplicaSpec, ReplicaSupervisor, ResilientRouter, RouterServer,
+        SubprocessReplica,
+    )
+    from deeplearning4j_tpu.serving.decode import DecodeConfig
+
+    failures, out = [], {}
+    arch = ("zoo:TransformerLM?vocab_size=48&n_layers=1&n_embd=32"
+            "&n_heads=4&seq_length=32")
+    roles = ("prefill", "decode")
+
+    def factory(i):
+        return SubprocessReplica(
+            f"kv-{i}",
+            ReplicaSpec([], lms=[("lm", arch)],
+                        decode=DecodeConfig(slots=4, page_size=4),
+                        postmortem_dir=pm_dir,
+                        kv_role=roles[i % len(roles)]),
+            env=env)
+
+    # the probe interval is deliberately SLOW: the drill tests the
+    # ROUTER's mid-transfer failover, so the supervisor must not sweep
+    # the corpse out of the routing set before the router trips over it
+    sup = ReplicaSupervisor(factory, 2, probe_interval_s=30.0,
+                            probe_timeout_s=2.0, unhealthy_after=3)
+    t0 = time.perf_counter()
+    sup.start()
+    out["fleet_start_s"] = round(time.perf_counter() - t0, 1)
+    router = ResilientRouter(sup.healthy, hedge=False,
+                             disagg_min_tokens=8, timeout_s=30.0)
+    server = RouterServer(router, supervisor=sup)
+    codes = {}
+
+    def gen(i):
+        code, toks = _sse_gen(server.url, "lm",
+                              [(7 * i + j) % 48 for j in range(12)])
+        codes[code] = codes.get(code, 0) + 1
+        return toks
+
+    def transfer_total(family):
+        return _metric_total(monitor.prometheus_text(), family)
+
+    try:
+        # same prompt twice: the orchestrated path must stay greedy-exact
+        a, b = gen(0), gen(0)
+        if not a or a != b:
+            failures.append(f"disaggregated greedy parity broke: "
+                            f"{a} vs {b}")
+        for i in range(1, 7):
+            gen(i)
+        orch = transfer_total("serving_transfer_orchestrations_total")
+        out["orchestrations_before_kill"] = orch
+        if orch <= 0:
+            failures.append(
+                "no disaggregated transfer completed before the kill — "
+                "the drill never exercised the prefill/decode split")
+        victim = sup.replicas[0]
+        out["killed"] = victim.name
+        victim.proc.kill()          # machine loss: no drain, no goodbye
+        # the router must hit the dead transfer peer before the (slow)
+        # supervisor does: keep offering streams until a failover meters
+        deadline = time.monotonic() + 15.0
+        i = 100
+        while transfer_total("serving_transfer_failovers_total") <= 0 \
+                and time.monotonic() < deadline:
+            gen(i)
+            i += 1
+        out["failovers"] = transfer_total(
+            "serving_transfer_failovers_total")
+        if out["failovers"] <= 0:
+            failures.append(
+                "killing the prefill replica never tripped a transfer "
+                "failover (the supervisor swept the corpse first?)")
+        # streams keep flowing on local decode-side prefill afterwards
+        for i in range(200, 204):
+            if not gen(i):
+                failures.append(
+                    f"stream {i} produced no tokens after the prefill "
+                    "peer loss")
+                break
+    finally:
+        sup.stop()
+        server.stop()
+    out["codes"] = {str(k): v for k, v in codes.items()}
+    bad = {c: n for c, n in codes.items()
+           if isinstance(c, int) and c >= 500 and c != 503}
+    if bad:
+        failures.append(f"5xx during the disaggregation drill: {bad} "
+                        "(contract: peer loss degrades to local "
+                        "prefill, never a server error)")
+    if codes.get("transport"):
+        failures.append(
+            f"{codes['transport']} transport-level failures reached the "
+            "client during the disaggregation drill")
+    # the failover must have postmortemed the DEAD PEER by name while
+    # the request evidence was still in the flight ring
+    pm = None
+    for fn in sorted(os.listdir(pm_dir)) if os.path.isdir(pm_dir) else []:
+        if fn.startswith("postmortem-") and fn.endswith(".json"):
+            with open(os.path.join(pm_dir, fn)) as f:
+                doc = json.load(f)
+            if doc["reason"] == "transfer_peer_lost" \
+                    and doc["meta"].get("peer") == out.get("killed"):
+                pm = (fn, doc)
+    if pm is None:
+        failures.append(
+            "no transfer_peer_lost postmortem names the dead prefill "
+            f"peer {out.get('killed')!r}")
+    else:
+        out["postmortem"] = {"file": pm[0], "meta": pm[1]["meta"],
+                             "n_records": pm[1]["n_records"]}
+    return out, failures
+
+
 def main(argv=None) -> int:
     import argparse
 
@@ -76,6 +262,9 @@ def main(argv=None) -> int:
     ap.add_argument("--bank-postmortem", default=None, metavar="PATH",
                     help="copy the fault-window flight postmortem here "
                          "(banked next to CHAOS_r*.json)")
+    ap.add_argument("--out", default=None, metavar="PATH",
+                    help="bank the summary JSON here (e.g. "
+                         "CHAOS_r20.json at the repo root)")
     cli = ap.parse_args(argv)
     from deeplearning4j_tpu.nn.conf.base import InputType
     from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
@@ -91,6 +280,7 @@ def main(argv=None) -> int:
 
     failures = []
     summary = {}
+    calib_start = _calibrate()
 
     conf = (NeuralNetConfiguration.Builder().seed(0).updater(Adam(1e-2))
             .list()
@@ -444,8 +634,20 @@ def main(argv=None) -> int:
         supervisor.stop()
         server.stop()
 
+    # ------------- disaggregation drill: prefill death mid-transfer -----
+    # its own fleet (prefill/decode split LM replicas), run after the
+    # predict fleet wound down so the two drills never fight for cores
+    disagg, disagg_failures = _disagg_drill(env, pm_dir)
+    summary["disagg"] = disagg
+    failures.extend(disagg_failures)
+
     summary["ok"] = not failures
     summary["failures"] = failures
+    # host-speed reference sampled at both ends of the run and averaged
+    # (the drills take minutes; the box's speed can drift mid-run) —
+    # rounds before this banked none, so perf_report skips those as
+    # baselines rather than judging a calibrated run by raw wall-clock
+    summary["calib_cpu_ms"] = round((calib_start + _calibrate()) / 2, 3)
     # bench-style row so the driver can bank this run as CHAOS_r*.json and
     # tools/perf_report.py can gate the chaos-SLO trajectory
     summary["sweep"] = [{
@@ -472,8 +674,20 @@ def main(argv=None) -> int:
         "chaos_slo_burn_long_at_fire": next(
             (h["burn_long"] for h in summary.get("slo_alerts", [])
              if h["event"] == "fired"), None),
+    }, {
+        # the disaggregation drill row: ungated context proving the
+        # prefill/decode split served transfers and survived peer loss
+        "mode": "serve_chaos_disagg", "on_tpu": False, "batch": None,
+        "chaos_disagg_orchestrations": disagg.get(
+            "orchestrations_before_kill"),
+        "chaos_disagg_failovers": disagg.get("failovers"),
+        "chaos_disagg_codes": disagg.get("codes"),
+        "disagg_postmortem": (disagg.get("postmortem") or {}).get("file"),
     }]
     print(json.dumps(summary, indent=1))
+    if cli.out:
+        with open(cli.out, "w") as f:
+            json.dump(summary, f, indent=1)
     return 0 if not failures else 1
 
 

@@ -179,6 +179,7 @@ class LoadGen:
             self.itls = {}              # class -> [seconds] between tokens
             self.tokens = 0
             self.prefix_stats = {}      # prefix class -> counters/ttfts
+            self.replica_stats = {}     # X-Served-By -> requests/hits
             # speculative-decoding counters per class, read off the done
             # event (0/0/0 streams on a plain servable stay comparable)
             self.spec_stats = {}        # class -> proposed/accepted/rounds
@@ -239,11 +240,14 @@ class LoadGen:
         t0 = time.perf_counter()
         retry_after = None
         ttft, itls, ntok, last, done = None, [], 0, None, False
-        cached = spec = None
+        cached = spec = served = None
         try:
             r = urllib.request.urlopen(urllib.request.Request(
                 self.url, data=body, headers=headers),
                 timeout=self.args.timeout_s)
+            # fleet mode: the router names the replica that took the
+            # stream — the per-replica cache-hit split keys off it
+            served = r.headers.get("X-Served-By")
             for line in r:
                 if not line.startswith(b"data: "):
                     continue
@@ -273,10 +277,11 @@ class LoadGen:
         except Exception:               # connection refused/reset, timeout
             code = 0
         return (code, time.perf_counter() - t0, retry_after, ttft, itls,
-                ntok, cached, spec)
+                ntok, cached, spec, served)
 
     def _record(self, i: int, code, dt: float, ttft=None, itls=(),
-                ntok: int = 0, trace_id=None, cached=None, spec=None):
+                ntok: int = 0, trace_id=None, cached=None, spec=None,
+                served=None):
         cls = self._class_of(i) or "default"
         kind = classify(code if code != 0 else "transport")
         with self.lock:
@@ -318,15 +323,24 @@ class LoadGen:
                         if ttft is not None:
                             st["ttft_hot" if hot
                                else "ttft_cold"].append(ttft)
+                        if served is not None:
+                            # fleet view: WHERE did the hits land —
+                            # prefix-affinity routing concentrates the
+                            # shared class's hits on the owner replica
+                            rst = self.replica_stats.setdefault(
+                                served, {"requests": 0, "hits": 0})
+                            rst["requests"] += 1
+                            rst["hits"] += int(hot)
 
     def _attempt(self, i: int, traceparent=None, trace_id=None):
         """One wire attempt in the configured workload; returns
         (code, retry_after)."""
         if self.mode == "decode":
             (code, dt, retry_after, ttft, itls, ntok, cached,
-             spec) = self._send_decode(i, traceparent)
+             spec, served) = self._send_decode(i, traceparent)
             self._record(i, code, dt, ttft=ttft, itls=itls, ntok=ntok,
-                         trace_id=trace_id, cached=cached, spec=spec)
+                         trace_id=trace_id, cached=cached, spec=spec,
+                         served=served)
         else:
             code, dt, retry_after = self._send(i, traceparent)
             self._record(i, code, dt, trace_id=trace_id)
@@ -588,6 +602,16 @@ class LoadGen:
                         } for pcls, s in sorted(
                             self.prefix_stats.items())},
                 }
+                if self.replica_stats:
+                    # which replica the hits landed on (fleet runs via
+                    # the router's X-Served-By header): affinity routing
+                    # shows up as hit rates concentrated on owners
+                    rep["prefix"]["per_replica"] = {
+                        name: {"requests": s["requests"],
+                               "cache_hit_rate": round(
+                                   s["hits"] / s["requests"], 4)
+                               if s["requests"] else None}
+                        for name, s in sorted(self.replica_stats.items())}
         if len(self.class_cycle) > 1 or self.class_cycle[0] is not None:
             rep["per_class"] = {
                 cls: {"latency_ms": _latency_stats(
